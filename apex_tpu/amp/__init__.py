@@ -1,0 +1,50 @@
+"""apex_tpu.amp — automatic mixed precision for TPU.
+
+Public surface mirrors apex.amp (reference: apex/amp/__init__.py:1-4):
+``initialize``, ``scale_loss``, ``state_dict``/``load_state_dict``,
+``register_{half,float,promote}_function`` — re-designed functionally:
+dtype policies instead of monkey-patching, pytree scaler state instead of
+stateful LossScaler objects.
+"""
+
+from apex_tpu.amp.frontend import (
+    initialize,
+    state_dict,
+    load_state_dict,
+    opt_levels,
+    Properties,
+    build_policy,
+)
+from apex_tpu.amp.scaler import LossScaler, LossScalerState
+from apex_tpu.amp.amp_optimizer import AmpOptimizer, AmpOptState
+from apex_tpu.amp.handle import scale_loss, value_and_scaled_grad, disable_casts
+from apex_tpu.amp.policy import (
+    Policy,
+    autocast,
+    current_policy,
+    compute_dtype,
+    half_function,
+    float_function,
+    promote_function,
+    register_half_function,
+    register_float_function,
+    register_promote_function,
+    cast_for_op,
+    lookup_cast,
+    FP16_FUNCS,
+    FP32_FUNCS,
+    CASTS,
+    SEQUENCE_CASTS,
+    BANNED_FUNCS,
+)
+from apex_tpu.amp import _amp_state
+
+__all__ = [
+    "initialize", "state_dict", "load_state_dict", "opt_levels", "Properties",
+    "build_policy", "LossScaler", "LossScalerState", "AmpOptimizer",
+    "AmpOptState", "scale_loss", "value_and_scaled_grad", "disable_casts",
+    "Policy", "autocast", "current_policy", "compute_dtype", "half_function",
+    "float_function", "promote_function", "register_half_function",
+    "register_float_function", "register_promote_function", "cast_for_op",
+    "lookup_cast",
+]

@@ -1,0 +1,49 @@
+"""Module-level amp state (reference: apex/amp/_amp_state.py:18-69).
+
+Holds the currently-selected Properties/Policy and verbosity. Unlike the
+reference, no tensors live here — all numerical state is a pytree owned by
+the caller (AmpOptState) so jit/pjit stay pure.
+"""
+
+import sys
+
+
+class AmpState:
+    def __init__(self):
+        self.hard_override = False
+        self.allow_incoming_model_not_fp32 = False
+        self.verbosity = 1
+        self.opt_properties = None
+        self.policy = None
+        self.loss_scalers = []
+        self.optimizers = []
+
+
+_amp_state = AmpState()
+this = sys.modules[__name__]
+
+
+def __getattr__(name):
+    return getattr(_amp_state, name)
+
+
+def warn_or_err(msg):
+    if _amp_state.hard_override:
+        print("Warning: " + msg)
+    else:
+        raise RuntimeError(msg)
+
+
+def maybe_print(msg, verbosity=None, rank0=True):
+    """Rank-0 gated print (reference: _amp_state.py:40-51)."""
+    import jax
+
+    v = verbosity if verbosity is not None else _amp_state.verbosity
+    if v == 0:
+        return
+    try:
+        if rank0 and jax.process_index() != 0:
+            return
+    except Exception:
+        pass
+    print(msg)

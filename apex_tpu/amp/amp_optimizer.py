@@ -1,0 +1,136 @@
+"""AmpOptimizer — functional master-weight + loss-scale + skip-step wrapper.
+
+This is the TPU-native re-design of apex's optimizer mutation
+(reference: apex/amp/_process_optimizer.py:321 — ``_amp_stash`` injection,
+lazy fp32 master copies, unscale-into-master backward hooks, patched
+``step``/``zero_grad``) plus the scale_loss context's overflow handling
+(apex/amp/handle.py:17-154). Instead of hooks, everything is one pure
+``apply_gradients`` transition safe under ``jax.jit``:
+
+    grads (wrt scaled loss, half) ──unscale──► fp32 ──tx.update──► master
+    params'──cast──► model params', with the whole update select-gated on
+    overflow (apex's one-shot ``skip_step``).
+
+The fused unscale + isfinite is the multi_tensor_scale analog; the master →
+model copy after step is _process_optimizer.py:353-364.
+"""
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from apex_tpu.amp.scaler import LossScaler, LossScalerState
+
+
+@struct.dataclass
+class AmpOptState:
+    inner: Any  # wrapped optax state
+    master_params: Any  # fp32 master copies (None when master_weights=False)
+    scalers: Tuple[LossScalerState, ...]  # one per loss (num_losses)
+
+
+def _where_tree(pred, a, b):
+    return jax.tree_util.tree_map(lambda x, y: jnp.where(pred, x, y), a, b)
+
+
+@dataclasses.dataclass(frozen=True)
+class AmpOptimizer:
+    """Wraps an optax GradientTransformation with amp semantics.
+
+    Usable directly, or via ``amp.initialize``. All methods are pure.
+    """
+
+    tx: optax.GradientTransformation
+    scaler: LossScaler = LossScaler(loss_scale="dynamic")
+    num_losses: int = 1
+    master_weights: bool = False
+    param_dtype: Any = jnp.float32
+
+    def init(self, params):
+        if self.master_weights:
+            master = jax.tree_util.tree_map(
+                lambda p: p.astype(jnp.float32)
+                if jnp.issubdtype(p.dtype, jnp.floating) else p,
+                params,
+            )
+        else:
+            master = None
+        inner = self.tx.init(master if master is not None else params)
+        scalers = tuple(self.scaler.init() for _ in range(self.num_losses))
+        return AmpOptState(inner=inner, master_params=master, scalers=scalers)
+
+    # -- loss scaling (apex/amp/handle.py:113) --
+    def scale_loss(self, loss, state, loss_id=0):
+        return self.scaler.scale(loss, state.scalers[loss_id])
+
+    def unscale(self, grads, state, loss_id=0):
+        """Returns (unscaled fp32 grads, found_inf). Grad accumulation across
+        calls is the caller's sum — the axpby stash path collapses to ``+``."""
+        grads, found_inf = self.scaler.unscale(grads, state.scalers[loss_id])
+        grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
+        return grads, found_inf
+
+    def apply_gradients(self, grads, state, params, loss_id=0,
+                        grads_already_unscaled=False, found_inf=None):
+        """One optimizer step with amp semantics.
+
+        Args:
+          grads: gradient pytree wrt the *scaled* loss (unless
+            ``grads_already_unscaled``).
+          state: AmpOptState. params: current (model-dtype) params.
+        Returns (new_params, new_state, info dict with 'overflow' and
+        'loss_scale').
+        """
+        sstate = state.scalers[loss_id]
+        if grads_already_unscaled:
+            assert found_inf is not None
+            fp32_grads = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32), grads)
+        else:
+            fp32_grads, found_inf = self.scaler.unscale(grads, sstate)
+            fp32_grads = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32), fp32_grads)
+        new_sstate = self.scaler.update(sstate, found_inf)
+
+        opt_params = state.master_params if self.master_weights else params
+        updates, new_inner = self.tx.update(fp32_grads, state.inner, opt_params)
+        stepped = optax.apply_updates(opt_params, updates)
+
+        # skip-step select (handle.py:128-154): on overflow keep everything
+        new_inner = _where_tree(found_inf, state.inner, new_inner)
+        stepped = _where_tree(found_inf, opt_params, stepped)
+
+        if self.master_weights:
+            new_master = stepped
+            # master→model copy (multi_tensor_scale copy,
+            # _process_optimizer.py:353-364)
+            new_params = jax.tree_util.tree_map(
+                lambda m, p: m.astype(p.dtype), new_master, params
+            )
+        else:
+            new_master = None
+            new_params = jax.tree_util.tree_map(
+                lambda s, p: s.astype(p.dtype), stepped, params
+            )
+
+        scalers = tuple(
+            new_sstate if i == loss_id else s for i, s in enumerate(state.scalers)
+        )
+        new_state = AmpOptState(inner=new_inner, master_params=new_master,
+                                scalers=scalers)
+        info = {"overflow": found_inf, "loss_scale": new_sstate.loss_scale}
+        return new_params, new_state, info
+
+    # -- optax GradientTransformation interface so AmpOptimizer drops into
+    # flax TrainState etc. (update == apply_gradients minus the param cast) --
+    def update(self, grads, state, params=None):
+        new_params, new_state, _ = self.apply_gradients(grads, state, params)
+        updates = jax.tree_util.tree_map(
+            lambda n, p: (n.astype(jnp.float32) - p.astype(jnp.float32)).astype(p.dtype),
+            new_params, params,
+        )
+        return updates, new_state

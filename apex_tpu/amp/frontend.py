@@ -1,0 +1,358 @@
+"""amp frontend: opt-level presets, the ``Properties`` option struct, and
+``initialize``.
+
+Port of the semantics of apex/amp/frontend.py: ``Properties`` with
+consistency checks in ``__setattr__`` (frontend.py:7-97), the O0–O3 presets
+(:102-186), and ``initialize`` (:195). The TPU-native difference: instead of
+mutating models/optimizers in place, ``initialize`` returns a cast parameter
+pytree and an ``AmpOptimizer`` wrapper (functional master-weight + loss-scale
++ skip-step semantics, replacing _initialize.py/_process_optimizer.py's
+monkey-patching).
+
+TPU note on "fp16": the half dtype is configurable (``half_dtype``). bf16 is
+the MXU-native choice and needs no loss scaling in practice, but fp16 +
+dynamic scaling is kept available for numerical-parity runs with the
+reference; O-level presets use bf16 by default.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp import _amp_state
+from apex_tpu.amp.policy import Policy
+from apex_tpu.amp.scaler import LossScaler
+from apex_tpu.amp.amp_optimizer import AmpOptimizer
+
+
+class Properties(object):
+    """Option struct with mutual-consistency logic in ``__setattr__``
+    (reference: apex/amp/frontend.py:7-97)."""
+
+    def __init__(self):
+        self.options = {
+            "enabled": False,
+            "opt_level": None,
+            "cast_model_type": None,
+            "patch_torch_functions": False,
+            "keep_batchnorm_fp32": None,
+            "master_weights": None,
+            "loss_scale": 1.0,
+            "half_dtype": jnp.bfloat16,  # TPU extension: which half type
+        }
+
+    def _update_options_dict(self, new_options):
+        for k, v in new_options.items():
+            if k in self.options:
+                self.options[k] = v
+            else:
+                raise ValueError(f"Tried to set unexpected option {k}")
+
+    def __getattr__(self, name):
+        if "options" in self.__dict__:
+            options = self.__dict__["options"]
+            if name in options:
+                return options[name]
+        raise AttributeError(f"'Properties' object has no attribute '{name}'")
+
+    def __setattr__(self, name, value):
+        if "options" in self.__dict__:
+            if name not in self.options:
+                raise AttributeError(f"Tried to set unexpected option {name}")
+            # consistency checks mirroring frontend.py:33-93
+            if name == "cast_model_type":
+                if self.opt_level == "O1" and value is not None:
+                    if value is not False and value != jnp.float32:
+                        raise RuntimeError(
+                            "O1 inserts casts around functions rather than "
+                            "casting the model."
+                        )
+                self.options[name] = value
+            elif name == "patch_torch_functions":
+                if self.opt_level != "O1" and value:
+                    raise RuntimeError(
+                        "Currently, patch_torch_functions=True should only be "
+                        "set by selecting opt_level='O1'."
+                    )
+                self.options[name] = value
+            elif name == "keep_batchnorm_fp32":
+                if self.opt_level == "O1" and value is not None:
+                    raise RuntimeError(
+                        "With opt_level O1, batchnorm functions are "
+                        "automatically patched to run in fp32, so "
+                        "keep_batchnorm_fp32 should be None."
+                    )
+                if value == "False":
+                    self.options[name] = False
+                elif value == "True":
+                    self.options[name] = True
+                else:
+                    assert value in (True, False, None), (
+                        "keep_batchnorm_fp32 must be a boolean, the string "
+                        f"'True' or 'False', or None, found {value}"
+                    )
+                    self.options[name] = value
+            elif name == "master_weights":
+                if self.opt_level == "O1" and value is not None:
+                    raise RuntimeError(
+                        "It doesn't make sense to use master_weights with O1."
+                    )
+                self.options[name] = value
+            elif name == "loss_scale":
+                if value == "dynamic":
+                    self.options[name] = value
+                else:
+                    self.options[name] = float(value)
+            else:
+                self.options[name] = value
+        else:
+            super().__setattr__(name, value)
+
+
+class O3:
+    """Pure half. 'Speed of light' ceiling (frontend.py:102-122)."""
+
+    brief = "O3: Pure half-precision (speed-of-light ceiling)."
+
+    def __call__(self, properties):
+        properties.enabled = True
+        properties.opt_level = "O3"
+        properties.cast_model_type = "half"
+        properties.patch_torch_functions = False
+        properties.keep_batchnorm_fp32 = False
+        properties.master_weights = False
+        properties.loss_scale = 1.0
+        return properties
+
+
+class O2:
+    """Half model + fp32 master weights + dynamic scaling (frontend.py:124)."""
+
+    brief = "O2: half casting of the model, with FP32 master weights."
+
+    def __call__(self, properties):
+        properties.enabled = True
+        properties.opt_level = "O2"
+        properties.cast_model_type = "half"
+        properties.patch_torch_functions = False
+        properties.keep_batchnorm_fp32 = True
+        properties.master_weights = True
+        properties.loss_scale = "dynamic"
+        return properties
+
+
+class O1:
+    """Policy-driven op casting (the patch-engine analog), dynamic scaling
+    (frontend.py:147)."""
+
+    brief = "O1: insert automatic casts around safe ops (dtype policy)."
+
+    def __call__(self, properties):
+        properties.enabled = True
+        properties.opt_level = "O1"
+        properties.cast_model_type = None
+        properties.patch_torch_functions = True
+        properties.keep_batchnorm_fp32 = None
+        properties.master_weights = None
+        properties.loss_scale = "dynamic"
+        return properties
+
+
+class O0:
+    """Pure fp32 baseline (frontend.py:169)."""
+
+    brief = "O0: Pure FP32 training."
+
+    def __call__(self, properties):
+        properties.enabled = True
+        properties.opt_level = "O0"
+        properties.cast_model_type = jnp.float32
+        properties.patch_torch_functions = False
+        properties.keep_batchnorm_fp32 = None
+        properties.master_weights = False
+        properties.loss_scale = 1.0
+        return properties
+
+
+opt_levels = {"O3": O3(), "O2": O2(), "O1": O1(), "O0": O0()}
+
+
+def _default_bn_predicate(path):
+    """Heuristic BN detection over a flax param path (keep_batchnorm_fp32)."""
+    joined = "/".join(str(p) for p in path).lower()
+    return any(tag in joined for tag in ("batchnorm", "batch_norm", "bn_", "/bn", "batchstats", "batch_stats"))
+
+
+def _cast_params(params, dtype, keep_bn_fp32, bn_predicate):
+    """convert_network analog (reference: apex/fp16_utils/fp16util.py via
+    _initialize.py:176-182): cast floating params, keeping BN params fp32."""
+
+    def cast(path, leaf):
+        if not (hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating)):
+            return leaf
+        if keep_bn_fp32 and bn_predicate(path):
+            return leaf.astype(jnp.float32)
+        return leaf.astype(dtype)
+
+    return jax.tree_util.tree_map_with_path(cast, params)
+
+
+def build_policy(properties):
+    """Map a Properties struct to the functional dtype Policy.
+
+    ``cast_model_type`` overrides the model dtype when set to a concrete
+    dtype (reference allows e.g. cast_model_type=torch.float16 with any
+    opt_level, frontend.py:195-210).
+    """
+    half = properties.half_dtype
+    cmt = properties.cast_model_type
+    if cmt not in (None, "half", False):
+        half = jnp.dtype(cmt)
+        if half == jnp.float32:
+            return Policy()
+        return Policy(param_dtype=half, compute_dtype=half,
+                      output_dtype=jnp.float32,
+                      keep_batchnorm_fp32=properties.keep_batchnorm_fp32
+                      in (True, None),
+                      )
+    if properties.opt_level == "O3":
+        return Policy(param_dtype=half, compute_dtype=half, output_dtype=half,
+                      keep_batchnorm_fp32=False)
+    if properties.opt_level == "O2":
+        return Policy(param_dtype=half, compute_dtype=half, output_dtype=jnp.float32,
+                      keep_batchnorm_fp32=bool(properties.keep_batchnorm_fp32))
+    if properties.opt_level == "O1":
+        return Policy(param_dtype=jnp.float32, compute_dtype=half,
+                      output_dtype=jnp.float32, keep_batchnorm_fp32=True)
+    return Policy()  # O0
+
+
+def initialize(
+    params,
+    optimizer=None,
+    opt_level="O1",
+    cast_model_type=None,
+    patch_torch_functions=None,
+    keep_batchnorm_fp32=None,
+    master_weights=None,
+    loss_scale=None,
+    num_losses=1,
+    min_loss_scale=None,
+    max_loss_scale=2.0 ** 24,
+    half_dtype=None,
+    bn_predicate=_default_bn_predicate,
+    verbosity=1,
+):
+    """Functional ``amp.initialize`` (reference: apex/amp/frontend.py:195-358).
+
+    Args:
+      params: parameter pytree (the "model") — returned cast per the policy.
+      optimizer: an optax ``GradientTransformation`` (or list of them) to wrap
+        with master-weight + loss-scale + skip-step semantics, or None.
+      opt_level / overrides: as the reference; ``half_dtype`` selects
+        bf16 (default) or fp16.
+      num_losses / min_loss_scale / max_loss_scale: per-loss scalers
+        (frontend.py:195-210).
+
+    Returns (cast_params, amp_optimizer) — or just cast_params if no
+    optimizer given. Policy + properties are recorded in amp._amp_state.
+    """
+    if opt_level not in opt_levels:
+        raise RuntimeError(f"Unexpected optimization level {opt_level}.")
+    properties = opt_levels[opt_level](Properties())
+    _amp_state.maybe_print(
+        f"Selected optimization level {opt_level}: {opt_levels[opt_level].brief}",
+        verbosity, True,
+    )
+    for name, value in (
+        ("cast_model_type", cast_model_type),
+        ("patch_torch_functions", patch_torch_functions),
+        ("keep_batchnorm_fp32", keep_batchnorm_fp32),
+        ("master_weights", master_weights),
+        ("loss_scale", loss_scale),
+        ("half_dtype", half_dtype),
+    ):
+        if value is not None:
+            setattr(properties, name, value)
+
+    policy = build_policy(properties)
+    _amp_state.opt_properties = properties
+    _amp_state.policy = policy
+    _amp_state.verbosity = verbosity
+
+    cast_params = params
+    if policy.param_dtype != jnp.dtype(jnp.float32):
+        cast_params = _cast_params(
+            params, policy.param_dtype, policy.keep_batchnorm_fp32, bn_predicate
+        )
+
+    if optimizer is None:
+        return cast_params
+
+    scaler = LossScaler(
+        loss_scale=properties.loss_scale,
+        min_loss_scale=min_loss_scale,
+        max_loss_scale=max_loss_scale,
+    )
+    # NB: optax.GradientTransformation is itself a NamedTuple — check for the
+    # transform interface before treating the argument as a sequence.
+    def _is_tx(o):
+        return hasattr(o, "init") and hasattr(o, "update")
+
+    single = _is_tx(optimizer)
+    optimizers = [optimizer] if single else list(optimizer)
+    wrapped = [
+        AmpOptimizer(
+            tx,
+            scaler=scaler,
+            num_losses=num_losses,
+            master_weights=bool(properties.master_weights),
+            param_dtype=policy.param_dtype,
+        )
+        for tx in optimizers
+    ]
+    _amp_state.loss_scalers = [scaler] * num_losses
+    _amp_state.optimizers = wrapped
+    return cast_params, (wrapped[0] if single else wrapped)
+
+
+def state_dict(amp_opt_states=None, destination=None):
+    """Persist per-scaler loss_scale + unskipped (frontend.py:361-370)."""
+    states = amp_opt_states if amp_opt_states is not None else []
+    out = {}
+    i = 0
+    for opt_state in states:
+        for s in opt_state.scalers:
+            out[f"loss_scaler{i}"] = {
+                "loss_scale": jax.device_get(s.loss_scale).item(),
+                "unskipped": jax.device_get(s.unskipped).item(),
+            }
+            i += 1
+    return out
+
+
+def load_state_dict(state_dict_in, amp_opt_states):
+    """Restore per-scaler state (frontend.py:373-400). Returns new opt states."""
+    import warnings
+
+    n_saved = len(state_dict_in)
+    n_here = sum(len(s.scalers) for s in amp_opt_states)
+    if n_saved != n_here:
+        warnings.warn(
+            f"Loading state_dict containing {n_saved} loss_scalers into an "
+            f"amp setup with {n_here} loss_scalers."
+        )
+    flat = [state_dict_in[k] for k in sorted(state_dict_in, key=lambda k: int(k.replace("loss_scaler", "")))]
+    out = []
+    i = 0
+    for opt_state in amp_opt_states:
+        new_scalers = []
+        for s in opt_state.scalers:
+            if i < len(flat):
+                s = s.replace(
+                    loss_scale=jnp.asarray(flat[i]["loss_scale"], jnp.float32),
+                    unskipped=jnp.asarray(flat[i]["unskipped"], jnp.int32),
+                )
+            new_scalers.append(s)
+            i += 1
+        out.append(opt_state.replace(scalers=tuple(new_scalers)))
+    return out

@@ -1,0 +1,62 @@
+"""Loss-scaling helpers — the functional analog of apex.amp.handle
+(reference: apex/amp/handle.py:17-167).
+
+The reference's ``with amp.scale_loss(loss, optimizer) as scaled:`` context
+exists to interleave with eager autograd. Under JAX the backward is a
+transform, so the same capability is a *grad-transformer*:
+
+    value_and_scaled_grad(loss_fn, optimizer) returns a function computing
+    (loss, unscaled_grads, found_inf) with scaling applied inside —
+    everything the context manager + hooks achieved, in one jit-safe call.
+
+``scale_loss`` itself is still provided for step-by-step parity use.
+"""
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+
+
+def scale_loss(loss, amp_optimizer, state, loss_id=0):
+    """Return the scaled loss (reference: handle.py:113 ``loss.float()*scale``)."""
+    return amp_optimizer.scale_loss(loss, state, loss_id=loss_id)
+
+
+def value_and_scaled_grad(loss_fn, amp_optimizer, loss_id=0, has_aux=False):
+    """Build a jit-safe (loss, grads) function with loss scaling inside.
+
+    ``loss_fn(params, *args)`` → scalar loss (optionally (loss, aux)).
+    Returned fn: ``f(params, amp_state, *args)`` →
+    ((loss, aux?), unscaled_fp32_grads, found_inf).
+
+    Covers the whole scale→backward→unscale→overflow-check sequence of the
+    reference's context exit (handle.py:118-154) minus the scale-state
+    update, which `AmpOptimizer.apply_gradients` performs.
+    """
+
+    def scaled_loss_fn(params, amp_state, *args):
+        out = loss_fn(params, *args)
+        loss = out[0] if has_aux else out
+        scaled = amp_optimizer.scale_loss(loss, amp_state, loss_id=loss_id)
+        return scaled, (out[1] if has_aux else None, loss)
+
+    grad_fn = jax.grad(scaled_loss_fn, has_aux=True)
+
+    def f(params, amp_state, *args):
+        grads, (aux, loss) = grad_fn(params, amp_state, *args)
+        unscaled, found_inf = amp_optimizer.unscale(grads, amp_state, loss_id=loss_id)
+        if has_aux:
+            return (loss, aux), unscaled, found_inf
+        return loss, unscaled, found_inf
+
+    return f
+
+
+@contextlib.contextmanager
+def disable_casts():
+    """Reference: handle.py:163-167."""
+    from apex_tpu.amp import policy as _policy
+
+    with _policy.disable_casts():
+        yield

@@ -1,0 +1,300 @@
+"""Dtype-policy interpreter — the TPU-native replacement for amp O1's
+monkey-patch engine.
+
+The reference patches torch namespaces with cast wrappers driven by
+whitelist/blacklist tables (reference: apex/amp/amp.py:68-177,
+apex/amp/lists/*). Under JAX, tracing makes namespace patching both fragile
+and unnecessary: the same capability is a *policy* — (param_dtype,
+compute_dtype, output_dtype) — consulted by apex_tpu layers, plus explicit
+cast combinators (``half_function`` / ``float_function`` /
+``promote_function``, reference: apex/amp/amp.py:30-42) for user functions.
+
+A thread-local policy stack (``autocast``) plays the role of amp's global
+"handle is active" state (apex/amp/_amp_state.py).
+"""
+
+import contextlib
+import functools
+import threading
+
+import jax
+import jax.numpy as jnp
+
+# ---------------------------------------------------------------------------
+# Cast lists — port of apex/amp/lists/{functional,torch,tensor}_overrides.py.
+# Names are abstract op categories rather than torch symbols; apex_tpu layers
+# look themselves up here so tests can assert the table drives behaviour.
+# ---------------------------------------------------------------------------
+
+# FP16 (half) list: matmul-class ops where reduced precision is safe and the
+# MXU wants bf16 (reference: lists/functional_overrides.py:18-27,
+# lists/torch_overrides.py:7-28).
+FP16_FUNCS = {
+    "conv1d", "conv2d", "conv3d", "conv_transpose1d", "conv_transpose2d",
+    "conv_transpose3d", "conv_tbc", "linear", "matmul", "mm", "bmm", "addmm",
+    "addbmm", "baddbmm", "dot", "einsum", "prelu", "mv", "dot_general",
+}
+
+# FP32 list: reductions / transcendentals / losses / norms
+# (reference: lists/functional_overrides.py:29-69, torch_overrides.py:29-60).
+FP32_FUNCS = {
+    "softmax", "log_softmax", "gelu", "tanh", "sigmoid", "erf", "erfinv",
+    "exp", "expm1", "log", "log10", "log2", "log1p", "cosh", "sinh", "acos",
+    "asin", "atan", "reciprocal", "rsqrt", "pow", "norm", "prod", "sum",
+    "cumsum", "cumprod", "mean", "var", "std", "renorm", "dist",
+    "layer_norm", "group_norm", "batch_norm", "instance_norm",
+    "nll_loss", "cross_entropy", "l1_loss", "mse_loss", "smooth_l1_loss",
+    "kl_div", "poisson_nll_loss", "cosine_embedding_loss", "hinge_embedding_loss",
+    "margin_ranking_loss", "multilabel_margin_loss", "soft_margin_loss",
+    "triplet_margin_loss", "multi_margin_loss", "softmin", "softplus",
+}
+
+# Promote table: binary ops where the widest input dtype wins
+# (reference: lists/torch_overrides.py CASTS).
+CASTS = {
+    "add", "addcdiv", "addcmul", "atan2", "cross", "bilinear", "div", "mul",
+    "dot_product", "equal", "ge", "gt", "le", "lt", "ne", "sub", "true_divide",
+}
+
+# Sequence promotes: ops over tensor sequences (torch.cat/stack analog).
+SEQUENCE_CASTS = {"cat", "stack", "concatenate"}
+
+# Banned in half precision with a remediation message
+# (reference: lists/functional_overrides.py:70 — binary_cross_entropy).
+BANNED_FUNCS = {
+    "binary_cross_entropy": (
+        "apex_tpu.amp does not work out-of-the-box with binary_cross_entropy "
+        "on half inputs. Use a sigmoid-fused cross entropy "
+        "(optax.sigmoid_binary_cross_entropy) on fp32 logits, or decorate "
+        "your loss with @amp.float_function."
+    )
+}
+
+
+class Policy:
+    """(param, compute, output) dtype triple + BN handling.
+
+    The functional core of an O-level; built by ``amp.frontend.opt_levels``.
+    """
+
+    def __init__(
+        self,
+        param_dtype=jnp.float32,
+        compute_dtype=jnp.float32,
+        output_dtype=jnp.float32,
+        keep_batchnorm_fp32=True,
+        cast_inputs=None,
+        enabled=True,
+    ):
+        self.param_dtype = jnp.dtype(param_dtype)
+        self.compute_dtype = jnp.dtype(compute_dtype)
+        self.output_dtype = jnp.dtype(output_dtype)
+        self.keep_batchnorm_fp32 = keep_batchnorm_fp32
+        self.cast_inputs = cast_inputs
+        self.enabled = enabled
+
+    def cast_to_compute(self, tree):
+        return _cast_floating(tree, self.compute_dtype)
+
+    def cast_to_param(self, tree):
+        return _cast_floating(tree, self.param_dtype)
+
+    def cast_to_output(self, tree):
+        return _cast_floating(tree, self.output_dtype)
+
+    def __repr__(self):
+        return (
+            f"Policy(param={self.param_dtype.name}, compute={self.compute_dtype.name}, "
+            f"output={self.output_dtype.name}, keep_bn_fp32={self.keep_batchnorm_fp32})"
+        )
+
+
+def _is_floating(x):
+    return hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+
+
+def _cast_floating(tree, dtype):
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(dtype) if _is_floating(x) else x, tree
+    )
+
+
+# ---------------------------------------------------------------------------
+# Thread-local active-policy stack (the _amp_state analog)
+# ---------------------------------------------------------------------------
+
+_local = threading.local()
+
+
+def _stack():
+    if not hasattr(_local, "stack"):
+        _local.stack = []
+    return _local.stack
+
+
+def current_policy():
+    """The innermost active policy, or None outside any autocast region."""
+    s = _stack()
+    return s[-1] if s else None
+
+
+def compute_dtype(default=jnp.float32):
+    """Dtype apex_tpu layers should compute matmul-class ops in."""
+    p = current_policy()
+    if p is None or not p.enabled:
+        return jnp.dtype(default)
+    return p.compute_dtype
+
+
+@contextlib.contextmanager
+def autocast(policy=None, enabled=True, dtype=jnp.bfloat16):
+    """Activate a policy for the dynamic extent (the O1 region analog).
+
+    With no explicit policy, builds one computing matmuls in ``dtype``
+    (bf16 — the MXU-native half type — by default; fp16 for parity runs).
+    """
+    if policy is None:
+        policy = Policy(
+            param_dtype=jnp.float32,
+            compute_dtype=dtype,
+            output_dtype=jnp.float32,
+            enabled=enabled,
+        )
+    _stack().append(policy)
+    try:
+        yield policy
+    finally:
+        _stack().pop()
+
+
+@contextlib.contextmanager
+def disable_casts():
+    """Reference: apex/amp/handle.py:163-167 — run a region in fp32 (used
+    around optimizer steps in O1)."""
+    p = current_policy()
+    disabled = Policy(enabled=False) if p is None else Policy(
+        param_dtype=p.param_dtype,
+        compute_dtype=jnp.float32,
+        output_dtype=p.output_dtype,
+        keep_batchnorm_fp32=p.keep_batchnorm_fp32,
+        enabled=False,
+    )
+    _stack().append(disabled)
+    try:
+        yield
+    finally:
+        _stack().pop()
+
+
+# ---------------------------------------------------------------------------
+# Cast combinators — apex/amp/wrap.py semantics, functional
+# ---------------------------------------------------------------------------
+
+def _widest_dtype(args):
+    dtypes = [a.dtype for a in jax.tree_util.tree_leaves(args) if _is_floating(a)]
+    if not dtypes:
+        return None
+    return functools.reduce(jnp.promote_types, dtypes)
+
+
+def half_function(fn):
+    """Run ``fn`` with floating inputs cast to the active compute dtype
+    (reference: amp.half_function, apex/amp/amp.py:30; wrapper
+    wrap.make_cast_wrapper, apex/amp/wrap.py:10-29)."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        p = current_policy()
+        if p is None or not p.enabled:
+            return fn(*args, **kwargs)
+        args, kwargs = _cast_floating((args, kwargs), p.compute_dtype)
+        return fn(*args, **kwargs)
+
+    wrapper.__amp_wrapped__ = "half"
+    return wrapper
+
+
+def float_function(fn):
+    """Run ``fn`` in fp32 regardless of the active policy
+    (reference: amp.float_function, apex/amp/amp.py:34)."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        p = current_policy()
+        if p is None or not p.enabled:
+            return fn(*args, **kwargs)
+        args, kwargs = _cast_floating((args, kwargs), jnp.float32)
+        return fn(*args, **kwargs)
+
+    wrapper.__amp_wrapped__ = "float"
+    return wrapper
+
+
+def promote_function(fn):
+    """Cast all floating inputs to the widest participating dtype
+    (reference: amp.promote_function, apex/amp/amp.py:38; wrap.promote,
+    apex/amp/wrap.py:65)."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        p = current_policy()
+        if p is None or not p.enabled:
+            return fn(*args, **kwargs)
+        widest = _widest_dtype((args, kwargs))
+        if widest is not None:
+            args, kwargs = _cast_floating((args, kwargs), widest)
+        return fn(*args, **kwargs)
+
+    wrapper.__amp_wrapped__ = "promote"
+    return wrapper
+
+
+# user registries (reference: apex/amp/amp.py:46-64)
+_user_registries = {"half": [], "float": [], "promote": []}
+
+
+def register_half_function(module, name):
+    setattr(module, name, half_function(getattr(module, name)))
+    _user_registries["half"].append((module, name))
+
+
+def register_float_function(module, name):
+    setattr(module, name, float_function(getattr(module, name)))
+    _user_registries["float"].append((module, name))
+
+
+def register_promote_function(module, name):
+    setattr(module, name, promote_function(getattr(module, name)))
+    _user_registries["promote"].append((module, name))
+
+
+def lookup_cast(op_name):
+    """Which cast class an abstract op belongs to — the table-dispatch the
+    patch engine performed at import time (apex/amp/amp.py:80-177)."""
+    if op_name in BANNED_FUNCS:
+        raise NotImplementedError(BANNED_FUNCS[op_name])
+    if op_name in FP16_FUNCS:
+        return "half"
+    if op_name in FP32_FUNCS:
+        return "float"
+    if op_name in CASTS:
+        return "promote"
+    if op_name in SEQUENCE_CASTS:
+        return "sequence_promote"
+    return None
+
+
+def cast_for_op(op_name, *args):
+    """Cast ``args`` the way the O1 patch engine would for ``op_name``."""
+    p = current_policy()
+    if p is None or not p.enabled:
+        return args
+    kind = lookup_cast(op_name)
+    if kind == "half":
+        return _cast_floating(args, p.compute_dtype)
+    if kind == "float":
+        return _cast_floating(args, jnp.float32)
+    if kind in ("promote", "sequence_promote"):
+        widest = _widest_dtype(args)
+        return _cast_floating(args, widest) if widest is not None else args
+    return args

@@ -1,0 +1,133 @@
+"""Dynamic loss scaling as a pure pytree state machine.
+
+Port of the semantics of apex.amp.scaler.LossScaler (reference:
+apex/amp/scaler.py:33-217): static or dynamic scaling, init 2**16, x2 every
+2000 unskipped steps, /2 on overflow, clamped to [min_loss_scale,
+max_loss_scale]. The CUDA overflow sentinel (GPU-side ``_overflow_buf``,
+scaler.py:105-117) becomes an on-device ``jnp.isfinite`` reduction fused into
+the unscale, so a jitted train step never syncs the host to decide whether to
+skip — the skip itself is a ``jnp.where`` select (the observable behaviour of
+apex's one-shot patched ``skip_step``, apex/amp/handle.py:128-154).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class LossScalerState:
+    """The mutable part of a LossScaler, as a jit-safe pytree.
+
+    ``loss_scale`` + ``unskipped`` are exactly the fields apex persists in
+    ``amp.state_dict()`` (reference: apex/amp/frontend.py:361-370).
+    """
+
+    loss_scale: jnp.ndarray  # f32 scalar
+    unskipped: jnp.ndarray  # i32 scalar — steps since last overflow
+    overflow: jnp.ndarray  # bool scalar — last-step overflow flag
+
+
+@dataclasses.dataclass(frozen=True)
+class LossScaler:
+    """Static config + pure transition functions.
+
+    Reference ctor semantics: apex/amp/scaler.py:38-55. ``loss_scale`` is
+    either a number (static) or "dynamic".
+    """
+
+    loss_scale: object = "dynamic"
+    init_scale: float = 2.0 ** 16
+    scale_factor: float = 2.0
+    scale_window: int = 2000
+    min_loss_scale: float = None
+    max_loss_scale: float = 2.0 ** 24
+
+    @property
+    def dynamic(self):
+        return self.loss_scale == "dynamic"
+
+    def init(self):
+        scale = self.init_scale if self.dynamic else float(self.loss_scale)
+        return LossScalerState(
+            loss_scale=jnp.asarray(scale, jnp.float32),
+            unskipped=jnp.asarray(0, jnp.int32),
+            overflow=jnp.asarray(False),
+        )
+
+    # -- forward: loss scaling (apex/amp/handle.py:113 yields loss*scale) --
+    def scale(self, loss, state):
+        return loss.astype(jnp.float32) * state.loss_scale
+
+    # -- backward: fused unscale + overflow detect (apex/amp/scaler.py:94-189) --
+    def unscale(self, grads, state):
+        """Returns (unscaled fp32 grads, found_inf). One fused pass; the
+        isfinite reduction replaces amp_C's noop_flag.
+
+        The unscaled result stays fp32 — apex unscales *into* fp32 master
+        grads (_process_optimizer.py:161); casting back to fp16 here would
+        flush small unscaled values to zero.
+        """
+        inv = 1.0 / state.loss_scale
+        leaves = jax.tree_util.tree_leaves(grads)
+        finite = jnp.array(True)
+        for g in leaves:
+            finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
+        unscaled = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32) * inv, grads
+        )
+        return unscaled, ~finite
+
+    def update(self, state, found_inf):
+        """Scale-update state machine (apex/amp/scaler.py:197-217).
+
+        On overflow: scale = max(scale*0.5, min_loss_scale), unskipped = 0.
+        Else: unskipped += 1; at scale_window: scale = min(scale*2,
+        max_loss_scale), unskipped = 0. Static scaling only tracks overflow.
+        """
+        if not self.dynamic:
+            return LossScalerState(
+                loss_scale=state.loss_scale,
+                unskipped=state.unskipped,
+                overflow=found_inf,
+            )
+        min_scale = self.min_loss_scale if self.min_loss_scale is not None else 0.0
+        shrunk = jnp.maximum(state.loss_scale / self.scale_factor, min_scale)
+        unskipped = jnp.where(found_inf, 0, state.unskipped + 1)
+        grow = unskipped == self.scale_window
+        grown = jnp.minimum(state.loss_scale * self.scale_factor, self.max_loss_scale)
+        new_scale = jnp.where(found_inf, shrunk, jnp.where(grow, grown, state.loss_scale))
+        new_unskipped = jnp.where(grow, 0, unskipped)
+        return LossScalerState(
+            loss_scale=new_scale.astype(jnp.float32),
+            unskipped=new_unskipped.astype(jnp.int32),
+            overflow=found_inf,
+        )
+
+    def unscale_and_update(self, grads, state):
+        """Convenience: unscale, update scale state, and report skip.
+
+        Returns (grads, new_state, should_skip). Mirrors the scale_loss
+        context-exit sequence (apex/amp/handle.py:118-154).
+        """
+        grads, found_inf = self.unscale(grads, state)
+        new_state = self.update(state, found_inf)
+        return grads, new_state, found_inf
+
+    # -- persistence: apex/amp/frontend.py:361-400 --
+    @staticmethod
+    def state_dict(state):
+        return {
+            "loss_scale": state.loss_scale,
+            "unskipped": state.unskipped,
+        }
+
+    @staticmethod
+    def load_state_dict(state, d):
+        return LossScalerState(
+            loss_scale=jnp.asarray(d["loss_scale"], jnp.float32),
+            unskipped=jnp.asarray(d["unskipped"], jnp.int32),
+            overflow=state.overflow,
+        )

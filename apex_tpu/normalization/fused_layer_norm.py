@@ -1,0 +1,140 @@
+"""FusedLayerNorm / FusedRMSNorm.
+
+Capability port of apex.normalization (reference:
+apex/normalization/fused_layer_norm.py:16-437; CUDA
+csrc/layer_norm_cuda_kernel.cu — warp-shuffle Welford row statistics). On
+TPU the forward/backward row reductions fuse natively in XLA; a Pallas row
+kernel (apex_tpu.ops.layer_norm_pallas) is used for large rows on real TPU
+backends, with this jnp path the reference/fallback.
+
+Dtype semantics mirror the reference:
+  * plain ``FusedLayerNorm``/``FusedRMSNorm``: statistics + affine math in
+    fp32, result cast back to input dtype.
+  * ``Mixed*`` variants (fused_layer_norm.py:398/420): params are created in
+    the input dtype (Megatron-compatible).
+"""
+
+import numbers
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def _normalized_axes(x, normalized_shape):
+    if isinstance(normalized_shape, numbers.Integral):
+        normalized_shape = (int(normalized_shape),)
+    n = len(normalized_shape)
+    assert tuple(x.shape[-n:]) == tuple(normalized_shape), (
+        f"input tail {x.shape[-n:]} != normalized_shape {normalized_shape}")
+    return tuple(range(x.ndim - n, x.ndim)), tuple(normalized_shape)
+
+
+def fused_layer_norm(x, normalized_shape, weight=None, bias=None, eps=1e-5,
+                     memory_efficient=False):
+    """Functional layer norm, fp32 statistics (reference autograd fns:
+    fused_layer_norm.py:32,59,84,103)."""
+    del memory_efficient  # remat is a jax.checkpoint policy decision here
+    axes, _ = _normalized_axes(x, normalized_shape)
+    orig_dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    if weight is not None:
+        y = y * weight.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(orig_dtype)
+
+
+def fused_rms_norm(x, normalized_shape, weight=None, eps=1e-5,
+                   memory_efficient=False):
+    """Functional RMS norm (reference: fused_layer_norm.py:122,145 and the
+    pure-python manual_rms_norm fallback :16-29)."""
+    del memory_efficient
+    axes, _ = _normalized_axes(x, normalized_shape)
+    orig_dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xf), axis=axes, keepdims=True)
+    y = xf * jax.lax.rsqrt(ms + eps)
+    if weight is not None:
+        y = y * weight.astype(jnp.float32)
+    return y.astype(orig_dtype)
+
+
+# aliases matching the reference's functional names
+fused_layer_norm_affine = fused_layer_norm
+fused_rms_norm_affine = fused_rms_norm
+
+
+def mixed_dtype_fused_layer_norm_affine(x, weight, bias, normalized_shape,
+                                        eps=1e-5, memory_efficient=False):
+    """Mixed-dtype path (params follow input dtype; fused_layer_norm.py:84)."""
+    return fused_layer_norm(x, normalized_shape, weight, bias, eps,
+                            memory_efficient)
+
+
+def mixed_dtype_fused_rms_norm_affine(x, weight, normalized_shape, eps=1e-5,
+                                      memory_efficient=False):
+    return fused_rms_norm(x, normalized_shape, weight, eps, memory_efficient)
+
+
+class FusedLayerNorm(nn.Module):
+    """Module surface of apex.normalization.FusedLayerNorm
+    (fused_layer_norm.py:204)."""
+
+    normalized_shape: tuple
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+    memory_efficient: bool = False
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        shape = self.normalized_shape
+        if isinstance(shape, numbers.Integral):
+            shape = (int(shape),)
+        else:
+            shape = tuple(int(s) for s in shape)
+        weight = bias = None
+        if self.elementwise_affine:
+            weight = self.param(
+                "weight", nn.initializers.ones, shape, self.param_dtype)
+            bias = self.param(
+                "bias", nn.initializers.zeros, shape, self.param_dtype)
+        return fused_layer_norm(x, shape, weight, bias, self.eps,
+                                self.memory_efficient)
+
+
+class FusedRMSNorm(nn.Module):
+    """Module surface of apex.normalization.FusedRMSNorm
+    (fused_layer_norm.py:300)."""
+
+    normalized_shape: tuple
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+    memory_efficient: bool = False
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        shape = self.normalized_shape
+        if isinstance(shape, numbers.Integral):
+            shape = (int(shape),)
+        else:
+            shape = tuple(int(s) for s in shape)
+        weight = None
+        if self.elementwise_affine:
+            weight = self.param(
+                "weight", nn.initializers.ones, shape, self.param_dtype)
+        return fused_rms_norm(x, shape, weight, self.eps, self.memory_efficient)
+
+
+class MixedFusedLayerNorm(FusedLayerNorm):
+    """Params follow input dtype (reference: fused_layer_norm.py:398) —
+    realized by constructing with ``param_dtype`` = model half dtype."""
+
+
+class MixedFusedRMSNorm(FusedRMSNorm):
+    """Reference: fused_layer_norm.py:420."""

@@ -1,0 +1,29 @@
+"""apex_tpu.optimizers — fused optimizers.
+
+Reference surface: apex/optimizers/__init__.py (FusedAdam, FusedLAMB,
+FusedSGD, FusedNovoGrad, FusedAdagrad, FusedMixedPrecisionLamb). Each comes
+in two forms: the optax-style transform (``fused_adam(...)``) for jit/pjit
+training loops, and the torch-like class (``FusedAdam``) for API parity.
+"""
+
+from apex_tpu.optimizers.fused_adam import FusedAdam, fused_adam, FusedAdamState
+from apex_tpu.optimizers.fused_sgd import FusedSGD, fused_sgd, FusedSGDState
+from apex_tpu.optimizers.fused_lamb import FusedLAMB, fused_lamb, FusedLAMBState
+from apex_tpu.optimizers.fused_novograd import (
+    FusedNovoGrad, fused_novograd, FusedNovoGradState,
+)
+from apex_tpu.optimizers.fused_adagrad import (
+    FusedAdagrad, fused_adagrad, FusedAdagradState,
+)
+from apex_tpu.optimizers.fused_mixed_precision_lamb import (
+    FusedMixedPrecisionLamb, fused_mixed_precision_lamb,
+)
+
+__all__ = [
+    "FusedAdam", "fused_adam", "FusedAdamState",
+    "FusedSGD", "fused_sgd", "FusedSGDState",
+    "FusedLAMB", "fused_lamb", "FusedLAMBState",
+    "FusedNovoGrad", "fused_novograd", "FusedNovoGradState",
+    "FusedAdagrad", "fused_adagrad", "FusedAdagradState",
+    "FusedMixedPrecisionLamb", "fused_mixed_precision_lamb",
+]

@@ -1,0 +1,66 @@
+"""Shared torch-like stateful wrapper over the optax-style fused transforms.
+
+The reference exposes torch ``Optimizer`` subclasses; here the stateful class
+is a thin veneer over the pure transform so eager-style code and parity tests
+get the familiar surface (param_groups, step) while pjit users take the
+functional transform directly.
+"""
+
+import jax
+
+
+class FusedOptimizerBase:
+    def __init__(self, params, defaults):
+        self.defaults = dict(defaults)
+        self.param_groups = self._make_groups(params)
+        self._states = [None] * len(self.param_groups)
+        self._txs = [None] * len(self.param_groups)
+
+    def _make_groups(self, params):
+        if isinstance(params, dict):
+            params = [params]
+        params = list(params)
+        if params and isinstance(params[0], dict):
+            groups = []
+            for g in params:
+                d = dict(self.defaults)
+                d.update({k: v for k, v in g.items() if k != "params"})
+                d["params"] = list(g["params"])
+                groups.append(d)
+            return groups
+        return [dict(self.defaults, params=params)]
+
+    def _group_tx(self, group):
+        raise NotImplementedError
+
+    def step(self, grads):
+        """``grads``: gradient list (or list-of-lists matching param groups).
+        Returns updated params; also stored on the groups."""
+        if len(self.param_groups) == 1 and (
+            not grads or not isinstance(grads[0], (list, tuple))
+        ):
+            grads = [grads]
+        out = []
+        for i, (group, g) in enumerate(zip(self.param_groups, grads)):
+            # rebuild the cached transform only when group hyperparams change
+            # (torch-style LR scheduling mutates group["lr"] between steps)
+            hp_key = tuple(sorted(
+                (k, repr(v)) for k, v in group.items() if k != "params"))
+            if self._txs[i] is None or self._txs[i][0] != hp_key:
+                self._txs[i] = (hp_key, self._group_tx(group))
+            tx = self._txs[i][1]
+            if self._states[i] is None:
+                self._states[i] = tx.init(group["params"])
+            updates, self._states[i] = tx.update(list(g), self._states[i], group["params"])
+            group["params"] = [
+                p + u.astype(p.dtype) for p, u in zip(group["params"], updates)
+            ]
+            out.append(group["params"])
+        return out[0] if len(out) == 1 else out
+
+    @property
+    def state(self):
+        return self._states
+
+    def zero_grad(self, set_to_none=True):
+        pass

@@ -1,0 +1,67 @@
+"""FusedAdagrad — fused Adagrad.
+
+Capability port of apex.optimizers.FusedAdagrad (reference:
+apex/optimizers/fused_adagrad.py; kernel csrc/multi_tensor_adagrad.cu).
+``adagrad_w_mode`` = decoupled weight decay (as in the kernel's ADAGRAD
+MODE_1).
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.optimizers._base import FusedOptimizerBase
+from apex_tpu.optimizers._fused import FlatMeta, get_meta
+
+
+class FusedAdagradState(NamedTuple):
+    count: jnp.ndarray
+    sum_sq: jnp.ndarray  # flat fp32 accumulated g^2
+
+
+def fused_adagrad(learning_rate=1e-2, eps=1e-10, weight_decay=0.0,
+                  adagrad_w_mode=False):
+    def init(params):
+        meta = get_meta(jax.tree_util.tree_leaves(params))
+        return FusedAdagradState(
+            count=jnp.zeros((), jnp.int32),
+            sum_sq=jnp.zeros((meta.total,), jnp.float32),
+        )
+
+    def update(grads, state, params=None):
+        assert params is not None
+        leaves_g, treedef = jax.tree_util.tree_flatten(grads)
+        leaves_p = jax.tree_util.tree_leaves(params)
+        meta = get_meta(leaves_p)
+        g = meta.flatten(leaves_g)
+        p = meta.flatten(leaves_p)
+        count = state.count + 1
+        lr = learning_rate(count) if callable(learning_rate) else learning_rate
+        if weight_decay != 0 and not adagrad_w_mode:
+            g = g + weight_decay * p
+        sum_sq = state.sum_sq + g * g
+        upd = g / (jnp.sqrt(sum_sq) + eps)
+        if weight_decay != 0 and adagrad_w_mode:
+            upd = upd + weight_decay * p
+        flat_u = -lr * upd
+        updates = jax.tree_util.tree_unflatten(
+            treedef, meta.unflatten(flat_u, [x.dtype for x in leaves_g]))
+        return updates, FusedAdagradState(count=count, sum_sq=sum_sq)
+
+    return optax.GradientTransformation(init, update)
+
+
+class FusedAdagrad(FusedOptimizerBase):
+    """Reference API: apex/optimizers/fused_adagrad.py."""
+
+    def __init__(self, params, lr=1e-2, eps=1e-10, weight_decay=0.0,
+                 set_grad_none=True, adagrad_w_mode=False):
+        super().__init__(params, dict(lr=lr, eps=eps, weight_decay=weight_decay))
+        self.adagrad_w_mode = adagrad_w_mode
+
+    def _group_tx(self, group):
+        return fused_adagrad(learning_rate=group["lr"], eps=group["eps"],
+                             weight_decay=group["weight_decay"],
+                             adagrad_w_mode=self.adagrad_w_mode)

@@ -1,0 +1,94 @@
+"""FusedMixedPrecisionLamb — LAMB with fp32 master state over half params.
+
+Capability port of apex.optimizers.FusedMixedPrecisionLamb (reference:
+apex/optimizers/fused_mixed_precision_lamb.py; kernel
+csrc/multi_tensor_lamb_mp.cu — fp32 master params + bf16/fp16 model params
+updated in one kernel, device-resident step count). Here: the fused LAMB
+transform runs on a flat fp32 master buffer and half model params are
+recast from it in the same jitted computation — the single-kernel property
+falls out of XLA fusion.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.optimizers._base import FusedOptimizerBase
+from apex_tpu.optimizers._fused import FlatMeta, get_meta
+from apex_tpu.optimizers.fused_lamb import fused_lamb
+
+
+class MixedPrecisionLambState(NamedTuple):
+    master_flat: jnp.ndarray  # fp32 flat master params
+    inner: object  # FusedLAMBState
+
+
+def fused_mixed_precision_lamb(learning_rate=1e-3, betas=(0.9, 0.999),
+                               eps=1e-6, weight_decay=0.01,
+                               bias_correction=True, grad_averaging=True,
+                               max_grad_norm=1.0, use_nvlamb=False):
+    """Transform whose update() consumes half-precision grads/params but
+    steps fp32 masters; returned updates are in model dtype."""
+    lamb = fused_lamb(learning_rate=learning_rate, betas=betas, eps=eps,
+                      weight_decay=weight_decay, bias_correction=bias_correction,
+                      grad_averaging=grad_averaging, max_grad_norm=max_grad_norm,
+                      use_nvlamb=use_nvlamb)
+
+    def init(params):
+        leaves = jax.tree_util.tree_leaves(params)
+        meta = get_meta(leaves)
+        master_flat = meta.flatten(leaves)  # fp32 copies
+        master_tree = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(params),
+            meta.unflatten(master_flat, [jnp.float32] * meta.num_tensors))
+        return MixedPrecisionLambState(master_flat=master_flat,
+                                       inner=lamb.init(master_tree))
+
+    def update(grads, state, params=None):
+        assert params is not None
+        leaves_p, treedef = jax.tree_util.tree_flatten(params)
+        meta = get_meta(leaves_p)
+        masters = jax.tree_util.tree_unflatten(
+            treedef, meta.unflatten(state.master_flat,
+                                    [jnp.float32] * meta.num_tensors))
+        fp32_grads = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32), grads)
+        upd, inner = lamb.update(fp32_grads, state.inner, masters)
+        new_masters = optax.apply_updates(masters, upd)
+        new_flat = meta.flatten(jax.tree_util.tree_leaves(new_masters))
+        # model-dtype updates so new half params == cast(new masters)
+        updates = jax.tree_util.tree_map(
+            lambda nm, p: (nm.astype(p.dtype).astype(jnp.float32)
+                           - p.astype(jnp.float32)).astype(p.dtype),
+            new_masters, params)
+        return updates, MixedPrecisionLambState(master_flat=new_flat, inner=inner)
+
+    return optax.GradientTransformation(init, update)
+
+
+class FusedMixedPrecisionLamb(FusedOptimizerBase):
+    """Reference API: apex/optimizers/fused_mixed_precision_lamb.py."""
+
+    def __init__(self, params, lr=1e-3, bias_correction=True,
+                 betas=(0.9, 0.999), eps=1e-6, weight_decay=0.01,
+                 amsgrad=False, grad_averaging=True, set_grad_none=True,
+                 max_grad_norm=1.0, use_nvlamb=False, step=0,
+                 reduced_precision_dtype=None):
+        if amsgrad:
+            raise RuntimeError(
+                "FusedMixedPrecisionLamb does not support the AMSGrad variant.")
+        super().__init__(params, dict(
+            lr=lr, bias_correction=bias_correction, betas=betas, eps=eps,
+            weight_decay=weight_decay, grad_averaging=grad_averaging,
+            max_grad_norm=max_grad_norm))
+        self.use_nvlamb = use_nvlamb
+
+    def _group_tx(self, group):
+        return fused_mixed_precision_lamb(
+            learning_rate=group["lr"], betas=group["betas"], eps=group["eps"],
+            weight_decay=group["weight_decay"],
+            bias_correction=group["bias_correction"],
+            grad_averaging=group["grad_averaging"],
+            max_grad_norm=group["max_grad_norm"], use_nvlamb=self.use_nvlamb)

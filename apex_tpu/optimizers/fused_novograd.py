@@ -1,0 +1,126 @@
+"""FusedNovoGrad — per-layer second-moment optimizer, fused.
+
+Capability port of apex.optimizers.FusedNovoGrad (reference:
+apex/optimizers/fused_novograd.py:68-211; kernel
+csrc/multi_tensor_novograd.cu:16-110,150-185). Reference semantics kept
+exactly:
+
+  * ``v`` stores the per-layer grad **norm** (not its square,
+    fused_novograd.py:158-159), blended as
+    L2:   v' = sqrt(beta2*v^2 + (1-beta2)*|g|^2)
+    Linf: v' = beta2*v + (1-beta2)*max|g|        (norm_out_cuda blend)
+  * beta2 bias correction is sqrt(1-beta2^t) applied to the *norm*
+    (multi_tensor_novograd.cu:150-152); denom = v'/bc2 + eps.
+  * MOMENT_MODE_0 (``reg_inside_moment=True``): r_g = g/denom + decay*p,
+    m = beta1*m + beta3*r_g, p -= lr*m/bc1 (kernel :98-105).
+  * MOMENT_MODE_1 (default): m = beta1*m + beta3*g (raw grad), update =
+    (m/bc1)/denom + decay*p (kernel :106-113).
+  * ``init_zero=False``: v initialized with the first step's norm so the
+    first blend is a no-op (fused_novograd.py:166-174).
+
+On TPU the per-layer norms are one ``segment_sum``/``segment_max`` over the
+flat buffer.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.optimizers._base import FusedOptimizerBase
+from apex_tpu.optimizers._fused import FlatMeta, get_meta
+
+
+class FusedNovoGradState(NamedTuple):
+    count: jnp.ndarray
+    m: jnp.ndarray  # flat fp32 first moment
+    v: jnp.ndarray  # [num_tensors] fp32 per-layer grad NORM (not squared)
+
+
+def fused_novograd(learning_rate=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                   weight_decay=0.0, grad_averaging=True, init_zero=False,
+                   reg_inside_moment=False, norm_type=2, bias_correction=True):
+    beta1, beta2 = betas
+    if norm_type not in (0, 2):
+        raise RuntimeError("FusedNovoGrad only support l2/inf norm now.")
+
+    def init(params):
+        meta = get_meta(jax.tree_util.tree_leaves(params))
+        return FusedNovoGradState(
+            count=jnp.zeros((), jnp.int32),
+            m=jnp.zeros((meta.total,), jnp.float32),
+            v=jnp.zeros((meta.num_tensors,), jnp.float32),
+        )
+
+    def update(grads, state, params=None):
+        assert params is not None
+        leaves_g, treedef = jax.tree_util.tree_flatten(grads)
+        leaves_p = jax.tree_util.tree_leaves(params)
+        meta = get_meta(leaves_p)
+        g = meta.flatten(leaves_g)
+        p = meta.flatten(leaves_p)
+        count = state.count + 1
+        t = count.astype(jnp.float32)
+        lr = learning_rate(count) if callable(learning_rate) else learning_rate
+
+        if norm_type == 2:
+            step_norm = jnp.sqrt(meta.per_tensor_sq_norms(g))
+        else:  # L-inf
+            step_norm = jax.ops.segment_max(
+                jnp.abs(g), meta.seg_ids, num_segments=meta.num_tensors)
+
+        # v init: first step uses the step norm so the first blend is a no-op
+        # (unless init_zero, which starts averaging immediately from 0)
+        v_prev = state.v if init_zero else jnp.where(
+            count == 1, step_norm, state.v)
+        if norm_type == 2:
+            v = jnp.sqrt(beta2 * v_prev * v_prev + (1.0 - beta2) * step_norm ** 2)
+        else:
+            v = beta2 * v_prev + (1.0 - beta2) * step_norm
+
+        if bias_correction:
+            bc1 = 1.0 - beta1 ** t
+            bc2 = jnp.sqrt(1.0 - beta2 ** t)  # sqrt: v is a norm, not a square
+        else:
+            bc1 = bc2 = 1.0
+        denom = meta.broadcast_per_tensor(v / bc2) + eps
+        beta3 = 1.0 - beta1 if grad_averaging else 1.0
+
+        if reg_inside_moment:  # MOMENT_MODE_0
+            r_g = g / denom + weight_decay * p
+            m = beta1 * state.m + beta3 * r_g
+            flat_u = -lr * m / bc1
+        else:  # MOMENT_MODE_1 (decoupled decay)
+            m = beta1 * state.m + beta3 * g
+            flat_u = -lr * ((m / bc1) / denom + weight_decay * p)
+        updates = jax.tree_util.tree_unflatten(
+            treedef, meta.unflatten(flat_u, [x.dtype for x in leaves_g]))
+        return updates, FusedNovoGradState(count=count, m=m, v=v)
+
+    return optax.GradientTransformation(init, update)
+
+
+class FusedNovoGrad(FusedOptimizerBase):
+    """Reference API: apex/optimizers/fused_novograd.py:68."""
+
+    def __init__(self, params, lr=1e-3, bias_correction=True,
+                 betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0, amsgrad=False,
+                 reg_inside_moment=False, grad_averaging=True, norm_type=2,
+                 init_zero=False, set_grad_none=True):
+        if amsgrad:
+            raise RuntimeError("FusedNovoGrad does not support the AMSGrad variant.")
+        super().__init__(params, dict(
+            lr=lr, bias_correction=bias_correction, betas=betas, eps=eps,
+            weight_decay=weight_decay, grad_averaging=grad_averaging))
+        self.reg_inside_moment = reg_inside_moment
+        self.norm_type = norm_type
+        self.init_zero = init_zero
+
+    def _group_tx(self, group):
+        return fused_novograd(
+            learning_rate=group["lr"], betas=group["betas"], eps=group["eps"],
+            weight_decay=group["weight_decay"],
+            grad_averaging=group["grad_averaging"],
+            init_zero=self.init_zero, reg_inside_moment=self.reg_inside_moment,
+            norm_type=self.norm_type, bias_correction=group["bias_correction"])

@@ -1,0 +1,80 @@
+"""FusedSGD — momentum SGD as one fused flat update.
+
+Capability port of apex.optimizers.FusedSGD (reference:
+apex/optimizers/fused_sgd.py:7-227; kernel csrc/multi_tensor_sgd_kernel.cu).
+Momentum buffer lives as a single flat fp32 array; first-step semantics
+match torch (buf = grad on first momentum use).
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.optimizers._base import FusedOptimizerBase
+from apex_tpu.optimizers._fused import FlatMeta, get_meta
+
+
+class FusedSGDState(NamedTuple):
+    count: jnp.ndarray
+    momentum_buf: jnp.ndarray  # flat fp32
+
+
+def fused_sgd(learning_rate=1e-3, momentum=0.0, dampening=0.0,
+              weight_decay=0.0, nesterov=False):
+    if nesterov and (momentum <= 0 or dampening != 0):
+        raise ValueError("Nesterov momentum requires a momentum and zero dampening")
+
+    def init(params):
+        meta = get_meta(jax.tree_util.tree_leaves(params))
+        return FusedSGDState(
+            count=jnp.zeros((), jnp.int32),
+            momentum_buf=jnp.zeros((meta.total,), jnp.float32),
+        )
+
+    def update(grads, state, params=None):
+        assert params is not None
+        leaves_g, treedef = jax.tree_util.tree_flatten(grads)
+        leaves_p = jax.tree_util.tree_leaves(params)
+        meta = get_meta(leaves_p)
+        g = meta.flatten(leaves_g)
+        p = meta.flatten(leaves_p)
+        count = state.count + 1
+        lr = learning_rate(count) if callable(learning_rate) else learning_rate
+        if weight_decay != 0:
+            g = g + weight_decay * p
+        if momentum != 0:
+            # first step: buf = g (torch semantics); after: buf = mu*buf + (1-damp)*g
+            buf = jnp.where(count == 1, g,
+                            momentum * state.momentum_buf + (1.0 - dampening) * g)
+            d = g + momentum * buf if nesterov else buf
+        else:
+            buf = state.momentum_buf
+            d = g
+        flat_u = -lr * d
+        updates = jax.tree_util.tree_unflatten(
+            treedef, meta.unflatten(flat_u, [x.dtype for x in leaves_g]))
+        return updates, FusedSGDState(count=count, momentum_buf=buf)
+
+    return optax.GradientTransformation(init, update)
+
+
+class FusedSGD(FusedOptimizerBase):
+    """Reference API: apex/optimizers/fused_sgd.py:7. The amp-specific
+    ``materialize_master_grads`` / ``wd_after_momentum`` knobs are eager-mode
+    artifacts; master-weight handling lives in amp.AmpOptimizer here."""
+
+    def __init__(self, params, lr=1e-3, momentum=0.0, dampening=0.0,
+                 weight_decay=0.0, nesterov=False, wd_after_momentum=False,
+                 materialize_master_grads=True, set_grad_none=False):
+        super().__init__(params, dict(lr=lr, momentum=momentum,
+                                      dampening=dampening,
+                                      weight_decay=weight_decay,
+                                      nesterov=nesterov))
+
+    def _group_tx(self, group):
+        return fused_sgd(learning_rate=group["lr"], momentum=group["momentum"],
+                         dampening=group["dampening"],
+                         weight_decay=group["weight_decay"],
+                         nesterov=group["nesterov"])

@@ -1,0 +1,21 @@
+"""Test harness configuration.
+
+Multi-chip behaviour is tested on a virtual 8-device CPU mesh
+(``--xla_force_host_platform_device_count=8``) — the TPU analog of the
+reference's single-node multi-process NCCL test base
+(apex/transformer/testing/distributed_test_base.py:27-45). Must run before
+any jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", False)

@@ -1,0 +1,240 @@
+"""amp frontend + policy tests (reference: tests/L0/run_amp/test_basic_casts.py,
+test_promotion.py, test_checkpointing.py semantics)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu import amp
+
+
+# --------------------------- Properties / opt_levels ---------------------------
+
+def test_opt_level_presets():
+    p = amp.opt_levels["O2"](amp.Properties())
+    assert p.cast_model_type == "half"
+    assert p.master_weights is True
+    assert p.loss_scale == "dynamic"
+    p = amp.opt_levels["O1"](amp.Properties())
+    assert p.patch_torch_functions is True
+    assert p.cast_model_type is None
+    p = amp.opt_levels["O0"](amp.Properties())
+    assert p.loss_scale == 1.0
+    p = amp.opt_levels["O3"](amp.Properties())
+    assert p.master_weights is False
+
+
+def test_properties_validation():
+    p = amp.opt_levels["O1"](amp.Properties())
+    with pytest.raises(RuntimeError):
+        p.keep_batchnorm_fp32 = True  # O1 forbids explicit BN override
+    with pytest.raises(RuntimeError):
+        p.master_weights = True
+    p2 = amp.opt_levels["O2"](amp.Properties())
+    p2.keep_batchnorm_fp32 = "False"
+    assert p2.keep_batchnorm_fp32 is False
+    with pytest.raises(AttributeError):
+        p2.not_an_option = 1
+
+
+def test_bad_opt_level():
+    with pytest.raises(RuntimeError):
+        amp.initialize({"w": jnp.zeros(2)}, opt_level="O4")
+
+
+# --------------------------- initialize: param casting ---------------------------
+
+def _toy_params():
+    return {
+        "dense": {"kernel": jnp.ones((4, 4), jnp.float32),
+                  "bias": jnp.zeros((4,), jnp.float32)},
+        "batch_norm": {"scale": jnp.ones((4,), jnp.float32),
+                       "bias": jnp.zeros((4,), jnp.float32)},
+    }
+
+
+def test_initialize_o2_casts_except_bn():
+    params = amp.initialize(_toy_params(), opt_level="O2", verbosity=0)
+    assert params["dense"]["kernel"].dtype == jnp.bfloat16
+    assert params["batch_norm"]["scale"].dtype == jnp.float32  # keep_batchnorm_fp32
+
+
+def test_initialize_o3_casts_everything():
+    params = amp.initialize(_toy_params(), opt_level="O3", verbosity=0)
+    assert params["dense"]["kernel"].dtype == jnp.bfloat16
+    assert params["batch_norm"]["scale"].dtype == jnp.bfloat16
+
+
+def test_initialize_o1_o0_keep_fp32_params():
+    for lvl in ("O0", "O1"):
+        params = amp.initialize(_toy_params(), opt_level=lvl, verbosity=0)
+        assert params["dense"]["kernel"].dtype == jnp.float32
+
+
+def test_initialize_fp16_override():
+    params = amp.initialize(_toy_params(), opt_level="O2",
+                            half_dtype=jnp.float16, verbosity=0)
+    assert params["dense"]["kernel"].dtype == jnp.float16
+
+
+# --------------------------- policy interpreter (O1 analog) ---------------------------
+
+def test_autocast_half_function():
+    @amp.half_function
+    def mm(a, b):
+        return a @ b
+
+    a = jnp.ones((2, 2), jnp.float32)
+    with amp.autocast(dtype=jnp.bfloat16):
+        out = mm(a, a)
+    assert out.dtype == jnp.bfloat16
+    out = mm(a, a)  # outside autocast: untouched
+    assert out.dtype == jnp.float32
+
+
+def test_autocast_float_function():
+    @amp.float_function
+    def softmax(x):
+        return jax.nn.softmax(x)
+
+    x = jnp.ones((4,), jnp.bfloat16)
+    with amp.autocast():
+        out = softmax(x)
+    assert out.dtype == jnp.float32
+
+
+def test_promote_function():
+    @amp.promote_function
+    def add(a, b):
+        return a + b
+
+    a = jnp.ones((2,), jnp.bfloat16)
+    b = jnp.ones((2,), jnp.float32)
+    with amp.autocast():
+        out = add(a, b)
+    assert out.dtype == jnp.float32
+
+
+def test_cast_table_lookup():
+    assert amp.lookup_cast("matmul") == "half"
+    assert amp.lookup_cast("softmax") == "float"
+    assert amp.lookup_cast("add") == "promote"
+    assert amp.lookup_cast("cat") == "sequence_promote"
+    assert amp.lookup_cast("relu") is None
+    with pytest.raises(NotImplementedError):
+        amp.lookup_cast("binary_cross_entropy")
+
+
+def test_cast_for_op():
+    x = jnp.ones((2, 2), jnp.float32)
+    with amp.autocast(dtype=jnp.bfloat16):
+        (xc,) = amp.cast_for_op("matmul", x)
+        assert xc.dtype == jnp.bfloat16
+        (xf,) = amp.cast_for_op("softmax", jnp.ones((2,), jnp.bfloat16))
+        assert xf.dtype == jnp.float32
+
+
+def test_disable_casts():
+    @amp.half_function
+    def mm(a, b):
+        return a @ b
+
+    a = jnp.ones((2, 2), jnp.float32)
+    with amp.autocast(dtype=jnp.bfloat16):
+        with amp.disable_casts():
+            out = mm(a, a)
+    assert out.dtype == jnp.float32
+
+
+# --------------------------- AmpOptimizer end-to-end ---------------------------
+
+def _quadratic_loss(params, target):
+    return jnp.sum((params["w"] - target) ** 2)
+
+
+def test_amp_optimizer_o2_training_step():
+    params32 = {"w": jnp.full((4,), 3.0, jnp.float32)}
+    params, opt = amp.initialize(params32, optax.sgd(0.1), opt_level="O2",
+                                 verbosity=0)
+    assert params["w"].dtype == jnp.bfloat16
+    state = opt.init(params)
+    assert state.master_params["w"].dtype == jnp.float32
+    target = jnp.zeros((4,), jnp.bfloat16)
+
+    grad_fn = amp.value_and_scaled_grad(_quadratic_loss, opt)
+    loss, grads, found_inf = grad_fn(params, state, target)
+    assert not bool(found_inf)
+    new_params, new_state, info = opt.apply_gradients(
+        grads, state, params, grads_already_unscaled=True, found_inf=found_inf)
+    # sgd on w=3, grad=2*3=6, lr=.1 → w=2.4
+    np.testing.assert_allclose(
+        np.asarray(new_state.master_params["w"]), np.full(4, 2.4), rtol=1e-2)
+    assert new_params["w"].dtype == jnp.bfloat16
+
+
+def test_amp_optimizer_skip_on_overflow():
+    params = {"w": jnp.full((4,), 3.0, jnp.float32)}
+    params, opt = amp.initialize(params, optax.sgd(0.1), opt_level="O2",
+                                 verbosity=0)
+    state = opt.init(params)
+    bad_grads = {"w": jnp.full((4,), jnp.inf, jnp.bfloat16)}
+    new_params, new_state, info = opt.apply_gradients(bad_grads, state, params)
+    assert bool(info["overflow"])
+    # params unchanged, scale halved
+    np.testing.assert_allclose(np.asarray(new_state.master_params["w"], np.float32),
+                               np.asarray(state.master_params["w"], np.float32))
+    assert float(new_state.scalers[0].loss_scale) == 2.0 ** 15
+
+
+def test_amp_optimizer_jit_full_step():
+    params = {"w": jnp.full((8,), 5.0, jnp.float32)}
+    params, opt = amp.initialize(params, optax.sgd(0.01), opt_level="O2",
+                                 verbosity=0)
+    state = opt.init(params)
+
+    @jax.jit
+    def train_step(params, state, target):
+        def loss_fn(p, t):
+            return jnp.sum((p["w"].astype(jnp.float32) - t) ** 2)
+        grad_fn = amp.value_and_scaled_grad(loss_fn, opt)
+        loss, grads, found_inf = grad_fn(params, state, target)
+        new_p, new_s, info = opt.apply_gradients(
+            grads, state, params, grads_already_unscaled=True,
+            found_inf=found_inf)
+        return new_p, new_s, loss
+
+    target = jnp.zeros((8,), jnp.float32)
+    losses = []
+    for _ in range(20):
+        params, state, loss = train_step(params, state, target)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_multi_loss_scalers():
+    params = {"w": jnp.full((4,), 3.0, jnp.float32)}
+    params, opt = amp.initialize(params, optax.sgd(0.1), opt_level="O2",
+                                 num_losses=3, verbosity=0)
+    state = opt.init(params)
+    assert len(state.scalers) == 3
+    bad = {"w": jnp.full((4,), jnp.nan, jnp.bfloat16)}
+    _, state, _ = opt.apply_gradients(bad, state, params, loss_id=1)
+    assert float(state.scalers[1].loss_scale) == 2.0 ** 15
+    assert float(state.scalers[0].loss_scale) == 2.0 ** 16  # untouched
+
+
+def test_amp_state_dict_roundtrip():
+    params = {"w": jnp.ones((2,), jnp.float32)}
+    params, opt = amp.initialize(params, optax.sgd(0.1), opt_level="O2",
+                                 verbosity=0)
+    state = opt.init(params)
+    bad = {"w": jnp.asarray([jnp.inf, 1.0], jnp.bfloat16)}
+    _, state, _ = opt.apply_gradients(bad, state, params)
+    sd = amp.state_dict([state])
+    assert sd["loss_scaler0"]["loss_scale"] == 2.0 ** 15
+    fresh = opt.init(params)
+    [restored] = amp.load_state_dict(sd, [fresh])
+    assert float(restored.scalers[0].loss_scale) == 2.0 ** 15

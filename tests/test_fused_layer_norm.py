@@ -1,0 +1,86 @@
+"""FusedLayerNorm/FusedRMSNorm forward/backward parity vs torch
+(reference: tests/L0/run_fused_layer_norm/test_fused_layer_norm.py —
+apex vs torch.nn.LayerNorm across shapes/dtypes, fwd + bwd)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import torch
+
+from apex_tpu.normalization import (
+    FusedLayerNorm, FusedRMSNorm, fused_layer_norm, fused_rms_norm,
+)
+
+SHAPES = [((2, 3, 8), (8,)), ((4, 16), (16,)), ((2, 5, 4, 6), (4, 6))]
+
+
+@pytest.mark.parametrize("shape,norm_shape", SHAPES)
+@pytest.mark.parametrize("affine", [True, False])
+def test_layer_norm_forward_vs_torch(shape, norm_shape, affine):
+    rng = np.random.RandomState(0)
+    x = rng.randn(*shape).astype(np.float32)
+    w = rng.rand(*norm_shape).astype(np.float32) + 0.5 if affine else None
+    b = rng.randn(*norm_shape).astype(np.float32) if affine else None
+
+    tln = torch.nn.functional.layer_norm(
+        torch.tensor(x), norm_shape,
+        torch.tensor(w) if affine else None,
+        torch.tensor(b) if affine else None, eps=1e-5)
+    got = fused_layer_norm(jnp.asarray(x), norm_shape,
+                           jnp.asarray(w) if affine else None,
+                           jnp.asarray(b) if affine else None, eps=1e-5)
+    np.testing.assert_allclose(tln.numpy(), np.asarray(got), rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("shape,norm_shape", SHAPES[:2])
+def test_layer_norm_backward_vs_torch(shape, norm_shape):
+    rng = np.random.RandomState(1)
+    x = rng.randn(*shape).astype(np.float32)
+    w = rng.rand(*norm_shape).astype(np.float32) + 0.5
+    b = rng.randn(*norm_shape).astype(np.float32)
+
+    xt = torch.tensor(x, requires_grad=True)
+    wt = torch.tensor(w, requires_grad=True)
+    bt = torch.tensor(b, requires_grad=True)
+    torch.nn.functional.layer_norm(xt, norm_shape, wt, bt, eps=1e-5).sum().backward()
+
+    def f(x, w, b):
+        return jnp.sum(fused_layer_norm(x, norm_shape, w, b, eps=1e-5))
+
+    gx, gw, gb = jax.grad(f, argnums=(0, 1, 2))(
+        jnp.asarray(x), jnp.asarray(w), jnp.asarray(b))
+    np.testing.assert_allclose(xt.grad.numpy(), np.asarray(gx), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(wt.grad.numpy(), np.asarray(gw), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(bt.grad.numpy(), np.asarray(gb), rtol=1e-4, atol=1e-4)
+
+
+def test_rms_norm_vs_manual():
+    rng = np.random.RandomState(2)
+    x = rng.randn(4, 16).astype(np.float32)
+    w = rng.rand(16).astype(np.float32) + 0.5
+    ms = np.mean(x ** 2, axis=-1, keepdims=True)
+    want = x / np.sqrt(ms + 1e-5) * w
+    got = fused_rms_norm(jnp.asarray(x), (16,), jnp.asarray(w), eps=1e-5)
+    np.testing.assert_allclose(want, np.asarray(got), rtol=1e-5, atol=1e-5)
+
+
+def test_half_dtype_output():
+    x = jnp.ones((4, 8), jnp.bfloat16)
+    out = fused_layer_norm(x, (8,))
+    assert out.dtype == jnp.bfloat16  # stats in fp32, output back to input dtype
+
+
+def test_modules():
+    mod = FusedLayerNorm(normalized_shape=(8,))
+    x = jnp.ones((2, 8))
+    params = mod.init(jax.random.PRNGKey(0), x)
+    y = mod.apply(params, x)
+    assert y.shape == (2, 8)
+    assert params["params"]["weight"].shape == (8,)
+
+    mod = FusedRMSNorm(normalized_shape=8, elementwise_affine=False)
+    params = mod.init(jax.random.PRNGKey(0), x)
+    y = mod.apply(params, x)
+    assert y.shape == (2, 8)

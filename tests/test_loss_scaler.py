@@ -1,0 +1,105 @@
+"""LossScaler state-machine tests (reference semantics:
+apex/amp/scaler.py:38-55,197-217 — init 2**16, x2 every scale_window
+unskipped steps, /2 on overflow, min/max clamps)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp import LossScaler
+
+
+def test_dynamic_init_and_scale():
+    s = LossScaler(loss_scale="dynamic")
+    st = s.init()
+    assert float(st.loss_scale) == 2.0 ** 16
+    loss = jnp.asarray(2.0)
+    assert float(s.scale(loss, st)) == 2.0 * 2.0 ** 16
+
+
+def test_overflow_halves_scale():
+    s = LossScaler(loss_scale="dynamic")
+    st = s.init()
+    grads = {"w": jnp.asarray([1.0, jnp.inf])}
+    _, st2, skip = s.unscale_and_update(grads, st)
+    assert bool(skip)
+    assert float(st2.loss_scale) == 2.0 ** 15
+    assert int(st2.unskipped) == 0
+
+
+def test_growth_after_window():
+    s = LossScaler(loss_scale="dynamic", scale_window=3)
+    st = s.init()
+    grads = {"w": jnp.asarray([1.0, 2.0])}
+    for i in range(3):
+        _, st, skip = s.unscale_and_update(grads, st)
+        assert not bool(skip)
+    assert float(st.loss_scale) == 2.0 ** 17
+    assert int(st.unskipped) == 0
+
+
+def test_max_clamp():
+    s = LossScaler(loss_scale="dynamic", scale_window=1, max_loss_scale=2.0 ** 17)
+    st = s.init()
+    grads = {"w": jnp.asarray([1.0])}
+    for _ in range(5):
+        _, st, _ = s.unscale_and_update(grads, st)
+    assert float(st.loss_scale) == 2.0 ** 17
+
+
+def test_min_clamp():
+    s = LossScaler(loss_scale="dynamic", min_loss_scale=2.0 ** 15)
+    st = s.init()
+    grads = {"w": jnp.asarray([jnp.nan])}
+    for _ in range(5):
+        _, st, _ = s.unscale_and_update(grads, st)
+    assert float(st.loss_scale) == 2.0 ** 15
+
+
+def test_static_scale():
+    s = LossScaler(loss_scale=128.0)
+    st = s.init()
+    assert float(st.loss_scale) == 128.0
+    grads = {"w": jnp.asarray([256.0])}
+    unscaled, st2, skip = s.unscale_and_update(grads, st)
+    assert not bool(skip)
+    assert float(st2.loss_scale) == 128.0
+    np.testing.assert_allclose(np.asarray(unscaled["w"]), [2.0])
+
+
+def test_unscale_values():
+    s = LossScaler(loss_scale="dynamic")
+    st = s.init()
+    g = {"w": jnp.asarray([2.0 ** 16, 2.0 ** 17])}
+    unscaled, found_inf = s.unscale(g, st)
+    assert not bool(found_inf)
+    np.testing.assert_allclose(np.asarray(unscaled["w"]), [1.0, 2.0])
+
+
+def test_state_dict_roundtrip():
+    s = LossScaler(loss_scale="dynamic")
+    st = s.init()
+    grads = {"w": jnp.asarray([jnp.inf])}
+    _, st, _ = s.unscale_and_update(grads, st)
+    d = LossScaler.state_dict(st)
+    st2 = LossScaler.load_state_dict(s.init(), d)
+    assert float(st2.loss_scale) == float(st.loss_scale)
+    assert int(st2.unskipped) == int(st.unskipped)
+
+
+def test_jit_safe():
+    s = LossScaler(loss_scale="dynamic")
+    st = s.init()
+
+    @jax.jit
+    def step(grads, st):
+        return s.unscale_and_update(grads, st)
+
+    g_ok = {"w": jnp.asarray([1.0])}
+    g_bad = {"w": jnp.asarray([jnp.inf])}
+    _, st, skip = step(g_ok, st)
+    assert not bool(skip)
+    _, st, skip = step(g_bad, st)
+    assert bool(skip)
+    assert float(st.loss_scale) == 2.0 ** 15

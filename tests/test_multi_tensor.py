@@ -1,0 +1,81 @@
+"""Port of the multi_tensor kernel micro-tests
+(reference: tests/L0/run_amp/test_multi_tensor_{scale,axpby,l2norm}.py):
+fused ops vs per-tensor reference math, across dtype grids + overflow
+injection."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from apex_tpu.multi_tensor_apply import (
+    multi_tensor_applier,
+    multi_tensor_scale,
+    multi_tensor_axpby,
+    multi_tensor_l2norm,
+    multi_tensor_l2norm_per_tensor,
+    flatten,
+    unflatten,
+)
+
+SHAPES = [(3,), (4, 5), (2, 3, 4), (1,)]
+DTYPES = [jnp.float32, jnp.bfloat16, jnp.float16]
+
+
+def _make(shapes, dtype, seed=0):
+    rng = np.random.RandomState(seed)
+    return [jnp.asarray(rng.randn(*s), dtype=dtype) for s in shapes]
+
+
+def test_flatten_unflatten_roundtrip():
+    ts = _make(SHAPES, jnp.float32)
+    flat = flatten(ts)
+    assert flat.shape == (sum(int(np.prod(s)) for s in SHAPES),)
+    back = unflatten(flat, ts)
+    for a, b in zip(ts, back):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("in_dtype", DTYPES)
+@pytest.mark.parametrize("out_dtype", [jnp.float32, jnp.float16])
+def test_scale(in_dtype, out_dtype):
+    srcs = _make(SHAPES, in_dtype)
+    dsts = _make(SHAPES, out_dtype, seed=1)
+    outs, noop = multi_tensor_applier(multi_tensor_scale, [srcs, dsts], 0.5)
+    assert int(noop) == 0
+    for s, o in zip(srcs, outs):
+        assert o.dtype == out_dtype
+        np.testing.assert_allclose(
+            np.asarray(s, np.float32) * 0.5, np.asarray(o, np.float32),
+            rtol=1e-2 if out_dtype != jnp.float32 else 1e-6)
+
+
+def test_scale_overflow_flag():
+    srcs = _make(SHAPES, jnp.float32)
+    srcs[1] = srcs[1].at[0, 0].set(jnp.inf)
+    _, noop = multi_tensor_scale([srcs, srcs], 1.0)
+    assert int(noop) == 1
+    srcs[1] = srcs[1].at[0, 0].set(jnp.nan)
+    _, noop = multi_tensor_scale([srcs, srcs], 1.0)
+    assert int(noop) == 1
+
+
+def test_axpby():
+    xs = _make(SHAPES, jnp.float32, seed=2)
+    ys = _make(SHAPES, jnp.float32, seed=3)
+    outs, noop = multi_tensor_axpby([xs, ys, xs], 2.0, -3.0)
+    assert int(noop) == 0
+    for x, y, o in zip(xs, ys, outs):
+        np.testing.assert_allclose(
+            2.0 * np.asarray(x) - 3.0 * np.asarray(y), np.asarray(o), rtol=1e-6)
+
+
+def test_l2norm():
+    ts = _make(SHAPES, jnp.float32, seed=4)
+    got = float(multi_tensor_l2norm(ts))
+    want = np.sqrt(sum(np.sum(np.asarray(t) ** 2) for t in ts))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    g, per = multi_tensor_l2norm_per_tensor(ts)
+    np.testing.assert_allclose(float(g), want, rtol=1e-6)
+    for t, p in zip(ts, np.asarray(per)):
+        np.testing.assert_allclose(np.linalg.norm(np.asarray(t).ravel()), p, rtol=1e-5)
