@@ -1,0 +1,14 @@
+from apex_tpu.fused_dense.fused_dense import (
+    FusedDense,
+    FusedDenseGeluDense,
+    DenseNoBias,
+    fused_dense_function,
+    dense_no_bias_function,
+    fused_dense_gelu_dense_function,
+)
+
+__all__ = [
+    "FusedDense", "FusedDenseGeluDense", "DenseNoBias",
+    "fused_dense_function", "dense_no_bias_function",
+    "fused_dense_gelu_dense_function",
+]

@@ -1,0 +1,74 @@
+"""mlp.MLP — whole-MLP fused forward/backward.
+
+Capability port of apex.mlp (reference: apex/mlp/mlp.py:12-87; CUDA
+csrc/mlp_cuda.cu — chained cublas GEMMs with fused bias/activation
+epilogues in one autograd Function). Under XLA the layer chain compiles to
+exactly that (GEMM + fused epilogue per layer), so the module is the API:
+``mlp_sizes`` like the reference, activation ∈ {none, relu, sigmoid}.
+"""
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from apex_tpu.amp import policy as _policy
+
+_ACTS = {
+    "none": lambda x: x,
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+}
+
+
+def mlp_function(x, weights, biases, activation="relu"):
+    """Functional N-layer MLP (reference: mlp.py:12-40 MlpFunction).
+
+    ``weights[i]``: [out_i, in_i] (torch layout); activation applied to all
+    layers except the last (matching mlp_cuda.forward).
+    """
+    if activation not in _ACTS:
+        raise TypeError(f"activation must be relu or none or sigmoid, got {activation}")
+    act = _ACTS[activation]
+    dt = _policy.compute_dtype(x.dtype)
+    h = x.astype(dt)
+    n = len(weights)
+    for i, (w, b) in enumerate(zip(weights, biases)):
+        h = jax.lax.dot_general(
+            h, w.astype(dt), (((h.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dt)
+        if b is not None:
+            h = h + b.astype(dt)
+        if i < n - 1:
+            h = act(h)
+    return h
+
+
+class MLP(nn.Module):
+    """Module surface of apex.mlp.MLP (reference: mlp.py:43-87).
+
+    ``mlp_sizes``: e.g. [in, hidden1, hidden2, out].
+    """
+
+    mlp_sizes: Sequence[int]
+    bias: bool = True
+    relu: bool = True  # legacy flag (reference kept it alongside activation)
+    activation: str = "relu"
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        activation = self.activation if self.relu else "none"
+        weights, biases = [], []
+        for i in range(len(self.mlp_sizes) - 1):
+            fan_in, fan_out = self.mlp_sizes[i], self.mlp_sizes[i + 1]
+            w = self.param(f"weight_{i}", nn.initializers.lecun_normal(),
+                           (fan_out, fan_in), self.param_dtype)
+            weights.append(w)
+            if self.bias:
+                biases.append(self.param(f"bias_{i}", nn.initializers.zeros,
+                                         (fan_out,), self.param_dtype))
+            else:
+                biases.append(None)
+        return mlp_function(x, weights, biases, activation)

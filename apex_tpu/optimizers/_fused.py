@@ -37,9 +37,20 @@ class FlatMeta:
 
     @property
     def seg_ids(self):
-        if self._seg_dev is None:
-            self._seg_dev = jnp.asarray(self._seg)
-        return self._seg_dev
+        # Cache the device array only when built outside any trace —
+        # materializing it inside jit/shard_map and reusing it later would
+        # leak a tracer, while re-uploading a [total]-sized array on every
+        # eager step would be pure H2D waste.
+        try:
+            from jax._src.core import trace_state_clean
+        except ImportError:  # future jax: fall back to no caching
+            return jnp.asarray(self._seg)
+
+        if trace_state_clean():
+            if self._seg_dev is None:
+                self._seg_dev = jnp.asarray(self._seg)
+            return self._seg_dev
+        return jnp.asarray(self._seg)
 
     def flatten(self, params, dtype=jnp.float32):
         if not params:

@@ -94,8 +94,7 @@ class FusedAdam(FusedOptimizerBase):
 
     def __init__(self, params, lr=1e-3, bias_correction=True,
                  betas=(0.9, 0.999), eps=1e-8, adam_w_mode=True,
-                 weight_decay=0.0, amsgrad=False, set_grad_none=True,
-                 capturable=False, master_weights=False):
+                 weight_decay=0.0, amsgrad=False, set_grad_none=True):
         if amsgrad:
             raise RuntimeError("FusedAdam does not support the AMSGrad variant.")
         super().__init__(params, dict(lr=lr, bias_correction=bias_correction,

@@ -1,0 +1,105 @@
+"""LARC — Layer-wise Adaptive Rate Clipping/Scaling.
+
+Capability port of apex.parallel.LARC (reference: apex/parallel/LARC.py:5-107):
+wraps any optimizer, computing per-parameter adaptive LR
+``trust_coefficient * |p| / (|g| + wd*|p| + eps)`` and either clipping
+(min with 1 relative to group lr) or scaling the gradient by it before the
+wrapped optimizer runs. Two surfaces: an optax ``larc(...)`` transform to
+chain before any inner transform, and a ``LARC`` class wrapping the
+torch-like fused optimizer classes.
+"""
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.optimizers._fused import get_meta
+
+
+def larc(trust_coefficient=0.02, clip=True, eps=1e-8, weight_decay=0.0,
+         learning_rate=None):
+    """optax transform applying LARC gradient scaling (reference math:
+    LARC.py:81-107). Chain as ``optax.chain(larc(...), inner_tx)``.
+
+    With ``clip=True`` the adaptive lr is min(adaptive/lr, 1) relative to
+    ``learning_rate`` (required for clip mode, as in the reference where the
+    group lr is consulted).
+    """
+    if clip and learning_rate is None:
+        raise ValueError("clip mode needs the group learning_rate")
+
+    def init(params):
+        return optax.EmptyState()
+
+    def update(grads, state, params=None):
+        assert params is not None
+        leaves_g, treedef = jax.tree_util.tree_flatten(grads)
+        leaves_p = jax.tree_util.tree_leaves(params)
+        meta = get_meta(leaves_p)
+        g = meta.flatten(leaves_g)
+        p = meta.flatten(leaves_p)
+        p_norm = jnp.sqrt(meta.per_tensor_sq_norms(p))
+        g_norm = jnp.sqrt(meta.per_tensor_sq_norms(g))
+        adaptive = trust_coefficient * p_norm / (
+            g_norm + weight_decay * p_norm + eps)
+        # reference: skip adaptation when either norm is 0 (LARC.py:90)
+        adaptive = jnp.where((p_norm > 0) & (g_norm > 0), adaptive, 1.0)
+        if clip:
+            adaptive = jnp.minimum(adaptive / learning_rate, 1.0)
+        if weight_decay != 0:
+            g = g + weight_decay * p
+        g = meta.broadcast_per_tensor(adaptive) * g
+        out = jax.tree_util.tree_unflatten(
+            treedef, meta.unflatten(g, [x.dtype for x in leaves_g]))
+        return out, state
+
+    return optax.GradientTransformation(init, update)
+
+
+class LARC:
+    """Class surface wrapping a fused optimizer instance
+    (reference: LARC.py:5 — ``LARC(optimizer, trust_coefficient=...)``)."""
+
+    def __init__(self, optimizer, trust_coefficient=0.02, clip=True, eps=1e-8):
+        self.optim = optimizer
+        self.trust_coefficient = trust_coefficient
+        self.clip = clip
+        self.eps = eps
+
+    @property
+    def param_groups(self):
+        return self.optim.param_groups
+
+    @property
+    def state(self):
+        return self.optim.state
+
+    def step(self, grads):
+        if len(self.param_groups) == 1 and (
+            not grads or not isinstance(grads[0], (list, tuple))
+        ):
+            grads = [grads]
+        new_grads = []
+        for group, g_list in zip(self.optim.param_groups, grads):
+            wd = group.get("weight_decay", 0.0)
+            lr = group["lr"]
+            tx = larc(self.trust_coefficient, self.clip, self.eps,
+                      weight_decay=wd, learning_rate=lr)
+            scaled, _ = tx.update(list(g_list), optax.EmptyState(),
+                                  group["params"])
+            new_grads.append(scaled)
+            # reference zeroes group wd so it isn't applied twice (LARC.py:97)
+        saved_wd = [g.get("weight_decay", 0.0) for g in self.optim.param_groups]
+        for g in self.optim.param_groups:
+            if "weight_decay" in g:
+                g["weight_decay"] = 0.0
+        try:
+            out = self.optim.step(new_grads if len(new_grads) > 1 else new_grads[0])
+        finally:
+            for g, wd in zip(self.optim.param_groups, saved_wd):
+                if "weight_decay" in g:
+                    g["weight_decay"] = wd
+        return out
+
+    def zero_grad(self, set_to_none=True):
+        self.optim.zero_grad(set_to_none)

@@ -1,0 +1,28 @@
+"""apex_tpu.parallel — data parallelism + synced BN.
+
+Reference surface: apex/parallel/__init__.py:9-95 (DistributedDataParallel,
+Reducer, SyncBatchNorm, convert_syncbn_model, create_syncbn_process_group,
+LARC, multiproc). NCCL process groups become mesh axis names; collectives
+are XLA psum/all_gather over ICI.
+"""
+
+from apex_tpu.parallel.distributed import (
+    pvary,
+    DistributedDataParallel,
+    Reducer,
+    allreduce_gradients,
+    broadcast_params,
+)
+from apex_tpu.parallel.sync_batchnorm import (
+    SyncBatchNorm,
+    sync_batch_norm,
+    convert_syncbn_model,
+    create_syncbn_process_group,
+)
+from apex_tpu.parallel.LARC import LARC, larc
+
+__all__ = [
+    "DistributedDataParallel", "Reducer", "allreduce_gradients",
+    "pvary", "broadcast_params", "SyncBatchNorm", "sync_batch_norm",
+    "convert_syncbn_model", "create_syncbn_process_group", "LARC", "larc",
+]

@@ -1,0 +1,154 @@
+"""Data-parallel gradient synchronization.
+
+Capability port of apex.parallel.DistributedDataParallel + Reducer
+(reference: apex/parallel/distributed.py:89-639). The reference's machinery —
+per-param backward hooks, greedy bucket assembly, rank-0 bucket-structure
+broadcast, multi-stream flatten/allreduce/unflatten overlap — exists to hide
+NCCL latency behind eager-mode backward. Under XLA none of that is manual:
+gradients live in one jitted computation, ``psum`` over a mesh axis is an
+async collective the latency-hiding scheduler overlaps with the remaining
+backward automatically, and "buckets" are XLA's collective-combining pass.
+
+What survives as *semantics* (and is preserved here):
+  * gradient averaging over the data-parallel group (``gradient_average``)
+  * ``allreduce_always_fp32`` — upcast before the reduction
+  * ``gradient_predivide_factor`` — divide by f before, world/f after
+    (distributed.py:148-175)
+  * param broadcast at init → ``broadcast_params`` (distributed.py:253)
+Bucket/stream knobs are accepted and ignored (documented no-ops).
+
+Use inside ``shard_map``/``pmap`` over a mesh with a data axis; under plain
+``pjit`` with sharded batches XLA inserts the same psum from the loss mean.
+
+NOTE on shard_map's varying-type system (jax >= 0.8): differentiating wrt a
+*replicated* (invariant) param auto-inserts the cross-replica psum — grads
+arrive already summed, and calling ``average_gradients`` on them would
+double-count. The apex-DDP model (each replica owns a param copy, grads
+reduced explicitly) corresponds to *varying* params: apply
+``jax.lax.pvary(params, axis_name)`` before the local grad, then
+``average_gradients``. ``broadcast_params`` returns varying params.
+"""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+
+
+def pvary(x, axis_name):
+    """invariant → varying cast (per-replica ownership); wraps the current
+    jax spelling (lax.pcast, with fallback to the older lax.pvary)."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis_name, to="varying")
+    return jax.lax.pvary(x, axis_name)
+
+
+def allreduce_gradients(grads, axis_name="data", gradient_average=True,
+                        allreduce_always_fp32=False,
+                        gradient_predivide_factor=1.0):
+    """All-reduce (mean) a gradient pytree over ``axis_name``.
+
+    The functional core of DDP (reference hot path:
+    apex/parallel/distributed.py:425-475 allreduce_bucket →
+    allreduce_maybe_retain). One psum per dtype-group; XLA combines and
+    overlaps.
+    """
+    world = jax.lax.psum(1, axis_name)
+
+    def reduce_one(g):
+        orig = g.dtype
+        if allreduce_always_fp32:
+            g = g.astype(jnp.float32)
+        if gradient_predivide_factor != 1.0:
+            g = g / gradient_predivide_factor
+        g = jax.lax.psum(g, axis_name)
+        if gradient_average:
+            post = world / gradient_predivide_factor if gradient_predivide_factor != 1.0 else world
+            g = g / post
+        elif gradient_predivide_factor != 1.0:
+            g = g * gradient_predivide_factor
+        return g.astype(orig) if allreduce_always_fp32 else g
+
+    return jax.tree_util.tree_map(reduce_one, grads)
+
+
+def broadcast_params(params, axis_name="data", src_index=0):
+    """Make params identical across the axis by broadcasting rank 0's copy
+    (reference: flat_dist_call broadcast at distributed.py:253,296)."""
+
+    def bcast(p):
+        idx = jax.lax.axis_index(axis_name)
+        masked = jnp.where(idx == src_index, p, jnp.zeros_like(p))
+        # psum yields an *invariant* (replicated-type) value; re-pvary so the
+        # result keeps DDP's per-replica ownership semantics — otherwise
+        # later grads wrt it would be auto-psum'd by shard_map's type system
+        # and an explicit average_gradients would double-count.
+        return pvary(jax.lax.psum(masked, axis_name), axis_name)
+
+    return jax.tree_util.tree_map(bcast, params)
+
+
+class DistributedDataParallel:
+    """Stateless config object mirroring the reference ctor
+    (apex/parallel/distributed.py:129-175); call ``average_gradients``
+    inside your shard_map'd step.
+
+    ``message_size``/``num_allreduce_streams``/``delay_allreduce``/
+    ``allreduce_trigger_params``/``retain_allreduce_buffers`` are
+    eager-NCCL artifacts — accepted, warned once, ignored (XLA's collective
+    combiner and async scheduler subsume them).
+    """
+
+    def __init__(self, module=None, message_size=10000000,
+                 delay_allreduce=False, shared_param=None,
+                 allreduce_trigger_params=None, retain_allreduce_buffers=False,
+                 allreduce_always_fp32=False, num_allreduce_streams=1,
+                 allreduce_communicators=None, gradient_average=True,
+                 gradient_predivide_factor=1.0, gradient_average_split_factor=None,
+                 prof=False, axis_name="data"):
+        if shared_param is not None:
+            raise ValueError(
+                "shared_param is no longer supported as an option.")
+        self.module = module
+        self.axis_name = axis_name
+        self.allreduce_always_fp32 = allreduce_always_fp32
+        self.gradient_average = gradient_average
+        self.gradient_predivide_factor = gradient_predivide_factor
+        for name, val, default in (
+            ("message_size", message_size, 10000000),
+            ("delay_allreduce", delay_allreduce, False),
+            ("num_allreduce_streams", num_allreduce_streams, 1),
+            ("retain_allreduce_buffers", retain_allreduce_buffers, False),
+        ):
+            if val != default:
+                warnings.warn(
+                    f"apex_tpu DDP: `{name}` is a CUDA-stream/bucketing knob "
+                    "with no TPU counterpart — XLA handles collective "
+                    "combining and overlap; option ignored.")
+
+    def average_gradients(self, grads):
+        return allreduce_gradients(
+            grads, self.axis_name,
+            gradient_average=self.gradient_average,
+            allreduce_always_fp32=self.allreduce_always_fp32,
+            gradient_predivide_factor=self.gradient_predivide_factor)
+
+    def broadcast_params(self, params):
+        return broadcast_params(params, self.axis_name)
+
+    def __call__(self, *args, **kwargs):
+        if self.module is None:
+            raise ValueError("DistributedDataParallel was built without a module")
+        return self.module(*args, **kwargs)
+
+
+class Reducer:
+    """Manual, user-triggered grad reduction (reference:
+    apex/parallel/distributed.py:89-126 — for delayed/periodic allreduce)."""
+
+    def __init__(self, module_or_grads_list=None, axis_name="data"):
+        self.axis_name = axis_name
+        self.module = module_or_grads_list
+
+    def reduce(self, grads):
+        return allreduce_gradients(grads, self.axis_name)

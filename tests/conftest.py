@@ -3,13 +3,16 @@
 Multi-chip behaviour is tested on a virtual 8-device CPU mesh
 (``--xla_force_host_platform_device_count=8``) — the TPU analog of the
 reference's single-node multi-process NCCL test base
-(apex/transformer/testing/distributed_test_base.py:27-45). Must run before
-any jax import.
+(apex/transformer/testing/distributed_test_base.py:27-45).
+
+NB: the ``JAX_PLATFORMS`` env var is overridden by the axon TPU plugin in
+this environment; ``jax.config.update("jax_platforms", ...)`` is what
+actually forces the CPU backend. XLA_FLAGS must still be set before the
+backend initializes.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -18,4 +21,9 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
+
+assert len(jax.devices()) == 8, (
+    f"expected 8 virtual CPU devices, got {jax.devices()}"
+)

@@ -1,0 +1,123 @@
+"""fused_dense + MLP parity tests
+(reference: tests/L0/run_mlp/test_mlp.py — MLP vs unfused sequential;
+apex/fused_dense tests)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import torch
+
+from apex_tpu.fused_dense import (
+    FusedDense, FusedDenseGeluDense, fused_dense_function,
+    fused_dense_gelu_dense_function,
+)
+from apex_tpu.mlp import MLP, mlp_function
+from apex_tpu import amp
+
+
+def test_fused_dense_vs_torch():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 8).astype(np.float32)
+    w = rng.randn(16, 8).astype(np.float32)
+    b = rng.randn(16).astype(np.float32)
+    want = torch.nn.functional.linear(
+        torch.tensor(x), torch.tensor(w), torch.tensor(b)).numpy()
+    got = fused_dense_function(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b))
+    np.testing.assert_allclose(want, np.asarray(got), rtol=1e-5, atol=1e-5)
+
+
+def test_fused_dense_gelu_dense_vs_torch():
+    rng = np.random.RandomState(1)
+    x = rng.randn(4, 8).astype(np.float32)
+    w1 = rng.randn(16, 8).astype(np.float32)
+    b1 = rng.randn(16).astype(np.float32)
+    w2 = rng.randn(8, 16).astype(np.float32)
+    b2 = rng.randn(8).astype(np.float32)
+    h = torch.nn.functional.linear(torch.tensor(x), torch.tensor(w1),
+                                   torch.tensor(b1))
+    h = torch.nn.functional.gelu(h)
+    want = torch.nn.functional.linear(h, torch.tensor(w2),
+                                      torch.tensor(b2)).numpy()
+    got = fused_dense_gelu_dense_function(
+        jnp.asarray(x), jnp.asarray(w1), jnp.asarray(b1), jnp.asarray(w2),
+        jnp.asarray(b2))
+    np.testing.assert_allclose(want, np.asarray(got), rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("activation", ["relu", "sigmoid", "none"])
+def test_mlp_vs_torch_sequential(activation):
+    """Reference: run_mlp/test_mlp.py — fused MLP vs torch Sequential."""
+    mlp_sizes = [7, 16, 8, 4]
+    rng = np.random.RandomState(2)
+    x = rng.randn(5, 7).astype(np.float32)
+    ws = [rng.randn(mlp_sizes[i + 1], mlp_sizes[i]).astype(np.float32)
+          for i in range(3)]
+    bs = [rng.randn(mlp_sizes[i + 1]).astype(np.float32) for i in range(3)]
+
+    h = torch.tensor(x)
+    for i in range(3):
+        h = torch.nn.functional.linear(h, torch.tensor(ws[i]),
+                                       torch.tensor(bs[i]))
+        if i < 2:
+            if activation == "relu":
+                h = torch.relu(h)
+            elif activation == "sigmoid":
+                h = torch.sigmoid(h)
+    want = h.numpy()
+    got = mlp_function(jnp.asarray(x), [jnp.asarray(w) for w in ws],
+                       [jnp.asarray(b) for b in bs], activation)
+    np.testing.assert_allclose(want, np.asarray(got), rtol=1e-4, atol=1e-4)
+
+
+def test_mlp_backward_vs_torch():
+    mlp_sizes = [4, 8, 2]
+    rng = np.random.RandomState(3)
+    x = rng.randn(3, 4).astype(np.float32)
+    ws = [rng.randn(8, 4).astype(np.float32), rng.randn(2, 8).astype(np.float32)]
+    bs = [rng.randn(8).astype(np.float32), rng.randn(2).astype(np.float32)]
+
+    xt = torch.tensor(x, requires_grad=True)
+    wts = [torch.tensor(w, requires_grad=True) for w in ws]
+    bts = [torch.tensor(b, requires_grad=True) for b in bs]
+    h = torch.relu(torch.nn.functional.linear(xt, wts[0], bts[0]))
+    h = torch.nn.functional.linear(h, wts[1], bts[1])
+    h.sum().backward()
+
+    def f(x, ws, bs):
+        return jnp.sum(mlp_function(x, ws, bs, "relu"))
+
+    gx, gws, gbs = jax.grad(f, argnums=(0, 1, 2))(
+        jnp.asarray(x), [jnp.asarray(w) for w in ws],
+        [jnp.asarray(b) for b in bs])
+    np.testing.assert_allclose(xt.grad.numpy(), np.asarray(gx), rtol=1e-4,
+                               atol=1e-5)
+    for wt, gw in zip(wts, gws):
+        np.testing.assert_allclose(wt.grad.numpy(), np.asarray(gw), rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_mlp_bad_activation():
+    with pytest.raises(TypeError):
+        mlp_function(jnp.ones((2, 2)), [jnp.ones((2, 2))], [None], "tanh")
+
+
+def test_modules_and_autocast():
+    mod = MLP(mlp_sizes=[4, 8, 2])
+    x = jnp.ones((3, 4))
+    params = mod.init(jax.random.PRNGKey(0), x)
+    y = mod.apply(params, x)
+    assert y.shape == (3, 2)
+    with amp.autocast(dtype=jnp.bfloat16):
+        y16 = mod.apply(params, x)
+    assert y16.dtype == jnp.bfloat16  # matmuls ran in the policy dtype
+
+    d = FusedDense(in_features=4, out_features=6)
+    params = d.init(jax.random.PRNGKey(0), x)
+    assert d.apply(params, x).shape == (3, 6)
+
+    g = FusedDenseGeluDense(in_features=4, intermediate_features=8,
+                            out_features=4)
+    params = g.init(jax.random.PRNGKey(0), x)
+    assert g.apply(params, x).shape == (3, 4)

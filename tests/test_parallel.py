@@ -1,0 +1,223 @@
+"""Data-parallel tests on the 8-device CPU mesh.
+
+Ports: tests/distributed/DDP/ddp_race_condition_test.py (math-check of
+reduced grads), tests/distributed/synced_batchnorm/ (synced BN == full-batch
+BN parity, fwd+bwd), tests/L0/run_amp/test_larc.py.
+"""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from apex_tpu.parallel import (
+    convert_syncbn_model,
+    DistributedDataParallel, allreduce_gradients, broadcast_params,
+    SyncBatchNorm, sync_batch_norm, LARC, larc,
+)
+from apex_tpu.optimizers import FusedSGD
+
+NDEV = 8
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:NDEV]), ("data",))
+
+
+def test_allreduce_gradients_mean():
+    mesh = _mesh()
+    grads = {"w": jnp.arange(NDEV * 3, dtype=jnp.float32).reshape(NDEV, 3)}
+
+    f = shard_map(
+        lambda g: allreduce_gradients(g, "data"),
+        mesh=mesh, in_specs=(P("data"),), out_specs=P("data"))
+    out = f(grads)
+    want = np.mean(np.arange(NDEV * 3, dtype=np.float32).reshape(NDEV, 3),
+                   axis=0)
+    for i in range(NDEV):
+        np.testing.assert_allclose(np.asarray(out["w"][i]), want, rtol=1e-6)
+
+
+def test_allreduce_predivide_and_fp32():
+    mesh = _mesh()
+    grads = {"w": jnp.ones((NDEV, 4), jnp.bfloat16)}
+    ddp = DistributedDataParallel(allreduce_always_fp32=True,
+                                  gradient_predivide_factor=2.0)
+    f = shard_map(ddp.average_gradients, mesh=mesh, in_specs=(P("data"),),
+                  out_specs=P("data"))
+    out = f(grads)
+    assert out["w"].dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out["w"], np.float32), 1.0)
+
+
+def test_allreduce_sum_mode():
+    mesh = _mesh()
+    grads = jnp.ones((NDEV, 2))
+    f = shard_map(
+        lambda g: allreduce_gradients(g, "data", gradient_average=False),
+        mesh=mesh, in_specs=(P("data"),), out_specs=P("data"))
+    np.testing.assert_allclose(np.asarray(f(grads)), 8.0)
+
+
+def test_broadcast_params():
+    mesh = _mesh()
+    params = {"w": jnp.arange(NDEV, dtype=jnp.float32).reshape(NDEV, 1)}
+    f = shard_map(lambda p: broadcast_params(p, "data"), mesh=mesh,
+                  in_specs=(P("data"),), out_specs=P("data"))
+    out = f(params)
+    np.testing.assert_allclose(np.asarray(out["w"]), 0.0)  # rank 0's value
+
+
+def test_ddp_warns_on_bucket_knobs():
+    with pytest.warns(UserWarning, match="message_size"):
+        DistributedDataParallel(message_size=1)
+
+
+def test_ddp_grad_math_check():
+    """Port of ddp_race_condition_test.py:28-40: grad of sum(w*x) over the
+    axis must equal mean of per-rank x."""
+    mesh = _mesh()
+    w = jnp.ones((4,), jnp.float32)
+    xs = jnp.arange(NDEV * 4, dtype=jnp.float32).reshape(NDEV, 4)
+
+    def step(w, x):
+        # pvary = each replica owns its copy (the DDP model); grads are then
+        # per-replica and the explicit allreduce averages them.
+        w = jax.lax.pvary(w, "data")
+        g = jax.grad(lambda w: jnp.sum(w * x))(w)
+        return allreduce_gradients(g, "data")
+
+    f = shard_map(step, mesh=mesh, in_specs=(P(), P("data")),
+                  out_specs=P("data"))
+    out = np.asarray(f(w, xs)).reshape(NDEV, 4)  # concatenated (4,) outputs
+    want = np.mean(np.arange(NDEV * 4, dtype=np.float32).reshape(NDEV, 4), 0)
+    for i in range(NDEV):
+        np.testing.assert_allclose(out[i], want, rtol=1e-6)
+
+
+# ------------------------------ SyncBatchNorm ------------------------------
+
+def test_syncbn_matches_full_batch_bn():
+    """The core parity property: BN over the full batch == SyncBN over the
+    per-device shards (reference: tests/distributed/synced_batchnorm/
+    single_gpu_unit_test.py equivalence)."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(NDEV * 4, 16).astype(np.float32)  # [B, C]
+    mesh = _mesh()
+
+    # reference: plain full-batch BN
+    mean = x.mean(0)
+    var = x.var(0)
+    want = (x - mean) / np.sqrt(var + 1e-5)
+
+    f = shard_map(
+        lambda x: sync_batch_norm(x, None, None, axis_name="data",
+                                  training=True)[0],
+        mesh=mesh, in_specs=(P("data"),), out_specs=P("data"))
+    got = f(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
+
+
+def test_syncbn_backward_matches_full_batch():
+    rng = np.random.RandomState(1)
+    x = rng.randn(NDEV * 2, 8).astype(np.float32)
+    scale = rng.rand(8).astype(np.float32) + 0.5
+    bias = rng.randn(8).astype(np.float32)
+    mesh = _mesh()
+
+    def full_loss(x):
+        m = jnp.mean(x, 0)
+        v = jnp.mean((x - m) ** 2, 0)
+        y = (x - m) * jax.lax.rsqrt(v + 1e-5) * scale + bias
+        return jnp.sum(y ** 2)
+
+    want = jax.grad(full_loss)(jnp.asarray(x))
+
+    def sharded_loss_grad(x):
+        def loss(x):
+            y, _, _ = sync_batch_norm(x, scale, bias, axis_name="data",
+                                      training=True)
+            return jax.lax.psum(jnp.sum(y ** 2), "data")
+        return jax.grad(loss)(x)
+
+    f = shard_map(sharded_loss_grad, mesh=mesh, in_specs=(P("data"),),
+                  out_specs=P("data"))
+    got = f(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_syncbn_module_running_stats_and_eval():
+    mod = SyncBatchNorm(num_features=4, axis_name=None, momentum=0.5)
+    x = jnp.asarray(np.random.RandomState(2).randn(16, 4), jnp.float32)
+    variables = mod.init(jax.random.PRNGKey(0), x)
+    y, updated = mod.apply(variables, x, mutable=["batch_stats"])
+    rm = np.asarray(updated["batch_stats"]["running_mean"])
+    np.testing.assert_allclose(rm, 0.5 * np.asarray(x).mean(0), rtol=1e-5)
+    # eval uses running stats
+    y_eval = mod.apply(
+        {"params": variables["params"], "batch_stats": updated["batch_stats"]},
+        x, use_running_average=True)
+    assert y_eval.shape == x.shape
+
+
+def test_syncbn_fuse_relu():
+    x = jnp.asarray(np.random.RandomState(3).randn(8, 4), jnp.float32)
+    y, _, _ = sync_batch_norm(x, None, None, axis_name=None, training=True,
+                              fuse_relu=True)
+    assert float(jnp.min(y)) >= 0.0
+
+
+def test_syncbn_channels_first():
+    x = jnp.asarray(np.random.RandomState(4).randn(6, 4, 5, 5), jnp.float32)
+    y, _, _ = sync_batch_norm(x, None, None, axis_name=None, training=True,
+                              channel_axis=1)
+    got = np.asarray(y)
+    assert abs(got.mean(axis=(0, 2, 3))).max() < 1e-5  # normalized per channel
+
+
+# --------------------------------- LARC ---------------------------------
+
+def test_larc_scaling_math():
+    p = {"w": jnp.full((4,), 2.0)}
+    g = {"w": jnp.full((4,), 0.1)}
+    tx = larc(trust_coefficient=0.02, clip=False, eps=0.0)
+    scaled, _ = tx.update(g, None, p)
+    # adaptive = 0.02 * |p| / |g| = 0.02 * 4 / 0.2 = 0.4 → g*0.4
+    np.testing.assert_allclose(np.asarray(scaled["w"]), 0.04, rtol=1e-5)
+
+
+def test_larc_clip_mode():
+    p = {"w": jnp.full((4,), 2.0)}
+    g = {"w": jnp.full((4,), 0.1)}
+    tx = larc(trust_coefficient=10.0, clip=True, eps=0.0, learning_rate=0.1)
+    scaled, _ = tx.update(g, None, p)
+    # adaptive huge → clipped at 1 → grads unchanged
+    np.testing.assert_allclose(np.asarray(scaled["w"]), 0.1, rtol=1e-5)
+
+
+def test_larc_wrapping_fused_sgd():
+    params = [jnp.full((4,), 2.0)]
+    opt = LARC(FusedSGD(params, lr=0.1), trust_coefficient=0.02, clip=False)
+    out = opt.step([jnp.full((4,), 0.1)])
+    # scaled grad 0.04 → p - 0.1*0.04 = 1.996
+    np.testing.assert_allclose(np.asarray(out[0]), 1.996, rtol=1e-5)
+
+
+def test_convert_syncbn_model_from_flax_bn():
+    """Converted flax BatchNorm must infer features and actually run
+    (regression: num_features used to default to 0)."""
+    from flax import linen as nn
+    bn = nn.BatchNorm(use_running_average=False)
+    sbn = convert_syncbn_model(bn)
+    assert isinstance(sbn, SyncBatchNorm)
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 3), jnp.float32)
+    variables = sbn.init(jax.random.PRNGKey(0), x)
+    y, _ = sbn.apply(variables, x, mutable=["batch_stats"])
+    assert y.shape == (4, 3)
+    assert variables["params"]["weight"].shape == (3,)
