@@ -94,7 +94,12 @@ class FusedAdam(FusedOptimizerBase):
 
     def __init__(self, params, lr=1e-3, bias_correction=True,
                  betas=(0.9, 0.999), eps=1e-8, adam_w_mode=True,
-                 weight_decay=0.0, amsgrad=False, set_grad_none=True):
+                 weight_decay=0.0, amsgrad=False, set_grad_none=True,
+                 capturable=False, master_weights=False):
+        # capturable (CUDA-graph capture) and master_weights are accepted
+        # for reference API parity (apex/optimizers/fused_adam.py ctor):
+        # under jit every step is "captured", and master fp32 state is the
+        # default here — both are no-ops, not errors.
         if amsgrad:
             raise RuntimeError("FusedAdam does not support the AMSGrad variant.")
         super().__init__(params, dict(lr=lr, bias_correction=bias_correction,

@@ -42,12 +42,16 @@ def larc(trust_coefficient=0.02, clip=True, eps=1e-8, weight_decay=0.0,
         g_norm = jnp.sqrt(meta.per_tensor_sq_norms(g))
         adaptive = trust_coefficient * p_norm / (
             g_norm + weight_decay * p_norm + eps)
-        # reference: skip adaptation when either norm is 0 (LARC.py:90)
-        adaptive = jnp.where((p_norm > 0) & (g_norm > 0), adaptive, 1.0)
         if clip:
             adaptive = jnp.minimum(adaptive / learning_rate, 1.0)
+        # reference applies adaptation AND the wd injection only when both
+        # norms are nonzero (LARC.py:90-97) — zero-grad/frozen params pass
+        # through untouched
+        valid = (p_norm > 0) & (g_norm > 0)
+        adaptive = jnp.where(valid, adaptive, 1.0)
         if weight_decay != 0:
-            g = g + weight_decay * p
+            g = g + weight_decay * p * meta.broadcast_per_tensor(
+                valid.astype(p.dtype))
         g = meta.broadcast_per_tensor(adaptive) * g
         out = jax.tree_util.tree_unflatten(
             treedef, meta.unflatten(g, [x.dtype for x in leaves_g]))

@@ -46,7 +46,10 @@ def sync_batch_norm(x, scale, bias, axis_name=None, eps=1e-5, momentum=0.1,
             ss = jax.lax.psum(ss, axis_name)
             count = jax.lax.psum(count, axis_name)
         mean = s / count
-        var = ss / count - mean * mean  # biased (normalization) variance
+        # E[x²]−E[x]² can go (slightly) negative under fp cancellation for
+        # large-offset activations — clamp, as Welford would never produce
+        # a negative variance (reference kernel avoids this by design)
+        var = jnp.maximum(ss / count - mean * mean, 0.0)
         # running stats EMA uses the unbiased variance
         # (reference kernel.py:53-57)
         if running_mean is not None:
@@ -58,6 +61,12 @@ def sync_batch_norm(x, scale, bias, axis_name=None, eps=1e-5, momentum=0.1,
     else:
         # eval falls back to running stats (reference
         # optimized_sync_batchnorm.py:74-77)
+        if running_mean is None or running_var is None:
+            raise ValueError(
+                "sync_batch_norm(training=False) requires running_mean and "
+                "running_var; with track_running_stats=False evaluate with "
+                "batch statistics (training=True) as the reference does "
+                "(optimized_sync_batchnorm.py:85)")
         mean, var = running_mean, running_var
         new_rm, new_rv = running_mean, running_var
 
@@ -108,7 +117,10 @@ class SyncBatchNorm(nn.Module):
                                 lambda: jnp.zeros((num_features,), jnp.float32))
         ra_var = self.variable("batch_stats", "running_var",
                                lambda: jnp.ones((num_features,), jnp.float32))
-        training = not use_running_average
+        # reference passes `self.training or not self.track_running_stats`
+        # as the use-batch-stats flag (optimized_sync_batchnorm.py:85):
+        # without tracked stats, eval still normalizes with batch statistics
+        training = (not use_running_average) or (not self.track_running_stats)
         # during module init there is no mapped axis to reduce over yet
         # (same rule as flax.linen.BatchNorm)
         axis_name = None if self.is_initializing() else self.axis_name
@@ -139,6 +151,7 @@ def convert_syncbn_model(module, process_group=None, channel_last=False):
         return SyncBatchNorm(
             num_features=None,
             eps=module.epsilon, momentum=1.0 - module.momentum,
+            affine=module.use_scale or module.use_bias,
             axis_name=process_group, channel_last=channel_last)
     if isinstance(module, nn.Module) and dataclasses.is_dataclass(module):
         changes = {}

@@ -1,0 +1,24 @@
+"""apex_tpu.transformer — model-parallel transformer runtime (L5).
+
+Capability port of apex/transformer/__init__.py:1-23: parallel topology
+(mesh-axis manager), tensor/sequence parallel layers, pipeline schedules,
+TP-aware grad scaling, fused scale-mask softmax, microbatch calculators.
+"""
+
+from apex_tpu.transformer import parallel_state  # noqa: F401
+from apex_tpu.transformer import tensor_parallel  # noqa: F401
+from apex_tpu.transformer.enums import (  # noqa: F401
+    AttnMaskType,
+    AttnType,
+    LayerType,
+    ModelType,
+)
+
+
+def __getattr__(name):
+    import importlib
+
+    if name in ("pipeline_parallel", "amp", "functional", "layers",
+                "testing", "microbatches", "utils", "log_util"):
+        return importlib.import_module(f"apex_tpu.transformer.{name}")
+    raise AttributeError(f"module 'apex_tpu.transformer' has no attribute {name!r}")

@@ -1,0 +1,234 @@
+"""Model/data parallel topology over a jax.sharding.Mesh.
+
+Capability port of apex/transformer/parallel_state.py:81-660. The reference
+builds NCCL process groups for every purpose (data / tensor / pipeline /
+model / embedding) from (tp_size, pp_size, vpp_size). On TPU there are no
+process-group objects: ONE device mesh with named axes replaces them all, and
+"which group am I in" becomes "which mesh axis does the collective name".
+
+Axis layout (reference rank order, parallel_state.py:184-250: tp fastest,
+then dp, then pp slowest):
+
+    mesh shape  = (pp_size, dp_size, tp_size)
+    axis names  = ("pp", "dp", "tp")
+
+so tensor-parallel groups are ICI-adjacent device blocks (collectives on
+"tp" ride the fastest links), data-parallel groups stride tp, and pipeline
+groups stride dp*tp — exactly the reference's group construction, expressed
+as mesh geometry instead of rank lists.
+
+Rank getters come in two forms:
+  * world sizes / axis names — host-level, static, from the mesh;
+  * ``get_*_rank()`` — valid inside a traced context (``shard_map``) where
+    they lower to ``lax.axis_index``; there is no meaningful per-rank host
+    value in single-controller JAX.
+"""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis names (the reference's group names).
+TENSOR_AXIS = "tp"
+PIPELINE_AXIS = "pp"
+DATA_AXIS = "dp"
+SEQUENCE_AXIS = TENSOR_AXIS  # Megatron SP shares the TP group
+CONTEXT_AXIS = "cp"  # extension beyond the reference (ring attention)
+
+
+class _ParallelState:
+    mesh = None
+    tensor_model_parallel_size = 1
+    pipeline_model_parallel_size = 1
+    data_parallel_size = 1
+    virtual_pipeline_model_parallel_size = None
+    virtual_pipeline_model_parallel_rank = None
+    pipeline_model_parallel_split_rank = None
+
+
+_STATE = _ParallelState()
+
+
+def initialize_model_parallel(tensor_model_parallel_size_=1,
+                              pipeline_model_parallel_size_=1,
+                              virtual_pipeline_model_parallel_size_=None,
+                              pipeline_model_parallel_split_rank_=None,
+                              *, devices=None,
+                              default_backend=None, p2p_backend=None):
+    """Build the (pp, dp, tp) mesh (reference: parallel_state.py:81-340).
+
+    ``default_backend``/``p2p_backend`` are accepted for API parity; on TPU
+    the transport is always XLA collectives over ICI/DCN — there is nothing
+    to select (reference selects nccl/ucc at :87-132).
+    """
+    if devices is None:
+        devices = jax.devices()
+    world_size = len(devices)
+    tp = tensor_model_parallel_size_
+    pp = pipeline_model_parallel_size_
+    assert world_size % (tp * pp) == 0, (
+        f"world size ({world_size}) is not divisible by tensor parallel size "
+        f"({tp}) times pipeline parallel size ({pp})")
+    dp = world_size // (tp * pp)
+
+    if virtual_pipeline_model_parallel_size_ is not None:
+        assert pp > 2 or virtual_pipeline_model_parallel_size_ == 1 or pp == 2, \
+            "interleaved schedule needs pipeline_model_parallel_size > 2"
+
+    dev_array = np.asarray(devices).reshape(pp, dp, tp)
+    _STATE.mesh = Mesh(dev_array, (PIPELINE_AXIS, DATA_AXIS, TENSOR_AXIS))
+    _STATE.tensor_model_parallel_size = tp
+    _STATE.pipeline_model_parallel_size = pp
+    _STATE.data_parallel_size = dp
+    _STATE.virtual_pipeline_model_parallel_size = (
+        virtual_pipeline_model_parallel_size_)
+    _STATE.virtual_pipeline_model_parallel_rank = (
+        0 if virtual_pipeline_model_parallel_size_ is not None else None)
+    _STATE.pipeline_model_parallel_split_rank = (
+        pipeline_model_parallel_split_rank_)
+    return _STATE.mesh
+
+
+def model_parallel_is_initialized():
+    """Reference: parallel_state.py:347."""
+    return _STATE.mesh is not None
+
+
+def get_mesh():
+    assert _STATE.mesh is not None, "model parallel is not initialized"
+    return _STATE.mesh
+
+
+def destroy_model_parallel():
+    """Reference: parallel_state.py:640."""
+    _STATE.mesh = None
+    _STATE.tensor_model_parallel_size = 1
+    _STATE.pipeline_model_parallel_size = 1
+    _STATE.data_parallel_size = 1
+    _STATE.virtual_pipeline_model_parallel_size = None
+    _STATE.virtual_pipeline_model_parallel_rank = None
+    _STATE.pipeline_model_parallel_split_rank = None
+
+
+# ---------------------------------------------------------------------------
+# group → axis-name getters (reference returns ProcessGroup objects,
+# parallel_state.py:342-470; here the axis name IS the group handle)
+# ---------------------------------------------------------------------------
+
+def get_tensor_model_parallel_group():
+    return TENSOR_AXIS
+
+
+def get_pipeline_model_parallel_group():
+    return PIPELINE_AXIS
+
+
+def get_data_parallel_group():
+    return DATA_AXIS
+
+
+def get_model_parallel_group():
+    """The model-parallel "group" spans both tp and pp axes; collectives over
+    it take the axis tuple (reference: parallel_state.py:366)."""
+    return (PIPELINE_AXIS, TENSOR_AXIS)
+
+
+def get_embedding_group():
+    """First+last pipeline stages (tied embeddings). On TPU the tied-weight
+    grad sync is a masked psum over the pp axis — see
+    pipeline_parallel.schedules.allreduce_embedding_grads."""
+    return PIPELINE_AXIS
+
+
+# ---------------------------------------------------------------------------
+# world sizes (host-level, static)
+# ---------------------------------------------------------------------------
+
+def get_tensor_model_parallel_world_size():
+    return _STATE.tensor_model_parallel_size
+
+
+def get_pipeline_model_parallel_world_size():
+    return _STATE.pipeline_model_parallel_size
+
+
+def get_data_parallel_world_size():
+    return _STATE.data_parallel_size
+
+
+def get_virtual_pipeline_model_parallel_world_size():
+    return _STATE.virtual_pipeline_model_parallel_size
+
+
+def get_pipeline_model_parallel_split_rank():
+    return _STATE.pipeline_model_parallel_split_rank
+
+
+def set_pipeline_model_parallel_split_rank(rank):
+    _STATE.pipeline_model_parallel_split_rank = rank
+
+
+# ---------------------------------------------------------------------------
+# ranks (traced: lax.axis_index inside shard_map)
+# ---------------------------------------------------------------------------
+
+def get_tensor_model_parallel_rank():
+    return jax.lax.axis_index(TENSOR_AXIS)
+
+
+def get_pipeline_model_parallel_rank():
+    return jax.lax.axis_index(PIPELINE_AXIS)
+
+
+def get_data_parallel_rank():
+    return jax.lax.axis_index(DATA_AXIS)
+
+
+def get_virtual_pipeline_model_parallel_rank():
+    """Host-side loop variable maintained by the interleaved schedule
+    (reference: parallel_state.py:512)."""
+    return _STATE.virtual_pipeline_model_parallel_rank
+
+
+def set_virtual_pipeline_model_parallel_rank(rank):
+    _STATE.virtual_pipeline_model_parallel_rank = rank
+
+
+def is_pipeline_first_stage(ignore_virtual=False):
+    """Traced predicate (reference: parallel_state.py:538). Inside shard_map
+    returns a traced bool; with pp==1 returns a concrete True."""
+    if not ignore_virtual:
+        vpp = _STATE.virtual_pipeline_model_parallel_size
+        if vpp is not None and _STATE.virtual_pipeline_model_parallel_rank != 0:
+            return False
+    if _STATE.pipeline_model_parallel_size == 1:
+        return True
+    return jax.lax.axis_index(PIPELINE_AXIS) == 0
+
+
+def is_pipeline_last_stage(ignore_virtual=False):
+    """Reference: parallel_state.py:552."""
+    if not ignore_virtual:
+        vpp = _STATE.virtual_pipeline_model_parallel_size
+        if (vpp is not None
+                and _STATE.virtual_pipeline_model_parallel_rank != vpp - 1):
+            return False
+    if _STATE.pipeline_model_parallel_size == 1:
+        return True
+    return (jax.lax.axis_index(PIPELINE_AXIS)
+            == _STATE.pipeline_model_parallel_size - 1)
+
+
+def get_tensor_model_parallel_src_rank():
+    """In mesh terms the TP-source "rank" is simply index 0 along tp
+    (reference: parallel_state.py:578 computes the global rank; the global
+    numbering has no TPU meaning)."""
+    return 0
+
+
+def get_pipeline_model_parallel_first_rank():
+    return 0
+
+
+def get_pipeline_model_parallel_last_rank():
+    return _STATE.pipeline_model_parallel_size - 1

@@ -1,0 +1,54 @@
+"""tensor_parallel — Megatron-style TP/SP layers over mesh collectives.
+
+Public surface mirrors apex/transformer/tensor_parallel/__init__.py.
+"""
+
+from apex_tpu.transformer.tensor_parallel.cross_entropy import (
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.tensor_parallel.data import broadcast_data
+from apex_tpu.transformer.tensor_parallel.layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    copy_to_tensor_model_parallel_region,
+    gather_from_sequence_parallel_region,
+    gather_from_tensor_model_parallel_region,
+    reduce_from_tensor_model_parallel_region,
+    reduce_scatter_to_sequence_parallel_region,
+    scatter_to_sequence_parallel_region,
+    scatter_to_tensor_model_parallel_region,
+)
+from apex_tpu.transformer.tensor_parallel.random import (
+    checkpoint,
+    get_cuda_rng_tracker,
+    get_rng_state_tracker,
+    model_parallel_cuda_manual_seed,
+    model_parallel_rng_seed,
+)
+from apex_tpu.transformer.tensor_parallel.utils import (
+    split_tensor_along_last_dim,
+)
+
+__all__ = [
+    "vocab_parallel_cross_entropy",
+    "broadcast_data",
+    "ColumnParallelLinear",
+    "RowParallelLinear",
+    "VocabParallelEmbedding",
+    "copy_to_tensor_model_parallel_region",
+    "gather_from_sequence_parallel_region",
+    "gather_from_tensor_model_parallel_region",
+    "reduce_from_tensor_model_parallel_region",
+    "reduce_scatter_to_sequence_parallel_region",
+    "scatter_to_sequence_parallel_region",
+    "scatter_to_tensor_model_parallel_region",
+    "checkpoint",
+    "get_cuda_rng_tracker",
+    "get_rng_state_tracker",
+    "model_parallel_cuda_manual_seed",
+    "model_parallel_rng_seed",
+    "split_tensor_along_last_dim",
+]

@@ -1,0 +1,104 @@
+"""Vocab-parallel cross entropy.
+
+Capability port of apex/transformer/tensor_parallel/cross_entropy.py:23-134.
+Logits are sharded along the vocab (last) dim across tp; the loss is computed
+without ever materializing the full-vocab softmax on one device:
+
+    local max → psum-MAX → stable exp/sum → psum-SUM → masked local lookup
+    of the target logit → psum-SUM                      (reference :30-76)
+
+Backward is the closed form (softmax − one_hot)·g with label-smoothing
+adjustment, supplied via custom_vjp exactly as the reference's
+``_VocabParallelCrossEntropy.backward`` (:79-129) — not AD — so the saved
+residuals are just (softmax, target mask/index), matching the reference's
+memory profile.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+
+
+def _fwd_core(vocab_parallel_logits, target, label_smoothing, axis_name):
+    partition_vocab_size = vocab_parallel_logits.shape[-1]
+    rank = lax.axis_index(axis_name)
+    world = lax.axis_size(axis_name)
+
+    # max-subtraction for stability (reference :30-36)
+    logits_max = jnp.max(vocab_parallel_logits, axis=-1)
+    logits_max = lax.pmax(logits_max, axis_name)
+    logits = (vocab_parallel_logits
+              - jax.lax.stop_gradient(logits_max)[..., None]).astype(jnp.float32)
+
+    # this rank's vocab range (reference :38-44)
+    start = rank * partition_vocab_size
+    in_range = (target >= start) & (target < start + partition_vocab_size)
+    masked_target = jnp.where(in_range, target - start, 0)
+
+    # predicted logit for the target class (reference :46-58)
+    predicted = jnp.take_along_axis(
+        logits, masked_target[..., None], axis=-1)[..., 0]
+    predicted = jnp.where(in_range, predicted, 0.0)
+    predicted = lax.psum(predicted, axis_name)
+
+    exp_logits = jnp.exp(logits)
+    sum_exp = jnp.sum(exp_logits, axis=-1)
+    sum_exp = lax.psum(sum_exp, axis_name)
+
+    loss = jnp.log(sum_exp) - predicted
+
+    softmax = exp_logits / sum_exp[..., None]
+
+    if label_smoothing > 0:
+        # reference :60-73: loss = (1-s)·ce + s·mean(-log p) over vocab
+        vocab_size = partition_vocab_size * world
+        smoothing = label_smoothing * vocab_size / (vocab_size - 1)
+        log_probs = logits - jnp.log(sum_exp)[..., None]
+        mean_log_probs = lax.psum(jnp.sum(log_probs, axis=-1),
+                                  axis_name) / vocab_size
+        loss = (1.0 - smoothing) * loss - smoothing * mean_log_probs
+
+    return loss, (softmax, in_range, masked_target)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def vocab_parallel_cross_entropy(vocab_parallel_logits, target,
+                                 label_smoothing=0.0, axis_name=TENSOR_AXIS):
+    """Per-token CE loss over vocab-sharded logits (reference :132)."""
+    loss, _ = _fwd_core(vocab_parallel_logits, target, label_smoothing,
+                        axis_name)
+    return loss
+
+
+def _ce_fwd(vocab_parallel_logits, target, label_smoothing, axis_name):
+    loss, res = _fwd_core(vocab_parallel_logits, target, label_smoothing,
+                          axis_name)
+    # zero-size carrier records the input dtype (dtypes aren't jax types)
+    return loss, (res, jnp.zeros((0,), vocab_parallel_logits.dtype))
+
+
+def _ce_bwd(label_smoothing, axis_name, carry, g):
+    (softmax, in_range, masked_target), dtype_carrier = carry
+    in_dtype = dtype_carrier.dtype
+    partition_vocab_size = softmax.shape[-1]
+    world = lax.axis_size(axis_name)
+
+    # grad = softmax − one_hot(target), scaled (reference :79-129)
+    one_hot = (jax.nn.one_hot(masked_target, partition_vocab_size,
+                              dtype=softmax.dtype)
+               * in_range[..., None].astype(softmax.dtype))
+    if label_smoothing > 0:
+        vocab_size = partition_vocab_size * world
+        smoothing = label_smoothing * vocab_size / (vocab_size - 1)
+        grad = softmax - (1.0 - smoothing) * one_hot - smoothing / vocab_size
+    else:
+        grad = softmax - one_hot
+    grad = grad * g[..., None]
+    return grad.astype(in_dtype), None
+
+
+vocab_parallel_cross_entropy.defvjp(_ce_fwd, _ce_bwd)

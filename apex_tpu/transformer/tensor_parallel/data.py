@@ -1,0 +1,51 @@
+"""Cross-rank data broadcast for tensor-parallel input pipelines.
+
+Capability port of apex/transformer/tensor_parallel/data.py:80-122. The
+reference loads each batch only on the TP-source rank and broadcasts the
+tensors (plus a size dictionary) over the TP group so the other ranks don't
+duplicate host dataloading. In single-controller JAX the host feeds every
+device, so the broadcast is an identity with validation; under multi-process
+JAX the equivalent is feeding per-process shards and letting
+``make_array_from_process_local_data`` replicate over tp. The function keeps
+the reference signature so trainer code ports unchanged.
+"""
+
+import jax.numpy as jnp
+
+_MAX_DATA_DIM = 5  # reference: data.py:13
+
+
+def _check_data_types(keys, data, target_dtype):
+    """Reference: data.py:17-23."""
+    for key in keys:
+        assert data[key].dtype == target_dtype, (
+            f"{key} has data type {data[key].dtype} which "
+            f"is different than {target_dtype}")
+
+
+def _build_key_size_numel_dictionaries(keys, data):
+    """Reference: data.py:26-77 (sizes flattened/broadcast; here direct)."""
+    key_size = {}
+    key_numel = {}
+    total_numel = 0
+    for key in keys:
+        assert data[key].ndim < _MAX_DATA_DIM, "you should increase MAX_DATA_DIM"
+        key_size[key] = tuple(data[key].shape)
+        numel = 1
+        for s in data[key].shape:
+            numel *= s
+        key_numel[key] = numel
+        total_numel += numel
+    return key_size, key_numel, total_numel
+
+
+def broadcast_data(keys, data, datatype):
+    """Broadcast data from the TP-source rank (reference: data.py:80).
+
+    On TPU every device already receives the host-fed batch (replication over
+    the tp axis is a sharding annotation, not a collective); this validates
+    dtypes/shapes and returns device arrays, preserving the call site."""
+    key_size, key_numel, total_numel = _build_key_size_numel_dictionaries(
+        keys, data)
+    _check_data_types(keys, data, datatype)
+    return {key: jnp.asarray(data[key]) for key in keys}

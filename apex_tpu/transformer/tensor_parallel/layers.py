@@ -1,0 +1,221 @@
+"""Tensor-parallel layers: vocab-parallel embedding, column/row linears.
+
+Capability port of apex/transformer/tensor_parallel/layers.py:167-780. The
+modules are flax.linen modules meant to run inside ``shard_map`` over the
+"tp" mesh axis: parameters are the *local shard* (e.g. ColumnParallelLinear
+weight is ``[out/tp, in]``), and the reference's collective plumbing is the
+custom-vjp mappings from ``mappings.py``.
+
+What does NOT need porting, and why:
+  * ``LinearWithGradAccumulationAndAsyncCommunication`` (layers.py:272) —
+    overlaps the async input-grad all-reduce with the wgrad GEMM and
+    accumulates wgrad into a persistent fp32 ``main_grad`` buffer via
+    ``fused_weight_gradient_mlp_cuda``. Under XLA both halves are automatic:
+    the latency-hiding scheduler overlaps the bwd psum with the wgrad
+    dot_general, and grad accumulation across microbatches is a donated
+    fp32 buffer add fused by XLA. The flags (``gradient_accumulation_fusion``,
+    ``no_async_tensor_model_parallel_allreduce``, ``accumulation_in_fp16``)
+    are accepted for API parity and are documented no-ops.
+  * CPU vs GPU init (layers.py:103-165) — both collapse to "initialize the
+    master weight at full shape, slice this rank's shard", which is also how
+    we guarantee rank-consistent init (master_weight is identical on every
+    rank because the RNG is; the slice is by ``lax.axis_index``). XLA DCEs
+    the unused remainder after init.
+"""
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax import lax
+
+from apex_tpu.amp import policy as _policy
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+from apex_tpu.transformer.tensor_parallel import mappings
+from apex_tpu.transformer.utils import VocabUtility, divide
+
+
+def _mm(x, w):
+    """x @ w^T in the active amp compute dtype, fp32 accumulation (MXU)."""
+    dt = _policy.compute_dtype(x.dtype)
+    return lax.dot_general(
+        x.astype(dt), w.astype(dt),
+        (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(dt)
+
+
+def _sharded_init(base_init: Callable, full_shape, shard_dim: int,
+                  axis_name: str):
+    """Initializer producing this rank's shard of a master weight initialized
+    at full shape (reference: _initialize_affine_weight_cpu layers.py:103 —
+    'Build the master weight on all processes. … split and copy')."""
+
+    def init(key, local_shape, dtype):
+        size = lax.axis_size(axis_name)
+        if size == 1:
+            return base_init(key, tuple(full_shape), dtype)
+        master = base_init(key, tuple(full_shape), dtype)
+        idx = lax.axis_index(axis_name)
+        chunk = full_shape[shard_dim] // size
+        return lax.dynamic_slice_in_dim(master, idx * chunk, chunk,
+                                        axis=shard_dim)
+
+    return init
+
+
+class VocabParallelEmbedding(nn.Module):
+    """Embedding parallelized along the vocab dimension
+    (reference: layers.py:167-269).
+
+    Each rank owns a contiguous vocab range; out-of-range tokens are masked
+    to zero locally and the partial lookups are summed across tp
+    (layers.py:216-267: masked lookup + all-reduce).
+    """
+
+    num_embeddings: int
+    embedding_dim: int
+    init_method: Callable = nn.initializers.normal(stddev=0.02)
+    params_dtype: Any = jnp.float32
+    axis_name: str = TENSOR_AXIS
+    reduce_output: bool = True   # False → caller handles the reduction (SP)
+
+    @nn.compact
+    def __call__(self, input_ids):
+        world = lax.axis_size(self.axis_name)
+        per_partition = divide(self.num_embeddings, world)
+        weight = self.param(
+            "weight",
+            _sharded_init(self.init_method,
+                          (self.num_embeddings, self.embedding_dim), 0,
+                          self.axis_name),
+            (per_partition, self.embedding_dim), self.params_dtype)
+
+        if world == 1:
+            return jnp.take(weight, input_ids, axis=0)
+
+        rank = lax.axis_index(self.axis_name)
+        start = rank * per_partition
+        # Mask + shift (layers.py:245-252)
+        in_range = (input_ids >= start) & (input_ids < start + per_partition)
+        masked = jnp.where(in_range, input_ids - start, 0)
+        out = jnp.take(weight, masked, axis=0)
+        out = jnp.where(in_range[..., None], out, 0.0)
+        if self.reduce_output:
+            out = mappings.reduce_from_tensor_model_parallel_region(
+                out, self.axis_name)
+        return out
+
+
+class ColumnParallelLinear(nn.Module):
+    """Y = XA + b with A partitioned along its output (column) dim
+    (reference: layers.py:429-611). Weight layout [out/tp, in] (torch
+    convention, weight @ is transposed).
+
+    sequence_parallel_enabled: input arrives sequence-sharded [s/tp, …, h]
+    and is all-gathered before the GEMM; backward reduce-scatters
+    (layers.py:500-540 via _gather_along_first_dim in the autograd fn).
+    """
+
+    input_size: int
+    output_size: int
+    bias: bool = True
+    gather_output: bool = True
+    init_method: Callable = nn.initializers.lecun_normal()
+    skip_bias_add: bool = False
+    sequence_parallel_enabled: bool = False
+    params_dtype: Any = jnp.float32
+    axis_name: str = TENSOR_AXIS
+    # accepted for API parity; automatic under XLA (see module docstring)
+    gradient_accumulation_fusion: bool = False
+    no_async_tensor_model_parallel_allreduce: bool = False
+    accumulation_in_fp16: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        world = lax.axis_size(self.axis_name)
+        out_per_partition = divide(self.output_size, world)
+        weight = self.param(
+            "weight",
+            _sharded_init(self.init_method,
+                          (self.output_size, self.input_size), 0,
+                          self.axis_name),
+            (out_per_partition, self.input_size), self.params_dtype)
+        b = (self.param("bias", nn.initializers.zeros,
+                        (out_per_partition,), self.params_dtype)
+             if self.bias else None)
+
+        if self.sequence_parallel_enabled:
+            assert not self.gather_output, \
+                "sequence parallel is incompatible with gather_output"
+            x = mappings.gather_from_sequence_parallel_region(
+                x, self.axis_name, True)
+        else:
+            x = mappings.copy_to_tensor_model_parallel_region(
+                x, self.axis_name)
+
+        out = _mm(x, weight)
+        if b is not None and not self.skip_bias_add:
+            out = out + b.astype(out.dtype)
+        if self.gather_output:
+            out = mappings.gather_from_tensor_model_parallel_region(
+                out, self.axis_name)
+        if self.skip_bias_add:
+            return out, b
+        return out
+
+
+class RowParallelLinear(nn.Module):
+    """Y = XA + b with A partitioned along its input (row) dim
+    (reference: layers.py:613-780). Weight layout [out, in/tp].
+
+    The partial products are summed across tp; with
+    sequence_parallel_enabled the sum is a reduce-scatter producing
+    sequence-sharded output (layers.py:729-744).
+    """
+
+    input_size: int
+    output_size: int
+    bias: bool = True
+    input_is_parallel: bool = False
+    init_method: Callable = nn.initializers.lecun_normal()
+    skip_bias_add: bool = False
+    sequence_parallel_enabled: bool = False
+    params_dtype: Any = jnp.float32
+    axis_name: str = TENSOR_AXIS
+    gradient_accumulation_fusion: bool = False
+    accumulation_in_fp16: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        world = lax.axis_size(self.axis_name)
+        in_per_partition = divide(self.input_size, world)
+        weight = self.param(
+            "weight",
+            _sharded_init(self.init_method,
+                          (self.output_size, self.input_size), 1,
+                          self.axis_name),
+            (self.output_size, in_per_partition), self.params_dtype)
+        b = (self.param("bias", nn.initializers.zeros,
+                        (self.output_size,), self.params_dtype)
+             if self.bias else None)
+
+        if not self.input_is_parallel:
+            assert not self.sequence_parallel_enabled, \
+                "sequence parallel requires input_is_parallel"
+            x = mappings.scatter_to_tensor_model_parallel_region(
+                x, self.axis_name)
+
+        partial = _mm(x, weight)
+        if self.sequence_parallel_enabled:
+            out = mappings.reduce_scatter_to_sequence_parallel_region(
+                partial, self.axis_name)
+        else:
+            out = mappings.reduce_from_tensor_model_parallel_region(
+                partial, self.axis_name)
+        if b is not None and not self.skip_bias_add:
+            out = out + b.astype(out.dtype)
+        if self.skip_bias_add:
+            return out, b
+        return out

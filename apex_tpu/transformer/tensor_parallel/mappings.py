@@ -1,0 +1,208 @@
+"""Tensor/sequence-parallel collective mappings with custom gradients.
+
+Capability port of apex/transformer/tensor_parallel/mappings.py:23-296 — the
+seven autograd collectives at the heart of Megatron-style TP/SP. Each is a
+``jax.custom_vjp`` over XLA collectives, used inside ``shard_map`` over a
+mesh axis (default: the "tp" axis from parallel_state):
+
+  fwd                      | bwd                       | reference
+  -------------------------|---------------------------|----------------------
+  copy (identity)          | all-reduce                | _CopyToModelParallelRegion :133
+  all-reduce               | identity                  | _ReduceFromModelParallelRegion :151
+  split last dim           | all-gather last dim       | _ScatterToModelParallelRegion :169
+  all-gather last dim      | split last dim            | _GatherFromModelParallelRegion :187
+  split first dim          | all-gather first dim      | _ScatterToSequenceParallelRegion :205
+  all-gather first dim     | reduce-scatter first dim  | _GatherFromSequenceParallelRegion :223
+  reduce-scatter first dim | all-gather first dim      | _ReduceScatterToSequenceParallelRegion :245
+
+Note the deliberately *asymmetric* pairs (gather-fwd/reduce-scatter-bwd):
+these are Megatron's sequence-parallel identities, not the true vjps of the
+primitives — which is exactly why they are custom_vjp here.
+"""
+
+from functools import partial
+
+import jax
+from jax import lax
+
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+
+
+# --------------------------- primitive impls -------------------------------
+# (reference: mappings.py:23-130)
+
+def _reduce(x, axis_name):
+    """All-reduce sum over the model-parallel axis (mappings.py:23)."""
+    return lax.psum(x, axis_name)
+
+
+def _split_along_last_dim(x, axis_name):
+    """Keep this rank's chunk of the last dim (mappings.py:36)."""
+    size = lax.axis_size(axis_name)
+    if size == 1:
+        return x
+    chunk = x.shape[-1] // size
+    assert chunk * size == x.shape[-1], (
+        f"last dim {x.shape[-1]} not divisible by axis size {size}")
+    idx = lax.axis_index(axis_name)
+    return lax.dynamic_slice_in_dim(x, idx * chunk, chunk, axis=x.ndim - 1)
+
+
+def _split_along_first_dim(x, axis_name):
+    """Reference: mappings.py:55."""
+    size = lax.axis_size(axis_name)
+    if size == 1:
+        return x
+    chunk = x.shape[0] // size
+    assert chunk * size == x.shape[0], (
+        f"first dim {x.shape[0]} not divisible by axis size {size}")
+    idx = lax.axis_index(axis_name)
+    return lax.dynamic_slice_in_dim(x, idx * chunk, chunk, axis=0)
+
+
+def _gather_along_last_dim(x, axis_name):
+    """All-gather, concatenated along the last dim (mappings.py:71)."""
+    if lax.axis_size(axis_name) == 1:
+        return x
+    return lax.all_gather(x, axis_name, axis=x.ndim - 1, tiled=True)
+
+
+def _gather_along_first_dim(x, axis_name):
+    """Reference: mappings.py:95."""
+    if lax.axis_size(axis_name) == 1:
+        return x
+    return lax.all_gather(x, axis_name, axis=0, tiled=True)
+
+
+def _reduce_scatter_along_first_dim(x, axis_name):
+    """Reference: mappings.py:114."""
+    if lax.axis_size(axis_name) == 1:
+        return x
+    return lax.psum_scatter(x, axis_name, scatter_dimension=0, tiled=True)
+
+
+# --------------------------- autograd wrappers -----------------------------
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def copy_to_tensor_model_parallel_region(x, axis_name=TENSOR_AXIS):
+    """Identity fwd / all-reduce bwd (mappings.py:133, public :268)."""
+    return x
+
+
+def _copy_fwd(x, axis_name):
+    return x, None
+
+
+def _copy_bwd(axis_name, _, g):
+    return (_reduce(g, axis_name),)
+
+
+copy_to_tensor_model_parallel_region.defvjp(_copy_fwd, _copy_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_from_tensor_model_parallel_region(x, axis_name=TENSOR_AXIS):
+    """All-reduce fwd / identity bwd (mappings.py:151, public :274)."""
+    return _reduce(x, axis_name)
+
+
+def _reduce_fwd(x, axis_name):
+    return _reduce(x, axis_name), None
+
+
+def _reduce_bwd(axis_name, _, g):
+    return (g,)
+
+
+reduce_from_tensor_model_parallel_region.defvjp(_reduce_fwd, _reduce_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scatter_to_tensor_model_parallel_region(x, axis_name=TENSOR_AXIS):
+    """Split-last-dim fwd / all-gather bwd (mappings.py:169, public :280)."""
+    return _split_along_last_dim(x, axis_name)
+
+
+def _scatter_fwd(x, axis_name):
+    return _split_along_last_dim(x, axis_name), None
+
+
+def _scatter_bwd(axis_name, _, g):
+    return (_gather_along_last_dim(g, axis_name),)
+
+
+scatter_to_tensor_model_parallel_region.defvjp(_scatter_fwd, _scatter_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def gather_from_tensor_model_parallel_region(x, axis_name=TENSOR_AXIS):
+    """All-gather-last-dim fwd / split bwd (mappings.py:187, public :286)."""
+    return _gather_along_last_dim(x, axis_name)
+
+
+def _gather_fwd(x, axis_name):
+    return _gather_along_last_dim(x, axis_name), None
+
+
+def _gather_bwd(axis_name, _, g):
+    return (_split_along_last_dim(g, axis_name),)
+
+
+gather_from_tensor_model_parallel_region.defvjp(_gather_fwd, _gather_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scatter_to_sequence_parallel_region(x, axis_name=TENSOR_AXIS):
+    """Split-first-dim fwd / all-gather bwd (mappings.py:205, public :292)."""
+    return _split_along_first_dim(x, axis_name)
+
+
+def _sp_scatter_fwd(x, axis_name):
+    return _split_along_first_dim(x, axis_name), None
+
+
+def _sp_scatter_bwd(axis_name, _, g):
+    return (_gather_along_first_dim(g, axis_name),)
+
+
+scatter_to_sequence_parallel_region.defvjp(_sp_scatter_fwd, _sp_scatter_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def gather_from_sequence_parallel_region(x, axis_name=TENSOR_AXIS,
+                                         tensor_parallel_output_grad=True):
+    """All-gather-first-dim fwd; bwd reduce-scatters when the output grad is
+    tensor-parallel (the usual SP case) else plain split
+    (mappings.py:223-243, public :294)."""
+    return _gather_along_first_dim(x, axis_name)
+
+
+def _sp_gather_fwd(x, axis_name, tensor_parallel_output_grad):
+    return _gather_along_first_dim(x, axis_name), None
+
+
+def _sp_gather_bwd(axis_name, tensor_parallel_output_grad, _, g):
+    if tensor_parallel_output_grad:
+        return (_reduce_scatter_along_first_dim(g, axis_name),)
+    return (_split_along_first_dim(g, axis_name),)
+
+
+gather_from_sequence_parallel_region.defvjp(_sp_gather_fwd, _sp_gather_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_scatter_to_sequence_parallel_region(x, axis_name=TENSOR_AXIS):
+    """Reduce-scatter-first-dim fwd / all-gather bwd (mappings.py:245,
+    public :296)."""
+    return _reduce_scatter_along_first_dim(x, axis_name)
+
+
+def _sp_rs_fwd(x, axis_name):
+    return _reduce_scatter_along_first_dim(x, axis_name), None
+
+
+def _sp_rs_bwd(axis_name, _, g):
+    return (_gather_along_first_dim(g, axis_name),)
+
+
+reduce_scatter_to_sequence_parallel_region.defvjp(_sp_rs_fwd, _sp_rs_bwd)

@@ -1,0 +1,81 @@
+"""Memory buffers for checkpointed activations.
+
+Capability port of apex/transformer/tensor_parallel/memory.py:37-151. The
+reference preallocates one big flat buffer and hands out zero-copy views to
+avoid allocator churn for distributed saved activations. XLA owns device
+memory under jit — there is no user allocator to bypass — so these classes
+keep the API (shape bookkeeping, rotation) with jnp slices, and exist for
+code written against the reference surface.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+class MemoryBuffer:
+    """Reference: memory.py:37."""
+
+    def __init__(self, name, numel, dtype, track_usage=False):
+        self.name = name
+        self.numel = numel
+        self.dtype = dtype
+        self.data = jnp.zeros((numel,), dtype=dtype)
+        self._start = 0
+        self.track_usage = track_usage
+        self.in_use_value = 0.0
+        self.total_value = 0.0
+
+    def reset(self):
+        self._start = 0
+
+    def is_in_use(self):
+        return self._start > 0
+
+    def numel_in_use(self):
+        return self._start
+
+    def add(self, tensor):
+        """Allocate a view for ``tensor``'s shape and copy it in
+        (reference: memory.py:74-98)."""
+        assert tensor.dtype == self.dtype, (
+            f"buffer is {self.dtype}, got {tensor.dtype}")
+        size = int(np.prod(tensor.shape))
+        assert self._start + size <= self.numel, "buffer overflow"
+        self.data = jax.lax.dynamic_update_slice(
+            self.data, jnp.ravel(tensor), (self._start,))
+        view = jax.lax.dynamic_slice(
+            self.data, (self._start,), (size,)).reshape(tensor.shape)
+        self._start += size
+        if self.track_usage:
+            self.in_use_value += float(size)
+            self.total_value += float(self.numel)
+        return view
+
+    def get_data(self):
+        return self.data
+
+    def print_average_usage(self):
+        if self.track_usage and self.total_value:
+            print(f" > usage of {self.name} memory buffer: "
+                  f"{self.in_use_value * 100.0 / self.total_value:.2f} %")
+
+
+class RingMemBuffer:
+    """Ring of MemoryBuffers (reference: memory.py:135)."""
+
+    def __init__(self, name, num_buffers, numel, dtype, track_usage=False):
+        self.num_buffers = num_buffers
+        self.buffers = [
+            MemoryBuffer(f"{name} {i}", numel, dtype, track_usage)
+            for i in range(num_buffers)
+        ]
+        self._index = -1
+
+    def get_next_buffer(self):
+        self._index += 1
+        self._index = self._index % self.num_buffers
+        buff = self.buffers[self._index]
+        assert not buff.is_in_use(), "buffer is already in use"
+        return buff

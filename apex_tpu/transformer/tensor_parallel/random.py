@@ -1,0 +1,113 @@
+"""Model-parallel RNG tracking + activation checkpointing.
+
+Capability port of apex/transformer/tensor_parallel/random.py:124-330.
+
+The reference maintains forked CUDA RNG states per name so that dropout is
+identical within a TP group where it must be (default state) and different
+where it must be (model-parallel regions; `model-parallel-rng` seeded
+``seed + 2718 + tp_rank``, random.py:204-233). In JAX, RNG state is explicit:
+the tracker stores a base key per name and derives per-call keys with
+``jax.random.fold_in`` — the tp-rank fold reproduces the per-rank offset.
+
+Activation checkpointing (``CheckpointFunction`` random.py:237-306) maps to
+``jax.checkpoint``; ``distribute_saved_activations`` (partition saved inputs
+across tp, :253-260) has no TPU buffer-juggling analog — its *memory*
+behavior is expressed as a rematerialization policy instead (save nothing,
+recompute; or save only seq-sharded residuals via
+``checkpoint_policies.save_only_these_names``).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+
+_MODEL_PARALLEL_RNG_TRACKER_NAME = "model-parallel-rng"
+
+
+class RngStateTracker:
+    """Named RNG key tracker (reference: CudaRNGStatesTracker random.py:124).
+
+    ``add(name, seed)`` registers a stream; ``fork(name)`` yields a fresh key
+    for that stream and advances it (the functional analog of forking the
+    CUDA RNG state and restoring it afterwards).
+    """
+
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def get_states(self):
+        return dict(self.states_)
+
+    def set_states(self, states):
+        self.states_ = dict(states)
+
+    def add(self, name, seed):
+        # duplicate-seed detection only applies to concrete (host) seeds;
+        # traced seeds (tp-rank dependent) can't be compared at trace time
+        if isinstance(seed, int):
+            if seed in self.seeds_:
+                raise Exception(f"seed {seed} already exists")
+            self.seeds_.add(seed)
+        if name in self.states_:
+            raise Exception(f"rng state {name} already exists")
+        # seed may be a traced value (tp-rank dependent) — fold it into a key
+        self.states_[name] = jax.random.fold_in(
+            jax.random.PRNGKey(0), jnp.asarray(seed, jnp.uint32))
+
+    def fork(self, name=_MODEL_PARALLEL_RNG_TRACKER_NAME):
+        """Return a fresh key from stream ``name`` and advance the stream."""
+        if name not in self.states_:
+            raise Exception(f"rng state {name} is not added")
+        key, next_state = jax.random.split(self.states_[name])
+        self.states_[name] = next_state
+        return key
+
+
+_RNG_STATE_TRACKER = RngStateTracker()
+
+
+def get_rng_state_tracker():
+    """Reference: get_cuda_rng_tracker random.py:198."""
+    return _RNG_STATE_TRACKER
+
+
+# torch-named alias for drop-in parity
+get_cuda_rng_tracker = get_rng_state_tracker
+
+
+def model_parallel_rng_seed(seed, axis_name=TENSOR_AXIS):
+    """Seed the tracker: default stream = data-parallel-identical seed,
+    model-parallel stream offset by 2718 + tp_rank
+    (reference: model_parallel_cuda_manual_seed random.py:204-233)."""
+    offset = seed + 2718
+    try:
+        tp_rank = lax.axis_index(axis_name)
+    except NameError:
+        tp_rank = 0
+    model_parallel_seed = offset + tp_rank
+    _RNG_STATE_TRACKER.reset()
+    _RNG_STATE_TRACKER.add("default", seed)
+    _RNG_STATE_TRACKER.add(_MODEL_PARALLEL_RNG_TRACKER_NAME,
+                           model_parallel_seed)
+
+
+# torch-named alias
+model_parallel_cuda_manual_seed = model_parallel_rng_seed
+
+
+def checkpoint(function, distribute_saved_activations, *args):
+    """Rematerialized application of ``function`` (reference:
+    CheckpointFunction.apply via checkpoint(), random.py:237-330).
+
+    ``distribute_saved_activations=True`` selects the most aggressive
+    policy (save nothing — the analog of sharding the saved input across
+    tp to cut its memory by 1/tp)."""
+    del distribute_saved_activations  # both map to full remat on TPU
+    return jax.checkpoint(function)(*args)

@@ -1,0 +1,9 @@
+"""Re-export of shared tensor_parallel utils (reference:
+apex/transformer/tensor_parallel/utils.py)."""
+
+from apex_tpu.transformer.utils import (  # noqa: F401
+    VocabUtility,
+    divide,
+    ensure_divisibility,
+    split_tensor_along_last_dim,
+)

@@ -1,0 +1,48 @@
+"""Shared transformer utilities.
+
+Capability port of apex/transformer/utils.py and
+apex/transformer/tensor_parallel/utils.py:22-100.
+"""
+
+import jax.numpy as jnp
+
+
+def ensure_divisibility(numerator, denominator):
+    """Reference: tensor_parallel/utils.py:16."""
+    assert numerator % denominator == 0, (
+        f"{numerator} is not divisible by {denominator}"
+    )
+
+
+def divide(numerator, denominator):
+    """Reference: tensor_parallel/utils.py:22."""
+    ensure_divisibility(numerator, denominator)
+    return numerator // denominator
+
+
+def split_tensor_along_last_dim(tensor, num_partitions):
+    """Split a tensor along its last dimension (reference:
+    tensor_parallel/utils.py:28-45). Returns a list of equally-sized views."""
+    last_dim_size = divide(tensor.shape[-1], num_partitions)
+    return [
+        jnp.asarray(t)
+        for t in jnp.split(tensor, num_partitions, axis=-1)
+    ] if last_dim_size else []
+
+
+class VocabUtility:
+    """Vocab range helpers for vocab-parallel embedding / cross-entropy
+    (reference: tensor_parallel/utils.py:46-70)."""
+
+    @staticmethod
+    def vocab_range_from_per_partition_vocab_size(per_partition_vocab_size,
+                                                  rank, world_size):
+        index_f = rank * per_partition_vocab_size
+        index_l = index_f + per_partition_vocab_size
+        return index_f, index_l
+
+    @staticmethod
+    def vocab_range_from_global_vocab_size(global_vocab_size, rank, world_size):
+        per_partition_vocab_size = divide(global_vocab_size, world_size)
+        return VocabUtility.vocab_range_from_per_partition_vocab_size(
+            per_partition_vocab_size, rank, world_size)

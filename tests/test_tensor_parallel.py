@@ -1,0 +1,391 @@
+"""Tensor/sequence-parallel tests on the 8-device CPU mesh.
+
+Ports: tests/L0/run_transformer/test_parallel_state.py, test_mapping.py,
+test_layers.py (column/row/embedding parity vs unsheared references incl.
+sequence_parallel), test_cross_entropy.py, test_random.py.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.tensor_parallel import mappings
+from apex_tpu.transformer.tensor_parallel import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.tensor_parallel.random import (
+    RngStateTracker,
+    get_rng_state_tracker,
+    model_parallel_rng_seed,
+)
+
+NDEV = 8
+
+
+def tp_mesh(tp=NDEV):
+    return Mesh(np.array(jax.devices()[:tp]), ("tp",))
+
+
+def smap(fn, mesh, in_specs, out_specs):
+    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     check_vma=False)
+
+
+# ----------------------------- parallel_state ------------------------------
+
+def test_initialize_model_parallel_sizes():
+    """Port of test_parallel_state.py size checks."""
+    parallel_state.initialize_model_parallel(2, 2)
+    assert parallel_state.model_parallel_is_initialized()
+    assert parallel_state.get_tensor_model_parallel_world_size() == 2
+    assert parallel_state.get_pipeline_model_parallel_world_size() == 2
+    assert parallel_state.get_data_parallel_world_size() == 2
+    mesh = parallel_state.get_mesh()
+    assert mesh.axis_names == ("pp", "dp", "tp")
+    assert mesh.devices.shape == (2, 2, 2)
+    parallel_state.destroy_model_parallel()
+    assert not parallel_state.model_parallel_is_initialized()
+
+
+def test_initialize_model_parallel_invalid():
+    with pytest.raises(AssertionError):
+        parallel_state.initialize_model_parallel(3, 1)  # 8 % 3 != 0
+    parallel_state.destroy_model_parallel()
+
+
+def test_rank_getters_inside_shard_map():
+    parallel_state.initialize_model_parallel(2, 2)
+    mesh = parallel_state.get_mesh()
+
+    def ranks():
+        return (parallel_state.get_tensor_model_parallel_rank(),
+                parallel_state.get_pipeline_model_parallel_rank(),
+                parallel_state.get_data_parallel_rank())
+
+    f = shard_map(lambda: [jnp.stack(ranks())], mesh=mesh, in_specs=(),
+                  out_specs=[P(("pp", "dp", "tp"))], check_vma=False)
+    [out] = f()
+    out = np.asarray(out).reshape(2, 2, 2, 3)
+    for pp in range(2):
+        for dp in range(2):
+            for tp in range(2):
+                np.testing.assert_array_equal(out[pp, dp, tp], [tp, pp, dp])
+    parallel_state.destroy_model_parallel()
+
+
+# -------------------------------- mappings ---------------------------------
+
+def test_copy_to_region_fwd_and_bwd():
+    """id fwd / psum bwd (test_mapping.py analog)."""
+    mesh = tp_mesh()
+    x = jnp.ones((4,))
+
+    def fn(x):
+        y = mappings.copy_to_tensor_model_parallel_region(x, "tp")
+        return jnp.sum(y)
+
+    def grad_fn(x):
+        return jax.grad(fn)(x)
+
+    g = smap(grad_fn, mesh, (P(),), P(None))(x)
+    # bwd all-reduces the per-rank ones → NDEV
+    np.testing.assert_allclose(np.asarray(g), NDEV)
+
+
+def test_reduce_from_region_fwd_and_bwd():
+    mesh = tp_mesh()
+    xs = jnp.arange(NDEV * 4, dtype=jnp.float32).reshape(NDEV, 4)
+
+    f = smap(lambda x: mappings.reduce_from_tensor_model_parallel_region(x, "tp"),
+             mesh, (P("tp"),), P(None))
+    np.testing.assert_allclose(np.asarray(f(xs)),
+                               np.asarray(xs).sum(0, keepdims=True))
+
+    # bwd is identity: grad of sum(psum(x)) wrt local x is all-ones
+    def loss(x):
+        return jnp.sum(
+            mappings.reduce_from_tensor_model_parallel_region(x, "tp"))
+
+    g = smap(jax.grad(loss), mesh, (P("tp"),), P("tp"))(xs)
+    np.testing.assert_allclose(np.asarray(g), 1.0)
+
+
+def test_scatter_gather_last_dim_roundtrip():
+    mesh = tp_mesh()
+    x = jnp.arange(2 * NDEV * 3, dtype=jnp.float32).reshape(2, NDEV * 3)
+
+    def roundtrip(x):
+        local = mappings.scatter_to_tensor_model_parallel_region(x, "tp")
+        assert local.shape == (2, 3)
+        return mappings.gather_from_tensor_model_parallel_region(local, "tp")
+
+    out = smap(roundtrip, mesh, (P(),), P(None))(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+
+def test_scatter_bwd_is_gather():
+    mesh = tp_mesh()
+    x = jnp.ones((NDEV * 2,))
+
+    def loss(x):
+        local = mappings.scatter_to_tensor_model_parallel_region(x, "tp")
+        rank = jax.lax.axis_index("tp").astype(jnp.float32)
+        return jnp.sum(local * (rank + 1.0))
+
+    # d/dx_i = (rank owning i) + 1
+    g = smap(jax.grad(loss), mesh, (P(),), P(None))(x)
+    want = np.repeat(np.arange(NDEV) + 1.0, 2)
+    np.testing.assert_allclose(np.asarray(g), want)
+
+
+def test_sequence_parallel_scatter_gather_roundtrip():
+    mesh = tp_mesh()
+    x = jnp.arange(NDEV * 2 * 3, dtype=jnp.float32).reshape(NDEV * 2, 3)
+
+    def roundtrip(x):
+        local = mappings.scatter_to_sequence_parallel_region(x, "tp")
+        assert local.shape == (2, 3)
+        return mappings.gather_from_sequence_parallel_region(local, "tp", True)
+
+    out = smap(roundtrip, mesh, (P(),), P(None))(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+
+def test_reduce_scatter_to_sequence_parallel():
+    mesh = tp_mesh()
+    xs = jnp.ones((NDEV * NDEV * 2, 3))  # per-rank [seq=16, 3]
+
+    f = smap(lambda x: mappings.reduce_scatter_to_sequence_parallel_region(x, "tp"),
+             mesh, (P("tp"),), P("tp"))
+    out = f(xs)
+    # each rank ends with seq/NDEV=2 rows of the sum (=NDEV)
+    assert out.shape == (NDEV * 2, 3)
+    np.testing.assert_allclose(np.asarray(out), NDEV)
+
+
+def test_gather_sequence_parallel_bwd_reduce_scatter():
+    mesh = tp_mesh()
+    x = jnp.ones((2, 3))  # per-rank seq shard
+
+    def loss(x):
+        full = mappings.gather_from_sequence_parallel_region(x, "tp", True)
+        return jnp.sum(full)  # same on all ranks
+
+    g = smap(jax.grad(loss), mesh, (P(),), P(None))(x)
+    # reduce-scatter of the all-ones grads of the full seq → NDEV per element
+    np.testing.assert_allclose(np.asarray(g), NDEV)
+
+
+# --------------------------------- layers ----------------------------------
+
+def test_column_parallel_linear_parity():
+    """Column output (gathered) == dense with the gathered master weight
+    (port of test_layers.py:26-130)."""
+    mesh = tp_mesh()
+    x = jnp.asarray(np.random.RandomState(0).randn(5, 16), jnp.float32)
+    mod = ColumnParallelLinear(input_size=16, output_size=32,
+                               gather_output=True)
+
+    def run(x):
+        y, variables = mod.init_with_output(jax.random.PRNGKey(1), x)
+        return y, variables["params"]["weight"], variables["params"]["bias"]
+
+    y, w_full, b_full = smap(run, mesh, (P(),),
+                             (P(None), P("tp", None), P("tp")))(x)
+    # weight shards are [out/tp, in]; gathered along dim 0
+    w_full = np.asarray(w_full)
+    want = np.asarray(x) @ w_full.T + np.asarray(b_full)
+    np.testing.assert_allclose(np.asarray(y), want, rtol=1e-5, atol=1e-5)
+
+
+def test_column_parallel_linear_grad_x():
+    mesh = tp_mesh()
+    x = jnp.asarray(np.random.RandomState(2).randn(4, 16), jnp.float32)
+    mod = ColumnParallelLinear(input_size=16, output_size=32,
+                               gather_output=True, bias=False)
+
+    def run(x):
+        variables = mod.init(jax.random.PRNGKey(1), x)
+        w = variables["params"]["weight"]
+        g = jax.grad(lambda x: jnp.sum(mod.apply(variables, x)))(x)
+        return g, w
+
+    g, w_full = smap(run, mesh, (P(),), (P(None), P("tp", None)))(x)
+    w_full = np.asarray(w_full)
+    want = np.ones((4, 32)) @ w_full
+    np.testing.assert_allclose(np.asarray(g), want, rtol=1e-5, atol=1e-5)
+
+
+def test_row_parallel_linear_parity():
+    mesh = tp_mesh()
+    x = jnp.asarray(np.random.RandomState(3).randn(5, 32), jnp.float32)
+    mod = RowParallelLinear(input_size=32, output_size=16,
+                            input_is_parallel=False)
+
+    def run(x):
+        y, variables = mod.init_with_output(jax.random.PRNGKey(4), x)
+        return y, variables["params"]["weight"], variables["params"]["bias"]
+
+    y, w_full, b = smap(run, mesh, (P(),),
+                        (P(None), P(None, "tp"), P(None)))(x)
+    # weight shards are [out, in/tp]; gathered along dim 1 → [out, NDEV*in/tp]
+    # shards correspond to contiguous input chunks in rank order
+    w_full = np.asarray(w_full).reshape(16, 32)
+    want = np.asarray(x) @ w_full.T + np.asarray(b)
+    np.testing.assert_allclose(np.asarray(y), want, rtol=1e-4, atol=1e-4)
+
+
+def test_column_row_sequence_parallel_mlp():
+    """SP end-to-end: seq-sharded input → Column(SP) → Row(SP) → seq-sharded
+    output equals the dense computation (test_layers.py sequence_parallel)."""
+    mesh = tp_mesh()
+    seq, hidden, ffn = NDEV * 2, 16, 64
+    x = jnp.asarray(np.random.RandomState(5).randn(seq, hidden), jnp.float32)
+
+    col = ColumnParallelLinear(input_size=hidden, output_size=ffn,
+                               gather_output=False, bias=False,
+                               sequence_parallel_enabled=True)
+    row = RowParallelLinear(input_size=ffn, output_size=hidden,
+                            input_is_parallel=True, bias=False,
+                            sequence_parallel_enabled=True)
+
+    def run(x_local):
+        h, col_vars = col.init_with_output(jax.random.PRNGKey(6), x_local)
+        y, row_vars = row.init_with_output(jax.random.PRNGKey(7), h)
+        return (y, col_vars["params"]["weight"],
+                row_vars["params"]["weight"])
+
+    y, wc, wr = smap(run, mesh, (P("tp"),),
+                     (P("tp"), P("tp", None), P(None, "tp")))(x)
+    wc = np.asarray(wc)
+    wr = np.asarray(wr)
+    want = (np.asarray(x) @ wc.T) @ wr.T
+    np.testing.assert_allclose(np.asarray(y), want, rtol=1e-4, atol=1e-4)
+
+
+def test_vocab_parallel_embedding_parity():
+    mesh = tp_mesh()
+    vocab, dim = NDEV * 4, 8
+    ids = jnp.asarray(np.random.RandomState(8).randint(0, vocab, (3, 5)))
+    mod = VocabParallelEmbedding(num_embeddings=vocab, embedding_dim=dim)
+
+    def run(ids):
+        y, variables = mod.init_with_output(jax.random.PRNGKey(9), ids)
+        return y, variables["params"]["weight"]
+
+    y, w_full = smap(run, mesh, (P(),), (P(None), P(("tp",))))(ids)
+    w_full = np.asarray(w_full).reshape(vocab, dim)
+    want = w_full[np.asarray(ids)]
+    np.testing.assert_allclose(np.asarray(y), want, rtol=1e-6)
+
+
+# ------------------------------ cross entropy ------------------------------
+
+def _ref_ce(logits, target, smoothing=0.0):
+    logits = logits - logits.max(-1, keepdims=True)
+    logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+    nll = -np.take_along_axis(logp, target[..., None], -1)[..., 0]
+    if smoothing > 0:
+        V = logits.shape[-1]
+        s = smoothing * V / (V - 1)
+        nll = (1 - s) * nll - s * logp.mean(-1)
+    return nll
+
+
+@pytest.mark.parametrize("smoothing", [0.0, 0.1])
+def test_vocab_parallel_cross_entropy(smoothing):
+    """Port of test_cross_entropy.py: sharded CE == full-vocab CE."""
+    mesh = tp_mesh()
+    B, V = 6, NDEV * 4
+    rng = np.random.RandomState(10)
+    logits = rng.randn(B, V).astype(np.float32)
+    target = rng.randint(0, V, (B,))
+
+    f = smap(lambda l, t: vocab_parallel_cross_entropy(l, t, smoothing, "tp"),
+             mesh, (P(None, "tp"), P()), P(None))
+    got = f(jnp.asarray(logits), jnp.asarray(target))
+    want = _ref_ce(logits, target, smoothing)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("smoothing", [0.0, 0.1])
+def test_vocab_parallel_cross_entropy_grad(smoothing):
+    mesh = tp_mesh()
+    B, V = 4, NDEV * 2
+    rng = np.random.RandomState(11)
+    logits = rng.randn(B, V).astype(np.float32)
+    target = rng.randint(0, V, (B,))
+
+    def sharded(l, t):
+        return jax.grad(
+            lambda l: jnp.sum(
+                vocab_parallel_cross_entropy(l, t, smoothing, "tp")))(l)
+
+    got = smap(sharded, mesh, (P(None, "tp"), P()), P(None, "tp"))(
+        jnp.asarray(logits), jnp.asarray(target))
+
+    def full(l):
+        return jnp.sum(_jax_ref_ce(l, jnp.asarray(target), smoothing))
+
+    want = jax.grad(full)(jnp.asarray(logits))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+
+
+def _jax_ref_ce(logits, target, smoothing):
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, target[..., None], -1)[..., 0]
+    if smoothing > 0:
+        V = logits.shape[-1]
+        s = smoothing * V / (V - 1)
+        nll = (1 - s) * nll - s * jnp.mean(logp, -1)
+    return nll
+
+
+# --------------------------------- random ----------------------------------
+
+def test_rng_tracker_fork_advances():
+    tr = RngStateTracker()
+    tr.add("default", 123)
+    k1 = tr.fork("default")
+    k2 = tr.fork("default")
+    assert not np.array_equal(np.asarray(k1), np.asarray(k2))
+
+
+def test_rng_tracker_duplicate_seed_raises():
+    tr = RngStateTracker()
+    tr.add("a", 1)
+    with pytest.raises(Exception, match="already exists"):
+        tr.add("b", 1)
+    with pytest.raises(Exception, match="is not added"):
+        tr.fork("nope")
+
+
+def test_model_parallel_seed_differs_per_rank():
+    """model-parallel stream differs across tp; default stream identical
+    (port of test_random.py semantics)."""
+    mesh = tp_mesh()
+
+    def run():
+        model_parallel_rng_seed(1234, "tp")
+        tr = get_rng_state_tracker()
+        default = jax.random.normal(tr.fork("default"), (1,))
+        mp = jax.random.normal(tr.fork("model-parallel-rng"), (1,))
+        return jnp.concatenate([default, mp])
+
+    out = np.asarray(
+        shard_map(lambda: run(), mesh=mesh, in_specs=(),
+                  out_specs=P("tp"), check_vma=False)()
+    ).reshape(NDEV, 2)
+    # default column identical across ranks
+    assert np.ptp(out[:, 0]) == 0.0
+    # model-parallel column all distinct
+    assert len(np.unique(out[:, 1])) == NDEV
