@@ -95,6 +95,10 @@ class SyncBatchNorm(nn.Module):
     eps: float = 1e-5
     momentum: float = 0.1
     affine: bool = True
+    # finer-grained than torch's affine: converted flax BatchNorms may have
+    # only one of scale/bias (None → follow ``affine``)
+    use_scale: Optional[bool] = None
+    use_bias: Optional[bool] = None
     track_running_stats: bool = True
     axis_name: Optional[str] = None  # process_group analog
     channel_last: bool = True
@@ -108,9 +112,12 @@ class SyncBatchNorm(nn.Module):
         if num_features is None:
             num_features = x.shape[channel_axis]
         scale = bias = None
-        if self.affine:
+        use_scale = self.affine if self.use_scale is None else self.use_scale
+        use_bias = self.affine if self.use_bias is None else self.use_bias
+        if use_scale:
             scale = self.param("weight", nn.initializers.ones,
                                (num_features,), self.param_dtype)
+        if use_bias:
             bias = self.param("bias", nn.initializers.zeros,
                               (num_features,), self.param_dtype)
         ra_mean = self.variable("batch_stats", "running_mean",
@@ -151,7 +158,7 @@ def convert_syncbn_model(module, process_group=None, channel_last=False):
         return SyncBatchNorm(
             num_features=None,
             eps=module.epsilon, momentum=1.0 - module.momentum,
-            affine=module.use_scale or module.use_bias,
+            use_scale=module.use_scale, use_bias=module.use_bias,
             axis_name=process_group, channel_last=channel_last)
     if isinstance(module, nn.Module) and dataclasses.is_dataclass(module):
         changes = {}

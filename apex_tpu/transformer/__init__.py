@@ -20,5 +20,12 @@ def __getattr__(name):
 
     if name in ("pipeline_parallel", "amp", "functional", "layers",
                 "testing", "microbatches", "utils", "log_util"):
-        return importlib.import_module(f"apex_tpu.transformer.{name}")
+        try:
+            return importlib.import_module(f"apex_tpu.transformer.{name}")
+        except ImportError as e:
+            # __getattr__ must raise AttributeError so hasattr()/getattr()
+            # probes behave
+            raise AttributeError(
+                f"module 'apex_tpu.transformer' has no attribute {name!r} "
+                f"({e})") from e
     raise AttributeError(f"module 'apex_tpu.transformer' has no attribute {name!r}")

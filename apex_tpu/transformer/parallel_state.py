@@ -72,7 +72,8 @@ def initialize_model_parallel(tensor_model_parallel_size_=1,
     dp = world_size // (tp * pp)
 
     if virtual_pipeline_model_parallel_size_ is not None:
-        assert pp > 2 or virtual_pipeline_model_parallel_size_ == 1 or pp == 2, \
+        # reference: parallel_state.py:167 — interleaving needs > 2 stages
+        assert pp > 2 or virtual_pipeline_model_parallel_size_ == 1, \
             "interleaved schedule needs pipeline_model_parallel_size > 2"
 
     dev_array = np.asarray(devices).reshape(pp, dp, tp)

@@ -1,0 +1,40 @@
+"""pipeline_parallel — SPMD pipeline schedules over the pp mesh axis.
+
+Public surface mirrors apex/transformer/pipeline_parallel/__init__.py.
+"""
+
+from apex_tpu.transformer.pipeline_parallel.schedules import (
+    build_model,
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_with_interleaving,
+    forward_backward_pipelining_without_interleaving,
+    get_forward_backward_func,
+    pipeline_forward,
+)
+from apex_tpu.transformer.pipeline_parallel.utils import (
+    average_losses_across_data_parallel_group,
+    get_current_global_batch_size,
+    get_kth_microbatch,
+    get_ltor_masks_and_position_ids,
+    get_num_microbatches,
+    setup_microbatch_calculator,
+    update_num_microbatches,
+)
+from apex_tpu.transformer.pipeline_parallel._timers import Timers
+
+__all__ = [
+    "build_model",
+    "forward_backward_no_pipelining",
+    "forward_backward_pipelining_with_interleaving",
+    "forward_backward_pipelining_without_interleaving",
+    "get_forward_backward_func",
+    "pipeline_forward",
+    "average_losses_across_data_parallel_group",
+    "get_current_global_batch_size",
+    "get_kth_microbatch",
+    "get_ltor_masks_and_position_ids",
+    "get_num_microbatches",
+    "setup_microbatch_calculator",
+    "update_num_microbatches",
+    "Timers",
+]

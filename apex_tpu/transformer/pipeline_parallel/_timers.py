@@ -1,0 +1,80 @@
+"""Step timers with device fencing.
+
+Capability port of apex/transformer/pipeline_parallel/_timers.py:6-83. The
+reference fences with ``torch.cuda.synchronize``; here the fence is
+``jax.block_until_ready`` on a marker (or ``jax.effects_barrier``), and
+TensorBoard export takes any object with an ``add_scalar`` method.
+"""
+
+import time
+
+import jax
+
+
+class _Timer:
+    """Reference: _timers.py:6."""
+
+    def __init__(self, name):
+        self.name_ = name
+        self.elapsed_ = 0.0
+        self.started_ = False
+        self.start_time = time.time()
+
+    def start(self, barrier_value=None):
+        assert not self.started_, "timer has already been started"
+        if barrier_value is not None:
+            jax.block_until_ready(barrier_value)
+        self.start_time = time.time()
+        self.started_ = True
+
+    def stop(self, barrier_value=None):
+        assert self.started_, "timer is not started"
+        if barrier_value is not None:
+            jax.block_until_ready(barrier_value)
+        self.elapsed_ += time.time() - self.start_time
+        self.started_ = False
+
+    def reset(self):
+        self.elapsed_ = 0.0
+        self.started_ = False
+
+    def elapsed(self, reset=True):
+        started_ = self.started_
+        if self.started_:
+            self.stop()
+        elapsed_ = self.elapsed_
+        if reset:
+            self.reset()
+        if started_:
+            self.start()
+        return elapsed_
+
+
+class Timers:
+    """Group of timers (reference: _timers.py:40)."""
+
+    def __init__(self):
+        self.timers = {}
+
+    def __call__(self, name):
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def write(self, names, writer, iteration, normalizer=1.0, reset=False):
+        """TensorBoard export (reference: _timers.py:54)."""
+        assert normalizer > 0.0
+        for name in names:
+            value = self.timers[name].elapsed(reset=reset) / normalizer
+            writer.add_scalar(f"{name}-time", value, iteration)
+
+    def log(self, names, normalizer=1.0, reset=True):
+        """Reference: _timers.py:64."""
+        assert normalizer > 0.0
+        string = "time (ms)"
+        for name in names:
+            elapsed_time = (self.timers[name].elapsed(reset=reset)
+                            * 1000.0 / normalizer)
+            string += f" | {name}: {elapsed_time:.2f}"
+        print(string, flush=True)
+        return string

@@ -1,0 +1,85 @@
+"""Stage-to-stage activation transfer primitives.
+
+Capability port of apex/transformer/pipeline_parallel/p2p_communication.py
+(:117 ``_communicate``, public 8-op API :321-578). The reference batches
+``torch.distributed.P2POp`` isend/irecv pairs with shape/dtype negotiation
+and optional scatter-gather. On TPU every transfer is a ``lax.ppermute``
+along the pp mesh axis inside the jitted schedule — shapes are static, so
+the negotiation protocol disappears, and "async" is XLA's default.
+
+These wrappers exist for API parity and for hand-rolled schedules; the
+shipped schedules (schedules.py) inline the same ppermutes.
+"""
+
+from jax import lax
+
+from apex_tpu.transformer.parallel_state import PIPELINE_AXIS
+
+
+def _shift(x, axis_name, forward, wrap=False):
+    pp = lax.axis_size(axis_name)
+    if forward:
+        perm = [(i, (i + 1) % pp) for i in range(pp if wrap else pp - 1)]
+    else:
+        perm = [((i + 1) % pp, i) for i in range(pp if wrap else pp - 1)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def send_forward_recv_forward(output_tensor, axis_name=PIPELINE_AXIS,
+                              wrap=False):
+    """Each stage sends its output to the next and receives the previous
+    stage's (reference: :321 recv_forward + :380 send_forward fused, and
+    :493 send_forward_recv_forward). Stage 0 receives zeros (or stage
+    pp−1's output with ``wrap=True`` — the interleaved ring)."""
+    return _shift(output_tensor, axis_name, forward=True, wrap=wrap)
+
+
+def send_backward_recv_backward(input_tensor_grad, axis_name=PIPELINE_AXIS,
+                                wrap=False):
+    """Gradient counterpart flowing last→first (reference: :528)."""
+    return _shift(input_tensor_grad, axis_name, forward=False, wrap=wrap)
+
+
+def recv_forward(x_zeros_like, axis_name=PIPELINE_AXIS):
+    """API-parity shim (reference :321): in SPMD there is no standalone
+    blocking recv — the value arrives via the paired send's ppermute. This
+    returns the zero placeholder a first-warmup stage would see."""
+    return x_zeros_like
+
+
+def recv_backward(g_zeros_like, axis_name=PIPELINE_AXIS):
+    """Reference :340 — see recv_forward."""
+    return g_zeros_like
+
+
+def send_forward(output_tensor, axis_name=PIPELINE_AXIS):
+    """Reference :380; the paired recv happens on the receiving stage in
+    the same ppermute."""
+    return send_forward_recv_forward(output_tensor, axis_name)
+
+
+def send_backward(input_tensor_grad, axis_name=PIPELINE_AXIS):
+    """Reference :405."""
+    return send_backward_recv_backward(input_tensor_grad, axis_name)
+
+
+def send_forward_recv_backward(output_tensor, input_tensor_grad,
+                               axis_name=PIPELINE_AXIS):
+    """1F1B steady-state pair (reference :430): ship activation ahead,
+    gradient astern, one ppermute each — XLA runs them concurrently."""
+    return (_shift(output_tensor, axis_name, True),
+            _shift(input_tensor_grad, axis_name, False))
+
+
+def send_backward_recv_forward(input_tensor_grad, output_tensor,
+                               axis_name=PIPELINE_AXIS):
+    """Reference :460."""
+    return (_shift(input_tensor_grad, axis_name, False),
+            _shift(output_tensor, axis_name, True))
+
+
+def send_forward_backward_recv_forward_backward(
+        output_tensor, input_tensor_grad, axis_name=PIPELINE_AXIS):
+    """Reference :556 — both directions at once."""
+    return (_shift(output_tensor, axis_name, True),
+            _shift(input_tensor_grad, axis_name, False))

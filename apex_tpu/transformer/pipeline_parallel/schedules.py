@@ -1,0 +1,288 @@
+"""Pipeline-parallel schedules.
+
+Capability port of apex/transformer/pipeline_parallel/schedules/
+(fwd_bwd_no_pipelining.py:31, fwd_bwd_pipelining_without_interleaving.py:228,
+fwd_bwd_pipelining_with_interleaving.py:26, common.py:30-380).
+
+The reference drives NCCL p2p send/recv from Python, hand-ordering a warmup /
+steady-1F1B / cooldown sequence per rank. On TPU the whole schedule is ONE
+jitted SPMD program inside ``shard_map`` over the "pp" mesh axis:
+
+  * a ``lax.scan`` over T = num_microbatches + pp − 1 ticks carries each
+    stage's live activation; ``lax.ppermute`` shifts activations one stage
+    ahead per tick (the p2p boundary, reference p2p_communication.py:117);
+  * every device runs the same stage trunk; bubbles are masked ticks;
+  * **the backward schedule is not hand-written at all** — differentiating
+    through the scan+ppermute reverses the permutation and replays the
+    ticks in reverse order, which IS the mirrored pipeline (cooldown ↔
+    warmup swap). ``jax.checkpoint`` around the trunk bounds activation
+    memory per tick, giving the 1F1B memory profile knob.
+
+Stage heterogeneity (embedding on the first stage, loss head on the last —
+the reference's ``pre_process``/``post_process``, common.py:30-80) is
+expressed with masked selects: embed/head params are pp-replicated, their
+compute is multiplied by an axis-index mask, so their gradients are zero on
+non-owning stages and the automatic cross-stage psum recovers exactly the
+owning stage's contribution.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.transformer.parallel_state import PIPELINE_AXIS
+
+
+def _tree_add(a, b):
+    return jax.tree_util.tree_map(jnp.add, a, b)
+
+
+def _tree_zeros_like(t):
+    return jax.tree_util.tree_map(jnp.zeros_like, t)
+
+
+def _index_microbatch(microbatches, idx):
+    return jax.tree_util.tree_map(
+        lambda a: lax.dynamic_index_in_dim(a, idx, keepdims=False),
+        microbatches)
+
+
+# ---------------------------------------------------------------------------
+# no pipelining (reference: forward_backward_no_pipelining
+# fwd_bwd_no_pipelining.py:31)
+# ---------------------------------------------------------------------------
+
+def forward_backward_no_pipelining(forward_step_func, batch, params, *,
+                                   forward_only=False, grad_mean=True,
+                                   **_compat):
+    """Sequential microbatch loop with gradient accumulation.
+
+    ``forward_step_func(params, microbatch) -> scalar loss``; ``batch`` is a
+    pytree with leading microbatch dim [M, ...]. Returns
+    ``(per-microbatch losses, accumulated grads or None)``. The reference's
+    ``model.no_sync`` dance (grad allreduce only on the last microbatch) is
+    moot: the caller reduces the returned grads once.
+
+    For a call-site-uniform dispatcher contract (the reference keeps one
+    signature across all schedules), this also accepts the pipelined form:
+    ``forward_step_func = (stage_fn, embed_fn, loss_fn)`` with
+    ``params = (stage_params, embed_params, head_params)`` — composed
+    sequentially — returning (mean loss, grads) exactly like the pipelined
+    variants.
+    """
+    if isinstance(forward_step_func, tuple):
+        stage_fn, embed_fn, loss_fn = forward_step_func
+
+        def composed(params3, mb):
+            sp, ep, hp = params3
+            h = embed_fn(ep, mb)
+            h = stage_fn(sp, h, 0)
+            return loss_fn(hp, h, mb)
+
+        losses, grads = forward_backward_no_pipelining(
+            composed, batch, params, forward_only=forward_only,
+            grad_mean=grad_mean)
+        return jnp.mean(losses), grads
+
+    if forward_only:
+        def body(_, mb):
+            return None, forward_step_func(params, mb)
+
+        _, losses = lax.scan(body, None, batch)
+        return losses, None
+
+    vg = jax.value_and_grad(forward_step_func)
+
+    def body(acc, mb):
+        loss, g = vg(params, mb)
+        return _tree_add(acc, g), loss
+
+    grads, losses = lax.scan(body, _tree_zeros_like(params), batch)
+    num_mb = losses.shape[0]
+    if grad_mean:
+        grads = jax.tree_util.tree_map(lambda g: g / num_mb, grads)
+    return losses, grads
+
+
+# ---------------------------------------------------------------------------
+# the SPMD scan pipeline core
+# ---------------------------------------------------------------------------
+
+def pipeline_forward(stage_fn, stage_params, embed_fn, embed_params,
+                     loss_fn, head_params, microbatches, num_microbatches,
+                     *, axis_name=PIPELINE_AXIS, checkpoint_stages=True,
+                     num_chunks=1):
+    """Pipelined forward producing the mean microbatch loss.
+
+    Must run inside ``shard_map`` with ``stage_params`` sharded over
+    ``axis_name`` (this device's stage chunk) and ``microbatches`` /
+    ``embed_params`` / ``head_params`` replicated along it.
+
+      stage_fn(stage_params, hidden, chunk_idx) -> hidden   (the trunk)
+      embed_fn(embed_params, microbatch)        -> hidden   (first stage)
+      loss_fn(head_params, hidden, microbatch)  -> scalar   (last stage)
+
+    ``num_chunks > 1`` = interleaved virtual pipeline
+    (fwd_bwd_pipelining_with_interleaving.py:26): ``stage_params`` carries a
+    leading [num_chunks] dim; each tick advances every chunk's slot (vmapped
+    over chunks — MXU-friendly), and the ring wraps hidden state from the
+    last stage of chunk v to the first stage of chunk v+1.
+    """
+    pp = lax.axis_size(axis_name)
+    p = lax.axis_index(axis_name)
+    M = num_microbatches
+    V = num_chunks
+    L = pp * V                      # virtual pipeline length
+    T = M + L - 1                   # ticks until the last mb clears the ring
+
+    mb0 = _index_microbatch(microbatches, 0)
+    hidden0 = embed_fn(embed_params, mb0)
+    act_shape = jax.eval_shape(lambda: hidden0)
+
+    trunk = stage_fn
+    if checkpoint_stages:
+        trunk = jax.checkpoint(stage_fn)
+
+    def one_chunk(chunk_params, x, v):
+        return trunk(chunk_params, x, v)
+
+    def tick(carry, t):
+        # acts: [V, *hidden] — chunk v's live activation on this device
+        acts, loss_acc = carry
+
+        # ---- first virtual stage (device 0, chunk 0): inject microbatch t
+        mb_in_idx = jnp.clip(t, 0, M - 1)
+        mb_in = _index_microbatch(microbatches, mb_in_idx)
+        x0 = embed_fn(embed_params, mb_in)
+        inject = jnp.where((p == 0) & (t < M), x0, acts[0])
+        acts = acts.at[0].set(inject)
+
+        # ---- advance every chunk's slot one stage
+        if V == 1:
+            ys = one_chunk(stage_params, acts[0], 0)[None]
+        else:
+            ys = jax.vmap(one_chunk, in_axes=(0, 0, 0))(
+                stage_params, acts, jnp.arange(V))
+
+        # ---- last virtual stage (device pp-1, chunk V-1): loss for the
+        # microbatch that entered L-1 ticks ago
+        mb_out_t = t - (L - 1)
+        mb_out = _index_microbatch(microbatches,
+                                   jnp.clip(mb_out_t, 0, M - 1))
+        l = loss_fn(head_params, ys[V - 1], mb_out)
+        valid = ((p == pp - 1) & (mb_out_t >= 0) & (mb_out_t < M))
+        loss_acc = loss_acc + jnp.where(valid, l, 0.0)
+
+        # ---- ring shift: stage i → i+1 within each chunk; the last stage's
+        # output wraps to stage 0 of the NEXT chunk (interleaving)
+        perm = [(i, (i + 1) % pp) for i in range(pp)]
+        shifted = lax.ppermute(ys, axis_name, perm)
+        # chunk v's new input = shifted output of chunk v, except stage 0,
+        # which (for v>0) takes the wrapped output of chunk v-1
+        new_acts = shifted
+        if V > 1:
+            wrapped = jnp.where(p == 0,
+                                jnp.roll(shifted, 1, axis=0),
+                                shifted)
+            new_acts = wrapped
+        return (new_acts, loss_acc), None
+
+    acts0 = jnp.zeros((V,) + act_shape.shape, act_shape.dtype)
+    (acts, loss_sum), _ = lax.scan(
+        tick, (acts0, jnp.zeros((), jnp.float32)), jnp.arange(T))
+    # LOCAL loss: nonzero only on the last stage. Deliberately NOT psum'd
+    # here — differentiating a psum'd scalar inside shard_map seeds every
+    # device's (identical) copy with cotangent 1, scaling grads by pp. The
+    # fwd_bwd wrappers psum for *reporting* outside the grad.
+    return loss_sum / M
+
+
+def forward_backward_pipelining_without_interleaving(
+        forward_step_func, batch, params, *, num_microbatches,
+        axis_name=PIPELINE_AXIS, forward_only=False,
+        checkpoint_stages=True, **_compat):
+    """1F1B-equivalent schedule (reference:
+    fwd_bwd_pipelining_without_interleaving.py:228).
+
+    ``params = (stage_params, embed_params, head_params)`` and
+    ``forward_step_func = (stage_fn, embed_fn, loss_fn)`` — the functional
+    split of the reference's pre_process/post_process model wrapping.
+    Returns (mean loss, grads pytree or None). Call inside shard_map over
+    the pp axis.
+    """
+    return _pipelined_fwd_bwd(forward_step_func, batch, params,
+                              num_microbatches=num_microbatches,
+                              axis_name=axis_name, forward_only=forward_only,
+                              checkpoint_stages=checkpoint_stages,
+                              num_chunks=1)
+
+
+def forward_backward_pipelining_with_interleaving(
+        forward_step_func, batch, params, *, num_microbatches,
+        num_model_chunks, axis_name=PIPELINE_AXIS, forward_only=False,
+        checkpoint_stages=True, **_compat):
+    """Interleaved (virtual pipeline) schedule (reference:
+    fwd_bwd_pipelining_with_interleaving.py:26). ``stage_params`` carries a
+    leading [num_model_chunks] dim per device."""
+    return _pipelined_fwd_bwd(forward_step_func, batch, params,
+                              num_microbatches=num_microbatches,
+                              axis_name=axis_name, forward_only=forward_only,
+                              checkpoint_stages=checkpoint_stages,
+                              num_chunks=num_model_chunks)
+
+
+def _pipelined_fwd_bwd(forward_step_func, batch, params, *, num_microbatches,
+                       axis_name, forward_only, checkpoint_stages,
+                       num_chunks):
+    stage_fn, embed_fn, loss_fn = forward_step_func
+    stage_params, embed_params, head_params = params
+
+    def loss_of(params3):
+        sp, ep, hp = params3
+        return pipeline_forward(
+            stage_fn, sp, embed_fn, ep, loss_fn, hp, batch,
+            num_microbatches, axis_name=axis_name,
+            checkpoint_stages=checkpoint_stages, num_chunks=num_chunks)
+
+    if forward_only:
+        return lax.psum(loss_of(params), axis_name), None
+
+    loss_local, grads = jax.value_and_grad(loss_of)(
+        (stage_params, embed_params, head_params))
+    gs, ge, gh = grads
+    # stage grads are per-device (varying); embed/head params are
+    # pp-replicated, so their logical grad is the sum of each stage copy's
+    # contribution (only the owning stage's is nonzero — the masked selects
+    # zero the rest) — this psum is the tied-weight grad all-reduce of
+    # schedules/common.py:320 (embedding-grad sync) generalized
+    ge = jax.tree_util.tree_map(lambda g: lax.psum(g, axis_name), ge)
+    gh = jax.tree_util.tree_map(lambda g: lax.psum(g, axis_name), gh)
+    return lax.psum(loss_local, axis_name), (gs, ge, gh)
+
+
+def get_forward_backward_func(virtual_pipeline_model_parallel_size,
+                              pipeline_model_parallel_size):
+    """Dispatcher (reference: schedules/__init__.py:19-35)."""
+    if pipeline_model_parallel_size > 1:
+        if virtual_pipeline_model_parallel_size is not None:
+            return functools.partial(
+                forward_backward_pipelining_with_interleaving,
+                num_model_chunks=virtual_pipeline_model_parallel_size)
+        return forward_backward_pipelining_without_interleaving
+    return forward_backward_no_pipelining
+
+
+def build_model(model_provider_func, wrap_with_ddp=True,
+                virtual_pipeline_model_parallel_size=None, **kwargs):
+    """Reference: schedules/common.py:30 — wraps per-virtual-chunk model
+    providers. Functional analog: returns a list of
+    ``model_provider_func(pre_process, post_process, chunk)`` results, one
+    per virtual chunk (a single-element list without interleaving)."""
+    chunks = virtual_pipeline_model_parallel_size or 1
+    models = []
+    for v in range(chunks):
+        models.append(model_provider_func(
+            pre_process=(v == 0), post_process=(v == chunks - 1), **kwargs))
+    return models

@@ -1,0 +1,170 @@
+"""Pipeline-parallel utilities + microbatch-calculator global.
+
+Capability port of apex/transformer/pipeline_parallel/utils.py:58-330.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.microbatches import (
+    build_num_microbatches_calculator,
+)
+
+_GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+_GLOBAL_AUTORESUME = None
+
+
+def _ensure_var_is_not_initialized(var, name):
+    assert var is None, f"{name} is already initialized."
+
+
+def _ensure_var_is_initialized(var, name):
+    assert var is not None, f"{name} is not initialized."
+
+
+def setup_microbatch_calculator(rank, rampup_batch_size, global_batch_size,
+                                micro_batch_size, data_parallel_size):
+    """Reference: utils.py:58-76."""
+    global _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    _ensure_var_is_not_initialized(_GLOBAL_NUM_MICROBATCHES_CALCULATOR,
+                                   "num microbatches calculator")
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = build_num_microbatches_calculator(
+        rank, rampup_batch_size, global_batch_size, micro_batch_size,
+        data_parallel_size)
+
+
+def destroy_microbatch_calculator():
+    global _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+
+
+def update_num_microbatches(consumed_samples, consistency_check=True):
+    """Reference: utils.py:101."""
+    _ensure_var_is_initialized(_GLOBAL_NUM_MICROBATCHES_CALCULATOR,
+                               "num microbatches calculator")
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR.update(consumed_samples,
+                                               consistency_check)
+
+
+def get_num_microbatches():
+    """Reference: utils.py:107."""
+    _ensure_var_is_initialized(_GLOBAL_NUM_MICROBATCHES_CALCULATOR,
+                               "num microbatches calculator")
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.get()
+
+
+def get_current_global_batch_size():
+    """Reference: utils.py:112."""
+    _ensure_var_is_initialized(_GLOBAL_NUM_MICROBATCHES_CALCULATOR,
+                               "num microbatches calculator")
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.get_current_global_batch_size()
+
+
+def get_kth_microbatch(batch, k):
+    """Slice microbatch k out of a batch pytree whose leaves carry the
+    global batch in dim 0 (reference: utils.py:122 — there, per-key dict
+    slicing [k*mbs : (k+1)*mbs])."""
+    if batch is None:
+        return batch
+    return jax.tree_util.tree_map(lambda a: a[k], batch)
+
+
+def get_autoresume():
+    """ADLR autoresume hook lookup (reference: utils.py:142) — external
+    cluster library; absent on TPU deployments (checkpoint-resume +
+    orchestration instead)."""
+    return _GLOBAL_AUTORESUME
+
+
+def listify_model(model):
+    """Reference: utils.py:90."""
+    if isinstance(model, list):
+        return model
+    return [model]
+
+
+def average_losses_across_data_parallel_group(losses, axis_name="dp"):
+    """Reference: utils.py:242 — all_reduce mean over the dp group."""
+    averaged = jnp.concatenate([jnp.reshape(l, (-1,)) for l in losses])
+    return jax.lax.pmean(averaged, axis_name)
+
+
+def calc_params_l2_norm(params, model_parallel_axes=("pp", "tp")):
+    """Global parameter L2 norm (reference: utils.py:213 — local
+    multi_tensor_l2norm then all-reduce over the model-parallel group)."""
+    leaves = jax.tree_util.tree_leaves(params)
+    sq = sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+    for ax in model_parallel_axes:
+        try:
+            sq = jax.lax.psum(sq, ax)
+        except NameError:
+            pass
+    return jnp.sqrt(sq)
+
+
+def report_memory(name):
+    """Device memory report (reference: utils.py:253 — torch.cuda memory
+    counters). Uses JAX's per-device memory_stats."""
+    lines = [f"[{name}] memory (MB)"]
+    for d in jax.local_devices():
+        stats = d.memory_stats() or {}
+        in_use = stats.get("bytes_in_use", 0) / 1e6
+        peak = stats.get("peak_bytes_in_use", 0) / 1e6
+        limit = stats.get("bytes_limit", 0) / 1e6
+        lines.append(f"  {d}: in_use {in_use:.1f} | peak {peak:.1f} "
+                     f"| limit {limit:.1f}")
+    out = "\n".join(lines)
+    print(out, flush=True)
+    return out
+
+
+def print_params_min_max_norm(params):
+    """Debug dump (reference: utils.py:265)."""
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        name = jax.tree_util.keystr(path)
+        leaf = leaf.astype(jnp.float32)
+        print(f"{name}: min {jnp.min(leaf):.3e} max {jnp.max(leaf):.3e} "
+              f"norm {jnp.linalg.norm(leaf):.3e}", flush=True)
+
+
+def get_ltor_masks_and_position_ids(data, eod_token, reset_position_ids=False,
+                                    reset_attention_mask=False,
+                                    eod_mask_loss=False):
+    """Build causal masks, loss mask, position ids for left-to-right LMs
+    (reference: utils.py:303-330; the reset_* variants loop per-document —
+    here expressed with cumulative counts, jit-compatible)."""
+    micro_batch_size, seq_length = data.shape
+
+    # causal attention mask [b, 1, s, s]
+    attention_mask = jnp.tril(
+        jnp.ones((seq_length, seq_length), jnp.bool_))[None, None]
+    attention_mask = jnp.broadcast_to(
+        attention_mask, (micro_batch_size, 1, seq_length, seq_length))
+
+    loss_mask = jnp.ones(data.shape, jnp.float32)
+    if eod_mask_loss:
+        loss_mask = jnp.where(data == eod_token, 0.0, loss_mask)
+
+    position_ids = jnp.broadcast_to(
+        jnp.arange(seq_length), data.shape)
+    if reset_position_ids or reset_attention_mask:
+        # document id = number of EODs strictly before each position
+        is_eod = (data == eod_token)
+        doc_id = jnp.cumsum(is_eod, axis=1) - jnp.where(is_eod, 1, 0)
+        if reset_position_ids:
+            # position within document: global pos − pos of doc start;
+            # the EOD token itself still belongs to the previous document
+            # (reference resets from i+1, utils.py:325-328), so shift the
+            # start markers right by one before the running max
+            doc_start = jnp.where(
+                is_eod, jnp.arange(seq_length)[None] + 1, 0)
+            doc_start = jnp.pad(doc_start[:, :-1], ((0, 0), (1, 0)))
+            doc_start = jax.lax.associative_scan(jnp.maximum, doc_start,
+                                                 axis=1)
+            position_ids = jnp.arange(seq_length)[None] - doc_start
+        if reset_attention_mask:
+            same_doc = doc_id[:, None, :, None] == doc_id[:, None, None, :]
+            attention_mask = attention_mask & same_doc
+    # reference convention: mask value <0.5 means masked
+    attention_mask = attention_mask < 0.5
+    return attention_mask, loss_mask, position_ids

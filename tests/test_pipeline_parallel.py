@@ -1,0 +1,273 @@
+"""Pipeline-parallel schedule tests on the 8-device CPU mesh.
+
+Port of tests/L0/run_transformer/test_pipeline_parallel_fwd_bwd.py — the
+analytic-loss pattern: deterministic weight fill, closed-form expected loss
+computed in fp64-equivalent numpy, schedules compared against it (and
+against each other) with no data or tolerance fuzz. Plus test_microbatches.py
+and p2p smoke.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.transformer.microbatches import (
+    ConstantNumMicroBatches,
+    RampupBatchsizeNumMicroBatches,
+)
+from apex_tpu.transformer.pipeline_parallel import (
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_with_interleaving,
+    forward_backward_pipelining_without_interleaving,
+    get_forward_backward_func,
+    p2p_communication,
+)
+from apex_tpu.transformer.pipeline_parallel.utils import (
+    get_ltor_masks_and_position_ids,
+)
+
+NDEV = 8
+PP = 4
+HID = 6
+M = 5  # microbatches
+
+
+def pp_mesh(pp=PP):
+    return Mesh(np.array(jax.devices()[:pp]), ("pp",))
+
+
+# The deterministic model (reference pattern: weight fill (rank+1)/k):
+#   embed:  h = x * e
+#   stage p: h = h @ W_p     with W_p = ((p+1)/8) * I + 0.01
+#   loss:   mean(h * c)
+def stage_weight(p, chunks=1):
+    # [chunks, HID, HID] when interleaved
+    ws = []
+    for v in range(chunks):
+        s = p + v * PP
+        ws.append(((s + 1) / 8.0) * np.eye(HID) + 0.01)
+    w = np.stack(ws).astype(np.float32)
+    return w if chunks > 1 else w[0]
+
+
+def stage_fn(w, h, v):
+    return h @ w
+
+
+def embed_fn(e, mb):
+    return mb * e
+
+
+def loss_fn(c, h, mb):
+    return jnp.mean(h * c)
+
+
+def closed_form(xs, e, ws, c):
+    """Sequential reference in numpy float64."""
+    losses = []
+    for m in range(xs.shape[0]):
+        h = xs[m].astype(np.float64) * e
+        for w in ws:
+            h = h @ w.astype(np.float64)
+        losses.append((h * c).mean())
+    return np.mean(losses)
+
+
+@pytest.fixture
+def batch():
+    rng = np.random.RandomState(0)
+    return rng.randn(M, 2, HID).astype(np.float32)
+
+
+def run_pipeline(batch, chunks=1, forward_only=False):
+    mesh = pp_mesh()
+    stacked = np.stack([stage_weight(p, chunks) for p in range(PP)])
+    e = jnp.asarray(1.5)
+    c = jnp.asarray(2.0)
+
+    fwd_bwd = (forward_backward_pipelining_without_interleaving if chunks == 1
+               else forward_backward_pipelining_with_interleaving)
+
+    def run(mbs, sp):
+        sp = sp[0]  # drop the sharded singleton: local stage params
+        kwargs = dict(num_microbatches=M, axis_name="pp",
+                      forward_only=forward_only)
+        if chunks > 1:
+            kwargs["num_model_chunks"] = chunks
+        loss, grads = fwd_bwd(
+            (stage_fn, embed_fn, loss_fn), mbs, (sp, e, c), **kwargs)
+        if grads is None:
+            return loss, sp[None], e, c
+        return loss, grads[0][None], grads[1], grads[2]
+
+    f = shard_map(run, mesh=mesh, in_specs=(P(), P("pp")),
+                  out_specs=(P(), P("pp"), P(), P()),
+                  check_vma=False)
+    loss, gs, ge, gc = jax.jit(f)(jnp.asarray(batch), jnp.asarray(stacked))
+    return np.asarray(loss), np.asarray(gs), np.asarray(ge), np.asarray(gc)
+
+
+def sequential_reference_grads(batch, chunks=1):
+    """jax.grad of the closed-form sequential composition."""
+    stacked = jnp.asarray(
+        np.stack([stage_weight(p, chunks) for p in range(PP)]))
+
+    def loss_of(args):
+        sp, e, c = args
+        # virtual stage order: chunk-major — v0p0..v0p3, v1p0..v1p3
+        total = 0.0
+        for m in range(M):
+            h = embed_fn(e, jnp.asarray(batch[m]))
+            for v in range(chunks):
+                for p in range(PP):
+                    w = sp[p, v] if chunks > 1 else sp[p]
+                    h = stage_fn(w, h, v)
+            total = total + loss_fn(c, h, jnp.asarray(batch[m]))
+        return total / M
+
+    args = (stacked, jnp.asarray(1.5), jnp.asarray(2.0))
+    loss, grads = jax.value_and_grad(loss_of)(args)
+    return np.asarray(loss), tuple(np.asarray(g) for g in grads)
+
+
+def test_pipeline_1f1b_loss_matches_closed_form(batch):
+    ws = [stage_weight(p) for p in range(PP)]
+    want = closed_form(batch, 1.5, ws, 2.0)
+    loss, _, _, _ = run_pipeline(batch)
+    np.testing.assert_allclose(loss.item(), want, rtol=1e-5)
+
+
+def test_pipeline_1f1b_grads_match_sequential(batch):
+    loss, gs, ge, gc = run_pipeline(batch)
+    ref_loss, (rgs, rge, rgc) = sequential_reference_grads(batch)
+    np.testing.assert_allclose(loss.item(), ref_loss.item(), rtol=1e-5)
+    np.testing.assert_allclose(gs, rgs, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(ge, rge, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(gc, rgc, rtol=1e-4, atol=1e-6)
+
+
+def test_pipeline_interleaved_matches_sequential(batch):
+    loss, gs, ge, gc = run_pipeline(batch, chunks=2)
+    ref_loss, (rgs, rge, rgc) = sequential_reference_grads(batch, chunks=2)
+    np.testing.assert_allclose(loss.item(), ref_loss.item(), rtol=1e-5)
+    np.testing.assert_allclose(gs, rgs, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(ge, rge, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(gc, rgc, rtol=1e-4, atol=1e-6)
+
+
+def test_pipeline_forward_only(batch):
+    ws = [stage_weight(p) for p in range(PP)]
+    want = closed_form(batch, 1.5, ws, 2.0)
+    loss, _, _, _ = run_pipeline(batch, forward_only=True)
+    np.testing.assert_allclose(loss.item(), want, rtol=1e-5)
+
+
+def test_no_pipelining_matches_sequential(batch):
+    """no-pipelining grad accumulation == mean of per-microbatch grads
+    (reference: fwd_bwd_no_pipelining.py:31)."""
+    stacked = jnp.asarray(np.stack([stage_weight(p) for p in range(PP)]))
+
+    def full_loss(params, mb):
+        sp, e, c = params
+        h = embed_fn(e, mb)
+        for p in range(PP):
+            h = stage_fn(sp[p], h, 0)
+        return loss_fn(c, h, mb)
+
+    params = (stacked, jnp.asarray(1.5), jnp.asarray(2.0))
+    losses, grads = forward_backward_no_pipelining(
+        full_loss, jnp.asarray(batch), params)
+
+    ref_loss, (rgs, rge, rgc) = sequential_reference_grads(batch)
+    np.testing.assert_allclose(np.mean(np.asarray(losses)), ref_loss,
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads[0]), rgs, rtol=1e-4,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(grads[1]), rge, rtol=1e-4,
+                               atol=1e-6)
+
+
+def test_get_forward_backward_func_dispatch():
+    """Reference: schedules/__init__.py:19-35."""
+    assert (get_forward_backward_func(None, 1)
+            is forward_backward_no_pipelining)
+    assert (get_forward_backward_func(None, 4)
+            is forward_backward_pipelining_without_interleaving)
+    f = get_forward_backward_func(2, 4)
+    assert f.func is forward_backward_pipelining_with_interleaving
+    assert f.keywords == {"num_model_chunks": 2}
+
+
+# ------------------------------ microbatches -------------------------------
+
+def test_constant_microbatches():
+    """Port of test_microbatches.py."""
+    calc = ConstantNumMicroBatches(32, 2, 4)
+    assert calc.get() == 4
+    assert calc.get_current_global_batch_size() == 32
+    with pytest.raises(AssertionError):
+        ConstantNumMicroBatches(33, 2, 4)
+
+
+def test_rampup_microbatches():
+    calc = RampupBatchsizeNumMicroBatches(
+        start_batch_size=8, batch_size_increment=8, ramup_samples=80,
+        global_batch_size=32, micro_batch_size=2, data_parallel_size=2)
+    assert calc.get_current_global_batch_size() == 8
+    assert calc.get() == 2
+    calc.update(40, True)
+    assert calc.get_current_global_batch_size() == 8 + 8
+    calc.update(100, True)
+    assert calc.get_current_global_batch_size() == 32
+    assert calc.get() == 8
+
+
+# ---------------------------------- p2p ------------------------------------
+
+def test_p2p_send_forward_recv_forward():
+    """Port of test_p2p_comm.py: each stage receives the previous stage's
+    tensor; stage 0 receives zeros."""
+    mesh = pp_mesh(NDEV)
+    xs = jnp.arange(NDEV, dtype=jnp.float32).reshape(NDEV, 1)
+
+    f = shard_map(
+        lambda x: p2p_communication.send_forward_recv_forward(x, "pp"),
+        mesh=mesh, in_specs=(P("pp"),), out_specs=P("pp"), check_vma=False)
+    out = np.asarray(f(xs)).ravel()
+    np.testing.assert_array_equal(out, [0.0] + list(range(NDEV - 1)))
+
+
+def test_p2p_send_backward_recv_backward():
+    mesh = pp_mesh(NDEV)
+    xs = jnp.arange(NDEV, dtype=jnp.float32).reshape(NDEV, 1)
+    f = shard_map(
+        lambda x: p2p_communication.send_backward_recv_backward(x, "pp"),
+        mesh=mesh, in_specs=(P("pp"),), out_specs=P("pp"), check_vma=False)
+    out = np.asarray(f(xs)).ravel()
+    np.testing.assert_array_equal(out, list(range(1, NDEV)) + [0.0])
+
+
+# ------------------------------- ltor masks --------------------------------
+
+def test_ltor_masks_and_position_ids():
+    data = jnp.asarray([[5, 1, 7, 1, 3]])  # eod = 1
+    mask, loss_mask, pos = get_ltor_masks_and_position_ids(
+        data, eod_token=1, eod_mask_loss=True)
+    assert mask.shape == (1, 1, 5, 5)
+    # causal: position 0 can only see itself → masked True above diagonal
+    assert bool(mask[0, 0, 0, 1])
+    assert not bool(mask[0, 0, 1, 0])
+    np.testing.assert_array_equal(np.asarray(loss_mask[0]),
+                                  [1, 0, 1, 0, 1])
+    np.testing.assert_array_equal(np.asarray(pos[0]), np.arange(5))
+
+
+def test_ltor_reset_position_ids():
+    data = jnp.asarray([[5, 1, 7, 2, 3]])  # eod at index 1
+    _, _, pos = get_ltor_masks_and_position_ids(
+        data, eod_token=1, reset_position_ids=True)
+    np.testing.assert_array_equal(np.asarray(pos[0]), [0, 1, 0, 1, 2])
