@@ -44,6 +44,9 @@ class LossScaler:
     scale_window: int = 2000
     min_loss_scale: float = None
     max_loss_scale: float = 2.0 ** 24
+    # overflow shrink multiplier; None → 1/scale_factor (apex always halves,
+    # torch GradScaler exposes it independently as backoff_factor)
+    backoff_factor: float = None
 
     @property
     def dynamic(self):
@@ -94,7 +97,9 @@ class LossScaler:
                 overflow=found_inf,
             )
         min_scale = self.min_loss_scale if self.min_loss_scale is not None else 0.0
-        shrunk = jnp.maximum(state.loss_scale / self.scale_factor, min_scale)
+        backoff = (self.backoff_factor if self.backoff_factor is not None
+                   else 1.0 / self.scale_factor)
+        shrunk = jnp.maximum(state.loss_scale * backoff, min_scale)
         unskipped = jnp.where(found_inf, 0, state.unskipped + 1)
         grow = unskipped == self.scale_window
         grown = jnp.minimum(state.loss_scale * self.scale_factor, self.max_loss_scale)

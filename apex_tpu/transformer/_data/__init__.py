@@ -1,0 +1,6 @@
+"""apex_tpu.transformer._data (reference: apex/transformer/_data)."""
+
+from apex_tpu.transformer._data._batchsampler import (  # noqa: F401
+    MegatronPretrainingRandomSampler,
+    MegatronPretrainingSampler,
+)

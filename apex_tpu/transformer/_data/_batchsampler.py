@@ -1,0 +1,144 @@
+"""Data-parallel-sharded pretraining batch samplers.
+
+Capability port of apex/transformer/_data/_batchsampler.py:38-180. Pure
+Python index generators (no torch dependency): both emit the LOCAL
+micro-batch index lists for one data-parallel rank, to be fed to any
+loader (tf.data, grain, numpy mmap, torch DataLoader batch_sampler=...).
+"""
+
+import numpy as np
+
+
+class _Base:
+    @property
+    def total_samples(self):
+        return self._total_samples
+
+    @property
+    def consumed_samples(self):
+        return self._consumed_samples
+
+    @property
+    def micro_batch_size(self):
+        return self._micro_batch_size
+
+    @property
+    def data_parallel_rank(self):
+        return self._data_parallel_rank
+
+    @property
+    def data_parallel_size(self):
+        return self._data_parallel_size
+
+    @property
+    def micro_batch_times_data_parallel_size(self):
+        return self._micro_batch_times_data_parallel_size
+
+
+class MegatronPretrainingSampler(_Base):
+    """Sequential DP-sharded sampler (reference: _batchsampler.py:38-100).
+
+    Each global batch of ``micro_batch_size * data_parallel_size`` sample
+    indices is split contiguously; this rank takes
+    ``[rank*mbs : (rank+1)*mbs)``.
+    """
+
+    def __init__(self, total_samples, consumed_samples, micro_batch_size,
+                 data_parallel_rank, data_parallel_size,
+                 drop_last=True):
+        self._total_samples = total_samples
+        self._consumed_samples = consumed_samples
+        self._micro_batch_size = micro_batch_size
+        self._data_parallel_rank = data_parallel_rank
+        self._data_parallel_size = data_parallel_size
+        self._micro_batch_times_data_parallel_size = (
+            micro_batch_size * data_parallel_size)
+        self.drop_last = drop_last
+
+        assert total_samples > 0, \
+            f"no sample to consume: {total_samples}"
+        assert consumed_samples < total_samples, \
+            f"no samples left to consume: {consumed_samples}, {total_samples}"
+        assert micro_batch_size > 0
+        assert data_parallel_size > 0
+        assert data_parallel_rank < data_parallel_size, (
+            f"data_parallel_rank should be smaller than data size: "
+            f"{data_parallel_rank}, {data_parallel_size}")
+
+    def __len__(self):
+        return self._total_samples
+
+    def get_start_end_idx(self):
+        start_idx = self._data_parallel_rank * self._micro_batch_size
+        end_idx = start_idx + self._micro_batch_size
+        return start_idx, end_idx
+
+    def __iter__(self):
+        batch = []
+        for idx in range(self._consumed_samples, self._total_samples):
+            batch.append(idx)
+            if len(batch) == self._micro_batch_times_data_parallel_size:
+                start_idx, end_idx = self.get_start_end_idx()
+                yield batch[start_idx:end_idx]
+                batch = []
+        if len(batch) > 0 and not self.drop_last:
+            start_idx, end_idx = self.get_start_end_idx()
+            yield batch[start_idx:end_idx]
+
+
+class MegatronPretrainingRandomSampler(_Base):
+    """Shuffled epoch-bucketed sampler (reference: _batchsampler.py:102-180).
+
+    Deterministic per-epoch permutation seeded by the epoch number; resume
+    mid-epoch via ``consumed_samples`` bookkeeping.
+    """
+
+    def __init__(self, total_samples, consumed_samples, micro_batch_size,
+                 data_parallel_rank, data_parallel_size):
+        self._total_samples = total_samples
+        self._consumed_samples = consumed_samples
+        self._micro_batch_size = micro_batch_size
+        self._data_parallel_rank = data_parallel_rank
+        self._data_parallel_size = data_parallel_size
+        self._micro_batch_times_data_parallel_size = (
+            micro_batch_size * data_parallel_size)
+        self.last_batch_size = (
+            self._total_samples % self._micro_batch_times_data_parallel_size)
+
+        assert total_samples > 0
+        assert micro_batch_size > 0
+        assert data_parallel_size > 0
+        assert data_parallel_rank < data_parallel_size
+        assert total_samples >= self._micro_batch_times_data_parallel_size, (
+            f"not enough samples ({total_samples}) for one global batch "
+            f"({self._micro_batch_times_data_parallel_size})")
+
+    def __len__(self):
+        return self._total_samples
+
+    def __iter__(self):
+        active_total_samples = self._total_samples - self.last_batch_size
+        self.epoch = self._consumed_samples // active_total_samples
+        current_epoch_samples = self._consumed_samples % active_total_samples
+        assert (current_epoch_samples
+                % self._micro_batch_times_data_parallel_size == 0)
+
+        # data sharding and random sampling
+        bucket_size = ((self._total_samples
+                        // self._micro_batch_times_data_parallel_size)
+                       * self._micro_batch_size)
+        bucket_offset = current_epoch_samples // self._data_parallel_size
+        start_idx = self._data_parallel_rank * bucket_size
+
+        rng = np.random.RandomState(seed=self.epoch)
+        random_idx = rng.permutation(bucket_size).tolist()
+        idx_range = [start_idx + x for x in random_idx[bucket_offset:]]
+
+        batch = []
+        for idx in idx_range:
+            batch.append(idx)
+            if len(batch) == self._micro_batch_size:
+                self._consumed_samples += (
+                    self._micro_batch_times_data_parallel_size)
+                yield batch
+                batch = []

@@ -1,0 +1,66 @@
+"""Model-parallel-aware grad scaler.
+
+Capability port of apex/transformer/amp/grad_scaler.py:21-119: a GradScaler
+whose overflow flag (``found_inf``) is all-reduced with MAX over the
+**model-parallel group** before the step/update decision — without this, a
+rank whose shard overflowed would skip the step while its TP/PP peers
+applied it, desynchronizing the model.
+
+Here the scaler is the pure-pytree :class:`apex_tpu.amp.LossScaler`
+specialized so ``unscale`` pmax-reduces ``found_inf`` over the model
+parallel axes (reference: ``_maybe_opt_step`` / ``_unscale_grads_`` at
+grad_scaler.py:38-49). Use inside ``shard_map`` over a mesh that includes
+the "tp"/"pp" axes.
+"""
+
+import dataclasses
+
+from jax import lax
+
+from apex_tpu.amp.scaler import LossScaler
+from apex_tpu.transformer import parallel_state
+
+
+@dataclasses.dataclass(frozen=True)
+class GradScaler(LossScaler):
+    """torch.cuda.amp.GradScaler-shaped constructor over LossScaler state.
+
+    (init_scale, growth_factor, backoff_factor, growth_interval map onto
+    LossScaler's init_scale, scale_factor, scale_window; apex keeps
+    growth==1/backoff which LossScaler also assumes.)
+    """
+
+    axis_names: tuple = ()
+
+    def __init__(self, init_scale=2.0 ** 16, growth_factor=2.0,
+                 backoff_factor=0.5, growth_interval=2000, enabled=True,
+                 axis_names=None):
+        assert growth_factor > 1.0, "The growth factor must be > 1.0."
+        assert 0.0 < backoff_factor < 1.0, \
+            "The backoff factor must be < 1.0."
+        object.__setattr__(self, "loss_scale", "dynamic" if enabled else 1.0)
+        object.__setattr__(self, "init_scale", init_scale)
+        object.__setattr__(self, "scale_factor", growth_factor)
+        object.__setattr__(self, "backoff_factor", backoff_factor)
+        object.__setattr__(self, "scale_window", growth_interval)
+        object.__setattr__(self, "min_loss_scale", None)
+        object.__setattr__(self, "max_loss_scale", 2.0 ** 24)
+        if axis_names is None:
+            axis_names = parallel_state.get_model_parallel_group()
+        object.__setattr__(self, "axis_names", tuple(axis_names))
+
+    def _sync_found_inf(self, found_inf):
+        """The all_reduce(found_inf, MAX, model_parallel_group) of
+        grad_scaler.py:38-49, as a pmax over the (pp, tp) mesh axes. Axes
+        not bound in the current shard_map are skipped (e.g. tp-only
+        tests)."""
+        for ax in self.axis_names:
+            try:
+                found_inf = lax.pmax(found_inf, ax)
+            except NameError:
+                pass
+        return found_inf
+
+    def unscale(self, grads, state):
+        grads, found_inf = super().unscale(grads, state)
+        return grads, self._sync_found_inf(found_inf)

@@ -1,0 +1,9 @@
+"""apex_tpu.transformer.functional (reference: apex/transformer/functional)."""
+
+from apex_tpu.transformer.functional.fused_softmax import (  # noqa: F401
+    FusedScaleMaskSoftmax,
+    GenericFusedScaleMaskSoftmax,
+    generic_scaled_masked_softmax,
+    scaled_masked_softmax,
+    scaled_upper_triang_masked_softmax,
+)

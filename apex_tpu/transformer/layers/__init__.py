@@ -1,0 +1,7 @@
+"""apex_tpu.transformer.layers (reference: apex/transformer/layers)."""
+
+from apex_tpu.transformer.layers.layer_norm import (  # noqa: F401
+    FastLayerNorm,
+    FusedLayerNorm,
+    MixedFusedLayerNorm,
+)

@@ -1,0 +1,70 @@
+"""Transformer-side layer norm with sequence-parallel grad marking.
+
+Capability port of apex/transformer/layers/layer_norm.py:26-99: the
+transformer stack re-exports the fused layer norms with a
+``sequence_parallel_enabled`` attribute. In the reference this sets
+``param.sequence_parallel_enabled`` so the trainer knows these params'
+grads must be all-reduced over the TP group (their input is
+sequence-sharded, so each TP rank sees different rows and computes a
+partial wgrad).
+
+On TPU the same rule is expressed functionally:
+``mark_sequence_parallel_grads`` (below) applies the psum over "tp" to the
+grads of every module instantiated with ``sequence_parallel_enabled=True``;
+module classes record the flag in their metadata (``self.sequence_parallel_
+enabled``) exactly like the reference marks params.
+"""
+
+from typing import Any, Iterable, Optional
+
+from jax import lax
+
+from apex_tpu.normalization.fused_layer_norm import (
+    FusedLayerNorm as _FusedLayerNorm,
+)
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+
+
+class FusedLayerNorm(_FusedLayerNorm):
+    """Reference: layer_norm.py:33-54 (``FusedLayerNorm`` with
+    ``sequence_parallel_enabled``)."""
+
+    sequence_parallel_enabled: bool = False
+
+
+class FastLayerNorm(FusedLayerNorm):
+    """Reference: layer_norm.py:54+ maps ``FastLayerNorm`` (the
+    contrib/layer_norm one-pass kernel, hidden sizes 768-12288) onto the
+    same module; on TPU both are the same XLA/Pallas row norm."""
+
+
+class MixedFusedLayerNorm(FusedLayerNorm):
+    """Params follow input dtype (Megatron-compatible; reference:
+    normalization/fused_layer_norm.py:398)."""
+
+
+def mark_sequence_parallel_grads(grads, axis_name: str = TENSOR_AXIS,
+                                 paths: Optional[Iterable[Any]] = None):
+    """All-reduce layer-norm (or any sequence-parallel param) grads over the
+    TP axis — the functional analog of apex's
+    ``param.sequence_parallel_enabled`` marking + trainer-side all-reduce
+    (reference: layer_norm.py:26-98 and Megatron's
+    allreduce_sequence_parallel_gradients).
+
+    ``grads``: pytree of this module's grads (inside shard_map over
+    ``axis_name``). ``paths``: optional set of pytree paths to reduce; when
+    None, all leaves are reduced (the common case of calling it on the
+    layer-norm subtree only).
+    """
+    import jax
+
+    if paths is None:
+        return jax.tree_util.tree_map(lambda g: lax.psum(g, axis_name), grads)
+    paths = set(paths)
+    flat = jax.tree_util.tree_flatten_with_path(grads)
+    leaves, treedef = flat
+    out = []
+    for path, leaf in leaves:
+        key = jax.tree_util.keystr(path)
+        out.append(lax.psum(leaf, axis_name) if key in paths else leaf)
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -1,0 +1,34 @@
+"""Transformer logging utilities.
+
+Capability port of apex/transformer/log_util.py:4-18 plus the rank-aware
+root-logger setup from apex/__init__.py:27-40.
+"""
+
+import logging
+
+
+class RankInfoFormatter(logging.Formatter):
+    """Prefixes records with process-index info (the reference prefixes
+    NCCL rank; in single-controller JAX the analog is the process index)."""
+
+    def format(self, record):
+        import jax
+
+        try:
+            rank = jax.process_index()
+            world = jax.process_count()
+        except RuntimeError:
+            rank, world = 0, 1
+        record.rank_info = f"[{rank}/{world}]"
+        return super().format(record)
+
+
+def get_transformer_logger(name: str) -> logging.Logger:
+    """Reference: log_util.py:4-10."""
+    name_wo_ext = name.rsplit(".", 1)[0]
+    return logging.getLogger(name_wo_ext)
+
+
+def set_logging_level(verbosity) -> None:
+    """Reference: log_util.py:12-18."""
+    logging.getLogger("apex_tpu").setLevel(verbosity)

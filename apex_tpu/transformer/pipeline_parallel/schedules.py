@@ -138,8 +138,7 @@ def pipeline_forward(stage_fn, stage_params, embed_fn, embed_params,
     T = M + L - 1                   # ticks until the last mb clears the ring
 
     mb0 = _index_microbatch(microbatches, 0)
-    hidden0 = embed_fn(embed_params, mb0)
-    act_shape = jax.eval_shape(lambda: hidden0)
+    act_shape = jax.eval_shape(embed_fn, embed_params, mb0)
 
     trunk = stage_fn
     if checkpoint_stages:

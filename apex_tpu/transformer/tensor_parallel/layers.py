@@ -65,6 +65,27 @@ def _sharded_init(base_init: Callable, full_shape, shard_dim: int,
     return init
 
 
+def vocab_parallel_embed(weight, input_ids, axis_name=TENSOR_AXIS,
+                         reduce_output=True):
+    """Masked lookup into this rank's vocab shard + all-reduce — the
+    functional core of VocabParallelEmbedding (reference:
+    layers.py:216-267), exposed so tied LM heads can reuse the same
+    weight (Megatron's word_embeddings_weight plumbing)."""
+    per_partition = weight.shape[0]
+    if lax.axis_size(axis_name) == 1:
+        return jnp.take(weight, input_ids, axis=0)
+    rank = lax.axis_index(axis_name)
+    start = rank * per_partition
+    # Mask + shift (layers.py:245-252)
+    in_range = (input_ids >= start) & (input_ids < start + per_partition)
+    masked = jnp.where(in_range, input_ids - start, 0)
+    out = jnp.take(weight, masked, axis=0)
+    out = jnp.where(in_range[..., None], out, 0.0)
+    if reduce_output:
+        out = mappings.reduce_from_tensor_model_parallel_region(out, axis_name)
+    return out
+
+
 class VocabParallelEmbedding(nn.Module):
     """Embedding parallelized along the vocab dimension
     (reference: layers.py:167-269).
@@ -92,20 +113,8 @@ class VocabParallelEmbedding(nn.Module):
                           self.axis_name),
             (per_partition, self.embedding_dim), self.params_dtype)
 
-        if world == 1:
-            return jnp.take(weight, input_ids, axis=0)
-
-        rank = lax.axis_index(self.axis_name)
-        start = rank * per_partition
-        # Mask + shift (layers.py:245-252)
-        in_range = (input_ids >= start) & (input_ids < start + per_partition)
-        masked = jnp.where(in_range, input_ids - start, 0)
-        out = jnp.take(weight, masked, axis=0)
-        out = jnp.where(in_range[..., None], out, 0.0)
-        if self.reduce_output:
-            out = mappings.reduce_from_tensor_model_parallel_region(
-                out, self.axis_name)
-        return out
+        return vocab_parallel_embed(weight, input_ids, self.axis_name,
+                                    self.reduce_output)
 
 
 class ColumnParallelLinear(nn.Module):

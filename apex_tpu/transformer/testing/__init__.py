@@ -1,0 +1,20 @@
+"""apex_tpu.transformer.testing (reference: apex/transformer/testing).
+
+Standalone GPT/BERT models built on the tensor/sequence-parallel layers,
+plus the global-vars singletons — the models double as the framework's
+flagship benchmark models.
+"""
+
+from apex_tpu.transformer.testing.standalone_transformer_lm import (  # noqa: F401
+    GPTModel,
+    BertModel,
+    TransformerConfig,
+    ParallelAttention,
+    ParallelMLP,
+    ParallelTransformer,
+    ParallelTransformerLayer,
+    parallel_lm_logits,
+    vocab_parallel_embed,
+    gpt_model_provider,
+    bert_model_provider,
+)

@@ -1,0 +1,561 @@
+"""Standalone tensor/sequence-parallel GPT and BERT.
+
+Capability port of apex/transformer/testing/standalone_transformer_lm.py
+(1,574 LoC: embeddings, ParallelAttention :401, ParallelMLP :304,
+ParallelTransformerLayer :709, ParallelTransformer :849, post-LM heads),
+standalone_gpt.py:111 and standalone_bert.py. These are the reference's
+test/benchmark models; here they are also the framework's flagship models.
+
+TPU-first design notes:
+
+  * hidden states keep Megatron's [s, b, h] layout so the sequence-parallel
+    first-dim scatter/gather mappings apply unchanged;
+  * attention is batched onto the MXU as [b*np, s, s] GEMMs in the amp
+    compute dtype with fp32 accumulation (the reference's cublas strided
+    batch GEMM + fused softmax kernel become two dot_generals + the ported
+    FusedScaleMaskSoftmax, which XLA fuses);
+  * weight tying (GPT logits against the word-embedding shard) is explicit
+    dataflow — ``parallel_lm_logits(hidden, word_embedding_weight)`` — the
+    functional form of Megatron's ``word_embeddings_weight()`` plumbing;
+  * dropout uses flax's "dropout" rng collection; pass
+    ``deterministic=True`` (default) for the reference's eval semantics and
+    the analytic pipeline tests.
+
+Run inside ``shard_map`` over the "tp" mesh axis (all parallel layers hold
+local shards), optionally nested under "pp"/"dp" axes via the pipeline
+schedules and DDP wrapper.
+"""
+
+import dataclasses
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax import lax
+
+from apex_tpu.normalization.fused_layer_norm import FusedLayerNorm
+from apex_tpu.transformer.enums import AttnMaskType, AttnType, LayerType
+from apex_tpu.transformer.functional import FusedScaleMaskSoftmax
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+from apex_tpu.transformer.tensor_parallel import mappings
+from apex_tpu.transformer.tensor_parallel.cross_entropy import (
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.tensor_parallel.layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    _sharded_init,
+    vocab_parallel_embed,
+)
+from apex_tpu.transformer.utils import divide
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    """One config dataclass replacing the reference's megatron argparse
+    bundle (testing/arguments.py:23-337) for model-shape options."""
+
+    hidden_size: int = 256
+    num_layers: int = 2
+    num_attention_heads: int = 8
+    ffn_hidden_size: Optional[int] = None  # default 4*h
+    vocab_size: int = 512
+    max_position_embeddings: int = 512
+    kv_channels: Optional[int] = None  # default h / heads
+    layernorm_epsilon: float = 1e-5
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    apply_query_key_layer_scaling: bool = True
+    attention_softmax_in_fp32: bool = False
+    masked_softmax_fusion: bool = True
+    sequence_parallel: bool = False
+    params_dtype: Any = jnp.float32
+    fp16: bool = False
+    bf16: bool = False
+    init_method_std: float = 0.02
+    # BERT extras
+    bert_binary_head: bool = True
+
+    @property
+    def ffn_size(self):
+        return self.ffn_hidden_size or 4 * self.hidden_size
+
+    @property
+    def head_dim(self):
+        return self.kv_channels or divide(self.hidden_size,
+                                          self.num_attention_heads)
+
+    @property
+    def compute_in_float16(self):
+        return self.fp16 or self.bf16
+
+
+def init_normal(std):
+    return nn.initializers.normal(stddev=std)
+
+
+def scaled_init_method_normal(sigma, num_layers):
+    """Output-layer init scaled by 1/sqrt(2*num_layers) (reference:
+    standalone_transformer_lm.py init helpers)."""
+    return nn.initializers.normal(stddev=sigma / math.sqrt(2.0 * num_layers))
+
+
+# ---------------------------------------------------------------------------
+# functional logits (explicit weight tying; embedding core lives in
+# tensor_parallel.layers.vocab_parallel_embed)
+# ---------------------------------------------------------------------------
+
+def parallel_lm_logits(hidden, word_embeddings_weight, parallel_output=True,
+                       bias=None, sequence_parallel=False,
+                       axis_name=TENSOR_AXIS):
+    """LM logits against the (vocab-sharded) embedding weight (reference:
+    standalone_transformer_lm.py post_language_model_processing /
+    megatron parallel_lm_logits). Column-parallel over vocab: each rank
+    computes its vocab slice; ``parallel_output=False`` gathers."""
+    if sequence_parallel:
+        hidden = mappings.gather_from_sequence_parallel_region(
+            hidden, axis_name, True)
+    else:
+        hidden = mappings.copy_to_tensor_model_parallel_region(
+            hidden, axis_name)
+    w = word_embeddings_weight.astype(hidden.dtype)
+    logits = lax.dot_general(
+        hidden, w, (((hidden.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(hidden.dtype)
+    if bias is not None:
+        logits = logits + bias.astype(logits.dtype)
+    if not parallel_output:
+        logits = mappings.gather_from_tensor_model_parallel_region(
+            logits, axis_name)
+    return logits
+
+
+# ---------------------------------------------------------------------------
+# transformer blocks
+# ---------------------------------------------------------------------------
+
+class ParallelMLP(nn.Module):
+    """h → 4h (column) → gelu → h (row) (reference:
+    standalone_transformer_lm.py:304-399)."""
+
+    cfg: TransformerConfig
+    axis_name: str = TENSOR_AXIS
+
+    @nn.compact
+    def __call__(self, hidden):
+        cfg = self.cfg
+        dense_h_to_4h = ColumnParallelLinear(
+            cfg.hidden_size, cfg.ffn_size, gather_output=False,
+            skip_bias_add=True,
+            init_method=init_normal(cfg.init_method_std),
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            params_dtype=cfg.params_dtype, axis_name=self.axis_name,
+            name="dense_h_to_4h")
+        dense_4h_to_h = RowParallelLinear(
+            cfg.ffn_size, cfg.hidden_size, input_is_parallel=True,
+            skip_bias_add=True,
+            init_method=scaled_init_method_normal(cfg.init_method_std,
+                                                  cfg.num_layers),
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            params_dtype=cfg.params_dtype, axis_name=self.axis_name,
+            name="dense_4h_to_h")
+
+        inter, bias = dense_h_to_4h(hidden)
+        # bias_gelu fusion (reference fuses via jit; XLA fuses here)
+        inter = nn.gelu(inter + bias.astype(inter.dtype), approximate=True)
+        out, out_bias = dense_4h_to_h(inter)
+        return out, out_bias
+
+
+class ParallelAttention(nn.Module):
+    """Self/cross attention over TP-sharded heads (reference:
+    standalone_transformer_lm.py:401-707)."""
+
+    cfg: TransformerConfig
+    layer_number: int = 1
+    attention_type: Any = AttnType.self_attn
+    attn_mask_type: Any = AttnMaskType.padding
+    axis_name: str = TENSOR_AXIS
+
+    @nn.compact
+    def __call__(self, hidden, attention_mask, encoder_output=None,
+                 deterministic=True):
+        cfg = self.cfg
+        tp = lax.axis_size(self.axis_name)
+        np_local = divide(cfg.num_attention_heads, tp)
+        hd = cfg.head_dim
+        proj_size = cfg.num_attention_heads * hd
+        layer_number = max(1, self.layer_number)
+
+        norm_factor = math.sqrt(hd)
+        coeff = None
+        # query-key layer scaling forces fp32 softmax (Megatron rule,
+        # reference arguments.py consistency checks)
+        softmax_in_fp32 = cfg.attention_softmax_in_fp32
+        if cfg.apply_query_key_layer_scaling:
+            coeff = float(layer_number)
+            norm_factor *= coeff
+            softmax_in_fp32 = True
+
+        if self.attention_type == AttnType.self_attn:
+            qkv_proj = ColumnParallelLinear(
+                cfg.hidden_size, 3 * proj_size, gather_output=False,
+                init_method=init_normal(cfg.init_method_std),
+                sequence_parallel_enabled=cfg.sequence_parallel,
+                params_dtype=cfg.params_dtype, axis_name=self.axis_name,
+                name="query_key_value")
+            qkv = qkv_proj(hidden)  # [s, b, 3*proj/tp]
+            s, b = qkv.shape[0], qkv.shape[1]
+            qkv = qkv.reshape(s, b, np_local, 3 * hd)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+        else:
+            q_proj = ColumnParallelLinear(
+                cfg.hidden_size, proj_size, gather_output=False,
+                init_method=init_normal(cfg.init_method_std),
+                params_dtype=cfg.params_dtype, axis_name=self.axis_name,
+                name="query")
+            kv_proj = ColumnParallelLinear(
+                cfg.hidden_size, 2 * proj_size, gather_output=False,
+                init_method=init_normal(cfg.init_method_std),
+                params_dtype=cfg.params_dtype, axis_name=self.axis_name,
+                name="key_value")
+            q = q_proj(hidden)
+            kv = kv_proj(encoder_output)
+            s, b = q.shape[0], q.shape[1]
+            sk = kv.shape[0]
+            q = q.reshape(s, b, np_local, hd)
+            kv = kv.reshape(sk, b, np_local, 2 * hd)
+            k, v = jnp.split(kv, 2, axis=-1)
+
+        # [s, b, np, hd] → [b*np, s, hd] for MXU-batched GEMMs
+        def to_bns(x):
+            return x.transpose(1, 2, 0, 3).reshape(-1, x.shape[0], hd)
+
+        qb, kb, vb = to_bns(q), to_bns(k), to_bns(v)
+
+        # raw scores [b*np, sq, sk], fp32 accumulation
+        scores = lax.dot_general(
+            qb / jnp.asarray(norm_factor, qb.dtype), kb,
+            (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+
+        sq, sk = scores.shape[1], scores.shape[2]
+        scores = scores.reshape(-1, np_local, sq, sk).astype(hidden.dtype)
+
+        scale_mask_softmax = FusedScaleMaskSoftmax(
+            cfg.fp16, cfg.bf16, self.attn_mask_type,
+            cfg.masked_softmax_fusion, attention_mask_func,
+            softmax_in_fp32, coeff)
+        probs = scale_mask_softmax(scores, attention_mask)
+
+        probs = nn.Dropout(rate=cfg.attention_dropout)(
+            probs, deterministic=deterministic)
+
+        ctx = lax.dot_general(
+            probs.reshape(-1, sq, sk).astype(vb.dtype), vb,
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32).astype(hidden.dtype)
+        # [b*np, sq, hd] → [sq, b, np*hd]
+        ctx = ctx.reshape(-1, np_local, sq, hd).transpose(2, 0, 1, 3)
+        ctx = ctx.reshape(sq, ctx.shape[1], np_local * hd)
+
+        dense = RowParallelLinear(
+            proj_size, cfg.hidden_size, input_is_parallel=True,
+            skip_bias_add=True,
+            init_method=scaled_init_method_normal(cfg.init_method_std,
+                                                  cfg.num_layers),
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            params_dtype=cfg.params_dtype, axis_name=self.axis_name,
+            name="dense")
+        out, bias = dense(ctx)
+        return out, bias
+
+
+def attention_mask_func(attention_scores, attention_mask):
+    """Reference: testing/standalone_transformer_lm.py attention_mask_func —
+    masked positions → large negative."""
+    fill = jnp.asarray(-10000.0, attention_scores.dtype)
+    return jnp.where(attention_mask, fill, attention_scores)
+
+
+class ParallelTransformerLayer(nn.Module):
+    """pre-LN block: LN → attn → residual → LN → MLP → residual
+    (reference: standalone_transformer_lm.py:709-847)."""
+
+    cfg: TransformerConfig
+    layer_number: int = 1
+    layer_type: Any = LayerType.encoder
+    self_attn_mask_type: Any = AttnMaskType.padding
+    axis_name: str = TENSOR_AXIS
+
+    @nn.compact
+    def __call__(self, hidden, attention_mask, encoder_output=None,
+                 enc_dec_attn_mask=None, deterministic=True):
+        cfg = self.cfg
+        ln = FusedLayerNorm(normalized_shape=cfg.hidden_size,
+                            eps=cfg.layernorm_epsilon,
+                            name="input_layernorm")
+        attn = ParallelAttention(cfg, self.layer_number,
+                                 AttnType.self_attn,
+                                 self.self_attn_mask_type,
+                                 axis_name=self.axis_name,
+                                 name="self_attention")
+        post_ln = FusedLayerNorm(normalized_shape=cfg.hidden_size,
+                                 eps=cfg.layernorm_epsilon,
+                                 name="post_attention_layernorm")
+        mlp = ParallelMLP(cfg, axis_name=self.axis_name, name="mlp")
+
+        def bias_dropout_add(x, bias, residual):
+            # reference: bias_dropout_add fusion (XLA fuses this chain)
+            x = x + bias.astype(x.dtype)
+            x = nn.Dropout(rate=cfg.hidden_dropout)(
+                x, deterministic=deterministic)
+            return residual + x
+
+        attn_out, attn_bias = attn(ln(hidden), attention_mask,
+                                   deterministic=deterministic)
+        hidden = bias_dropout_add(attn_out, attn_bias, hidden)
+
+        if self.layer_type == LayerType.decoder:
+            cross_ln = FusedLayerNorm(normalized_shape=cfg.hidden_size,
+                                      eps=cfg.layernorm_epsilon,
+                                      name="post_inter_attention_layernorm")
+            cross = ParallelAttention(cfg, self.layer_number,
+                                      AttnType.cross_attn,
+                                      AttnMaskType.padding,
+                                      axis_name=self.axis_name,
+                                      name="inter_attention")
+            c_out, c_bias = cross(post_ln(hidden), enc_dec_attn_mask,
+                                  encoder_output=encoder_output,
+                                  deterministic=deterministic)
+            hidden = bias_dropout_add(c_out, c_bias, hidden)
+            mlp_in = cross_ln(hidden)
+        else:
+            mlp_in = post_ln(hidden)
+
+        mlp_out, mlp_bias = mlp(mlp_in)
+        hidden = bias_dropout_add(mlp_out, mlp_bias, hidden)
+        return hidden
+
+
+class ParallelTransformer(nn.Module):
+    """Layer stack with optional final LN + activation recompute
+    (reference: standalone_transformer_lm.py:849-1020)."""
+
+    cfg: TransformerConfig
+    self_attn_mask_type: Any = AttnMaskType.padding
+    post_layer_norm: bool = True
+    pre_process: bool = True
+    post_process: bool = True
+    recompute_activations: bool = False
+    axis_name: str = TENSOR_AXIS
+
+    @nn.compact
+    def __call__(self, hidden, attention_mask, deterministic=True):
+        cfg = self.cfg
+        layer_cls = ParallelTransformerLayer
+        if self.recompute_activations:
+            # reference: tensor_parallel.random.checkpoint per layer;
+            # static_argnums: (5,) = deterministic ((0,) is self)
+            layer_cls = nn.remat(ParallelTransformerLayer,
+                                 static_argnums=(5,))
+        for i in range(cfg.num_layers):
+            layer = layer_cls(
+                cfg, layer_number=i + 1,
+                self_attn_mask_type=self.self_attn_mask_type,
+                axis_name=self.axis_name, name=f"layer_{i}")
+            hidden = layer(hidden, attention_mask, None, None, deterministic)
+        if self.post_process and self.post_layer_norm:
+            hidden = FusedLayerNorm(normalized_shape=cfg.hidden_size,
+                                    eps=cfg.layernorm_epsilon,
+                                    name="final_layernorm")(hidden)
+        return hidden
+
+
+# ---------------------------------------------------------------------------
+# GPT
+# ---------------------------------------------------------------------------
+
+class GPTModel(nn.Module):
+    """GPT language model (reference: standalone_gpt.py:111 +
+    standalone_transformer_lm.py TransformerLanguageModel/Embedding).
+
+    ``__call__(input_ids, position_ids, attention_mask, labels=None)``:
+    input_ids/position_ids [b, s]; returns vocab-parallel per-token loss
+    [b, s] when labels given, else logits. Hidden layout [s, b, h].
+    """
+
+    cfg: TransformerConfig
+    parallel_output: bool = True
+    pre_process: bool = True
+    post_process: bool = True
+    axis_name: str = TENSOR_AXIS
+
+    @nn.compact
+    def __call__(self, input_ids, position_ids, attention_mask, labels=None,
+                 deterministic=True, hidden_state=None):
+        """``hidden_state``: the upstream stage's [s, b, h] activation when
+        ``pre_process=False`` — the functional form of the reference's
+        ``set_input_tensor`` plumbing (schedules/common.py:30-80)."""
+        cfg = self.cfg
+        tp_world = lax.axis_size(self.axis_name)
+        word_embeddings = self.param(
+            "word_embeddings",
+            _sharded_init(init_normal(cfg.init_method_std),
+                          (cfg.vocab_size, cfg.hidden_size), 0,
+                          self.axis_name),
+            (divide(cfg.vocab_size, tp_world), cfg.hidden_size),
+            cfg.params_dtype)
+
+        hidden = hidden_state
+        if self.pre_process:
+            position_embeddings = self.param(
+                "position_embeddings", init_normal(cfg.init_method_std),
+                (cfg.max_position_embeddings, cfg.hidden_size),
+                cfg.params_dtype)
+            emb = (vocab_parallel_embed(word_embeddings, input_ids,
+                                        self.axis_name)
+                   + jnp.take(position_embeddings, position_ids, axis=0))
+            # [b, s, h] → [s, b, h]
+            emb = emb.transpose(1, 0, 2)
+            if cfg.compute_in_float16:
+                emb = emb.astype(jnp.bfloat16 if cfg.bf16 else jnp.float16)
+            if cfg.sequence_parallel:
+                emb = mappings.scatter_to_sequence_parallel_region(
+                    emb, self.axis_name)
+            hidden = nn.Dropout(rate=cfg.hidden_dropout)(
+                emb, deterministic=deterministic)
+        assert hidden is not None, (
+            "pre_process=False requires hidden_state (the upstream "
+            "pipeline stage's activation)")
+
+        hidden = ParallelTransformer(
+            cfg, self_attn_mask_type=AttnMaskType.causal,
+            pre_process=self.pre_process, post_process=self.post_process,
+            axis_name=self.axis_name, name="transformer")(
+            hidden, attention_mask, deterministic=deterministic)
+
+        if not self.post_process:
+            return hidden
+
+        logits = parallel_lm_logits(
+            hidden, word_embeddings, parallel_output=self.parallel_output,
+            sequence_parallel=cfg.sequence_parallel,
+            axis_name=self.axis_name)
+        # [s, b, v'] → [b, s, v']
+        logits = logits.transpose(1, 0, 2)
+
+        if labels is None:
+            return logits
+        # post_language_model_processing: vocab-parallel CE in fp32
+        return vocab_parallel_cross_entropy(
+            logits.astype(jnp.float32), labels, axis_name=self.axis_name)
+
+
+def gpt_model_provider(cfg, pre_process=True, post_process=True, **kwargs):
+    """Reference: run_gpt_minimal_test.py gpt_model_provider."""
+    return GPTModel(cfg, pre_process=pre_process, post_process=post_process,
+                    **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# BERT
+# ---------------------------------------------------------------------------
+
+class BertModel(nn.Module):
+    """Bidirectional encoder with MLM head + optional binary (NSP) head
+    (reference: standalone_bert.py, 255 LoC).
+
+    ``__call__(input_ids, attention_mask, tokentype_ids=None,
+    lm_labels=None)``; attention_mask [b, s] with 1 = attend.
+    """
+
+    cfg: TransformerConfig
+    parallel_output: bool = True
+    pre_process: bool = True
+    post_process: bool = True
+    axis_name: str = TENSOR_AXIS
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask, tokentype_ids=None,
+                 lm_labels=None, deterministic=True, hidden_state=None):
+        cfg = self.cfg
+        tp_world = lax.axis_size(self.axis_name)
+        b, s = input_ids.shape
+        position_ids = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+
+        # extended attention mask [b, 1, s, s]: True = masked out
+        m = attention_mask.astype(bool)
+        ext_mask = ~(m[:, None, None, :] & m[:, None, :, None])
+
+        word_embeddings = self.param(
+            "word_embeddings",
+            _sharded_init(init_normal(cfg.init_method_std),
+                          (cfg.vocab_size, cfg.hidden_size), 0,
+                          self.axis_name),
+            (divide(cfg.vocab_size, tp_world), cfg.hidden_size),
+            cfg.params_dtype)
+        position_embeddings = self.param(
+            "position_embeddings", init_normal(cfg.init_method_std),
+            (cfg.max_position_embeddings, cfg.hidden_size), cfg.params_dtype)
+
+        hidden = hidden_state
+        if self.pre_process:
+            emb = (vocab_parallel_embed(word_embeddings, input_ids,
+                                        self.axis_name)
+                   + jnp.take(position_embeddings, position_ids, axis=0))
+            if tokentype_ids is not None:
+                tokentype_embeddings = self.param(
+                    "tokentype_embeddings", init_normal(cfg.init_method_std),
+                    (2, cfg.hidden_size), cfg.params_dtype)
+                emb = emb + jnp.take(tokentype_embeddings, tokentype_ids,
+                                     axis=0)
+            emb = emb.transpose(1, 0, 2)
+            if cfg.compute_in_float16:
+                emb = emb.astype(jnp.bfloat16 if cfg.bf16 else jnp.float16)
+            hidden = nn.Dropout(rate=cfg.hidden_dropout)(
+                emb, deterministic=deterministic)
+        assert hidden is not None, (
+            "pre_process=False requires hidden_state")
+
+        hidden = ParallelTransformer(
+            cfg, self_attn_mask_type=AttnMaskType.padding,
+            pre_process=self.pre_process, post_process=self.post_process,
+            axis_name=self.axis_name, name="transformer")(
+            hidden, ext_mask, deterministic=deterministic)
+
+        if not self.post_process:
+            return hidden
+
+        # LM head: dense + gelu + LN, then logits vs tied embeddings
+        lm_dense = nn.Dense(cfg.hidden_size, name="lm_head_dense",
+                            param_dtype=cfg.params_dtype)
+        lm_ln = FusedLayerNorm(normalized_shape=cfg.hidden_size,
+                               eps=cfg.layernorm_epsilon, name="lm_head_ln")
+        hidden_lm = lm_ln(nn.gelu(lm_dense(hidden), approximate=True))
+        lm_logits = parallel_lm_logits(
+            hidden_lm, word_embeddings, parallel_output=self.parallel_output,
+            axis_name=self.axis_name).transpose(1, 0, 2)
+
+        binary_logits = None
+        if cfg.bert_binary_head:
+            pooled = jnp.tanh(nn.Dense(cfg.hidden_size, name="pooler",
+                                       param_dtype=cfg.params_dtype)(
+                hidden[0]))  # first token, [b, h]
+            binary_logits = nn.Dense(2, name="binary_head",
+                                     param_dtype=cfg.params_dtype)(pooled)
+
+        if lm_labels is None:
+            return lm_logits, binary_logits
+        lm_loss = vocab_parallel_cross_entropy(
+            lm_logits.astype(jnp.float32), lm_labels,
+            axis_name=self.axis_name)
+        return lm_loss, binary_logits
+
+
+def bert_model_provider(cfg, pre_process=True, post_process=True, **kwargs):
+    """Reference: run_bert_minimal_test.py bert_model_provider."""
+    return BertModel(cfg, pre_process=pre_process, post_process=post_process,
+                     **kwargs)

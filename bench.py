@@ -1,0 +1,129 @@
+"""Benchmark: flagship GPT training-step throughput on the local device.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The measured program is the full apex-equivalent training step — bf16
+forward/backward (amp O2 semantics), dynamic loss scaling, fused Adam —
+on a GPT-2-small-shaped model, single chip. ``vs_baseline`` is the ratio
+against the recorded first-measurement baseline in BENCH_BASELINE.json
+(created on first run; the reference repo publishes no numbers to compare
+against — see BASELINE.md).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from apex_tpu.amp.scaler import LossScaler
+    from apex_tpu.optimizers.fused_adam import fused_adam
+    from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+    from apex_tpu.transformer.testing import GPTModel, TransformerConfig
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+
+    # GPT-2 small shapes on TPU; tiny on CPU (local smoke)
+    if on_tpu:
+        cfg = TransformerConfig(
+            hidden_size=768, num_layers=12, num_attention_heads=12,
+            vocab_size=50304, max_position_embeddings=1024,
+            hidden_dropout=0.0, attention_dropout=0.0, bf16=True)
+        b, s, iters = 8, 1024, 20
+    else:
+        cfg = TransformerConfig(
+            hidden_size=128, num_layers=2, num_attention_heads=4,
+            vocab_size=512, max_position_embeddings=128,
+            hidden_dropout=0.0, attention_dropout=0.0, bf16=True)
+        b, s, iters = 2, 128, 3
+
+    model = GPTModel(cfg)
+    mesh = Mesh(np.asarray(jax.devices()[:1]), (TENSOR_AXIS,))
+    scaler = LossScaler()
+    tx = fused_adam(learning_rate=1e-4)
+
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (b, s)), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
+    labels = jnp.asarray(rs.randint(0, cfg.vocab_size, (b, s)), jnp.int32)
+
+    def shmap(f, n_in):
+        return jax.shard_map(f, mesh=mesh, in_specs=(P(),) * n_in,
+                             out_specs=P(), check_vma=False)
+
+    params = jax.jit(shmap(
+        lambda ids, pos: model.init(jax.random.PRNGKey(0), ids, pos,
+                                    None)["params"], 2))(ids, pos)
+    opt_state = jax.jit(lambda p: tx.init(p))(params)
+    scaler_state = scaler.init()
+
+    def train_step(params, opt_state, scaler_state, ids, pos, labels):
+        def local(params, opt_state, scaler_state, ids, pos, labels):
+            def loss_fn(p):
+                per_tok = model.apply({"params": p}, ids, pos, None, labels)
+                return jnp.mean(per_tok) * scaler_state.loss_scale
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            grads, found_inf = scaler.unscale(grads, scaler_state)
+            new_scaler_state = scaler.update(scaler_state, found_inf)
+            updates, new_opt_state = tx.update(grads, opt_state, params)
+            new_params = jax.tree_util.tree_map(
+                lambda p, u: jnp.where(found_inf, p, p + u.astype(p.dtype)),
+                params, updates)
+            new_opt_state = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(found_inf, old, new),
+                new_opt_state, opt_state)
+            return (new_params, new_opt_state, new_scaler_state,
+                    loss / scaler_state.loss_scale)
+
+        return jax.shard_map(
+            local, mesh=mesh, in_specs=(P(),) * 6, out_specs=P(),
+            check_vma=False)(params, opt_state, scaler_state, ids, pos,
+                             labels)
+
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+
+    # warmup / compile
+    params, opt_state, scaler_state, loss = step(
+        params, opt_state, scaler_state, ids, pos, labels)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, scaler_state, loss = step(
+            params, opt_state, scaler_state, ids, pos, labels)
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / iters
+
+    tokens_per_sec = b * s / dt
+
+    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "BENCH_BASELINE.json")
+    key = f"gpt_tokens_per_sec_{platform}"
+    baselines = {}
+    if os.path.exists(baseline_path):
+        with open(baseline_path) as f:
+            baselines = json.load(f)
+    if key not in baselines:
+        baselines[key] = tokens_per_sec
+        with open(baseline_path, "w") as f:
+            json.dump(baselines, f, indent=1)
+    vs_baseline = tokens_per_sec / baselines[key]
+
+    print(json.dumps({
+        "metric": f"gpt2s_train_tokens_per_sec ({platform})",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(vs_baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()
