@@ -1,0 +1,18 @@
+"""apex_tpu.contrib — production-hardened extras (L6).
+
+Capability port of apex/contrib (the MLPerf toolbox, SURVEY.md §2.7). Each
+feature is an opt-in submodule, imported lazily like the reference's
+per-extension feature gates (setup.py flags become plain imports — there is
+nothing to compile; the "native" side is XLA/Pallas).
+"""
+
+
+def __getattr__(name):
+    import importlib
+
+    if name in ("xentropy", "clip_grad", "focal_loss", "index_mul_2d",
+                "conv_bias_relu", "layer_norm", "groupbn", "cudnn_gbn",
+                "optimizers", "sparsity", "multihead_attn", "fmha",
+                "transducer", "bottleneck", "peer_memory"):
+        return importlib.import_module(f"apex_tpu.contrib.{name}")
+    raise AttributeError(f"module 'apex_tpu.contrib' has no attribute {name!r}")

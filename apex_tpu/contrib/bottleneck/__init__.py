@@ -1,0 +1,14 @@
+"""apex_tpu.contrib.bottleneck (reference: apex/contrib/bottleneck)."""
+
+from apex_tpu.contrib.bottleneck.bottleneck import (  # noqa: F401
+    Bottleneck,
+    FrozenBatchNorm2d,
+    SpatialBottleneck,
+)
+from apex_tpu.contrib.bottleneck.halo_exchangers import (  # noqa: F401
+    HaloExchanger,
+    HaloExchangerAllGather,
+    HaloExchangerNoComm,
+    HaloExchangerPeer,
+    HaloExchangerSendRecv,
+)

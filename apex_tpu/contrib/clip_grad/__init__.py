@@ -1,0 +1,3 @@
+"""apex_tpu.contrib.clip_grad (reference: apex/contrib/clip_grad)."""
+
+from apex_tpu.contrib.clip_grad.clip_grad import clip_grad_norm_  # noqa: F401

@@ -1,0 +1,8 @@
+"""apex_tpu.contrib.conv_bias_relu (reference: apex/contrib/conv_bias_relu)."""
+
+from apex_tpu.contrib.conv_bias_relu.conv_bias_relu import (  # noqa: F401
+    ConvBias,
+    ConvBiasMaskReLU,
+    ConvBiasReLU,
+    ConvFrozenScaleBiasReLU,
+)

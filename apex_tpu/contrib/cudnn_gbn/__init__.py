@@ -1,0 +1,3 @@
+"""apex_tpu.contrib.cudnn_gbn (reference: apex/contrib/cudnn_gbn)."""
+
+from apex_tpu.contrib.cudnn_gbn.batch_norm import GroupBatchNorm2d  # noqa: F401

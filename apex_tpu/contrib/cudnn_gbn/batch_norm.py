@@ -1,0 +1,22 @@
+"""cudnn-frontend group batch norm (stats reduced across a device group).
+
+Capability port of apex/contrib/cudnn_gbn/batch_norm.py:9-150 over
+``cudnn_gbn_lib`` (682 LoC) + ``peer_memory_cuda``. Same capability as
+contrib.groupbn with a cleaner surface: a GroupBatchNorm2d whose training
+statistics are averaged over ``group_size`` ranks. On TPU this is the
+identical psum-over-subgroups BN; the peer-memory fwd/bwd buffer pools the
+reference threads through are replaced by the collective itself.
+"""
+
+from apex_tpu.contrib.groupbn.batch_norm import BatchNorm2d_NHWC
+
+
+def GroupBatchNorm2d(num_features, group_size=1, axis_name=None,
+                     momentum=0.9, eps=1e-5, **kwargs):
+    """Factory mirroring the reference ctor (cudnn_gbn/batch_norm.py:44:
+    num_features, group_size, momentum, eps). Returns the TPU group-BN
+    module (flax modules are frozen dataclasses, so the arg adaptation is
+    a factory rather than a subclass __init__)."""
+    return BatchNorm2d_NHWC(num_features=num_features, bn_group=group_size,
+                            axis_name=axis_name, momentum=momentum, eps=eps,
+                            **kwargs)

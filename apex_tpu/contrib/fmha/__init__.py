@@ -1,0 +1,3 @@
+"""apex_tpu.contrib.fmha (reference: apex/contrib/fmha)."""
+
+from apex_tpu.contrib.fmha.fmha import FMHA, FMHAFun, fmha_varlen  # noqa: F401

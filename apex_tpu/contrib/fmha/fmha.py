@@ -1,0 +1,101 @@
+"""Fused multi-head attention for variable-length sequences (MLPerf BERT).
+
+Capability port of apex/contrib/fmha/fmha.py:33-90 over ``fmhalib``
+(6,958 LoC CUDA: fused QKV attention for seq ≤ 512, varlen batches packed
+as [total_tokens, 3, h, d] + cu_seqlens prefix offsets).
+
+TPU design: varlen packing exists to avoid padding waste on GPUs; on TPU
+the same effect comes from segment-id masking — the packed token stream
+stays packed, and attention is computed blockwise with a segment mask so
+tokens only attend within their own sequence. This implementation keeps
+the packed layout end-to-end (no unpack/pad round trip) and computes
+one [total, total] masked attention in the amp compute dtype; the
+dedicated Pallas flash-attention kernel (apex_tpu.ops) takes over for
+long totals, identical semantics.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+from jax import lax
+
+
+def _segment_ids_from_cu_seqlens(cu_seqlens, total):
+    """[total] segment id per packed token; cu_seqlens [b+1] prefix sums.
+    Tokens at/past cu_seqlens[-1] (padding) get id == num_seqs, which the
+    caller must treat as invalid."""
+    # token i belongs to segment = #(cu_seqlens[1:] <= i)
+    return jnp.sum(jnp.arange(total)[:, None]
+                   >= cu_seqlens[None, 1:], axis=-1)
+
+
+def fmha_varlen(qkv, cu_seqlens, p_dropout=0.0, max_s=512,
+                is_training=True, zero_tensors=False, rng=None):
+    """Packed varlen attention (reference: FMHAFun.forward fmha.py:33-47).
+
+    qkv: [total, 3, h, d]; cu_seqlens: [b+1] int32. Returns [total, h, d].
+    """
+    total, three, h, d = qkv.shape
+    assert three == 3
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # [total, h, d]
+
+    seg = _segment_ids_from_cu_seqlens(cu_seqlens, total)
+    num_seqs = cu_seqlens.shape[0] - 1
+    valid = seg < num_seqs  # tokens at/past cu_seqlens[-1] are padding
+    same_seg = (seg[:, None] == seg[None, :]) & valid[:, None] \
+        & valid[None, :]
+
+    scale = 1.0 / np.sqrt(d)
+    # [h, total, total] scores, fp32 accumulation on the MXU
+    scores = lax.dot_general(
+        (q * scale).transpose(1, 0, 2), k.transpose(1, 0, 2),
+        (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32)
+    neg = jnp.asarray(jnp.finfo(jnp.float32).min, jnp.float32)
+    scores = jnp.where(same_seg[None], scores, neg)
+    probs = jax.nn.softmax(scores, axis=-1)
+    probs = jnp.where(same_seg[None], probs, 0.0).astype(qkv.dtype)
+
+    if is_training and p_dropout > 0.0:
+        if rng is None:
+            raise ValueError("dropout requires an rng key")
+        keep = jax.random.bernoulli(rng, 1.0 - p_dropout, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - p_dropout), 0.0)
+
+    ctx = lax.dot_general(probs, v.transpose(1, 0, 2),
+                          (((2,), (1,)), ((0,), (0,))),
+                          preferred_element_type=jnp.float32)
+    return ctx.transpose(1, 0, 2).astype(qkv.dtype)  # [total, h, d]
+
+
+class FMHAFun:
+    """apply-surface of the reference autograd Function (fmha.py:33)."""
+
+    @staticmethod
+    def apply(qkv, cu_seqlens, p_dropout, max_s, is_training, zero_tensors,
+              rng=None):
+        return fmha_varlen(qkv, cu_seqlens, p_dropout, max_s, is_training,
+                           zero_tensors, rng)
+
+
+class FMHA(nn.Module):
+    """Module surface (reference: fmha.py:63-90; config carries
+    num_attention_heads / hidden_size / attention_probs_dropout_prob)."""
+
+    num_attention_heads: int
+    hidden_size: int
+    attention_probs_dropout_prob: float = 0.0
+
+    @nn.compact
+    def __call__(self, qkv, cu_seqlens, max_s, is_training=True,
+                 zero_tensors=False):
+        h = self.num_attention_heads
+        d = self.hidden_size // h
+        assert d * h == self.hidden_size, "Invalid hidden size/num_heads"
+        rng = (self.make_rng("dropout")
+               if is_training and self.attention_probs_dropout_prob > 0
+               else None)
+        ctx = fmha_varlen(qkv.reshape(-1, 3, h, d), cu_seqlens,
+                          self.attention_probs_dropout_prob, max_s,
+                          is_training, zero_tensors, rng)
+        return ctx.reshape(-1, self.hidden_size)

@@ -1,0 +1,6 @@
+"""apex_tpu.contrib.focal_loss (reference: apex/contrib/focal_loss)."""
+
+from apex_tpu.contrib.focal_loss.focal_loss import (  # noqa: F401
+    FocalLoss,
+    focal_loss,
+)

@@ -1,0 +1,68 @@
+"""Fused focal loss for detection (RetinaNet/EfficientDet-style).
+
+Capability port of apex/contrib/focal_loss/focal_loss.py:6-61 over
+``focal_loss_cuda`` (337 LoC). The CUDA kernel fuses sigmoid, the focal
+modulation, label smoothing, normalization by num_positives, and stashes
+the partial gradient; here the whole expression is one XLA fusion and the
+gradient is recomputed in backward (cheaper than stashing on TPU — it
+re-fuses with the cotangent multiply).
+
+Semantics (matching the kernel): one-vs-all sigmoid focal loss over
+``cls_output`` [..., num_classes_padded]; ``cls_targets_at_level`` holds
+class indices with -2 = ignore (zero loss), -1 = pure negative (background:
+all-classes-negative); classes ≥ num_real_classes are padding and excluded;
+the summed loss is normalized by ``num_positives_sum``.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _focal_loss(cls_output, cls_targets, num_positives_sum,
+                num_real_classes, alpha, gamma, label_smoothing):
+    # alpha/gamma/label_smoothing are Python floats (hyperparams, static
+    # under the caller's jit — same contract as the CUDA kernel's scalars)
+    x = cls_output.astype(jnp.float32)
+    num_classes = x.shape[-1]
+    t = cls_targets
+
+    # one-hot positives; -1 (negative) and -2 (ignore) produce all-zeros
+    y = jax.nn.one_hot(t, num_classes, dtype=jnp.float32)
+    if label_smoothing > 0:
+        y = y * (1.0 - label_smoothing) + 0.5 * label_smoothing
+
+    p = jax.nn.sigmoid(x)
+    # focal BCE per element: FL = -alpha_t (1-p_t)^gamma log(p_t)
+    p_t = p * y + (1.0 - p) * (1.0 - y)
+    alpha_t = alpha * y + (1.0 - alpha) * (1.0 - y)
+    # numerically-stable log(p_t) via logsigmoid
+    log_p_t = (jax.nn.log_sigmoid(x) * y
+               + jax.nn.log_sigmoid(-x) * (1.0 - y))
+    per_elem = -alpha_t * jnp.power(1.0 - p_t, gamma) * log_p_t
+
+    # mask: ignore anchors (t == -2) contribute nothing; padded classes off
+    anchor_mask = (t != -2).astype(jnp.float32)[..., None]
+    class_mask = (jnp.arange(num_classes) < num_real_classes).astype(
+        jnp.float32)
+    per_elem = per_elem * anchor_mask * class_mask
+
+    return jnp.sum(per_elem) / num_positives_sum.astype(jnp.float32)
+
+
+class FocalLoss:
+    """Class surface of the reference autograd Function (focal_loss.py:6)."""
+
+    @staticmethod
+    def apply(cls_output, cls_targets_at_level, num_positives_sum,
+              num_real_classes, alpha, gamma, label_smoothing=0.0):
+        return _focal_loss(cls_output, cls_targets_at_level,
+                           num_positives_sum, num_real_classes, alpha,
+                           gamma, label_smoothing)
+
+
+def focal_loss(cls_output, cls_targets_at_level, num_positive_sum,
+               num_real_classes, alpha, gamma, label_smoothing=0.0):
+    """Fused focal loss function (reference: focal_loss.py:42-61)."""
+    return FocalLoss.apply(cls_output, cls_targets_at_level,
+                           num_positive_sum, num_real_classes, alpha, gamma,
+                           label_smoothing)

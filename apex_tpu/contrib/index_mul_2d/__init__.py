@@ -1,0 +1,3 @@
+"""apex_tpu.contrib.index_mul_2d (reference: apex/contrib/index_mul_2d)."""
+
+from apex_tpu.contrib.index_mul_2d.index_mul_2d import index_mul_2d  # noqa: F401

@@ -1,0 +1,44 @@
+"""Fused gather-multiply (point-cloud workloads).
+
+Capability port of apex/contrib/index_mul_2d/index_mul_2d.py:5-120 over
+``fused_index_mul_2d`` (617 LoC CUDA): ``out = in1[idx1] * in2`` with a
+fused backward whose grad_in1 is a scatter-add (the CUDA kernel uses
+atomics; XLA lowers the same to a sorted segment-sum on TPU).
+
+Only dim-0 indexing of 2-D tensors, no broadcast — the kernel's contract.
+The custom_vjp exists to pin the backward to gather/scatter-add (vs XLA
+differentiating through take) and to keep grad_in1 accumulation fp32 for
+fp16 inputs like ``half_scale_forward`` does.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.custom_vjp
+def index_mul_2d(in1, in2, idx1):
+    """out[i, :] = in1[idx1[i], :] * in2[i, :] (reference:
+    IndexMul2d_.forward :12-49)."""
+    assert in1.ndim == 2 and in2.ndim == 2, \
+        "in1 and in2 must be 2-dimension tensor."
+    assert idx1.ndim == 1, "idx1 must be 1-dimension tensor."
+    assert in2.shape[0] == idx1.shape[0]
+    return jnp.take(in1, idx1, axis=0) * in2
+
+
+def _fwd(in1, in2, idx1):
+    return index_mul_2d(in1, in2, idx1), (in1, in2, idx1)
+
+
+def _bwd(res, grad_out):
+    in1, in2, idx1 = res
+    g = grad_out.astype(jnp.float32)
+    gathered = jnp.take(in1, idx1, axis=0).astype(jnp.float32)
+    grad_in2 = (gathered * g).astype(in2.dtype)
+    # scatter-add in fp32 (the kernel's atomicAdd on a zeroed buffer)
+    contrib = g * in2.astype(jnp.float32)
+    grad_in1 = jnp.zeros(in1.shape, jnp.float32).at[idx1].add(contrib)
+    return grad_in1.astype(in1.dtype), grad_in2, None
+
+
+index_mul_2d.defvjp(_fwd, _bwd)

@@ -1,0 +1,3 @@
+"""apex_tpu.contrib.layer_norm (reference: apex/contrib/layer_norm)."""
+
+from apex_tpu.contrib.layer_norm.layer_norm import FastLayerNorm  # noqa: F401

@@ -1,0 +1,8 @@
+"""apex_tpu.contrib.multihead_attn (reference: apex/contrib/multihead_attn)."""
+
+from apex_tpu.contrib.multihead_attn.self_multihead_attn import (  # noqa: F401
+    SelfMultiheadAttn,
+)
+from apex_tpu.contrib.multihead_attn.encdec_multihead_attn import (  # noqa: F401
+    EncdecMultiheadAttn,
+)

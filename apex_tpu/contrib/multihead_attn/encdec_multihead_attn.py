@@ -1,0 +1,61 @@
+"""Fast encoder-decoder multi-head attention.
+
+Capability port of apex/contrib/multihead_attn/encdec_multihead_attn.py:21-
+200 and encdec autograd fns (q from the decoder stream, packed kv from the
+encoder stream). Same TPU design notes as self_multihead_attn.
+"""
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from apex_tpu.contrib.multihead_attn.self_multihead_attn import _attn_core
+
+
+class EncdecMultiheadAttn(nn.Module):
+    """Reference ctor: encdec_multihead_attn.py:27-48."""
+
+    embed_dim: int
+    num_heads: int
+    dropout: float = 0.0
+    bias: bool = False
+    include_norm_add: bool = False
+    impl: str = "fast"
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, query, key, value=None, key_padding_mask=None,
+                 need_weights=False, attn_mask=None, is_training=True):
+        """``key`` is the encoder output; ``value`` must equal key
+        (the reference asserts inputs are the same stream and packs kv)."""
+        e, h = self.embed_dim, self.num_heads
+        assert e % h == 0
+        scaling = (e // h) ** -0.5
+
+        x = query
+        residual = query
+        if self.include_norm_add:
+            x = nn.LayerNorm(epsilon=1e-5, name="lyr_nrm",
+                             param_dtype=self.param_dtype)(x)
+
+        q = nn.DenseGeneral(e, use_bias=self.bias, name="q_proj",
+                            param_dtype=self.param_dtype,
+                            kernel_init=nn.initializers.xavier_uniform())(x)
+        kv = nn.DenseGeneral(2 * e, use_bias=self.bias, name="kv_proj",
+                             param_dtype=self.param_dtype,
+                             kernel_init=nn.initializers.xavier_uniform())(
+            key)
+        k, v = jnp.split(kv, 2, axis=-1)
+
+        drop = nn.Dropout(rate=self.dropout)
+        ctx = _attn_core(q, k, v, scaling, h, key_padding_mask, attn_mask,
+                         False, self.dropout, not is_training, drop)
+        out = nn.DenseGeneral(e, use_bias=self.bias, name="out_proj",
+                              param_dtype=self.param_dtype,
+                              kernel_init=nn.initializers.xavier_uniform())(
+            ctx)
+        if self.include_norm_add:
+            out = nn.Dropout(rate=self.dropout)(
+                out, deterministic=not is_training) + residual
+        return out, None

@@ -1,0 +1,122 @@
+"""Fast self multi-head attention.
+
+Capability port of apex/contrib/multihead_attn/self_multihead_attn.py:21-240
+and its autograd functions (self_multihead_attn_func.py,
+fast_self_multihead_attn_func.py, fast_self_multihead_attn_norm_add_func.py)
+over ``fast_multihead_attn`` (8,010 LoC CUDA).
+
+The CUDA "fast" path removes transposes/copies, fuses mask+softmax+dropout,
+and batches the GEMMs via cublasLt strided-batch; the "norm_add" variants
+prepend a fused LayerNorm and append the residual add. On TPU every one of
+those fusions is XLA's job — ``impl="fast"`` and ``impl="default"`` run the
+same program (the flag is kept so call sites port unchanged), and
+``include_norm_add`` composes the same LN → attn → dropout → +residual
+chain the fused kernel hardcodes.
+
+Layout: [seq, batch, embed] (torch MHA convention, as the reference).
+"""
+
+import math
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+from jax import lax
+
+
+def _attn_core(q, k, v, scaling, heads, key_padding_mask, attn_mask,
+               mask_additive, dropout, deterministic, dropout_module):
+    """Batched [b*h, s, d] attention with fp32-accumulated GEMMs and fp32
+    softmax (the CUDA kernels' internal accumulation)."""
+    sq, b, e = q.shape
+    sk = k.shape[0]
+    d = e // heads
+
+    def split_heads(x):
+        # [s, b, e] → [b*h, s, d]
+        return (x.reshape(x.shape[0], b * heads, d)
+                .transpose(1, 0, 2))
+
+    qb, kb, vb = split_heads(q * scaling), split_heads(k), split_heads(v)
+    scores = lax.dot_general(qb, kb, (((2,), (2,)), ((0,), (0,))),
+                             preferred_element_type=jnp.float32)
+
+    if attn_mask is not None:
+        if mask_additive:
+            scores = scores + attn_mask.astype(scores.dtype)
+        else:
+            scores = jnp.where(attn_mask.astype(bool), -jnp.inf, scores)
+    if key_padding_mask is not None:
+        # [b, sk] True = pad → mask every head/query of that batch
+        kp = key_padding_mask.astype(bool)[:, None, None, :]
+        kp = jnp.broadcast_to(kp, (b, heads, sq, sk)).reshape(
+            b * heads, sq, sk)
+        scores = jnp.where(kp, -jnp.inf, scores)
+
+    probs = nn.softmax(scores, axis=-1)
+    # fully-masked rows → 0 (matches the CUDA kernel's masked softmax)
+    probs = jnp.where(jnp.isnan(probs), 0.0, probs)
+    probs = dropout_module(probs.astype(q.dtype),
+                           deterministic=deterministic)
+
+    ctx = lax.dot_general(probs, vb, (((2,), (1,)), ((0,), (0,))),
+                          preferred_element_type=jnp.float32).astype(q.dtype)
+    return ctx.transpose(1, 0, 2).reshape(sq, b, e)
+
+
+class SelfMultiheadAttn(nn.Module):
+    """Reference ctor: self_multihead_attn.py:27-50 (embed_dim, num_heads,
+    dropout, bias, include_norm_add, impl, separate_qkv_params,
+    mask_additive)."""
+
+    embed_dim: int
+    num_heads: int
+    dropout: float = 0.0
+    bias: bool = False
+    include_norm_add: bool = False
+    impl: str = "fast"  # parity flag; both impls are the same XLA program
+    separate_qkv_params: bool = False
+    mask_additive: bool = False
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, query, key=None, value=None, key_padding_mask=None,
+                 need_weights=False, attn_mask=None, is_training=True):
+        """forward(query, key, value, key_padding_mask, need_weights,
+        attn_mask, is_training) (reference :150-240). key/value args are
+        accepted-and-ignored for self attention parity."""
+        e, h = self.embed_dim, self.num_heads
+        assert e % h == 0
+        scaling = (e // h) ** -0.5
+        dense = lambda n, feats: nn.DenseGeneral(  # noqa: E731
+            feats, use_bias=self.bias, name=n, param_dtype=self.param_dtype,
+            kernel_init=nn.initializers.xavier_uniform())
+
+        x = query
+        residual = query
+        if self.include_norm_add:
+            x = nn.LayerNorm(epsilon=1e-5, name="lyr_nrm",
+                             param_dtype=self.param_dtype)(x)
+
+        if self.separate_qkv_params:
+            q = dense("q_proj", e)(x)
+            k = dense("k_proj", e)(x)
+            v = dense("v_proj", e)(x)
+        else:
+            qkv = dense("in_proj", 3 * e)(x)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        drop = nn.Dropout(rate=self.dropout)
+        ctx = _attn_core(q, k, v, scaling, h, key_padding_mask, attn_mask,
+                         self.mask_additive, self.dropout,
+                         not is_training, drop)
+        out = nn.DenseGeneral(e, use_bias=self.bias, name="out_proj",
+                              param_dtype=self.param_dtype,
+                              kernel_init=nn.initializers.xavier_uniform())(
+            ctx)
+        if self.include_norm_add:
+            out = nn.Dropout(rate=self.dropout)(
+                out, deterministic=not is_training) + residual
+        if need_weights:
+            return out, None  # reference fast path never returns weights
+        return out, None

@@ -1,0 +1,22 @@
+"""apex_tpu.contrib.optimizers (reference: apex/contrib/optimizers).
+
+ZeRO-sharded optimizers (DistributedFusedAdam/LAMB) plus the deprecated
+earlier-generation fused optimizers kept for compat (reference:
+contrib/optimizers/fused_*.py — aliases of the main tier here, exactly as
+the reference kept old kernels behind the same names).
+"""
+
+from apex_tpu.contrib.optimizers.distributed_fused_adam import (  # noqa: F401
+    DistributedFusedAdam,
+    distributed_fused_adam,
+)
+from apex_tpu.contrib.optimizers.distributed_fused_lamb import (  # noqa: F401
+    DistributedFusedLAMB,
+    distributed_fused_lamb,
+)
+
+# deprecated compat aliases (reference: contrib/optimizers/fused_adam.py etc.)
+from apex_tpu.optimizers.fused_adam import FusedAdam  # noqa: F401
+from apex_tpu.optimizers.fused_lamb import FusedLAMB  # noqa: F401
+from apex_tpu.optimizers.fused_sgd import FusedSGD  # noqa: F401
+from apex_tpu.fp16_utils.fp16_optimizer import FP16_Optimizer  # noqa: F401

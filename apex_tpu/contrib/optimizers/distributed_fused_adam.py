@@ -1,0 +1,152 @@
+"""DistributedFusedAdam — ZeRO-2 sharded Adam.
+
+Capability port of apex/contrib/optimizers/distributed_fused_adam.py:76
+(1,426 LoC Python + 2,448 LoC CUDA): params flattened into a contiguous
+buffer, optimizer state + reduced gradients sharded over the data-parallel
+ranks, gradient sync by reduce-scatter overlapped with backward, updated
+shards re-assembled by all-gather.
+
+TPU-native shape — the whole algorithm is three collectives around flat
+math, inside ``shard_map`` over the dp axis:
+
+    flat grads ──psum_scatter──► my grad shard        (ZeRO grad sync)
+    my (m, v, master) shard ──adam──► my update shard (1/N state memory)
+    my update shard ──all_gather──► full flat update  (ZeRO param sync)
+
+The reference's overlap machinery (dwu_num_blocks/chunks double-buffering,
+side streams, pipeline hooks) is XLA's latency-hiding scheduler's job and
+the knobs are accepted as documented no-ops. The "distributed×redundant
+process grid" (dwu_group_size) maps to ``axis_index_groups`` if sub-axis
+sharding is ever needed; default shards over the full axis.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+from apex_tpu.optimizers._fused import get_meta
+from apex_tpu.optimizers.fused_adam import _adam_flat
+
+
+class DistAdamState(NamedTuple):
+    count: jnp.ndarray
+    m: jnp.ndarray       # [padded_total / num_shards] fp32, THIS rank's shard
+    v: jnp.ndarray
+    master: jnp.ndarray  # fp32 master copy of this rank's param shard
+
+
+def _padded(total, num_shards):
+    return (total + num_shards - 1) // num_shards * num_shards
+
+
+def distributed_fused_adam(learning_rate=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                           weight_decay=0.0, adam_w_mode=True,
+                           bias_correction=True, max_grad_norm=0.0, *,
+                           num_shards, axis_name="dp", grad_average=True):
+    """optax-style ZeRO-2 Adam for use INSIDE shard_map over ``axis_name``.
+
+    ``num_shards`` must equal the mesh axis size (static — shard shapes
+    depend on it). Gradients passed to ``update`` are the LOCAL grads;
+    the transform performs the cross-replica reduction itself (do NOT
+    pre-pmean them — that is this optimizer's job, like the reference DDP
+    interplay, distributed_fused_adam.py:76-120).
+    """
+    beta1, beta2 = betas
+
+    def init(params):
+        assert lax.axis_size(axis_name) == num_shards, (
+            f"num_shards ({num_shards}) != size of mesh axis "
+            f"{axis_name!r} ({lax.axis_size(axis_name)})")
+        leaves = jax.tree_util.tree_leaves(params)
+        meta = get_meta(leaves)
+        P = _padded(meta.total, num_shards)
+        shard = P // num_shards
+        idx = lax.axis_index(axis_name)
+        flat_p = jnp.concatenate(
+            [meta.flatten(leaves), jnp.zeros((P - meta.total,), jnp.float32)])
+        master = lax.dynamic_slice_in_dim(flat_p, idx * shard, shard)
+        return DistAdamState(
+            count=jnp.zeros((), jnp.int32),
+            m=jnp.zeros((shard,), jnp.float32),
+            v=jnp.zeros((shard,), jnp.float32),
+            master=master,
+        )
+
+    def update(grads, state, params=None):
+        assert params is not None
+        leaves_g, treedef = jax.tree_util.tree_flatten(grads)
+        leaves_p = jax.tree_util.tree_leaves(params)
+        meta = get_meta(leaves_p)
+        P = _padded(meta.total, num_shards)
+        shard = P // num_shards
+
+        flat_g = jnp.concatenate(
+            [meta.flatten(leaves_g),
+             jnp.zeros((P - meta.total,), jnp.float32)])
+        # ZeRO grad sync: reduce-scatter (sum) → my shard
+        g_shard = lax.psum_scatter(flat_g, axis_name, scatter_dimension=0,
+                                   tiled=True)
+        if grad_average:
+            g_shard = g_shard / num_shards
+
+        # global grad-norm clip on the reduced grads (reference:
+        # max_grad_norm handling in distributed_fused_adam.py step)
+        if max_grad_norm is not None and max_grad_norm > 0:
+            gnorm = jnp.sqrt(lax.psum(jnp.sum(g_shard * g_shard),
+                                      axis_name))
+            g_shard = g_shard / jnp.maximum(gnorm / max_grad_norm, 1.0)
+
+        count = state.count + 1
+        lr = learning_rate(count) if callable(learning_rate) \
+            else learning_rate
+        upd_shard, m, v = _adam_flat(
+            g_shard, state.master, state.m, state.v, count, lr, beta1,
+            beta2, eps, weight_decay, adam_w_mode, bias_correction)
+        master = state.master + upd_shard
+
+        # ZeRO param sync: all-gather updated shards → full flat update
+        flat_u = lax.all_gather(upd_shard, axis_name, tiled=True)
+        updates = jax.tree_util.tree_unflatten(
+            treedef, meta.unflatten(flat_u[:meta.total],
+                                    [x.dtype for x in leaves_p]))
+        return updates, DistAdamState(count=count, m=m, v=v, master=master)
+
+    return optax.GradientTransformation(init, update)
+
+
+class DistributedFusedAdam:
+    """Reference class surface (distributed_fused_adam.py:76). Accepts the
+    CUDA overlap/tuning kwargs as documented no-ops."""
+
+    def __init__(self, params, lr=1e-3, bias_correction=True,
+                 betas=(0.9, 0.999), eps=1e-8, eps_inside_sqrt=False,
+                 weight_decay=0.0, max_grad_norm=0.0, amsgrad=False,
+                 flat_mt=False, overlap_reductions=True,
+                 compute_L2_grad_norm=False, distributed_weight_update=0,
+                 dwu_group_size=0, dwu_num_blocks=4, dwu_num_rs_pg=1,
+                 dwu_num_ar_pg=4, dwu_num_ag_pg=0, dwu_num_chunks=4,
+                 revert_method=1, full_pipeline=True, e5m2_allgather=False,
+                 *, num_shards, axis_name="dp"):
+        assert not amsgrad, "amsgrad is not supported (as in the reference)"
+        self.params = params
+        self.tx = distributed_fused_adam(
+            learning_rate=lr, betas=betas, eps=eps,
+            weight_decay=weight_decay, bias_correction=bias_correction,
+            adam_w_mode=False, max_grad_norm=max_grad_norm,
+            num_shards=num_shards, axis_name=axis_name)
+        self.state = None
+
+    def init(self):
+        self.state = self.tx.init(self.params)
+        return self.state
+
+    def step(self, grads):
+        if self.state is None:
+            self.init()
+        updates, self.state = self.tx.update(grads, self.state, self.params)
+        self.params = jax.tree_util.tree_map(
+            lambda p, u: p + u.astype(p.dtype), self.params, updates)
+        return self.params

@@ -1,0 +1,188 @@
+"""DistributedFusedLAMB — ZeRO-sharded LAMB (MLPerf BERT).
+
+Capability port of apex/contrib/optimizers/distributed_fused_lamb.py:16
+(986 LoC + CUDA): sharded LAMB with overlapped reductions, fused L2 norm,
+optional compressed all-gather, ``full_ar`` vs reduce-scatter modes,
+``clip_after_ar`` grad clipping placement.
+
+TPU design mirrors distributed_fused_adam with LAMB's two extra global
+reductions, both cheap on ICI:
+
+  * global grad norm: local shard sum-of-squares → psum (the fused
+    multi_tensor_l2norm + allreduce of the reference);
+  * per-tensor trust ratios: segment-sum of the SHARDED flat buffers with
+    the matching seg-id slice → psum — per-tensor norms come out exact
+    even for tensors spanning shard boundaries, with no per-tensor
+    bookkeeping (the reference needs a dedicated L2-norm kernel over
+    block-partitioned buffers for this).
+
+e5m2 compressed allgather: bf16 gather is the TPU analog knob
+(``allgather_in_fp32=False``).
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+from apex_tpu.optimizers._fused import get_meta
+
+
+class DistLambState(NamedTuple):
+    count: jnp.ndarray
+    m: jnp.ndarray
+    v: jnp.ndarray
+    master: jnp.ndarray
+
+
+def _padded(total, num_shards):
+    return (total + num_shards - 1) // num_shards * num_shards
+
+
+def distributed_fused_lamb(learning_rate=1e-3, betas=(0.9, 0.999), eps=1e-6,
+                           weight_decay=0.01, bias_correction=True,
+                           adam_w_mode=True, grad_averaging=True,
+                           max_grad_norm=1.0, use_nvlamb=False,
+                           clip_after_ar=True, allgather_in_fp32=True, *,
+                           num_shards, axis_name="dp"):
+    """optax-style ZeRO LAMB for use INSIDE shard_map over ``axis_name``.
+    Takes LOCAL grads; reduction is internal (see distributed_fused_adam).
+    """
+    beta1, beta2 = betas
+
+    def init(params):
+        assert lax.axis_size(axis_name) == num_shards, (
+            f"num_shards ({num_shards}) != size of mesh axis "
+            f"{axis_name!r} ({lax.axis_size(axis_name)})")
+        leaves = jax.tree_util.tree_leaves(params)
+        meta = get_meta(leaves)
+        P = _padded(meta.total, num_shards)
+        shard = P // num_shards
+        idx = lax.axis_index(axis_name)
+        flat_p = jnp.concatenate(
+            [meta.flatten(leaves), jnp.zeros((P - meta.total,), jnp.float32)])
+        master = lax.dynamic_slice_in_dim(flat_p, idx * shard, shard)
+        return DistLambState(
+            count=jnp.zeros((), jnp.int32),
+            m=jnp.zeros((shard,), jnp.float32),
+            v=jnp.zeros((shard,), jnp.float32),
+            master=master,
+        )
+
+    def update(grads, state, params=None):
+        assert params is not None
+        leaves_g, treedef = jax.tree_util.tree_flatten(grads)
+        leaves_p = jax.tree_util.tree_leaves(params)
+        meta = get_meta(leaves_p)
+        P = _padded(meta.total, num_shards)
+        shard = P // num_shards
+        idx = lax.axis_index(axis_name)
+
+        flat_g = jnp.concatenate(
+            [meta.flatten(leaves_g),
+             jnp.zeros((P - meta.total,), jnp.float32)])
+        g_shard = lax.psum_scatter(flat_g, axis_name, scatter_dimension=0,
+                                   tiled=True)
+        # cross-rank averaging is unconditional (grad_averaging only
+        # selects LAMB's beta3, as in the reference)
+        g_shard = g_shard / num_shards
+
+        # sharded seg ids for per-tensor reductions (padding → segment N)
+        seg_full = jnp.concatenate(
+            [meta.seg_ids,
+             jnp.full((P - meta.total,), meta.num_tensors, jnp.int32)])
+        seg_shard = lax.dynamic_slice_in_dim(seg_full, idx * shard, shard)
+
+        def psum_segments(vals):
+            local = jax.ops.segment_sum(vals, seg_shard,
+                                        num_segments=meta.num_tensors + 1)
+            return lax.psum(local, axis_name)[:meta.num_tensors]
+
+        # global grad-norm clip (clip_after_ar=True: on reduced grads —
+        # reference distributed_fused_lamb.py "clip after allreduce")
+        gnorm_sq = lax.psum(jnp.sum(g_shard * g_shard), axis_name)
+        global_norm = jnp.sqrt(gnorm_sq)
+        if max_grad_norm is not None and max_grad_norm > 0:
+            clip = jnp.maximum(global_norm / max_grad_norm, 1.0)
+            g_shard = g_shard / clip
+
+        count = state.count + 1
+        t = count.astype(jnp.float32)
+        lr = learning_rate(count) if callable(learning_rate) \
+            else learning_rate
+        p = state.master
+        beta3 = 1.0 - beta1 if grad_averaging else 1.0
+        g_eff = g_shard if adam_w_mode else g_shard + weight_decay * p
+        m = beta1 * state.m + beta3 * g_eff
+        v = beta2 * state.v + (1.0 - beta2) * g_eff * g_eff
+        if bias_correction:
+            bc1 = 1.0 - beta1 ** t
+            bc2 = 1.0 - beta2 ** t
+        else:
+            bc1 = bc2 = 1.0
+        upd = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+        if adam_w_mode:
+            upd = upd + weight_decay * p
+
+        # exact per-tensor trust ratios from sharded buffers
+        w_norm = jnp.sqrt(psum_segments(p * p))
+        u_norm = jnp.sqrt(psum_segments(upd * upd))
+        ratio = jnp.where((w_norm > 0) & (u_norm > 0),
+                          w_norm / (u_norm + 1e-38), 1.0)
+        if weight_decay == 0.0 and not use_nvlamb:
+            ratio = jnp.ones_like(ratio)
+        ratio_flat = jnp.concatenate(
+            [ratio, jnp.ones((1,), jnp.float32)])[seg_shard]
+        upd_shard = -lr * ratio_flat * upd
+        master = p + upd_shard
+
+        gather_dtype = jnp.float32 if allgather_in_fp32 else jnp.bfloat16
+        flat_u = lax.all_gather(upd_shard.astype(gather_dtype), axis_name,
+                                tiled=True).astype(jnp.float32)
+        updates = jax.tree_util.tree_unflatten(
+            treedef, meta.unflatten(flat_u[:meta.total],
+                                    [x.dtype for x in leaves_p]))
+        return updates, DistLambState(count=count, m=m, v=v, master=master)
+
+    return optax.GradientTransformation(init, update)
+
+
+class DistributedFusedLAMB:
+    """Reference class surface (distributed_fused_lamb.py:16); CUDA
+    overlap/compression knobs accepted as documented no-ops."""
+
+    def __init__(self, params, lr=1e-3, bias_correction=True,
+                 betas=(0.9, 0.999), eps=1e-6, eps_inside_sqrt=False,
+                 weight_decay=0.01, max_grad_norm=1.0, adam_w_mode=True,
+                 use_nvlamb=False, step_supports_amp_scaling=True,
+                 overlap_reductions=True, dwu_group_size=0,
+                 dwu_num_blocks=4, dwu_num_chunks=4, dwu_num_rs_pg=1,
+                 dwu_num_ar_pg=4, dwu_num_ag_pg=0, fused_norm=False,
+                 e5m2_allgather=False, verbose=False, clip_after_ar=True,
+                 full_ar=False, set_param_views_to_flat_buffer=False,
+                 skip_allgather=False, fuse_scale=False,
+                 param_order=None, nccl_allgather_channels=0, *,
+                 num_shards, axis_name="dp"):
+        self.params = params
+        self.tx = distributed_fused_lamb(
+            learning_rate=lr, betas=betas, eps=eps,
+            weight_decay=weight_decay, bias_correction=bias_correction,
+            adam_w_mode=adam_w_mode, max_grad_norm=max_grad_norm,
+            use_nvlamb=use_nvlamb, clip_after_ar=clip_after_ar,
+            allgather_in_fp32=not e5m2_allgather, num_shards=num_shards,
+            axis_name=axis_name)
+        self.state = None
+
+    def init(self):
+        self.state = self.tx.init(self.params)
+        return self.state
+
+    def step(self, grads):
+        if self.state is None:
+            self.init()
+        updates, self.state = self.tx.update(grads, self.state, self.params)
+        self.params = jax.tree_util.tree_map(
+            lambda p, u: p + u.astype(p.dtype), self.params, updates)
+        return self.params

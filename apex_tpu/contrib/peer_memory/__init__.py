@@ -1,0 +1,6 @@
+"""apex_tpu.contrib.peer_memory (reference: apex/contrib/peer_memory)."""
+
+from apex_tpu.contrib.peer_memory.peer_memory import PeerMemoryPool  # noqa: F401
+from apex_tpu.contrib.peer_memory.peer_halo_exchanger_1d import (  # noqa: F401
+    PeerHaloExchanger1d,
+)

@@ -1,0 +1,46 @@
+"""1-D halo exchange over "peer memory" (ICI neighbor transfer).
+
+Capability port of apex/contrib/peer_memory/peer_halo_exchanger_1d.py:5-90.
+The reference pushes halo rows directly into neighbors' mapped buffers with
+signal flags; on TPU the neighbor push is ``lax.ppermute`` (see
+contrib.bottleneck.halo_exchangers for the design note). This class keeps
+the reference's "pad with halo rows in place" calling convention:
+``y`` arrives WITH 2*half_halo padding rows already allocated and the
+exchange fills them from the neighbors.
+"""
+
+import jax.numpy as jnp
+
+from apex_tpu.contrib.bottleneck.halo_exchangers import HaloExchangerSendRecv
+
+
+class PeerHaloExchanger1d:
+    """Reference ctor: (ranks, rank_in_group, peer_pool, half_halo)."""
+
+    def __init__(self, ranks=None, rank_in_group=None, peer_pool=None,
+                 half_halo=1, axis_name="spatial"):
+        self.peer_group_size = len(ranks) if ranks is not None else None
+        self.half_halo = half_halo
+        self.peer_pool = peer_pool
+        self._ex = HaloExchangerSendRecv(axis_name, self.peer_group_size)
+
+    def __call__(self, y, H_split=True, explicit_nhwc=False, numSM=1,
+                 diagnostics=False):
+        """y: NHWC [N, Hs, W, C] (H_split) or [N, H, Ws, C] with
+        2*half_halo padding rows/cols; returns y with the padding filled
+        from neighbors (functional: returns the new array)."""
+        hh = self.half_halo
+        axis = 1 if H_split else 2
+
+        def take(arr, start, size):
+            idx = [slice(None)] * arr.ndim
+            idx[axis] = slice(start, start + size)
+            return arr[tuple(idx)]
+
+        H = y.shape[axis] - 2 * hh
+        low_out = take(y, hh, hh)          # first interior rows → up
+        high_out = take(y, H, hh)          # last interior rows → down
+        low_in, high_in = self._ex.left_right_halo_exchange(low_out,
+                                                            high_out)
+        pieces = [low_in, take(y, hh, H), high_in]
+        return jnp.concatenate(pieces, axis=axis)

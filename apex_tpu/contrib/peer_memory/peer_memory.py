@@ -1,0 +1,45 @@
+"""Peer-memory pool — CUDA-IPC buffer compat surface.
+
+Capability port of apex/contrib/peer_memory/peer_memory.py:5-80 over
+``peer_memory_cuda`` (709 LoC). The reference mmaps raw CUDA allocations
+into sibling processes so halo pushes bypass NCCL. On TPU there is no
+process-addressable peer memory: direct neighbor transfers over ICI are
+what ``lax.ppermute`` compiles to, which is strictly the same capability
+(the kernel-bypass fast path) with no buffer management at all.
+
+The pool is therefore a thin allocator of ordinary device arrays that
+keeps the reference's call surface (allocate_peer_tensors) so ported code
+runs; the "peer" aspect is realized by the collectives that consume these
+buffers (see PeerHaloExchanger1d).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class PeerMemoryPool:
+    """Reference ctor: peer_memory.py:8 (static_size, dynamic_size,
+    peer_ranks)."""
+
+    def __init__(self, static_size=0, dynamic_size=0, peer_ranks=None):
+        self.static_size = static_size
+        self.dynamic_size = dynamic_size
+        self.peer_ranks = peer_ranks
+        self._dynamic_allocated = 0
+
+    def __del__(self):
+        pass
+
+    def reset(self):
+        """Reference: reset dynamic offset (peer_memory.py:40)."""
+        self._dynamic_allocated = 0
+
+    def allocate_peer_tensors(self, shape, dtype, channels_last,
+                              dynamic):
+        """Returns one zeroed buffer per peer rank (reference returns a
+        list of mapped peer tensors, peer_memory.py:50-80)."""
+        n = len(self.peer_ranks) if self.peer_ranks is not None else 1
+        size = int(np.prod(shape))
+        if dynamic:
+            self._dynamic_allocated += size * jnp.dtype(dtype).itemsize
+        return [jnp.zeros(tuple(shape), dtype) for _ in range(n)]

@@ -1,0 +1,4 @@
+"""apex_tpu.contrib.sparsity (reference: apex/contrib/sparsity)."""
+
+from apex_tpu.contrib.sparsity.asp import ASP  # noqa: F401
+from apex_tpu.contrib.sparsity.sparse_masklib import create_mask  # noqa: F401

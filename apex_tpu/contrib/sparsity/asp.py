@@ -1,0 +1,117 @@
+"""ASP — automatic sparsity.
+
+Capability port of apex/contrib/sparsity/asp.py:28-260: compute 2:4 masks
+for eligible weights, then keep applying them after every optimizer step so
+the network trains within the sparse support ("prune once, retrain").
+
+The torch version monkey-patches ``optimizer.step``; the functional analog
+wraps the optimizer transform: ``ASP.prune_trained_model``-equivalent is
+
+    asp = ASP()
+    asp.init_model_for_pruning(params)       # choose eligible weights
+    asp.compute_sparse_masks(params)         # snapshot masks
+    params = asp.apply_masks(params)         # prune
+    tx = asp.wrap_optimizer(tx)              # re-mask after every update
+
+``wrap_optimizer`` masks the UPDATES for masked weights, so a jitted train
+loop stays sparse without host sync — observably identical to the
+reference's step patch (weights outside the mask stay exactly zero).
+
+The channel-permutation accuracy search (permutation_lib.py, CUDA-
+accelerated) is out of scope here; ``allow_permutation`` is accepted and
+must be False.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.contrib.sparsity.sparse_masklib import create_mask
+
+
+def _default_allowed(path, leaf):
+    """Eligible: ≥2-D float weights whose dims divide the group (the
+    reference targets Linear/Conv weights with in-features %4 == 0,
+    asp.py:87-110)."""
+    if not jnp.issubdtype(leaf.dtype, jnp.floating):
+        return False
+    if leaf.ndim < 2:
+        return False
+    return leaf.shape[-1] % 4 == 0
+
+
+class ASP:
+    """Reference: asp.py:28 (classmethod-style singleton there; instances
+    here — tests want isolation)."""
+
+    def __init__(self):
+        self.masks = None
+        self._eligible = None
+        self.pattern = "m4n2_1d"
+
+    def init_model_for_pruning(self, params, mask_calculator="m4n2_1d",
+                               verbosity=2, whitelist=None,
+                               allowed_layer_names=None,
+                               disallowed_layer_names=(),
+                               allow_recompute_mask=False,
+                               custom_layer_dict=None,
+                               allow_permutation=False):
+        """Reference: asp.py:60-150. ``whitelist``/layer-name filters
+        operate on pytree path strings here."""
+        assert not allow_permutation, (
+            "channel-permutation search is not implemented in the TPU "
+            "build (reference: permutation_lib.py)")
+        self.pattern = mask_calculator
+
+        def eligible(path, leaf):
+            name = jax.tree_util.keystr(path)
+            if allowed_layer_names is not None and not any(
+                    a in name for a in allowed_layer_names):
+                return False
+            if any(d in name for d in disallowed_layer_names):
+                return False
+            return _default_allowed(path, leaf)
+
+        self._eligible = jax.tree_util.tree_map_with_path(eligible, params)
+        return self._eligible
+
+    def compute_sparse_masks(self, params):
+        """Reference: asp.py:152-200 — snapshot masks from current
+        magnitudes."""
+        assert self._eligible is not None, \
+            "call init_model_for_pruning first"
+        self.masks = jax.tree_util.tree_map(
+            lambda ok, p: create_mask(p, self.pattern) if ok
+            else jnp.ones_like(p),
+            self._eligible, params)
+        return self.masks
+
+    def apply_masks(self, params):
+        """Prune: w *= mask (reference: asp.py:176-184)."""
+        assert self.masks is not None
+        return jax.tree_util.tree_map(lambda p, m: p * m, params,
+                                      self.masks)
+
+    def wrap_optimizer(self, tx):
+        """Mask updates so pruned weights stay zero — the functional form
+        of the reference's patched ``optimizer.step`` (asp.py:214-240)."""
+        assert self.masks is not None
+        masks = self.masks
+
+        def init(params):
+            return tx.init(params)
+
+        def update(grads, state, params=None):
+            updates, state = tx.update(grads, state, params)
+            updates = jax.tree_util.tree_map(
+                lambda u, m: u * m.astype(u.dtype), updates, masks)
+            return updates, state
+
+        import optax
+
+        return optax.GradientTransformation(init, update)
+
+    # reference convenience (asp.py:242-260)
+    def prune_trained_model(self, params, tx):
+        self.init_model_for_pruning(params)
+        self.compute_sparse_masks(params)
+        return self.apply_masks(params), self.wrap_optimizer(tx)

@@ -1,0 +1,51 @@
+"""2:4 structured-sparsity mask computation.
+
+Capability port of apex/contrib/sparsity/sparse_masklib.py (the
+``create_mask`` dispatch + m4n2 pattern family at :145). The semantics:
+partition each weight row into groups of ``m`` consecutive elements and
+keep the ``n`` largest-magnitude entries per group (n:m sparsity; m4n2 =
+2-of-4, the pattern NVIDIA sparse tensor cores require).
+
+TPU note: MXUs don't execute 2:4 sparse matmuls, but the *capability* —
+training with hardware-friendly structured masks (for export to
+GPU-serving, or for FLOP reduction via mask-aware kernels) — ports
+directly; the mask math is pure tensor ops and jit-safe.
+"""
+
+import jax.numpy as jnp
+
+
+def _unstructured_mask(w, density):
+    k = max(1, int(round(w.size * density)))
+    flat = jnp.abs(w).reshape(-1)
+    thresh = jnp.sort(flat)[-k]
+    return (jnp.abs(w) >= thresh).astype(w.dtype).reshape(w.shape)
+
+
+def _nm_mask(w, n, m):
+    """Keep the n largest-|w| of every m consecutive elements along the
+    last dim (reference: mn_1d_best / m4n2_1d, sparse_masklib.py:98-148)."""
+    orig_shape = w.shape
+    assert orig_shape[-1] % m == 0, (
+        f"last dim {orig_shape[-1]} not divisible by group size {m}")
+    groups = jnp.abs(w).reshape(-1, m)
+    # rank within each group; keep the top-n
+    order = jnp.argsort(groups, axis=-1)  # ascending
+    ranks = jnp.argsort(order, axis=-1)
+    mask = (ranks >= (m - n)).astype(w.dtype)
+    return mask.reshape(orig_shape)
+
+
+def create_mask(tensor, pattern="m4n2_1d", density=0.5):
+    """Reference: sparse_masklib.py:145 ``create_mask(tensor, pattern)``.
+
+    Supported patterns: "m4n2_1d" (and the general "mMnN_1d" family),
+    "unstructured".
+    """
+    if pattern == "unstructured":
+        return _unstructured_mask(tensor, density)
+    if pattern.startswith("m") and "_1d" in pattern:
+        body = pattern[: pattern.index("_1d")]  # e.g. "m4n2"
+        m_str, n_str = body[1:].split("n")
+        return _nm_mask(tensor, int(n_str), int(m_str))
+    raise ValueError(f"unsupported sparsity pattern: {pattern}")

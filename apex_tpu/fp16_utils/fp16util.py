@@ -1,0 +1,145 @@
+"""Param-pytree half-precision helpers.
+
+Capability port of apex/fp16_utils/fp16util.py (187 LoC). The reference
+walks ``nn.Module`` trees casting parameters in place; here the analogs are
+pure transforms over flax/haiku-style param pytrees. Norm-layer params
+(batch/layer/group norm) stay fp32 — the "BN stays fp32" rule of
+``convert_network`` (fp16util.py:53-71).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_NORM_KEY_TOKENS = ("batchnorm", "bn", "norm", "layernorm", "groupnorm")
+
+
+def _is_norm_path(path):
+    keys = [getattr(p, "key", getattr(p, "name", "")) for p in path]
+    joined = "/".join(str(k).lower() for k in keys)
+    return any(tok in joined for tok in _NORM_KEY_TOKENS)
+
+
+def _is_float(x):
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+def tofp16(params, half_dtype=jnp.float16):
+    """Cast every floating leaf to half (reference: ``tofp16`` module
+    fp16util.py:7-14)."""
+    return jax.tree_util.tree_map(
+        lambda p: p.astype(half_dtype) if _is_float(p) else p, params)
+
+
+def BN_convert_float(params):
+    """Norm params back to fp32 (reference: fp16util.py:17-30)."""
+    def cast(path, p):
+        if _is_norm_path(path) and _is_float(p):
+            return p.astype(jnp.float32)
+        return p
+
+    return jax.tree_util.tree_map_with_path(cast, params)
+
+
+def network_to_half(params, half_dtype=jnp.float16):
+    """Half network with fp32 norms (reference: fp16util.py:33-40)."""
+    return BN_convert_float(tofp16(params, half_dtype))
+
+
+def convert_module(params, dtype):
+    """Cast one module's (subtree's) float params (reference:
+    fp16util.py:43-50)."""
+    return jax.tree_util.tree_map(
+        lambda p: p.astype(dtype) if _is_float(p) else p, params)
+
+
+def convert_network(params, dtype):
+    """Cast the network keeping norms fp32 (reference: fp16util.py:53-71)."""
+    def cast(path, p):
+        if not _is_float(p):
+            return p
+        if _is_norm_path(path):
+            return p.astype(jnp.float32)
+        return p.astype(dtype)
+
+    return jax.tree_util.tree_map_with_path(cast, params)
+
+
+class FP16Model:
+    """Wrapper casting inputs to half and running a half-converted model
+    (reference: fp16util.py:73-86 — ``network_to_half`` + input cast).
+
+    ``FP16Model(apply_fn)`` then ``model(params, *inputs)``; params are
+    converted at call time if not already.
+    """
+
+    def __init__(self, apply_fn, half_dtype=jnp.float16):
+        self.apply_fn = apply_fn
+        self.half_dtype = half_dtype
+
+    def __call__(self, params, *inputs, **kwargs):
+        params = network_to_half(params, self.half_dtype)
+        inputs = jax.tree_util.tree_map(
+            lambda x: x.astype(self.half_dtype) if _is_float(x) else x,
+            inputs)
+        return self.apply_fn(params, *inputs, **kwargs)
+
+
+def prep_param_lists(params, flat_master=False):
+    """(model_params, master_params) with fp32 master copies (reference:
+    fp16util.py:89-126). ``flat_master=True`` concatenates the masters into
+    one flat buffer (the reference's single-tensor mode)."""
+    if flat_master:
+        leaves = jax.tree_util.tree_leaves(params)
+        master = jnp.concatenate(
+            [jnp.ravel(p).astype(jnp.float32) for p in leaves])
+        return params, master
+    master = jax.tree_util.tree_map(
+        lambda p: p.astype(jnp.float32) if _is_float(p) else p, params)
+    return params, master
+
+
+def model_grads_to_master_grads(model_grads, master_params=None,
+                                flat_master=False):
+    """Upcast (half) grads into fp32 master grads (reference:
+    fp16util.py:129-144)."""
+    if flat_master:
+        leaves = jax.tree_util.tree_leaves(model_grads)
+        return jnp.concatenate(
+            [jnp.ravel(g).astype(jnp.float32) for g in leaves])
+    return jax.tree_util.tree_map(
+        lambda g: g.astype(jnp.float32) if _is_float(g) else g, model_grads)
+
+
+def master_params_to_model_params(model_params, master_params,
+                                  flat_master=False):
+    """Copy updated fp32 masters back into the model dtypes (reference:
+    fp16util.py:147-160). Returns the new model params (pure)."""
+    if flat_master:
+        leaves, treedef = jax.tree_util.tree_flatten(model_params)
+        out, off = [], 0
+        for p in leaves:
+            n = int(np.prod(p.shape)) if p.shape else 1
+            out.append(master_params[off:off + n].reshape(p.shape)
+                       .astype(p.dtype))
+            off += n
+        return jax.tree_util.tree_unflatten(treedef, out)
+    return jax.tree_util.tree_map(
+        lambda p, m: m.astype(p.dtype) if _is_float(p) else p,
+        model_params, master_params)
+
+
+def clip_grad_norm(grads, max_norm, norm_type=2):
+    """Global-norm clip returning (clipped grads, total_norm) (reference:
+    fp16util.py:163-187 wraps torch's; math identical). Pure: returns new
+    grads instead of mutating. Delegates to the contrib fused
+    implementation — one copy of the norm/clip math."""
+    from apex_tpu.contrib.clip_grad import clip_grad_norm_
+
+    return clip_grad_norm_(grads, max_norm, norm_type)
+
+
+def to_python_float(t):
+    """Reference: fp16util.py item()/first-element extraction."""
+    arr = np.asarray(t)
+    return float(arr.reshape(-1)[0]) if arr.size else 0.0

@@ -1,0 +1,74 @@
+"""Legacy loss scalers (stateful surface).
+
+Capability port of apex/fp16_utils/loss_scaler.py:10-186: the pre-amp
+``LossScaler`` (static) and ``DynamicLossScaler`` classes with their
+mutable-object, host-side-stepping API (including the reference's
+idiosyncrasies: no upper scale clamp, floor at 1). For jitted loops use
+the pure :class:`apex_tpu.amp.scaler.LossScaler` state machine instead.
+"""
+
+import jax
+import numpy as np
+
+
+class LossScaler:
+    """Static scaling (reference: loss_scaler.py:10-44)."""
+
+    def __init__(self, scale=1):
+        self.cur_scale = scale
+
+    def has_overflow(self, params):
+        return False
+
+    @staticmethod
+    def _has_inf_or_nan(x):
+        return not bool(np.all(np.isfinite(np.asarray(x))))
+
+    def update_scale(self, overflow):
+        pass
+
+    @property
+    def loss_scale(self):
+        return self.cur_scale
+
+    def scale_gradient(self, grads):
+        return jax.tree_util.tree_map(lambda g: g * self.loss_scale, grads)
+
+    def backward(self, loss_and_grad_fn, *args):
+        """Functional stand-in for ``scaled_loss.backward()``: runs the
+        grad fn on loss * scale and returns unscaled-later grads."""
+        return loss_and_grad_fn(*args)
+
+
+class DynamicLossScaler(LossScaler):
+    """Dynamic scaling (reference: loss_scaler.py:47-186): ÷2 on overflow,
+    ×2 after ``scale_window`` clean steps."""
+
+    def __init__(self, init_scale=2 ** 32, scale_factor=2.0,
+                 scale_window=1000):
+        self.cur_scale = init_scale
+        self.cur_iter = 0
+        self.last_overflow_iter = -1
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+
+    def has_overflow(self, params):
+        """Host-side inf/nan sweep (reference: loss_scaler.py:60-76)."""
+        leaves = jax.tree_util.tree_leaves(params)
+        for p in leaves:
+            if self._has_inf_or_nan(p):
+                return True
+        return False
+
+    def update_scale(self, overflow):
+        """Reference: loss_scaler.py:82-96."""
+        if overflow:
+            self.cur_scale = max(self.cur_scale / self.scale_factor, 1)
+            self.last_overflow_iter = self.cur_iter
+        elif (self.cur_iter - self.last_overflow_iter) % self.scale_window == 0:
+            self.cur_scale *= self.scale_factor
+        self.cur_iter += 1
+
+    @property
+    def loss_scale(self):
+        return self.cur_scale

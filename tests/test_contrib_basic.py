@@ -1,0 +1,261 @@
+"""Contrib tier-1 tests.
+
+Ports: apex/contrib/test/xentropy/test_label_smoothing.py (fused CE vs
+reference incl. smoothing + grads), contrib clip_grad tests, focal loss vs
+naive sigmoid-focal reference, index_mul_2d fwd/bwd vs dense ops,
+conv_bias_relu vs unfused, group BN stat sharing over mesh subgroups.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.contrib.clip_grad import clip_grad_norm_
+from apex_tpu.contrib.conv_bias_relu import (
+    ConvBias,
+    ConvBiasMaskReLU,
+    ConvBiasReLU,
+    ConvFrozenScaleBiasReLU,
+)
+from apex_tpu.contrib.focal_loss import focal_loss
+from apex_tpu.contrib.groupbn import BatchNorm2d_NHWC
+from apex_tpu.contrib.cudnn_gbn import GroupBatchNorm2d
+from apex_tpu.contrib.index_mul_2d import index_mul_2d
+from apex_tpu.contrib.layer_norm import FastLayerNorm
+from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
+
+
+# ------------------------------- xentropy ----------------------------------
+
+def _ce_ref(logits, labels, smoothing=0.0):
+    x = np.asarray(logits, np.float64)
+    lse = np.log(np.exp(x - x.max(-1, keepdims=True)).sum(-1)) \
+        + x.max(-1)
+    nll = lse - np.take_along_axis(x, labels[:, None], -1)[:, 0]
+    if smoothing:
+        mean_all = lse - x.mean(-1)
+        return (1 - smoothing) * nll + smoothing * mean_all
+    return nll
+
+
+@pytest.mark.parametrize("smoothing", [0.0, 0.1])
+def test_xentropy_matches_reference(smoothing):
+    rs = np.random.RandomState(0)
+    logits = jnp.asarray(rs.randn(8, 32), jnp.float32)
+    labels = jnp.asarray(rs.randint(0, 32, (8,)))
+    got = softmax_cross_entropy_loss(logits, labels, smoothing)
+    np.testing.assert_allclose(np.asarray(got),
+                               _ce_ref(logits, np.asarray(labels), smoothing),
+                               rtol=1e-5)
+
+
+@pytest.mark.parametrize("smoothing", [0.0, 0.1])
+def test_xentropy_grad_matches_autodiff(smoothing):
+    rs = np.random.RandomState(1)
+    logits = jnp.asarray(rs.randn(4, 16), jnp.float32)
+    labels = jnp.asarray(rs.randint(0, 16, (4,)))
+
+    def fused(x):
+        return jnp.sum(softmax_cross_entropy_loss(x, labels, smoothing))
+
+    def plain(x):
+        logp = jax.nn.log_softmax(x)
+        nll = -jnp.take_along_axis(logp, labels[:, None], -1)[:, 0]
+        if smoothing:
+            nll = (1 - smoothing) * nll - smoothing * jnp.mean(logp, -1)
+        return jnp.sum(nll)
+
+    np.testing.assert_allclose(np.asarray(jax.grad(fused)(logits)),
+                               np.asarray(jax.grad(plain)(logits)),
+                               atol=1e-5)
+
+
+def test_xentropy_half_to_float():
+    logits = jnp.ones((2, 8), jnp.bfloat16)
+    labels = jnp.zeros((2,), jnp.int32)
+    assert softmax_cross_entropy_loss(logits, labels, 0.0,
+                                      True).dtype == jnp.float32
+    assert softmax_cross_entropy_loss(logits, labels, 0.0,
+                                      False).dtype == jnp.bfloat16
+
+
+# ------------------------------- clip_grad ---------------------------------
+
+def test_clip_grad_norm_scales_and_noops():
+    grads = {"a": jnp.full((3,), 4.0), "b": jnp.full((4,), 3.0)}
+    total = float(np.sqrt(3 * 16 + 4 * 9))
+    clipped, norm = clip_grad_norm_(grads, max_norm=total * 2)
+    np.testing.assert_allclose(float(norm), total, rtol=1e-6)
+    # above max_norm → untouched
+    np.testing.assert_allclose(np.asarray(clipped["a"]), 4.0, rtol=1e-5)
+    clipped, _ = clip_grad_norm_(grads, max_norm=1.0)
+    new_norm = np.sqrt(sum(float(jnp.sum(g ** 2))
+                           for g in jax.tree_util.tree_leaves(clipped)))
+    np.testing.assert_allclose(new_norm, 1.0, rtol=1e-4)
+
+
+def test_clip_grad_norm_inf_norm():
+    grads = [jnp.asarray([1.0, -5.0]), jnp.asarray([2.0])]
+    _, norm = clip_grad_norm_(grads, 10.0, norm_type=float("inf"))
+    assert float(norm) == 5.0
+
+
+def test_clip_grad_norm_nonfinite_raises():
+    with pytest.raises(RuntimeError):
+        clip_grad_norm_([jnp.asarray([np.inf])], 1.0,
+                        error_if_nonfinite=True)
+
+
+# ------------------------------- focal loss --------------------------------
+
+def test_focal_loss_matches_naive():
+    """vs a naive per-element sigmoid focal loss (the contrib test's
+    reference implementation pattern)."""
+    rs = np.random.RandomState(2)
+    n_anchor, n_cls = 16, 8
+    logits = rs.randn(n_anchor, n_cls).astype(np.float32)
+    targets = rs.randint(-2, n_cls, (n_anchor,))
+    npos = np.float32(max((targets >= 0).sum(), 1))
+    alpha, gamma = 0.25, 2.0
+
+    got = float(focal_loss(jnp.asarray(logits), jnp.asarray(targets),
+                           jnp.asarray(npos), n_cls, alpha, gamma))
+
+    x = logits.astype(np.float64)
+    p = 1 / (1 + np.exp(-x))
+    want = 0.0
+    for i in range(n_anchor):
+        if targets[i] == -2:
+            continue
+        for c in range(n_cls):
+            y = 1.0 if targets[i] == c else 0.0
+            pt = p[i, c] * y + (1 - p[i, c]) * (1 - y)
+            at = alpha * y + (1 - alpha) * (1 - y)
+            want += -at * (1 - pt) ** gamma * np.log(pt)
+    np.testing.assert_allclose(got, want / npos, rtol=1e-4)
+
+
+def test_focal_loss_grad_finite():
+    logits = jnp.zeros((4, 4), jnp.float32)
+    targets = jnp.asarray([0, 1, -1, -2])
+    g = jax.grad(lambda x: focal_loss(x, targets, jnp.float32(2.0), 4,
+                                      0.25, 2.0))(logits)
+    assert np.isfinite(np.asarray(g)).all()
+    # ignored anchor (-2) must get zero grad
+    np.testing.assert_array_equal(np.asarray(g)[3], 0)
+
+
+# ------------------------------ index_mul_2d -------------------------------
+
+def test_index_mul_2d_fwd_bwd():
+    rs = np.random.RandomState(3)
+    in1 = jnp.asarray(rs.randn(10, 4), jnp.float32)
+    in2 = jnp.asarray(rs.randn(6, 4), jnp.float32)
+    idx = jnp.asarray(rs.randint(0, 10, (6,)))
+    out = index_mul_2d(in1, in2, idx)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(in1)[np.asarray(idx)]
+                               * np.asarray(in2), rtol=1e-6)
+
+    def fused(a, b):
+        return jnp.sum(index_mul_2d(a, b, idx) ** 2)
+
+    def plain(a, b):
+        return jnp.sum((jnp.take(a, idx, axis=0) * b) ** 2)
+
+    ga, gb = jax.grad(fused, argnums=(0, 1))(in1, in2)
+    ga2, gb2 = jax.grad(plain, argnums=(0, 1))(in1, in2)
+    np.testing.assert_allclose(np.asarray(ga), np.asarray(ga2), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gb), np.asarray(gb2), atol=1e-5)
+
+
+# ------------------------------ conv_bias_relu -----------------------------
+
+def test_conv_bias_relu_variants():
+    rs = np.random.RandomState(4)
+    x = jnp.asarray(rs.randn(2, 8, 8, 3), jnp.float32)
+    w = jnp.asarray(rs.randn(3, 3, 3, 5) * 0.1, jnp.float32)
+    b = jnp.asarray(rs.randn(5), jnp.float32)
+    mask = jnp.asarray(rs.rand(2, 8, 8, 5) < 0.5, jnp.float32)
+    scale = jnp.asarray(rs.rand(5) + 0.5, jnp.float32)
+
+    from jax import lax
+    raw = lax.conv_general_dilated(
+        x, w, (1, 1), ((1, 1), (1, 1)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    np.testing.assert_allclose(
+        np.asarray(ConvBiasReLU.apply(x, w, b, 1, 1)),
+        np.maximum(np.asarray(raw) + np.asarray(b), 0), atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(ConvBias.apply(x, w, b, 1, 1)),
+        np.asarray(raw) + np.asarray(b), atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(ConvBiasMaskReLU.apply(x, w, b, mask, 1, 1)),
+        np.maximum((np.asarray(raw) + np.asarray(b)) * np.asarray(mask), 0),
+        atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(ConvFrozenScaleBiasReLU.apply(x, w, scale, b, 1, 1)),
+        np.maximum(np.asarray(raw) * np.asarray(scale) + np.asarray(b), 0),
+        atol=1e-4)
+
+
+# ------------------------------ group BN -----------------------------------
+
+def test_groupbn_parity_with_plain_bn():
+    rs = np.random.RandomState(5)
+    x = jnp.asarray(rs.randn(4, 6, 6, 8), jnp.float32)
+    bn = BatchNorm2d_NHWC(num_features=8)
+    vars_ = bn.init(jax.random.PRNGKey(0), x)
+    y, _ = bn.apply(vars_, x, mutable=["batch_stats"])
+    xf = np.asarray(x)
+    want = (xf - xf.mean((0, 1, 2))) / np.sqrt(xf.var((0, 1, 2)) + 1e-5)
+    np.testing.assert_allclose(np.asarray(y), want, atol=1e-4)
+
+
+def test_groupbn_fuse_relu_and_residual():
+    rs = np.random.RandomState(6)
+    x = jnp.asarray(rs.randn(2, 4, 4, 3), jnp.float32)
+    z = jnp.asarray(rs.randn(2, 4, 4, 3), jnp.float32)
+    bn = BatchNorm2d_NHWC(num_features=3, fuse_relu=True)
+    vars_ = bn.init(jax.random.PRNGKey(0), x)
+    y, _ = bn.apply(vars_, x, z, mutable=["batch_stats"])
+    assert (np.asarray(y) >= 0).all()
+
+
+def test_group_bn_stats_shared_across_subgroups():
+    """bn_group=2 over an 8-wide dp axis: stats equal within pairs,
+    differ across pairs (reference: bn_group semantics)."""
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    rs = np.random.RandomState(7)
+    x = jnp.asarray(rs.randn(16, 4, 4, 3), jnp.float32)
+    bn = GroupBatchNorm2d(num_features=3, group_size=2, axis_name="dp")
+
+    def run(x):
+        vars_ = bn.init(jax.random.PRNGKey(0), x)
+        y, new_vars = bn.apply(vars_, x, mutable=["batch_stats"])
+        return y, new_vars["batch_stats"]["running_mean"]
+
+    y, means = shard_map(run, mesh=mesh, in_specs=(P("dp"),),
+                         out_specs=(P("dp"), P("dp")), check_vma=False)(x)
+    means = np.asarray(means).reshape(8, 3)
+    for pair in range(4):
+        np.testing.assert_allclose(means[2 * pair], means[2 * pair + 1],
+                                   rtol=1e-5)
+    assert not np.allclose(means[0], means[2])
+
+
+def test_fast_layer_norm_alias():
+    rs = np.random.RandomState(8)
+    x = jnp.asarray(rs.randn(4, 768), jnp.float32)
+    ln = FastLayerNorm(768)
+    vars_ = ln.init(jax.random.PRNGKey(0), x)
+    y = ln.apply(vars_, x)
+    xf = np.asarray(x)
+    want = (xf - xf.mean(-1, keepdims=True)) \
+        / np.sqrt(xf.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(np.asarray(y), want, atol=1e-4)

@@ -1,0 +1,125 @@
+"""ZeRO-sharded optimizer tests.
+
+The correctness bar (reference: contrib tests for
+DistributedFusedAdam/LAMB): sharded update == unsharded fused update, with
+optimizer state 1/N per shard.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.contrib.optimizers import (
+    distributed_fused_adam,
+    distributed_fused_lamb,
+)
+from apex_tpu.optimizers.fused_adam import fused_adam
+from apex_tpu.optimizers.fused_lamb import fused_lamb
+
+NDEV = 8
+
+
+def _params():
+    rs = np.random.RandomState(0)
+    return {
+        "a": jnp.asarray(rs.randn(13, 7), jnp.float32),   # odd sizes: test
+        "b": jnp.asarray(rs.randn(5,), jnp.float32),      # shard padding +
+        "c": jnp.asarray(rs.randn(3, 3, 3), jnp.float32), # boundary spans
+    }
+
+
+def _grads():
+    rs = np.random.RandomState(1)
+    return {
+        "a": jnp.asarray(rs.randn(13, 7), jnp.float32),
+        "b": jnp.asarray(rs.randn(5,), jnp.float32),
+        "c": jnp.asarray(rs.randn(3, 3, 3), jnp.float32),
+    }
+
+
+def _run_sharded(dist_tx, params, grads, steps=3):
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+
+    def run(params, grads):
+        state = dist_tx.init(params)
+        for _ in range(steps):
+            updates, state = dist_tx.update(grads, state, params)
+            params = jax.tree_util.tree_map(jnp.add, params, updates)
+        return params, jnp.asarray(state.m.shape[0])
+
+    # grads replicated: every rank contributes the same grad; the internal
+    # reduce-scatter sums then averages over num_shards
+    f = shard_map(run, mesh=mesh, in_specs=(P(), P()), out_specs=(P(), P()),
+                  check_vma=False)
+    params, shard_len = f(params, grads)
+    return params, int(shard_len)
+
+
+def _run_reference(tx, params, grads, steps=3):
+    state = tx.init(params)
+    for _ in range(steps):
+        updates, state = tx.update(grads, state, params)
+        params = jax.tree_util.tree_map(jnp.add, params, updates)
+    return params
+
+
+def test_distributed_adam_matches_fused_adam():
+    params, grads = _params(), _grads()
+    dist = distributed_fused_adam(learning_rate=0.1, weight_decay=0.01,
+                                  num_shards=NDEV, axis_name="dp")
+    ref = fused_adam(learning_rate=0.1, weight_decay=0.01)
+    got, shard_len = _run_sharded(dist, params, grads)
+    want = _run_reference(ref, params, grads)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(got[k]),
+                                   np.asarray(want[k]), rtol=2e-5,
+                                   atol=1e-6)
+    # ZeRO: state is 1/N (padded)
+    total = sum(int(np.prod(v.shape)) for v in params.values())
+    assert shard_len == (total + NDEV - 1) // NDEV * NDEV // NDEV
+
+
+def test_distributed_lamb_matches_fused_lamb():
+    params, grads = _params(), _grads()
+    dist = distributed_fused_lamb(learning_rate=0.01, weight_decay=0.01,
+                                  max_grad_norm=1.0, num_shards=NDEV,
+                                  axis_name="dp")
+    ref = fused_lamb(learning_rate=0.01, weight_decay=0.01,
+                     max_grad_norm=1.0)
+    got, _ = _run_sharded(dist, params, grads)
+    want = _run_reference(ref, params, grads)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(got[k]),
+                                   np.asarray(want[k]), rtol=2e-4,
+                                   atol=1e-6)
+
+
+def test_distributed_adam_reduces_distinct_rank_grads():
+    """Per-rank distinct grads → behaves like mean of grads (the DDP+ZeRO
+    composition)."""
+    params = {"w": jnp.zeros((16,), jnp.float32)}
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    dist = distributed_fused_adam(learning_rate=0.1, num_shards=NDEV,
+                                  axis_name="dp")
+    ref = fused_adam(learning_rate=0.1)
+
+    # rank r grad = (r+1) * ones → mean = 4.5
+    per_rank = jnp.stack([jnp.full((16,), float(r + 1))
+                          for r in range(NDEV)])
+
+    def run(params, my_grad):
+        g = {"w": my_grad[0]}
+        state = dist.init(params)
+        updates, state = dist.update(g, state, params)
+        return jax.tree_util.tree_map(jnp.add, params, updates)
+
+    got = shard_map(run, mesh=mesh, in_specs=(P(), P("dp")),
+                    out_specs=P(), check_vma=False)(params, per_rank)
+    state = ref.init(params)
+    updates, _ = ref.update({"w": jnp.full((16,), 4.5)}, state, params)
+    want = jax.tree_util.tree_map(jnp.add, params, updates)
+    np.testing.assert_allclose(np.asarray(got["w"]),
+                               np.asarray(want["w"]), rtol=1e-5)

@@ -1,0 +1,117 @@
+"""fp16_utils ports (reference tests: tests/L0/run_fp16util)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.fp16_utils import (
+    FP16_Optimizer,
+    clip_grad_norm,
+    convert_network,
+    master_params_to_model_params,
+    model_grads_to_master_grads,
+    network_to_half,
+    prep_param_lists,
+    to_python_float,
+    tofp16,
+)
+from apex_tpu.optimizers.fused_adam import fused_adam
+
+
+PARAMS = {
+    "dense": {"kernel": jnp.ones((4, 4), jnp.float32),
+              "bias": jnp.zeros((4,), jnp.float32)},
+    "batchnorm_0": {"scale": jnp.ones((4,), jnp.float32)},
+    "step": jnp.asarray(3, jnp.int32),  # non-float leaf stays untouched
+}
+
+
+def test_network_to_half_keeps_norms_fp32():
+    half = network_to_half(PARAMS)
+    assert half["dense"]["kernel"].dtype == jnp.float16
+    assert half["batchnorm_0"]["scale"].dtype == jnp.float32
+    assert half["step"].dtype == jnp.int32
+
+
+def test_tofp16_and_convert_network_bf16():
+    assert tofp16(PARAMS)["batchnorm_0"]["scale"].dtype == jnp.float16
+    conv = convert_network(PARAMS, jnp.bfloat16)
+    assert conv["dense"]["kernel"].dtype == jnp.bfloat16
+    assert conv["batchnorm_0"]["scale"].dtype == jnp.float32
+
+
+def test_prep_param_lists_flat_master_roundtrip():
+    """Reference: test_fp16util.py flat_master round trip."""
+    model = {"a": jnp.full((2, 3), 1.5, jnp.float16),
+             "b": jnp.full((4,), -2.0, jnp.float16)}
+    _, master = prep_param_lists(model, flat_master=True)
+    assert master.dtype == jnp.float32 and master.shape == (10,)
+    back = master_params_to_model_params(model, master, flat_master=True)
+    for k in model:
+        np.testing.assert_array_equal(np.asarray(back[k]),
+                                      np.asarray(model[k]))
+    grads = jax.tree_util.tree_map(jnp.ones_like, model)
+    mg = model_grads_to_master_grads(grads, flat_master=True)
+    assert mg.dtype == jnp.float32 and mg.shape == (10,)
+
+
+def test_clip_grad_norm():
+    grads = {"a": jnp.full((10,), 3.0), "b": jnp.full((10,), 4.0)}
+    clipped, total = clip_grad_norm(grads, max_norm=1.0)
+    np.testing.assert_allclose(float(total), np.sqrt(90 + 160), rtol=1e-6)
+    new_total = np.sqrt(sum(
+        float(jnp.sum(g ** 2)) for g in jax.tree_util.tree_leaves(clipped)))
+    np.testing.assert_allclose(new_total, 1.0, rtol=1e-4)
+
+
+def test_to_python_float():
+    assert to_python_float(jnp.asarray([2.5, 1.0])) == 2.5
+    assert to_python_float(jnp.asarray(7)) == 7.0
+
+
+def test_fp16_optimizer_step_and_overflow():
+    """FP16_Optimizer: master weights update, model params track, overflow
+    skips (reference: fp16_optimizer semantics)."""
+    params = {"w": jnp.full((4,), 2.0, jnp.float16)}
+    # init_scale small enough that scaled fp16 grads stay finite (2^16
+    # would overflow fp16 here — which the dynamic scaler would then
+    # legitimately skip)
+    opt = FP16_Optimizer(fused_adam(learning_rate=0.1), params,
+                         dynamic_loss_scale=True,
+                         dynamic_loss_args={"init_scale": 2.0 ** 8},
+                         verbose=False)
+
+    def lg(p_):
+        def loss_fn(p):
+            return jnp.sum(p["w"].astype(jnp.float32) ** 2) * opt.scaler_state.loss_scale
+        return jax.value_and_grad(loss_fn)(p_)
+
+    loss = opt.backward(lg, opt.model_params)
+    opt.step()
+    assert not opt.overflow
+    assert float(opt.master_params["w"][0]) < 2.0
+    np.testing.assert_allclose(np.asarray(opt.model_params["w"], np.float32),
+                               np.asarray(opt.master_params["w"]), atol=1e-2)
+
+    # inf grads → skip + scale halved
+    before = opt.master_params["w"]
+    scale_before = opt.loss_scale
+    opt._grads = {"w": jnp.full((4,), np.inf, jnp.float16)}
+    opt.step()
+    assert opt.overflow
+    np.testing.assert_array_equal(np.asarray(opt.master_params["w"]),
+                                  np.asarray(before))
+    assert opt.loss_scale == scale_before / 2
+
+
+def test_fp16_optimizer_state_dict_roundtrip():
+    params = {"w": jnp.full((4,), 2.0, jnp.float16)}
+    opt = FP16_Optimizer(fused_adam(learning_rate=0.1), params,
+                         dynamic_loss_scale=True, verbose=False)
+    sd = opt.state_dict()
+    opt2 = FP16_Optimizer(fused_adam(learning_rate=0.1), params,
+                          dynamic_loss_scale=True, verbose=False)
+    opt2.load_state_dict(sd)
+    np.testing.assert_array_equal(np.asarray(opt2.master_params["w"]),
+                                  np.asarray(opt.master_params["w"]))
