@@ -1,0 +1,8 @@
+"""apex_tpu.RNN (reference: apex/RNN/__init__.py:1-6)."""
+
+from apex_tpu.RNN.models import LSTM, GRU, ReLU, Tanh, mLSTM  # noqa: F401
+from apex_tpu.RNN.rnn_backend import (  # noqa: F401
+    RNN,
+    bidirectionalRNN,
+    stackedRNN,
+)

@@ -1,0 +1,31 @@
+"""RNN model factories (reference: apex/RNN/models.py:19-52).
+
+Same factory surface: ``LSTM(input_size, hidden_size, num_layers, ...)``
+returns a ready RNN module. ``batch_first`` transposes at the boundary
+(the reference accepts-and-ignores it; here it works).
+"""
+
+from apex_tpu.RNN.rnn_backend import RNN
+
+
+def _factory(cell_type):
+    def make(input_size, hidden_size, num_layers, bias=True,
+             batch_first=False, dropout=0, bidirectional=False,
+             output_size=None):
+        assert not batch_first, (
+            "batch_first is not supported by the reference backend either "
+            "(apex/RNN/models.py ignores it); pass [T, B, F] inputs")
+        return RNN(cell_type=cell_type, input_size=input_size,
+                   hidden_size=hidden_size, num_layers=num_layers,
+                   bias=bias, dropout=dropout, bidirectional=bidirectional,
+                   output_size=output_size)
+
+    make.__name__ = cell_type
+    return make
+
+
+LSTM = _factory("LSTM")
+GRU = _factory("GRU")
+ReLU = _factory("ReLU")
+Tanh = _factory("Tanh")
+mLSTM = _factory("mLSTM")

@@ -1,0 +1,219 @@
+"""Fused-cell RNN library.
+
+Capability port of apex/RNN/RNNBackend.py (506 LoC with cells.py/models.py):
+``RNNCell`` (generic gate container), ``stackedRNN`` (layer stack),
+``bidirectionalRNN`` (fwd/bwd concat), and the mLSTM cell. The reference
+exists because cuDNN's fused RNNs were inflexible — it runs per-timestep
+Python with "fused pointwise" kernels. The TPU-native shape is the
+opposite: one ``lax.scan`` over time per layer (the entire sequence loop
+is a single compiled region; XLA pipelines the gate GEMMs onto the MXU),
+cells as pure gate functions.
+
+Layout: [seq, batch, feature] (the reference's default; batch_first is
+handled by the factories in models.py).
+
+The reference's stateful surface (``init_hidden``/``detach_hidden``/
+``reset_hidden`` mutating ``self.hidden``) becomes explicit carry state:
+``__call__`` takes and returns hidden state pytrees, the jit-safe form of
+the same capability.
+"""
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+# --------------------------- cell gate functions ---------------------------
+# (reference: torch.nn._functions.rnn LSTMCell/GRUCell/... + cells.py
+#  mLSTMCell; each takes pre-projected gates and the hidden state)
+
+def lstm_cell(x, hidden, w_ih, w_hh, b_ih=None, b_hh=None):
+    h, c = hidden
+    gates = x @ w_ih.T + h @ w_hh.T
+    if b_ih is not None:
+        gates = gates + b_ih + b_hh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c = f * c + i * g
+    h = o * jnp.tanh(c)
+    return h, c
+
+
+def gru_cell(x, hidden, w_ih, w_hh, b_ih=None, b_hh=None):
+    h = hidden
+    gi = x @ w_ih.T + (b_ih if b_ih is not None else 0)
+    gh = h @ w_hh.T + (b_hh if b_hh is not None else 0)
+    i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+    h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(i_r + h_r)
+    z = jax.nn.sigmoid(i_z + h_z)
+    n = jnp.tanh(i_n + r * h_n)
+    return (1 - z) * n + z * h
+
+
+def rnn_relu_cell(x, hidden, w_ih, w_hh, b_ih=None, b_hh=None):
+    pre = x @ w_ih.T + hidden @ w_hh.T
+    if b_ih is not None:
+        pre = pre + b_ih + b_hh
+    return jax.nn.relu(pre)
+
+
+def rnn_tanh_cell(x, hidden, w_ih, w_hh, b_ih=None, b_hh=None):
+    pre = x @ w_ih.T + hidden @ w_hh.T
+    if b_ih is not None:
+        pre = pre + b_ih + b_hh
+    return jnp.tanh(pre)
+
+
+def mlstm_cell(x, hidden, w_ih, w_hh, w_mih, w_mhh, b_ih=None, b_hh=None):
+    """Multiplicative LSTM (reference: cells.py:50-80 ``mLSTMCell``):
+    m = (W_mih x) * (W_mhh h); gates use m in place of h."""
+    h, c = hidden
+    m = (x @ w_mih.T) * (h @ w_mhh.T)
+    return lstm_cell(x, (m, c), w_ih, w_hh, b_ih, b_hh)
+
+
+_CELLS = {
+    "LSTM": (lstm_cell, 4, 2),
+    "GRU": (gru_cell, 3, 1),
+    "ReLU": (rnn_relu_cell, 1, 1),
+    "Tanh": (rnn_tanh_cell, 1, 1),
+    "mLSTM": (mlstm_cell, 4, 2),
+}
+
+
+class RNN(nn.Module):
+    """Stacked (optionally bidirectional) RNN over any registered cell.
+
+    Functional surface of the reference's
+    ``toRNNBackend(RNNCell(...), num_layers, bidirectional)`` composition
+    (models.py:8-52 + RNNBackend.py:25-230).
+
+    __call__(x [T, B, in], hidden=None, collect_hidden=False) →
+    (output [T, B, dirs*out], last_hidden). ``hidden`` is a per-layer,
+    per-direction pytree; None initializes zeros (reference init_hidden).
+    """
+
+    cell_type: str
+    input_size: int
+    hidden_size: int
+    num_layers: int = 1
+    bias: bool = True
+    dropout: float = 0.0
+    bidirectional: bool = False
+    output_size: Optional[int] = None  # mLSTM-style projected output
+    param_dtype: Any = jnp.float32
+
+    def _cell_params(self, layer, direction, in_size):
+        cell_fn, gate_mult, n_states = _CELLS[self.cell_type]
+        out = self.output_size or self.hidden_size
+        name = f"l{layer}{'_rev' if direction else ''}"
+        shape_ih = (gate_mult * self.hidden_size, in_size)
+        shape_hh = (gate_mult * self.hidden_size, out)
+        # torch-style symmetric U(-1/sqrt(H), 1/sqrt(H)) (the reference's
+        # reset_parameters; flax's `uniform` is [0, scale) — not symmetric)
+        stdv = 1.0 / self.hidden_size ** 0.5
+
+        def init(key, shape, dtype):
+            return jax.random.uniform(key, shape, dtype, -stdv, stdv)
+        p = {
+            "w_ih": self.param(f"{name}_w_ih", init, shape_ih,
+                               self.param_dtype),
+            "w_hh": self.param(f"{name}_w_hh", init, shape_hh,
+                               self.param_dtype),
+        }
+        if self.bias:
+            p["b_ih"] = self.param(f"{name}_b_ih", nn.initializers.zeros,
+                                   (gate_mult * self.hidden_size,),
+                                   self.param_dtype)
+            p["b_hh"] = self.param(f"{name}_b_hh", nn.initializers.zeros,
+                                   (gate_mult * self.hidden_size,),
+                                   self.param_dtype)
+        if self.cell_type == "mLSTM":
+            p["w_mih"] = self.param(f"{name}_w_mih", init,
+                                    (self.hidden_size, in_size),
+                                    self.param_dtype)
+            p["w_mhh"] = self.param(f"{name}_w_mhh", init,
+                                    (self.hidden_size, out),
+                                    self.param_dtype)
+        if self.output_size and self.output_size != self.hidden_size:
+            p["w_ho"] = self.param(f"{name}_w_ho", init,
+                                   (self.output_size, self.hidden_size),
+                                   self.param_dtype)
+        return p
+
+    def _run_layer(self, params, x, h0, reverse):
+        cell_fn, _, n_states = _CELLS[self.cell_type]
+        b_ih = params.get("b_ih")
+        b_hh = params.get("b_hh")
+
+        def step(hidden, xt):
+            if self.cell_type == "mLSTM":
+                new = cell_fn(xt, hidden, params["w_ih"], params["w_hh"],
+                              params["w_mih"], params["w_mhh"], b_ih, b_hh)
+            else:
+                state_in = hidden if n_states == 2 else hidden[0]
+                new = cell_fn(xt, state_in, params["w_ih"], params["w_hh"],
+                              b_ih, b_hh)
+                new = new if n_states == 2 else (new,)
+            out = new[0]
+            if "w_ho" in params:
+                out = out @ params["w_ho"].T
+                new = (out,) + new[1:]
+            return new, out
+
+        hidden, outs = jax.lax.scan(step, h0, x, reverse=reverse)
+        return outs, hidden
+
+    @nn.compact
+    def __call__(self, x, hidden=None, collect_hidden=False,
+                 deterministic=True):
+        _, _, n_states = _CELLS[self.cell_type]
+        out_size = self.output_size or self.hidden_size
+        T, B = x.shape[0], x.shape[1]
+        dirs = 2 if self.bidirectional else 1
+
+        def zeros_state():
+            s = (jnp.zeros((B, out_size), x.dtype),)
+            if n_states == 2:
+                s = s + (jnp.zeros((B, self.hidden_size), x.dtype),)
+            return s
+
+        last_hidden = []
+        for layer in range(self.num_layers):
+            in_size = (self.input_size if layer == 0
+                       else out_size * dirs)
+            outs = []
+            layer_hidden = []
+            for d in range(dirs):
+                p = self._cell_params(layer, d, in_size)
+                h0 = (hidden[layer][d] if hidden is not None
+                      else zeros_state())
+                o, h = self._run_layer(p, x, h0, reverse=(d == 1))
+                outs.append(o)
+                layer_hidden.append(h)
+            x = outs[0] if dirs == 1 else jnp.concatenate(outs, axis=-1)
+            if self.dropout > 0 and layer < self.num_layers - 1:
+                x = nn.Dropout(rate=self.dropout)(
+                    x, deterministic=deterministic)
+            last_hidden.append(tuple(layer_hidden))
+        return x, tuple(last_hidden)
+
+
+def stackedRNN(cell_type, input_size, hidden_size, num_layers=1, dropout=0,
+               **kwargs):
+    """Reference: RNNBackend.py:90 (unidirectional stack)."""
+    return RNN(cell_type=cell_type, input_size=input_size,
+               hidden_size=hidden_size, num_layers=num_layers,
+               dropout=dropout, bidirectional=False, **kwargs)
+
+
+def bidirectionalRNN(cell_type, input_size, hidden_size, num_layers=1,
+                     dropout=0, **kwargs):
+    """Reference: RNNBackend.py:25 (fwd + reversed stacks, concat)."""
+    return RNN(cell_type=cell_type, input_size=input_size,
+               hidden_size=hidden_size, num_layers=num_layers,
+               dropout=dropout, bidirectional=True, **kwargs)

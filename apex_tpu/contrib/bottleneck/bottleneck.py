@@ -143,6 +143,9 @@ class SpatialBottleneck(Bottleneck):
         assert self.stride == 1, (
             "H-split with stride≠1 needs cross-shard output realignment "
             "(reference restricts spatial segments to stride-1 3x3s too)")
+        assert self.dilation == 1, (
+            "H-split halo width is hardcoded for dilation=1; dilation>1 "
+            "needs a dilation-row halo")
         halo_ex = self.halo_ex or HaloExchangerSendRecv(
             self.spatial_axis, self.spatial_group_size)
 

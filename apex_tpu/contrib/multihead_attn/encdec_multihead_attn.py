@@ -29,6 +29,9 @@ class EncdecMultiheadAttn(nn.Module):
                  need_weights=False, attn_mask=None, is_training=True):
         """``key`` is the encoder output; ``value`` must equal key
         (the reference asserts inputs are the same stream and packs kv)."""
+        assert value is None or value is key, (
+            "EncdecMultiheadAttn packs kv from one stream; pass value=None "
+            "or the same tensor as key (reference asserts the same)")
         e, h = self.embed_dim, self.num_heads
         assert e % h == 0
         scaling = (e // h) ** -0.5

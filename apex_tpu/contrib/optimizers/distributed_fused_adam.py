@@ -27,7 +27,13 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 
-from apex_tpu.optimizers._fused import get_meta
+from apex_tpu.optimizers._fused import (
+    get_meta,
+    zero_gather_updates,
+    zero_grad_shard,
+    zero_master_shard,
+    zero_padded_total,
+)
 from apex_tpu.optimizers.fused_adam import _adam_flat
 
 
@@ -36,10 +42,6 @@ class DistAdamState(NamedTuple):
     m: jnp.ndarray       # [padded_total / num_shards] fp32, THIS rank's shard
     v: jnp.ndarray
     master: jnp.ndarray  # fp32 master copy of this rank's param shard
-
-
-def _padded(total, num_shards):
-    return (total + num_shards - 1) // num_shards * num_shards
 
 
 def distributed_fused_adam(learning_rate=1e-3, betas=(0.9, 0.999), eps=1e-8,
@@ -57,17 +59,10 @@ def distributed_fused_adam(learning_rate=1e-3, betas=(0.9, 0.999), eps=1e-8,
     beta1, beta2 = betas
 
     def init(params):
-        assert lax.axis_size(axis_name) == num_shards, (
-            f"num_shards ({num_shards}) != size of mesh axis "
-            f"{axis_name!r} ({lax.axis_size(axis_name)})")
         leaves = jax.tree_util.tree_leaves(params)
         meta = get_meta(leaves)
-        P = _padded(meta.total, num_shards)
-        shard = P // num_shards
-        idx = lax.axis_index(axis_name)
-        flat_p = jnp.concatenate(
-            [meta.flatten(leaves), jnp.zeros((P - meta.total,), jnp.float32)])
-        master = lax.dynamic_slice_in_dim(flat_p, idx * shard, shard)
+        master = zero_master_shard(meta, leaves, num_shards, axis_name)
+        shard = master.shape[0]
         return DistAdamState(
             count=jnp.zeros((), jnp.int32),
             m=jnp.zeros((shard,), jnp.float32),
@@ -80,15 +75,9 @@ def distributed_fused_adam(learning_rate=1e-3, betas=(0.9, 0.999), eps=1e-8,
         leaves_g, treedef = jax.tree_util.tree_flatten(grads)
         leaves_p = jax.tree_util.tree_leaves(params)
         meta = get_meta(leaves_p)
-        P = _padded(meta.total, num_shards)
-        shard = P // num_shards
 
-        flat_g = jnp.concatenate(
-            [meta.flatten(leaves_g),
-             jnp.zeros((P - meta.total,), jnp.float32)])
         # ZeRO grad sync: reduce-scatter (sum) → my shard
-        g_shard = lax.psum_scatter(flat_g, axis_name, scatter_dimension=0,
-                                   tiled=True)
+        g_shard = zero_grad_shard(meta, leaves_g, num_shards, axis_name)
         if grad_average:
             g_shard = g_shard / num_shards
 
@@ -108,10 +97,9 @@ def distributed_fused_adam(learning_rate=1e-3, betas=(0.9, 0.999), eps=1e-8,
         master = state.master + upd_shard
 
         # ZeRO param sync: all-gather updated shards → full flat update
-        flat_u = lax.all_gather(upd_shard, axis_name, tiled=True)
         updates = jax.tree_util.tree_unflatten(
-            treedef, meta.unflatten(flat_u[:meta.total],
-                                    [x.dtype for x in leaves_p]))
+            treedef, zero_gather_updates(meta, upd_shard, axis_name,
+                                         [x.dtype for x in leaves_p]))
         return updates, DistAdamState(count=count, m=m, v=v, master=master)
 
     return optax.GradientTransformation(init, update)

@@ -27,7 +27,13 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 
-from apex_tpu.optimizers._fused import get_meta
+from apex_tpu.optimizers._fused import (
+    get_meta,
+    zero_gather_updates,
+    zero_grad_shard,
+    zero_master_shard,
+    zero_padded_total,
+)
 
 
 class DistLambState(NamedTuple):
@@ -35,10 +41,6 @@ class DistLambState(NamedTuple):
     m: jnp.ndarray
     v: jnp.ndarray
     master: jnp.ndarray
-
-
-def _padded(total, num_shards):
-    return (total + num_shards - 1) // num_shards * num_shards
 
 
 def distributed_fused_lamb(learning_rate=1e-3, betas=(0.9, 0.999), eps=1e-6,
@@ -53,17 +55,10 @@ def distributed_fused_lamb(learning_rate=1e-3, betas=(0.9, 0.999), eps=1e-6,
     beta1, beta2 = betas
 
     def init(params):
-        assert lax.axis_size(axis_name) == num_shards, (
-            f"num_shards ({num_shards}) != size of mesh axis "
-            f"{axis_name!r} ({lax.axis_size(axis_name)})")
         leaves = jax.tree_util.tree_leaves(params)
         meta = get_meta(leaves)
-        P = _padded(meta.total, num_shards)
-        shard = P // num_shards
-        idx = lax.axis_index(axis_name)
-        flat_p = jnp.concatenate(
-            [meta.flatten(leaves), jnp.zeros((P - meta.total,), jnp.float32)])
-        master = lax.dynamic_slice_in_dim(flat_p, idx * shard, shard)
+        master = zero_master_shard(meta, leaves, num_shards, axis_name)
+        shard = master.shape[0]
         return DistLambState(
             count=jnp.zeros((), jnp.int32),
             m=jnp.zeros((shard,), jnp.float32),
@@ -76,15 +71,11 @@ def distributed_fused_lamb(learning_rate=1e-3, betas=(0.9, 0.999), eps=1e-6,
         leaves_g, treedef = jax.tree_util.tree_flatten(grads)
         leaves_p = jax.tree_util.tree_leaves(params)
         meta = get_meta(leaves_p)
-        P = _padded(meta.total, num_shards)
+        P = zero_padded_total(meta.total, num_shards)
         shard = P // num_shards
         idx = lax.axis_index(axis_name)
 
-        flat_g = jnp.concatenate(
-            [meta.flatten(leaves_g),
-             jnp.zeros((P - meta.total,), jnp.float32)])
-        g_shard = lax.psum_scatter(flat_g, axis_name, scatter_dimension=0,
-                                   tiled=True)
+        g_shard = zero_grad_shard(meta, leaves_g, num_shards, axis_name)
         # cross-rank averaging is unconditional (grad_averaging only
         # selects LAMB's beta3, as in the reference)
         g_shard = g_shard / num_shards
@@ -139,11 +130,10 @@ def distributed_fused_lamb(learning_rate=1e-3, betas=(0.9, 0.999), eps=1e-6,
         master = p + upd_shard
 
         gather_dtype = jnp.float32 if allgather_in_fp32 else jnp.bfloat16
-        flat_u = lax.all_gather(upd_shard.astype(gather_dtype), axis_name,
-                                tiled=True).astype(jnp.float32)
         updates = jax.tree_util.tree_unflatten(
-            treedef, meta.unflatten(flat_u[:meta.total],
-                                    [x.dtype for x in leaves_p]))
+            treedef, zero_gather_updates(meta, upd_shard, axis_name,
+                                         [x.dtype for x in leaves_p],
+                                         gather_dtype))
         return updates, DistLambState(count=count, m=m, v=v, master=master)
 
     return optax.GradientTransformation(init, update)

@@ -98,6 +98,7 @@ class FP16_Optimizer:
             print(f"OVERFLOW! Skipping step. Reducing loss scale to "
                   f"{self.loss_scale}")
             self._grads = None
+            self._clip = None  # armed clip is per-step, even when skipped
             return
         if getattr(self, "_clip", None):
             from apex_tpu.fp16_utils.fp16util import clip_grad_norm

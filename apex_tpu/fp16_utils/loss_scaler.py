@@ -35,9 +35,14 @@ class LossScaler:
         return jax.tree_util.tree_map(lambda g: g * self.loss_scale, grads)
 
     def backward(self, loss_and_grad_fn, *args):
-        """Functional stand-in for ``scaled_loss.backward()``: runs the
-        grad fn on loss * scale and returns unscaled-later grads."""
-        return loss_and_grad_fn(*args)
+        """Functional stand-in for ``scaled_loss.backward()``: returns
+        (loss, grads-of-the-SCALED-loss) — the apex contract where the
+        caller divides by ``loss_scale`` before the update (reference:
+        loss_scaler.py backward/scale_gradient usage)."""
+        loss, grads = loss_and_grad_fn(*args)
+        scaled = jax.tree_util.tree_map(
+            lambda g: g * self.loss_scale, grads)
+        return loss, scaled
 
 
 class DynamicLossScaler(LossScaler):

@@ -1,0 +1,9 @@
+"""apex_tpu.models — reference models for the examples/benchmarks.
+
+The reference imports torchvision's ResNet and ships a DCGAN in examples/;
+the framework-side models here serve the same role for the TPU build
+(examples/imagenet, examples/dcgan, BASELINE.md configs).
+"""
+
+from apex_tpu.models.resnet import ResNet, resnet18, resnet50  # noqa: F401
+from apex_tpu.models.dcgan import Discriminator, Generator  # noqa: F401

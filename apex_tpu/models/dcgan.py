@@ -1,0 +1,70 @@
+"""DCGAN generator/discriminator (reference: examples/dcgan/main_amp.py —
+the amp multi-model/multi-optimizer example; BASELINE.md config 5)."""
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class Generator(nn.Module):
+    """z [B, 1, 1, nz] → image [B, isize, isize, nc], NHWC transposed
+    convs."""
+
+    nz: int = 100
+    ngf: int = 64
+    nc: int = 3
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, z, train=True):
+        def up(x, feats, kernel, stride, pad, name):
+            return nn.ConvTranspose(feats, (kernel, kernel),
+                                    (stride, stride), padding=pad,
+                                    use_bias=False, dtype=self.dtype,
+                                    name=name)(x)
+
+        # "SAME" + stride 2 gives the exact 2x upsampling of torch's
+        # ConvTranspose2d(k=4, s=2, p=1) (flax padding semantics differ)
+        y = up(z, self.ngf * 8, 4, 1, "VALID", "up1")  # 1x1 → 4x4
+        y = nn.relu(nn.BatchNorm(use_running_average=not train,
+                                 name="bn1")(y))
+        y = up(y, self.ngf * 4, 4, 2, "SAME", "up2")
+        y = nn.relu(nn.BatchNorm(use_running_average=not train,
+                                 name="bn2")(y))
+        y = up(y, self.ngf * 2, 4, 2, "SAME", "up3")
+        y = nn.relu(nn.BatchNorm(use_running_average=not train,
+                                 name="bn3")(y))
+        y = up(y, self.ngf, 4, 2, "SAME", "up4")
+        y = nn.relu(nn.BatchNorm(use_running_average=not train,
+                                 name="bn4")(y))
+        y = up(y, self.nc, 4, 2, "SAME", "up5")
+        return jnp.tanh(y)
+
+
+class Discriminator(nn.Module):
+    """image [B, isize, isize, nc] → logit [B]."""
+
+    ndf: int = 64
+    nc: int = 3
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        def down(x, feats, name):
+            return nn.Conv(feats, (4, 4), (2, 2), padding=[(1, 1), (1, 1)],
+                           use_bias=False, dtype=self.dtype, name=name)(x)
+
+        y = nn.leaky_relu(down(x, self.ndf, "down1"), 0.2)
+        y = down(y, self.ndf * 2, "down2")
+        y = nn.leaky_relu(nn.BatchNorm(use_running_average=not train,
+                                       name="bn2")(y), 0.2)
+        y = down(y, self.ndf * 4, "down3")
+        y = nn.leaky_relu(nn.BatchNorm(use_running_average=not train,
+                                       name="bn3")(y), 0.2)
+        y = down(y, self.ndf * 8, "down4")
+        y = nn.leaky_relu(nn.BatchNorm(use_running_average=not train,
+                                       name="bn4")(y), 0.2)
+        y = nn.Conv(1, (4, 4), (1, 1), padding="VALID", use_bias=False,
+                    dtype=self.dtype, name="out")(y)
+        return y.reshape(x.shape[0])

@@ -1,0 +1,122 @@
+"""ResNet for the ImageNet example (reference consumer:
+examples/imagenet/main_amp.py:108 ``models.__dict__[args.arch]()``).
+
+TPU-first: NHWC layout, bf16-friendly (params fp32, compute follows the
+amp policy via the conv/BN dtypes), BN swappable for the ICI
+SyncBatchNorm (the ``convert_syncbn_model`` capability is the
+``norm_axis_name`` knob here — set it to the "data" mesh axis inside
+shard_map and stats sync over ICI, SURVEY §3.4).
+"""
+
+from functools import partial
+from typing import Any, Callable, Optional, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+from jax import lax
+
+from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm
+
+
+def _conv(x, features, kernel, stride, name_scope, dtype):
+    return nn.Conv(features, (kernel, kernel), (stride, stride),
+                   padding=[(kernel // 2, kernel // 2)] * 2, use_bias=False,
+                   dtype=dtype, name=name_scope,
+                   kernel_init=nn.initializers.variance_scaling(
+                       2.0, "fan_out", "truncated_normal"))(x)
+
+
+class BottleneckBlock(nn.Module):
+    features: int
+    stride: int = 1
+    expansion: int = 4
+    norm: Callable = SyncBatchNorm
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        residual = x
+        y = _conv(x, self.features, 1, 1, "conv1", self.dtype)
+        y = self.norm(name="bn1")(y, use_running_average=not train)
+        y = nn.relu(y)
+        y = _conv(y, self.features, 3, self.stride, "conv2", self.dtype)
+        y = self.norm(name="bn2")(y, use_running_average=not train)
+        y = nn.relu(y)
+        y = _conv(y, self.features * self.expansion, 1, 1, "conv3",
+                  self.dtype)
+        y = self.norm(name="bn3")(y, use_running_average=not train)
+        if residual.shape != y.shape:
+            residual = _conv(x, self.features * self.expansion, 1,
+                             self.stride, "downsample_conv", self.dtype)
+            residual = self.norm(name="downsample_bn")(
+                residual, use_running_average=not train)
+        return nn.relu(y + residual)
+
+
+class BasicBlock(nn.Module):
+    features: int
+    stride: int = 1
+    expansion: int = 1
+    norm: Callable = SyncBatchNorm
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        residual = x
+        y = _conv(x, self.features, 3, self.stride, "conv1", self.dtype)
+        y = self.norm(name="bn1")(y, use_running_average=not train)
+        y = nn.relu(y)
+        y = _conv(y, self.features, 3, 1, "conv2", self.dtype)
+        y = self.norm(name="bn2")(y, use_running_average=not train)
+        if residual.shape != y.shape:
+            residual = _conv(x, self.features, 1, self.stride,
+                             "downsample_conv", self.dtype)
+            residual = self.norm(name="downsample_bn")(
+                residual, use_running_average=not train)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """NHWC ResNet; ``norm_axis_name`` = mesh axis for SyncBatchNorm
+    (None → local BN)."""
+
+    stage_sizes: Sequence[int]
+    block_cls: Any = BottleneckBlock
+    num_classes: int = 1000
+    num_filters: int = 64
+    norm_axis_name: Optional[str] = None
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        norm = partial(SyncBatchNorm, axis_name=self.norm_axis_name,
+                       momentum=0.1)
+        y = nn.Conv(self.num_filters, (7, 7), (2, 2),
+                    padding=[(3, 3), (3, 3)], use_bias=False,
+                    dtype=self.dtype, name="conv_init",
+                    kernel_init=nn.initializers.variance_scaling(
+                        2.0, "fan_out", "truncated_normal"))(x)
+        y = norm(name="bn_init")(y, use_running_average=not train)
+        y = nn.relu(y)
+        y = nn.max_pool(y, (3, 3), (2, 2), padding=[(1, 1), (1, 1)])
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                stride = 2 if i > 0 and j == 0 else 1
+                y = self.block_cls(self.num_filters * 2 ** i, stride=stride,
+                                   norm=norm, dtype=self.dtype,
+                                   name=f"stage{i}_block{j}")(y, train)
+        y = jnp.mean(y, axis=(1, 2))
+        y = nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(y)
+        return y
+
+
+def resnet50(num_classes=1000, norm_axis_name=None, dtype=jnp.float32):
+    return ResNet(stage_sizes=[3, 4, 6, 3], block_cls=BottleneckBlock,
+                  num_classes=num_classes, norm_axis_name=norm_axis_name,
+                  dtype=dtype)
+
+
+def resnet18(num_classes=1000, norm_axis_name=None, dtype=jnp.float32):
+    return ResNet(stage_sizes=[2, 2, 2, 2], block_cls=BasicBlock,
+                  num_classes=num_classes, norm_axis_name=norm_axis_name,
+                  dtype=dtype)

@@ -92,3 +92,44 @@ def get_meta(params):
 def tree_meta(params_tree):
     leaves = jax.tree_util.tree_leaves(params_tree)
     return get_meta(leaves), jax.tree_util.tree_structure(params_tree)
+
+
+# --------------------------- ZeRO shard plumbing ---------------------------
+# shared by contrib.optimizers.distributed_fused_{adam,lamb} (the reference
+# duplicates this machinery per optimizer; here it is one implementation)
+
+def zero_padded_total(total, num_shards):
+    return (total + num_shards - 1) // num_shards * num_shards
+
+
+def zero_master_shard(meta, leaves, num_shards, axis_name):
+    """This rank's fp32 shard of the flattened+padded params (ZeRO state
+    init). Asserts the mesh axis matches num_shards — shard shapes are
+    static and silently wrong otherwise."""
+    assert jax.lax.axis_size(axis_name) == num_shards, (
+        f"num_shards ({num_shards}) != size of mesh axis {axis_name!r} "
+        f"({jax.lax.axis_size(axis_name)})")
+    P = zero_padded_total(meta.total, num_shards)
+    shard = P // num_shards
+    flat = jnp.concatenate(
+        [meta.flatten(leaves), jnp.zeros((P - meta.total,), jnp.float32)])
+    idx = jax.lax.axis_index(axis_name)
+    return jax.lax.dynamic_slice_in_dim(flat, idx * shard, shard)
+
+
+def zero_grad_shard(meta, leaves_g, num_shards, axis_name):
+    """Reduce-scatter the flat grads: each rank gets the SUM of its padded
+    shard (the ZeRO-2 grad sync). Caller divides for averaging."""
+    P = zero_padded_total(meta.total, num_shards)
+    flat_g = jnp.concatenate(
+        [meta.flatten(leaves_g), jnp.zeros((P - meta.total,), jnp.float32)])
+    return jax.lax.psum_scatter(flat_g, axis_name, scatter_dimension=0,
+                                tiled=True)
+
+
+def zero_gather_updates(meta, upd_shard, axis_name, dtypes,
+                        gather_dtype=jnp.float32):
+    """All-gather updated shards back to full per-tensor updates."""
+    flat_u = jax.lax.all_gather(upd_shard.astype(gather_dtype), axis_name,
+                                tiled=True).astype(jnp.float32)
+    return meta.unflatten(flat_u[:meta.total], dtypes)

@@ -1,0 +1,139 @@
+"""DCGAN with amp — the multi-model / multi-optimizer / multi-loss path.
+
+Capability port of the reference example (examples/dcgan/main_amp.py):
+two models (G, D), two optimizers, three backward passes per iteration
+(D-real, D-fake, G) with ``num_losses=3`` per-loss scalers — the
+reference's ``amp.scale_loss(..., loss_id=k)`` pattern — on synthetic
+data.
+
+Run: python examples/dcgan/main_amp.py --steps 5 -b 16
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+
+from apex_tpu import amp  # noqa: E402
+from apex_tpu.models import Discriminator, Generator  # noqa: E402
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("-b", "--batch-size", type=int, default=16)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--nz", type=int, default=100)
+    p.add_argument("--ngf", type=int, default=64)
+    p.add_argument("--ndf", type=int, default=64)
+    p.add_argument("--lr", type=float, default=2e-4)
+    p.add_argument("--beta1", type=float, default=0.5)
+    p.add_argument("--opt-level", type=str, default="O1")
+    p.add_argument("--image-size", type=int, default=64)
+    return p.parse_args(argv)
+
+
+def bce_logits(logits, target):
+    return jnp.mean(optax.sigmoid_binary_cross_entropy(
+        logits.astype(jnp.float32), jnp.full(logits.shape, target)))
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    netG = Generator(nz=args.nz, ngf=args.ngf)
+    netD = Discriminator(ndf=args.ndf)
+    key = jax.random.PRNGKey(0)
+    z0 = jnp.zeros((args.batch_size, 1, 1, args.nz))
+    x0 = jnp.zeros((args.batch_size, args.image_size, args.image_size, 3))
+
+    varsG = netG.init(key, z0, train=False)
+    varsD = netD.init(key, x0, train=False)
+    pG, sG = varsG["params"], varsG["batch_stats"]
+    pD, sD = varsD["params"], varsD["batch_stats"]
+
+    txG = optax.adam(args.lr, b1=args.beta1)
+    txD = optax.adam(args.lr, b1=args.beta1)
+    # two models, two optimizers, three losses (reference: amp.initialize
+    # with num_losses=3, loss_id 0/1/2)
+    pG, optG = amp.initialize(pG, txG, opt_level=args.opt_level,
+                              num_losses=3)
+    pD, optD = amp.initialize(pD, txD, opt_level=args.opt_level,
+                              num_losses=3)
+    stG, stD = optG.init(pG), optD.init(pD)
+
+    @jax.jit
+    def train_step(pG, sG, stG, pD, sD, stD, real, z):
+        # --- D step: real (loss_id 0) + fake (loss_id 1) ---
+        def d_loss_real(p):
+            out, newv = netD.apply({"params": p, "batch_stats": sD}, real,
+                                   train=True, mutable=["batch_stats"])
+            return bce_logits(out, 1.0), newv["batch_stats"]
+
+        f0 = amp.value_and_scaled_grad(d_loss_real, optD, loss_id=0,
+                                       has_aux=True)
+        (lossD_real, sD1), g0, inf0 = f0(pD, stD)
+
+        # fake pass runs on the stats updated by the real pass (sequential
+        # backward passes, as in the reference example)
+        def d_loss_fake(p, fake):
+            out, newv = netD.apply({"params": p, "batch_stats": sD1}, fake,
+                                   train=True, mutable=["batch_stats"])
+            return bce_logits(out, 0.0), newv["batch_stats"]
+
+        fake, newsG = netG.apply({"params": pG, "batch_stats": sG}, z,
+                                 train=True, mutable=["batch_stats"])
+        newsG = newsG["batch_stats"]
+
+        f1 = amp.value_and_scaled_grad(
+            lambda p: d_loss_fake(p, jax.lax.stop_gradient(fake)), optD,
+            loss_id=1, has_aux=True)
+        (lossD_fake, sD2), g1, inf1 = f1(pD, stD)
+        gD = jax.tree_util.tree_map(jnp.add, g0, g1)
+        pD, stD, _ = optD.apply_gradients(
+            gD, stD, pD, loss_id=0, grads_already_unscaled=True,
+            found_inf=inf0 | inf1)
+
+        # --- G step (loss_id 2): non-saturating loss through D; G stats
+        # continue from the D-step forward (newsG), as in the reference ---
+        def g_loss(p):
+            fake, newv = netG.apply({"params": p, "batch_stats": newsG}, z,
+                                    train=True, mutable=["batch_stats"])
+            out, _ = netD.apply({"params": pD, "batch_stats": sD2}, fake,
+                                train=True, mutable=["batch_stats"])
+            return bce_logits(out, 1.0), newv["batch_stats"]
+
+        f2 = amp.value_and_scaled_grad(g_loss, optG, loss_id=2,
+                                       has_aux=True)
+        (lossG, newsG2), gG, inf2 = f2(pG, stG)
+        pG, stG, _ = optG.apply_gradients(
+            gG, stG, pG, loss_id=2, grads_already_unscaled=True,
+            found_inf=inf2)
+        return (pG, newsG2, stG, pD, sD2, stD,
+                jnp.stack([lossD_real + lossD_fake, lossG]))
+
+    rs = np.random.RandomState(0)
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        real = jnp.asarray(rs.rand(args.batch_size, args.image_size,
+                                   args.image_size, 3) * 2 - 1,
+                           jnp.float32)
+        z = jnp.asarray(rs.randn(args.batch_size, 1, 1, args.nz),
+                        jnp.float32)
+        pG, sG, stG, pD, sD, stD, losses = train_step(
+            pG, sG, stG, pD, sD, stD, real, z)
+        losses = np.asarray(losses)
+        print(f"[{i}/{args.steps}] Loss_D {losses[0]:.4f} "
+              f"Loss_G {losses[1]:.4f}", flush=True)
+    print(f"DONE {args.steps / (time.perf_counter() - t0):.2f} it/s")
+    return float(losses[0]), float(losses[1])
+
+
+if __name__ == "__main__":
+    main()
