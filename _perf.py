@@ -1,0 +1,34 @@
+import time, numpy as np, jax, jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+from apex_tpu.transformer.testing import GPTModel, TransformerConfig
+
+cfg = TransformerConfig(hidden_size=768, num_layers=12, num_attention_heads=12,
+                        vocab_size=50304, max_position_embeddings=1024,
+                        hidden_dropout=0.0, attention_dropout=0.0, bf16=True)
+model = GPTModel(cfg)
+mesh = Mesh(np.asarray(jax.devices()[:1]), (TENSOR_AXIS,))
+b, s = 8, 1024
+rs = np.random.RandomState(0)
+ids_all = jnp.asarray(rs.randint(0, cfg.vocab_size, (10, b, s)), jnp.int32)
+pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
+labels_all = jnp.asarray(rs.randint(0, cfg.vocab_size, (10, b, s)), jnp.int32)
+
+def shmap(f, n):
+    return jax.shard_map(f, mesh=mesh, in_specs=(P(),)*n, out_specs=P(), check_vma=False)
+
+params = jax.jit(shmap(lambda i,p: model.init(jax.random.PRNGKey(0), i, p, None)["params"], 2))(ids_all[0], pos)
+
+def bench(name, f, arg_batches):
+    jax.block_until_ready(f(*arg_batches[0]))
+    t0 = time.perf_counter()
+    outs = [f(*a) for a in arg_batches[1:]]
+    vals = [float(o) for o in outs]
+    dt = (time.perf_counter()-t0)/len(outs)
+    print(f"{name}: {dt*1000:.1f} ms  ({b*s/dt:.0f} tok/s)  loss0={vals[0]:.3f}")
+
+fwd = jax.jit(shmap(lambda p,i,po,l: jnp.mean(model.apply({"params":p}, i, po, None, l)), 4))
+bench("fwd+loss", fwd, [(params, ids_all[k], pos, labels_all[k]) for k in range(10)])
+
+vg = jax.jit(shmap(lambda p,i,po,l: jax.value_and_grad(lambda pp: jnp.mean(model.apply({"params":pp}, i, po, None, l)))(p)[0], 4))
+bench("fwd+bwd", vg, [(params, ids_all[k], pos, labels_all[k]) for k in range(10)])

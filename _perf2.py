@@ -1,0 +1,45 @@
+import time, numpy as np, jax, jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from apex_tpu.amp.scaler import LossScaler
+from apex_tpu.optimizers.fused_adam import fused_adam
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+from apex_tpu.transformer.testing import GPTModel, TransformerConfig
+
+cfg = TransformerConfig(hidden_size=768, num_layers=12, num_attention_heads=12,
+                        vocab_size=50304, max_position_embeddings=1024,
+                        hidden_dropout=0.0, attention_dropout=0.0, bf16=True)
+model = GPTModel(cfg)
+mesh = Mesh(np.asarray(jax.devices()[:1]), (TENSOR_AXIS,))
+scaler = LossScaler(); tx = fused_adam(learning_rate=1e-4)
+b, s = 8, 1024
+rs = np.random.RandomState(0)
+ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (b, s)), jnp.int32)
+pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
+labels = jnp.asarray(rs.randint(0, cfg.vocab_size, (b, s)), jnp.int32)
+def shmap(f, n):
+    return jax.shard_map(f, mesh=mesh, in_specs=(P(),)*n, out_specs=P(), check_vma=False)
+params = jax.jit(shmap(lambda i,p: model.init(jax.random.PRNGKey(0), i, p, None)["params"], 2))(ids, pos)
+opt_state = jax.jit(lambda p: tx.init(p))(params)
+sstate = scaler.init()
+
+def train_step(params, opt_state, sstate, ids, pos, labels):
+    def local(params, opt_state, sstate, ids, pos, labels):
+        def loss_fn(p):
+            return jnp.mean(model.apply({"params": p}, ids, pos, None, labels)) * sstate.loss_scale
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        grads, found_inf = scaler.unscale(grads, sstate)
+        new_s = scaler.update(sstate, found_inf)
+        updates, new_opt = tx.update(grads, opt_state, params)
+        new_params = jax.tree_util.tree_map(lambda p,u: jnp.where(found_inf, p, p+u.astype(p.dtype)), params, updates)
+        new_opt = jax.tree_util.tree_map(lambda n,o: jnp.where(found_inf, o, n), new_opt, opt_state)
+        return new_params, new_opt, new_s, loss / sstate.loss_scale
+    return jax.shard_map(local, mesh=mesh, in_specs=(P(),)*6, out_specs=P(), check_vma=False)(params, opt_state, sstate, ids, pos, labels)
+
+step = jax.jit(train_step, donate_argnums=(0,1))
+params, opt_state, sstate, loss = step(params, opt_state, sstate, ids, pos, labels)
+jax.block_until_ready(loss)
+for i in range(6):
+    t0 = time.perf_counter()
+    params, opt_state, sstate, loss = step(params, opt_state, sstate, ids, pos, labels)
+    lv = float(loss)
+    print(f"step {i}: {(time.perf_counter()-t0)*1000:.1f} ms loss={lv:.3f}")

@@ -1,0 +1,46 @@
+import time, numpy as np, jax, jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+from apex_tpu.transformer.testing import GPTModel, TransformerConfig
+from apex_tpu.transformer.testing.standalone_transformer_lm import ParallelTransformer
+from apex_tpu.transformer.enums import AttnMaskType
+
+cfg = TransformerConfig(hidden_size=768, num_layers=12, num_attention_heads=12,
+                        vocab_size=50304, max_position_embeddings=1024,
+                        hidden_dropout=0.0, attention_dropout=0.0, bf16=True)
+mesh = Mesh(np.asarray(jax.devices()[:1]), (TENSOR_AXIS,))
+b, s = 8, 1024
+rs = np.random.RandomState(0)
+hidden = jnp.asarray(rs.randn(s, b, cfg.hidden_size)*0.02, jnp.bfloat16)
+ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (b, s)), jnp.int32)
+pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
+labels = jnp.asarray(rs.randint(0, cfg.vocab_size, (b, s)), jnp.int32)
+def shmap(f, n):
+    return jax.shard_map(f, mesh=mesh, in_specs=(P(),)*n, out_specs=P(), check_vma=False)
+
+trunk = ParallelTransformer(cfg, self_attn_mask_type=AttnMaskType.causal)
+tp = jax.jit(shmap(lambda h: trunk.init(jax.random.PRNGKey(0), h, None), 1))(hidden)
+
+def time_it(name, f, args, iters=5):
+    jax.block_until_ready(f(*args))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = f(*args)
+    jax.block_until_ready(r)
+    print(f"{name}: {(time.perf_counter()-t0)/iters*1000:.1f} ms")
+
+# trunk fwd+bwd (grads USED: returned)
+def trunk_fb(p, h):
+    def loss(pp): return jnp.sum(trunk.apply(pp, h, None).astype(jnp.float32))
+    l, g = jax.value_and_grad(loss)(p)
+    return l, jax.tree_util.tree_map(lambda x: jnp.sum(x.astype(jnp.float32)), g)
+time_it("trunk fwd+bwd", jax.jit(shmap(trunk_fb, 2)), (tp, hidden))
+
+# full model fwd+bwd
+model = GPTModel(cfg)
+params = jax.jit(shmap(lambda i,p: model.init(jax.random.PRNGKey(0), i, p, None)["params"], 2))(ids, pos)
+def full_fb(p, i, po, l):
+    def loss(pp): return jnp.mean(model.apply({"params": pp}, i, po, None, l))
+    lv, g = jax.value_and_grad(loss)(p)
+    return lv, jax.tree_util.tree_map(lambda x: jnp.sum(x.astype(jnp.float32)), g)
+time_it("full fwd+bwd", jax.jit(shmap(full_fb, 4)), (params, ids, pos, labels))

@@ -43,6 +43,21 @@ def fmha_varlen(qkv, cu_seqlens, p_dropout=0.0, max_s=512,
     seg = _segment_ids_from_cu_seqlens(cu_seqlens, total)
     num_seqs = cu_seqlens.shape[0] - 1
     valid = seg < num_seqs  # tokens at/past cu_seqlens[-1] are padding
+    # padding gets a sentinel id no real token shares → fully masked rows
+    seg = jnp.where(valid, seg, num_seqs + 1)
+
+    if p_dropout == 0.0 or not is_training:
+        # flash path: packed stream as one [1, h, total, d] sequence with
+        # segment-id masking (TPU Pallas kernel; dense fallback elsewhere)
+        from apex_tpu.ops import fused_attention
+
+        ctx = fused_attention(
+            q.transpose(1, 0, 2)[None], k.transpose(1, 0, 2)[None],
+            v.transpose(1, 0, 2)[None],
+            sm_scale=1.0 / np.sqrt(d),
+            segment_ids=(seg[None], seg[None]))
+        return ctx[0].transpose(1, 0, 2).astype(qkv.dtype)
+
     same_seg = (seg[:, None] == seg[None, :]) & valid[:, None] \
         & valid[None, :]
 

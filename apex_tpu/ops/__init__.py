@@ -1,0 +1,9 @@
+"""apex_tpu.ops — Pallas TPU kernels for the hot ops.
+
+The L0 tier of the TPU build: where the reference ships CUDA kernels
+(csrc/, contrib/csrc — SURVEY §2.6), this package ships Pallas kernels /
+kernel wrappers with XLA-fusion fallbacks. Ops dispatch on the backend so
+the same model code runs on the CPU test mesh and on TPU.
+"""
+
+from apex_tpu.ops.attention import fused_attention  # noqa: F401

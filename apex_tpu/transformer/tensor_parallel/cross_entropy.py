@@ -9,9 +9,12 @@ without ever materializing the full-vocab softmax on one device:
 
 Backward is the closed form (softmax − one_hot)·g with label-smoothing
 adjustment, supplied via custom_vjp exactly as the reference's
-``_VocabParallelCrossEntropy.backward`` (:79-129) — not AD — so the saved
-residuals are just (softmax, target mask/index), matching the reference's
-memory profile.
+``_VocabParallelCrossEntropy.backward`` (:79-129) — not AD. Unlike the
+reference (which stashes the fp32 softmax, cross_entropy.py:76), the
+residuals here are only the [tokens]-shaped (max, sum_exp) statistics plus
+the live input logits: backward recomputes ``softmax = exp(x − max) /
+sum_exp`` fused into the grad expression. For a [8, 1024, 50k] bf16 GPT
+head that avoids a 1.6 GB fp32 round-trip to HBM per step.
 """
 
 from functools import partial
@@ -30,9 +33,11 @@ def _fwd_core(vocab_parallel_logits, target, label_smoothing, axis_name):
 
     # max-subtraction for stability (reference :30-36)
     logits_max = jnp.max(vocab_parallel_logits, axis=-1)
-    logits_max = lax.pmax(logits_max, axis_name)
-    logits = (vocab_parallel_logits
-              - jax.lax.stop_gradient(logits_max)[..., None]).astype(jnp.float32)
+    logits_max = lax.pmax(logits_max, axis_name).astype(jnp.float32)
+    # upcast before the subtraction (exact in fp32; XLA fuses the chain, so
+    # no fp32 [.., vocab] tensor is materialized)
+    logits = (vocab_parallel_logits.astype(jnp.float32)
+              - jax.lax.stop_gradient(logits_max)[..., None])
 
     # this rank's vocab range (reference :38-44)
     start = rank * partition_vocab_size
@@ -51,8 +56,6 @@ def _fwd_core(vocab_parallel_logits, target, label_smoothing, axis_name):
 
     loss = jnp.log(sum_exp) - predicted
 
-    softmax = exp_logits / sum_exp[..., None]
-
     if label_smoothing > 0:
         # reference :60-73: loss = (1-s)·ce + s·mean(-log p) over vocab
         vocab_size = partition_vocab_size * world
@@ -62,7 +65,7 @@ def _fwd_core(vocab_parallel_logits, target, label_smoothing, axis_name):
                                   axis_name) / vocab_size
         loss = (1.0 - smoothing) * loss - smoothing * mean_log_probs
 
-    return loss, (softmax, in_range, masked_target)
+    return loss, (logits_max, sum_exp, in_range, masked_target)
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(2, 3))
@@ -77,15 +80,22 @@ def vocab_parallel_cross_entropy(vocab_parallel_logits, target,
 def _ce_fwd(vocab_parallel_logits, target, label_smoothing, axis_name):
     loss, res = _fwd_core(vocab_parallel_logits, target, label_smoothing,
                           axis_name)
-    # zero-size carrier records the input dtype (dtypes aren't jax types)
-    return loss, (res, jnp.zeros((0,), vocab_parallel_logits.dtype))
+    # the input logits ride along (already live — no extra HBM) instead of
+    # a materialized fp32 softmax
+    return loss, (vocab_parallel_logits, res)
 
 
 def _ce_bwd(label_smoothing, axis_name, carry, g):
-    (softmax, in_range, masked_target), dtype_carrier = carry
-    in_dtype = dtype_carrier.dtype
-    partition_vocab_size = softmax.shape[-1]
+    vocab_parallel_logits, (logits_max, sum_exp, in_range,
+                            masked_target) = carry
+    in_dtype = vocab_parallel_logits.dtype
+    partition_vocab_size = vocab_parallel_logits.shape[-1]
     world = lax.axis_size(axis_name)
+
+    # recompute softmax (one fused pass; cheaper than an HBM round-trip)
+    softmax = jnp.exp(
+        vocab_parallel_logits.astype(jnp.float32) - logits_max[..., None]
+    ) / sum_exp[..., None]
 
     # grad = softmax − one_hot(target), scaled (reference :79-129)
     one_hot = (jax.nn.one_hot(masked_target, partition_vocab_size,

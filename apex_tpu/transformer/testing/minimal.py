@@ -115,8 +115,7 @@ class GPTHead(nn.Module):
             init_method=init_normal(cfg.init_method_std),
             params_dtype=cfg.params_dtype, name="lm_head")(hidden)
         logits = logits.transpose(1, 0, 2)  # [b, s, v/tp]
-        loss = vocab_parallel_cross_entropy(logits.astype(jnp.float32),
-                                            labels)
+        loss = vocab_parallel_cross_entropy(logits, labels)
         return jnp.mean(loss)
 
 

@@ -229,6 +229,39 @@ class ParallelAttention(nn.Module):
             kv = kv.reshape(sk, b, np_local, 2 * hd)
             k, v = jnp.split(kv, 2, axis=-1)
 
+        # flash path: causal self-attention with no explicit mask and no
+        # attention dropout lowers to the Pallas flash kernel on TPU (the
+        # fmhalib / fused-softmax replacement); other configs take the
+        # explicit scores→FusedScaleMaskSoftmax→ctx path below
+        use_flash = (
+            self.attn_mask_type == AttnMaskType.causal
+            and attention_mask is None
+            and (deterministic or cfg.attention_dropout == 0.0)
+        )
+        if use_flash:
+            from apex_tpu.ops import fused_attention
+
+            # [s, b, np, hd] → [b, np, s, hd]
+            qf = q.transpose(1, 2, 0, 3)
+            kf = k.transpose(1, 2, 0, 3)
+            vf = v.transpose(1, 2, 0, 3)
+            # q/norm_factor then softmax×coeff == plain 1/sqrt(hd) scaling
+            # (qk-layer-scaling is an fp16-range trick; flash accumulates
+            # in fp32 so the composed scale is exact)
+            ctx = fused_attention(qf, kf, vf, causal=True,
+                                  sm_scale=1.0 / math.sqrt(hd))
+            ctx = ctx.transpose(2, 0, 1, 3).reshape(
+                q.shape[0], q.shape[1], np_local * hd)
+            dense = RowParallelLinear(
+                proj_size, cfg.hidden_size, input_is_parallel=True,
+                skip_bias_add=True,
+                init_method=scaled_init_method_normal(cfg.init_method_std,
+                                                      cfg.num_layers),
+                sequence_parallel_enabled=cfg.sequence_parallel,
+                params_dtype=cfg.params_dtype, axis_name=self.axis_name,
+                name="dense")
+            return dense(ctx)
+
         # [s, b, np, hd] → [b*np, s, hd] for MXU-batched GEMMs
         def to_bns(x):
             return x.transpose(1, 2, 0, 3).reshape(-1, x.shape[0], hd)
@@ -451,7 +484,7 @@ class GPTModel(nn.Module):
             return logits
         # post_language_model_processing: vocab-parallel CE in fp32
         return vocab_parallel_cross_entropy(
-            logits.astype(jnp.float32), labels, axis_name=self.axis_name)
+            logits, labels, axis_name=self.axis_name)
 
 
 def gpt_model_provider(cfg, pre_process=True, post_process=True, **kwargs):
@@ -550,7 +583,7 @@ class BertModel(nn.Module):
         if lm_labels is None:
             return lm_logits, binary_logits
         lm_loss = vocab_parallel_cross_entropy(
-            lm_logits.astype(jnp.float32), lm_labels,
+            lm_logits, lm_labels,
             axis_name=self.axis_name)
         return lm_loss, binary_logits
 
