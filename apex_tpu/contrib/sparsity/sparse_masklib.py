@@ -12,14 +12,19 @@ GPU-serving, or for FLOP reduction via mask-aware kernels) — ports
 directly; the mask math is pure tensor ops and jit-safe.
 """
 
+import jax
 import jax.numpy as jnp
 
 
 def _unstructured_mask(w, density):
+    """Keep exactly round(size*density) entries. Selection is by index
+    (argsort of |w|), not a >=threshold compare — a threshold keeps every
+    tie at the cutoff (a constant tensor would come out fully dense)."""
     k = max(1, int(round(w.size * density)))
     flat = jnp.abs(w).reshape(-1)
-    thresh = jnp.sort(flat)[-k]
-    return (jnp.abs(w) >= thresh).astype(w.dtype).reshape(w.shape)
+    _, idx = jax.lax.top_k(flat, k)
+    mask = jnp.zeros(flat.shape, w.dtype).at[idx].set(1)
+    return mask.reshape(w.shape)
 
 
 def _nm_mask(w, n, m):

@@ -28,9 +28,17 @@ import jax.numpy as jnp
 from apex_tpu.transformer.enums import AttnMaskType
 
 
-def _softmax_fp32(x, where=None):
-    """Row softmax in fp32 with masked-row → all-zeros semantics."""
+def _softmax_fp32(x, where=None, scale=None):
+    """Row softmax in fp32 with masked-row → all-zeros semantics.
+
+    ``scale`` is applied AFTER the fp32 upcast, matching the CUDA kernels
+    (they load half values and multiply by the fp32 scale in registers) —
+    scaling in the input dtype can overflow fp16 / lose bf16 mantissa bits
+    exactly in the qk-layer-scaling regime this class protects.
+    """
     xf = x.astype(jnp.float32)
+    if scale is not None:
+        xf = xf * jnp.float32(scale)
     if where is not None:
         neg = jnp.asarray(jnp.finfo(jnp.float32).min, jnp.float32)
         xf = jnp.where(where, neg, xf)
@@ -49,8 +57,7 @@ def scaled_upper_triang_masked_softmax(x, scale=1.0):
     fused_softmax.py:21-66). ``x``: [attn_batches, sq, sk] with sq == sk."""
     sq, sk = x.shape[-2], x.shape[-1]
     causal = jnp.arange(sk)[None, :] > jnp.arange(sq)[:, None]
-    out = _softmax_fp32(x * jnp.asarray(scale, jnp.float32).astype(x.dtype),
-                        where=causal)
+    out = _softmax_fp32(x, where=causal, scale=scale)
     return out.astype(x.dtype)
 
 
@@ -58,10 +65,9 @@ def scaled_masked_softmax(x, mask, scale=1.0):
     """Explicit-mask scaled softmax (reference: scaled_masked_softmax.h;
     autograd fn fused_softmax.py:71-98). ``x``: [b, np, sq, sk]; ``mask``
     bool broadcastable to x, True = masked out."""
-    scaled = x * jnp.asarray(scale, jnp.float32).astype(x.dtype)
     where = None if mask is None else jnp.broadcast_to(
-        mask.astype(bool), scaled.shape)
-    return _softmax_fp32(scaled, where=where).astype(x.dtype)
+        mask.astype(bool), x.shape)
+    return _softmax_fp32(x, where=where, scale=scale).astype(x.dtype)
 
 
 def generic_scaled_masked_softmax(x, mask, scale=1.0):
