@@ -2,3 +2,11 @@
 
 from apex_tpu.contrib.sparsity.asp import ASP  # noqa: F401
 from apex_tpu.contrib.sparsity.sparse_masklib import create_mask  # noqa: F401
+from apex_tpu.contrib.sparsity.permutation_search import (  # noqa: F401
+    accelerated_search_for_good_permutation,
+    efficacy,
+    exhaustive_search,
+    magnitude_after_pruning_rows,
+    progressive_channel_swap,
+    sum_after_2_to_4,
+)

@@ -17,10 +17,18 @@ wraps the optimizer transform: ``ASP.prune_trained_model``-equivalent is
 loop stays sparse without host sync — observably identical to the
 reference's step patch (weights outside the mask stay exactly zero).
 
-The channel-permutation accuracy search (permutation_lib.py, CUDA-
-accelerated) is out of scope here; ``allow_permutation`` is accepted and
-must be False.
+With ``allow_permutation=True`` the channel-permutation accuracy search
+(reference: permutation_lib.py:42 + permutation_search_kernels/, ported in
+``permutation_search.py``) runs per eligible weight: masks are computed in
+the permuted column domain — where 2:4 groups align with the best
+grouping found — and scattered back, so training proceeds in the original
+layout while keeping the permuted-optimal magnitude. ``self.permutations``
+stores each weight's column permutation for export to a physically
+permuted 2:4 layout (the reference instead rewires the torch graph;
+a functional pytree has no graph to rewire).
 """
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -47,6 +55,9 @@ class ASP:
         self.masks = None
         self._eligible = None
         self.pattern = "m4n2_1d"
+        self.permutations = None
+        self._allow_permutation = False
+        self._search_options = None
 
     def init_model_for_pruning(self, params, mask_calculator="m4n2_1d",
                                verbosity=2, whitelist=None,
@@ -54,12 +65,20 @@ class ASP:
                                disallowed_layer_names=(),
                                allow_recompute_mask=False,
                                custom_layer_dict=None,
-                               allow_permutation=False):
+                               allow_permutation=False,
+                               permutation_search_options=None):
         """Reference: asp.py:60-150. ``whitelist``/layer-name filters
-        operate on pytree path strings here."""
-        assert not allow_permutation, (
-            "channel-permutation search is not implemented in the TPU "
-            "build (reference: permutation_lib.py)")
+        operate on pytree path strings here. ``allow_permutation`` enables
+        the channel-permutation search during ``compute_sparse_masks``."""
+        if allow_permutation and mask_calculator != "m4n2_1d":
+            # the search kernels score top-2-of-4 groups specifically
+            # (reference kernels are likewise m=4-only); any other pattern
+            # would be optimized against the wrong objective
+            raise ValueError(
+                "allow_permutation=True requires mask_calculator='m4n2_1d' "
+                f"(got {mask_calculator!r})")
+        self._allow_permutation = allow_permutation
+        self._search_options = permutation_search_options
         self.pattern = mask_calculator
 
         def eligible(path, leaf):
@@ -76,14 +95,39 @@ class ASP:
 
     def compute_sparse_masks(self, params):
         """Reference: asp.py:152-200 — snapshot masks from current
-        magnitudes."""
+        magnitudes (optionally in each weight's best permuted column
+        domain, reference permutation_lib.py)."""
         assert self._eligible is not None, \
             "call init_model_for_pruning first"
-        self.masks = jax.tree_util.tree_map(
-            lambda ok, p: create_mask(p, self.pattern) if ok
-            else jnp.ones_like(p),
-            self._eligible, params)
+
+        self.permutations = {} if self._allow_permutation else None
+
+        def make_mask(path, ok, p):
+            if not ok:
+                return jnp.ones_like(p)
+            if not self._allow_permutation:
+                return create_mask(p, self.pattern)
+            return self._permuted_mask(jax.tree_util.keystr(path), p)
+
+        self.masks = jax.tree_util.tree_map_with_path(
+            make_mask, self._eligible, params)
         return self.masks
+
+    def _permuted_mask(self, name, p):
+        """Search a column permutation, mask in the permuted domain, and
+        scatter the mask back to the original layout (recorded in
+        ``self.permutations[name]`` for physical-layout export)."""
+        from apex_tpu.contrib.sparsity.permutation_search import (
+            accelerated_search_for_good_permutation)
+
+        mat = np.asarray(p.astype(jnp.float32)).reshape(-1, p.shape[-1])
+        perm = accelerated_search_for_good_permutation(
+            mat, self._search_options)
+        self.permutations[name] = np.asarray(perm)
+        permuted = jnp.take(p, jnp.asarray(perm), axis=-1)
+        mask_p = create_mask(permuted, self.pattern)
+        inv = np.argsort(perm)
+        return jnp.take(mask_p, jnp.asarray(inv), axis=-1)
 
     def apply_masks(self, params):
         """Prune: w *= mask (reference: asp.py:176-184)."""

@@ -1,0 +1,283 @@
+"""Channel-permutation search for 2:4 sparsity.
+
+Capability port of apex/contrib/sparsity/permutation_lib.py:42 +
+permutation_search_kernels/ (exhaustive_search.py, channel_swap.py,
+permutation_utilities.py; CUDA kernels under CUDA_kernels/). Permuting the
+grouped (input-channel) axis before applying an n:m mask changes WHICH
+weights share a group, so a good permutation preserves far more magnitude
+than the naive layout — the accuracy-preserving half of ASP.
+
+TPU-first design: the reference farms per-stripe-group scoring out to CUDA
+kernels (build_permute_map / sum_after_2_to_4) driven by a greedy host
+loop. Here the same split is: ONE jitted batched scoring program
+(gather all stripe-pairs → apply all 35 canonical permutations → top-2-of-4
+magnitude sums, reduced over rows on the VPU) and a small greedy host loop
+over its [pairs] result. No per-pair kernel launches, no Python over rows.
+
+Layout convention: ``matrix`` is [rows, cols] with the GROUPED axis last
+(cols), matching ``sparse_masklib.create_mask``. For flax kernels
+[in, out] pass ``kernel.T`` if the grouped axis is the input dim.
+"""
+
+import functools
+import itertools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+GROUP = 4  # m in 2:4 — the kernels are specialized to m=4 like the CUDA ones
+KEEP = 2   # n
+
+
+def sum_after_2_to_4(matrix):
+    """Total |w| kept if 2:4 were applied along the last axis (reference:
+    permutation_utilities.py sum_after_2_to_4 — CUDA kernel / per-row loop;
+    here one vectorized top-2-of-4 reduction)."""
+    m = jnp.abs(jnp.asarray(matrix, jnp.float32))
+    g = m.reshape(*m.shape[:-1], m.shape[-1] // GROUP, GROUP)
+    s = jnp.sort(g, axis=-1)
+    return jnp.sum(s[..., KEEP:])
+
+
+def magnitude_after_pruning_rows(matrix, rate=0.5):
+    """Unstructured per-row pruning magnitude — the optimality bound used
+    for efficacy (reference: permutation_utilities.py
+    magnitude_after_pruning_rows)."""
+    m = jnp.abs(jnp.asarray(matrix, jnp.float32))
+    k = int(m.shape[-1] * (1.0 - rate))
+    s = jnp.sort(m, axis=-1)
+    return jnp.sum(s[..., m.shape[-1] - k:])
+
+
+def efficacy(optimal_lost, base_lost, cur_lost):
+    """How much of the naive→optimal gap a permutation recovers
+    (reference: permutation_utilities.py efficacy)."""
+    if base_lost == optimal_lost:
+        return 1.0
+    return (base_lost - cur_lost) / (base_lost - optimal_lost)
+
+
+@functools.lru_cache(maxsize=None)
+def _pair_permutations():
+    """The 35 canonical permutations of 8 columns into two sorted groups of
+    4 (group order and in-group order don't affect 2:4, so the canonical
+    form — sorted groups, group containing column 0 first — enumerates each
+    distinct grouping once; reference: exhaustive_search.py
+    generate_unique_combinations / predict_unique_combinations(8,4)=35)."""
+    perms = []
+    cols = range(8)
+    for ga in itertools.combinations(cols, GROUP):
+        if 0 not in ga:
+            continue
+        gb = tuple(c for c in cols if c not in ga)
+        perms.append(ga + gb)
+    return np.asarray(perms, np.int32)  # [35, 8]
+
+
+@jax.jit
+def _score_all_pairs(mat_stripes, pairs):
+    """Best-permutation improvement for every stripe pair.
+
+    mat_stripes: [R, S, 4]; pairs: [P, 2] int32.
+    Returns (improvement [P] fp32, best_perm_idx [P] int32) where
+    improvement is (best permuted kept-magnitude) − (unpermuted kept
+    magnitude) for the pair's 8 columns.
+    """
+    perms = jnp.asarray(_pair_permutations())  # [35, 8]
+
+    def _kept(x):
+        g = x.reshape(*x.shape[:-1], 2, GROUP)
+        s = jnp.sort(g, axis=-1)
+        # sum over rows (axis 0) and the two groups; keep pair/perm axes
+        return jnp.sum(s[..., KEEP:], axis=(0, -1, -2))
+
+    # [R, Pc, 8] — the pair's two stripes side by side
+    sub = jnp.concatenate(
+        [mat_stripes[:, pairs[:, 0]], mat_stripes[:, pairs[:, 1]]], axis=-1)
+    sub = jnp.abs(sub.astype(jnp.float32))
+    base = _kept(sub)                    # [Pc]
+    permuted = sub[:, :, perms]          # [R, Pc, 35, 8]
+    kept = _kept(permuted)               # [Pc, 35]
+    best = jnp.argmax(kept, axis=-1)
+    return jnp.max(kept, axis=-1) - base, best.astype(jnp.int32)
+
+
+def _score_pairs_chunked(mat_stripes, pairs, chunk=2048):
+    """Host-side chunking over pairs to bound the [R, Pc, 35, 8] tile.
+    Chunks are padded to a fixed grid of sizes so the jitted scorer
+    compiles O(log) distinct shapes, not one per touched-set size."""
+    outs_i, outs_b = [], []
+    for lo in range(0, len(pairs), chunk):
+        part = pairs[lo:lo + chunk]
+        n = len(part)
+        padded = 1 << (n - 1).bit_length() if n > 1 else 1
+        if padded != n:
+            part = np.concatenate(
+                [part, np.zeros((padded - n, 2), part.dtype)])
+        imp, best = _score_all_pairs(mat_stripes, jnp.asarray(part))
+        outs_i.append(np.asarray(imp)[:n])
+        outs_b.append(np.asarray(best)[:n])
+    return np.concatenate(outs_i), np.concatenate(outs_b)
+
+
+def exhaustive_search(matrix, stripe_group_size=8, escape_attempts=100,
+                      seed=0, threshold=1e-4):
+    """Greedy stripe-pair permutation search (reference:
+    exhaustive_search.py Exhaustive_Search: build_stripe_map scores every
+    stripe group, use_stripe_map greedily applies the best disjoint ones,
+    repeating until no positive improvement, with random perturbations to
+    escape local minima).
+
+    Only the reference's default window (stripe_group_size=8 → pairs of
+    4-column stripes, 35 canonical permutations each) is implemented; the
+    wider windows exist in the reference to feed the same greedy loop
+    bigger local moves and change results marginally.
+
+    Returns (permuted_matrix, permutation, improvement) with
+    ``permuted_matrix == matrix[:, permutation]``.
+    """
+    assert stripe_group_size == 8, (
+        "TPU build implements the default stripe_group_size=8 (pair) window")
+    mat = np.asarray(matrix, np.float32)
+    R, C = mat.shape
+    assert C % GROUP == 0
+    S = C // GROUP
+    rng = np.random.RandomState(seed)
+    perms35 = _pair_permutations()
+
+    perm = np.arange(C)
+    all_pairs = np.asarray(list(itertools.combinations(range(S), 2)),
+                           np.int32)
+    if len(all_pairs) == 0:
+        return mat, perm, 0.0
+
+    cur = mat.copy()
+    base_kept = float(sum_after_2_to_4(cur))
+    best_kept = base_kept
+    best_perm = perm.copy()
+    escapes_left = escape_attempts
+
+    imp, bidx = _score_pairs_chunked(cur.reshape(R, S, GROUP), all_pairs)
+
+    while True:
+        # greedy pass: apply best disjoint positive pairs (use_stripe_map)
+        order = np.argsort(-imp)
+        used = set()
+        applied = False
+        for pi in order:
+            if imp[pi] <= threshold:
+                break
+            a, b = all_pairs[pi]
+            if a in used or b in used:
+                continue
+            cols = np.concatenate([np.arange(a * GROUP, a * GROUP + GROUP),
+                                   np.arange(b * GROUP, b * GROUP + GROUP)])
+            p8 = perms35[bidx[pi]]
+            cur[:, cols] = cur[:, cols[p8]]
+            perm[cols] = perm[cols[p8]]
+            used.update((int(a), int(b)))
+            applied = True
+
+        if applied:
+            kept = float(sum_after_2_to_4(cur))
+            if kept > best_kept:
+                best_kept = kept
+                best_perm = perm.copy()
+            # rescore only pairs touching modified stripes (reference:
+            # build_stripe_map's used_stripes fast path)
+            touched = np.asarray(
+                [i for i, (a, b) in enumerate(all_pairs)
+                 if a in used or b in used], np.int32)
+            t_imp, t_bidx = _score_pairs_chunked(
+                cur.reshape(R, S, GROUP), all_pairs[touched])
+            imp[touched] = t_imp
+            bidx[touched] = t_bidx
+            continue
+
+        # converged: random two-channel cross-stripe swap to escape
+        # (reference: use_stripe_map's sm_perturbation path)
+        if escapes_left <= 0:
+            break
+        escapes_left -= 1
+        src = rng.randint(C)
+        dst = rng.randint(C)
+        if src // GROUP == dst // GROUP:
+            continue
+        cur[:, [src, dst]] = cur[:, [dst, src]]
+        perm[[src, dst]] = perm[[dst, src]]
+        touched = np.asarray(
+            [i for i, (a, b) in enumerate(all_pairs)
+             if a in (src // GROUP, dst // GROUP)
+             or b in (src // GROUP, dst // GROUP)], np.int32)
+        t_imp, t_bidx = _score_pairs_chunked(
+            cur.reshape(R, S, GROUP), all_pairs[touched])
+        imp[touched] = t_imp
+        bidx[touched] = t_bidx
+
+    return (np.asarray(matrix, np.float32)[:, best_perm], best_perm,
+            best_kept - base_kept)
+
+
+def progressive_channel_swap(matrix, max_attempts=1000,
+                             improvement_threshold=1e-9, seed=0):
+    """Random greedy channel swaps (reference:
+    call_permutation_search_kernels.py 'progressive channel swap' strategy;
+    bounded by attempts instead of wall-clock so results are
+    deterministic). Returns (permuted_matrix, permutation, improvement)."""
+    mat = np.asarray(matrix, np.float32)
+    R, C = mat.shape
+    S = C // GROUP
+    rng = np.random.RandomState(seed)
+    perm = np.arange(C)
+    cur = mat.copy()
+    base = float(sum_after_2_to_4(cur))
+
+    def stripe_kept(sidx):
+        g = np.abs(cur[:, sidx * GROUP:(sidx + 1) * GROUP])
+        return float(np.sum(np.sort(g, axis=-1)[:, KEEP:]))
+
+    kept_per_stripe = np.asarray([stripe_kept(s) for s in range(S)])
+
+    for _ in range(max_attempts):
+        src, dst = rng.randint(C), rng.randint(C)
+        sa, sb = src // GROUP, dst // GROUP
+        if sa == sb:
+            continue
+        # evaluate only the two affected stripes, without a matrix copy
+        cur[:, [src, dst]] = cur[:, [dst, src]]
+        new_a, new_b = stripe_kept(sa), stripe_kept(sb)
+        gain = (new_a + new_b) - (kept_per_stripe[sa] + kept_per_stripe[sb])
+        if gain > improvement_threshold:
+            perm[[src, dst]] = perm[[dst, src]]
+            kept_per_stripe[sa], kept_per_stripe[sb] = new_a, new_b
+        else:
+            cur[:, [src, dst]] = cur[:, [dst, src]]  # revert
+
+    return (np.asarray(matrix, np.float32)[:, perm], perm,
+            float(sum_after_2_to_4(cur)) - base)
+
+
+def accelerated_search_for_good_permutation(matrix, options=None):
+    """Strategy dispatch (reference:
+    call_permutation_search_kernels.py accelerated_search_for_good_
+    permutation). Returns the permutation sequence."""
+    options = dict(options or {})
+    strategy = options.setdefault("strategy", "exhaustive")
+    if strategy == "exhaustive":
+        _, perm, _ = exhaustive_search(
+            matrix,
+            stripe_group_size=options.get("stripe_group_size", 8),
+            escape_attempts=options.get("escape_attempts", 100),
+            seed=options.get("seed", 0))
+        return perm
+    if strategy == "progressive channel swap":
+        _, perm, _ = progressive_channel_swap(
+            matrix,
+            max_attempts=options.get("max_attempts", 1000),
+            improvement_threshold=options.get("improvement_threshold", 1e-9),
+            seed=options.get("seed", 0))
+        return perm
+    raise ValueError(f"unknown permutation search strategy: {strategy}")

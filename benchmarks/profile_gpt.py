@@ -1,0 +1,237 @@
+"""Component-level timing of the GPT-2-small training step on one chip.
+
+Measurement method: each measured program runs K chained iterations inside
+ONE ``lax.scan`` under a single jit dispatch — per-iteration device time is
+total/K. This is robust against host↔device tunnel dispatch latency and
+against any result caching of repeated identical dispatches (both observed
+on the axon-tunneled TPU backend).
+
+Results feed PERF.md; run on the real TPU:
+    PYTHONPATH=/root/repo:$PYTHONPATH python benchmarks/profile_gpt.py
+"""
+
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.amp.scaler import LossScaler
+from apex_tpu.optimizers.fused_adam import fused_adam
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+from apex_tpu.transformer.testing import GPTModel, TransformerConfig
+
+B, S = 8, 1024
+K = 8  # scan length
+PEAK = 197e12  # v5e bf16 peak FLOP/s
+
+cfg = TransformerConfig(
+    hidden_size=768, num_layers=12, num_attention_heads=12,
+    vocab_size=50304, max_position_embeddings=1024,
+    hidden_dropout=0.0, attention_dropout=0.0, bf16=True)
+model = GPTModel(cfg)
+mesh = Mesh(np.asarray(jax.devices()[:1]), (TENSOR_AXIS,))
+rs = np.random.RandomState(0)
+ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (B, S)), jnp.int32)
+pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+labels = jnp.asarray(rs.randint(0, cfg.vocab_size, (B, S)), jnp.int32)
+
+
+def shmap(f, n):
+    return jax.shard_map(f, mesh=mesh, in_specs=(P(),) * n, out_specs=P(),
+                         check_vma=False)
+
+
+params = jax.jit(shmap(
+    lambda i, p: model.init(jax.random.PRNGKey(0), i, p, None)["params"],
+    2))(ids, pos)
+n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+print(f"params: {n_params/1e6:.1f}M   (method: {K}-step lax.scan, 1 dispatch)")
+
+
+def scan_time(name, make_body, carry0, ops, flops_per_iter=None):
+    """make_body(*ops) -> body(carry, _) -> (carry, metric). ``ops`` (big
+    arrays) are jit ARGUMENTS — closure-captured constants would be inlined
+    into the HLO payload and overflow the remote-compile tunnel."""
+    def run(carry0, *ops):
+        body = make_body(*ops)
+        carry, ms = lax.scan(body, carry0, jnp.arange(K))
+        return carry, ms
+
+    f = jax.jit(shmap(run, 1 + len(ops)))
+    carry, ms = f(carry0, *ops)
+    jax.block_until_ready((carry, ms))  # compile + warm
+    t0 = time.perf_counter()
+    carry, ms = f(carry0, *ops)
+    jax.block_until_ready((carry, ms))
+    dt = (time.perf_counter() - t0) / K
+    extra = ""
+    if flops_per_iter:
+        extra = (f"  {flops_per_iter/dt/1e12:6.1f} TF/s"
+                 f"  MFU={flops_per_iter/dt/PEAK*100:5.1f}%")
+    print(f"{name:28s} {dt*1000:8.2f} ms{extra}")
+    return dt
+
+
+model_flops_fwd = 2 * n_params * B * S
+model_flops_fb = 6 * n_params * B * S
+
+# 1. fwd only — params ride in the carry (unchanged) to stay jit args
+def make_fwd(ids, pos, labels):
+    def body(p, _):
+        loss = jnp.mean(model.apply({"params": p}, ids, pos, None, labels))
+        # zero-strength feedback keeps iterations dependency-chained
+        p = jax.tree_util.tree_map(lambda a: a + 0.0 * loss.astype(a.dtype),
+                                   p)
+        return p, loss
+    return body
+
+t_fwd = scan_time("fwd+loss", make_fwd, params, (ids, pos, labels),
+                  flops_per_iter=model_flops_fwd)
+
+# 2. fwd+bwd
+def make_fb(ids, pos, labels):
+    def body(p, _):
+        loss, g = jax.value_and_grad(
+            lambda pp: jnp.mean(model.apply({"params": pp}, ids, pos, None,
+                                            labels)))(p)
+        p = jax.tree_util.tree_map(
+            lambda a, b: a - 0.0 * b.astype(a.dtype), p, g)
+        return p, loss
+    return body
+
+t_fb = scan_time("fwd+bwd", make_fb, params, (ids, pos, labels),
+                 flops_per_iter=model_flops_fb)
+
+# 3. optimizer update alone
+tx = fused_adam(learning_rate=1e-4)
+opt_state = jax.jit(lambda p: tx.init(p))(params)
+g0 = jax.tree_util.tree_map(lambda p: jnp.full_like(p, 1e-6), params)
+
+def make_opt(g0):
+    def body(carry, _):
+        p, s = carry
+        u, ns = tx.update(g0, s, p)
+        p = jax.tree_util.tree_map(lambda a, b: a + b.astype(a.dtype), p, u)
+        return (p, ns), ns.count.astype(jnp.float32)
+    return body
+
+t_opt = scan_time("adam update", make_opt, (params, opt_state), (g0,))
+
+# 4. scaler unscale+update alone
+scaler = LossScaler()
+
+def make_sc(g0):
+    def body(ss, _):
+        g2, found = scaler.unscale(g0, ss)
+        ns = scaler.update(ss, found)
+        # keep the unscaled grads live so XLA can't elide the pass
+        ns = ns._replace(loss_scale=ns.loss_scale + 0.0 * jnp.sum(
+            g2["position_embeddings"][0]))
+        return ns, ns.loss_scale
+    return body
+
+t_sc = scan_time("scaler unscale+update", make_sc, scaler.init(), (g0,))
+
+# 5. FULL train step
+def make_step(ids, pos, labels):
+    def body(carry, _):
+        p, o, ss = carry
+
+        def loss_fn(pp):
+            per_tok = model.apply({"params": pp}, ids, pos, None, labels)
+            return jnp.mean(per_tok) * ss.loss_scale
+
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        grads, found_inf = scaler.unscale(grads, ss)
+        nss = scaler.update(ss, found_inf)
+        updates, no = tx.update(grads, o, p)
+        np_ = jax.tree_util.tree_map(
+            lambda a, u: jnp.where(found_inf, a, a + u.astype(a.dtype)),
+            p, updates)
+        no = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(found_inf, old, new), no, o)
+        return (np_, no, nss), loss / ss.loss_scale
+    return body
+
+t_step = scan_time("FULL train step", make_step,
+                   (params, opt_state, scaler.init()), (ids, pos, labels),
+                   flops_per_iter=model_flops_fb)
+print(f"{'':28s} -> {B*S/t_step:.0f} tok/s")
+
+# 6. trunk-only fwd+bwd (no CE head / embedding)
+from apex_tpu.transformer.testing.standalone_transformer_lm import (
+    ParallelTransformer, parallel_lm_logits)
+from apex_tpu.transformer.enums import AttnMaskType
+from apex_tpu.transformer.tensor_parallel.cross_entropy import (
+    vocab_parallel_cross_entropy)
+from apex_tpu.transformer.tensor_parallel.layers import vocab_parallel_embed
+
+trunk = ParallelTransformer(cfg, self_attn_mask_type=AttnMaskType.causal)
+hidden0 = jnp.asarray(rs.randn(S, B, cfg.hidden_size) * 0.02, jnp.bfloat16)
+tparams = jax.jit(shmap(
+    lambda h: trunk.init(jax.random.PRNGKey(0), h, None), 1))(hidden0)
+n_trunk = sum(x.size for x in jax.tree_util.tree_leaves(tparams))
+
+def make_trunk(hidden0):
+    def body(p, _):
+        def loss(pp):
+            return jnp.sum(trunk.apply(pp, hidden0, None).astype(jnp.float32))
+        l, g = jax.value_and_grad(loss)(p)
+        p = jax.tree_util.tree_map(
+            lambda a, b: a - 0.0 * b.astype(a.dtype), p, g)
+        return p, l
+    return body
+
+scan_time("trunk fwd+bwd", make_trunk, tparams, (hidden0,),
+          flops_per_iter=6 * n_trunk * B * S)
+
+# 7. CE head alone (logits matmul + vocab CE), chained on weight
+w_emb0 = params["word_embeddings"]
+hid = jnp.asarray(rs.randn(S, B, cfg.hidden_size) * 0.5, jnp.bfloat16)
+
+def make_head(hid, labels):
+    def body(w, _):
+        def f(w):
+            logits = parallel_lm_logits(hid, w).transpose(1, 0, 2)
+            return jnp.mean(vocab_parallel_cross_entropy(logits, labels))
+        loss, gw = jax.value_and_grad(f)(w)
+        return w - 0.0 * gw, loss
+    return body
+
+head_flops = 6 * B * S * cfg.hidden_size * cfg.vocab_size
+scan_time("CE head fwd+bwd", make_head, w_emb0, (hid, labels),
+          flops_per_iter=head_flops)
+
+# 8. embedding fwd+bwd
+def make_emb(ids):
+    def body(w, _):
+        def f(w):
+            return jnp.sum(vocab_parallel_embed(w, ids).astype(jnp.float32))
+        l, g = jax.value_and_grad(f)(w)
+        return w - 0.0 * g, l
+    return body
+
+scan_time("vocab embed fwd+bwd", make_emb, w_emb0, (ids,))
+
+# 9. flash attention fwd+bwd
+from apex_tpu.ops import fused_attention
+
+q0 = jnp.asarray(rs.randn(B, 12, S, 64), jnp.bfloat16)
+k0 = jnp.asarray(rs.randn(B, 12, S, 64), jnp.bfloat16)
+v0 = jnp.asarray(rs.randn(B, 12, S, 64), jnp.bfloat16)
+
+def make_fa(k0, v0):
+    def body(q, _):
+        def f(q):
+            return jnp.sum(
+                fused_attention(q, k0, v0, causal=True).astype(jnp.float32))
+        l, g = jax.value_and_grad(f)(q)
+        return q - 0.0 * g, l
+    return body
+
+attn_flops = 4 * B * 12 * S * S * 64 * 3 // 2  # fwd+2x bwd, causal halves
+scan_time("flash attn fwd+bwd (1 lyr)", make_fa, q0, (k0, v0),
+          flops_per_iter=attn_flops)

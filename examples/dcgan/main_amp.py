@@ -6,24 +6,22 @@ two models (G, D), two optimizers, three backward passes per iteration
 reference's ``amp.scale_loss(..., loss_id=k)`` pattern — on synthetic
 data.
 
-Run: python examples/dcgan/main_amp.py --steps 5 -b 16
+Run (install the package first — ``pip install -e .`` from the repo root):
+    python examples/dcgan/main_amp.py --steps 5 -b 16
 """
 
 import argparse
 import os
-import sys
 import time
 
 import numpy as np
 
-sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import jax
+import jax.numpy as jnp
+import optax
 
-import jax  # noqa: E402
-import jax.numpy as jnp  # noqa: E402
-import optax  # noqa: E402
-
-from apex_tpu import amp  # noqa: E402
-from apex_tpu.models import Discriminator, Generator  # noqa: E402
+from apex_tpu import amp
+from apex_tpu.models import Discriminator, Generator
 
 
 def parse_args(argv=None):

@@ -7,7 +7,8 @@ TPU: ONE jitted SPMD train step inside shard_map over the "data" mesh axis
 replaces the DDP-hook + stream machinery; images/sec and prec@1/5 metering
 match the reference's AverageMeter output format.
 
-Run (synthetic data smoke):
+Run (synthetic data smoke; install the package first — ``pip install -e .``
+from the repo root):
     python examples/imagenet/main_amp.py --synthetic --steps 20 -b 32
 Real data expects an ImageFolder-style numpy loader — see make_loader.
 """
@@ -15,23 +16,20 @@ Real data expects an ImageFolder-style numpy loader — see make_loader.
 import argparse
 import os
 import pickle
-import sys
 import time
 
 import numpy as np
 
-sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
 
-import jax  # noqa: E402
-import jax.numpy as jnp  # noqa: E402
-from jax import lax  # noqa: E402
-from jax import shard_map  # noqa: E402
-from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
-
-from apex_tpu import amp  # noqa: E402
-from apex_tpu.models import resnet18, resnet50  # noqa: E402
-from apex_tpu.optimizers.fused_sgd import fused_sgd  # noqa: E402
-from apex_tpu.parallel.distributed import (  # noqa: E402
+from apex_tpu import amp
+from apex_tpu.models import resnet18, resnet50
+from apex_tpu.optimizers.fused_sgd import fused_sgd
+from apex_tpu.parallel.distributed import (
     allreduce_gradients,
 )
 

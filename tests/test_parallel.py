@@ -18,7 +18,7 @@ from jax import shard_map
 from apex_tpu.parallel import (
     convert_syncbn_model,
     DistributedDataParallel, allreduce_gradients, broadcast_params,
-    SyncBatchNorm, sync_batch_norm, LARC, larc,
+    SyncBatchNorm, sync_batch_norm, LARC, larc, pvary,
 )
 from apex_tpu.optimizers import FusedSGD
 
@@ -88,7 +88,7 @@ def test_ddp_grad_math_check():
     def step(w, x):
         # pvary = each replica owns its copy (the DDP model); grads are then
         # per-replica and the explicit allreduce averages them.
-        w = jax.lax.pvary(w, "data")
+        w = pvary(w, "data")
         g = jax.grad(lambda w: jnp.sum(w * x))(w)
         return allreduce_gradients(g, "data")
 
