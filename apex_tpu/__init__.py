@@ -48,7 +48,7 @@ from apex_tpu import multi_tensor_apply  # noqa: E402,F401
 from apex_tpu import optimizers  # noqa: E402,F401
 from apex_tpu import normalization  # noqa: E402,F401
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"  # keep in sync with pyproject.toml
 
 
 def __getattr__(name):

@@ -50,6 +50,9 @@ def main(argv=None):
                         "(default: <repo>/tests next to the package)")
     p.add_argument("--xml-report", default=None, metavar="PATH",
                    help="write a junit xml report")
+    p.add_argument("--slow", action="store_true",
+                   help="include the slow-marked end-to-end smokes "
+                        "(deselected by default via pyproject addopts)")
     args, pytest_extra = p.parse_known_args(argv)
 
     if args.list:
@@ -80,6 +83,8 @@ def main(argv=None):
     import pytest
 
     pytest_args = ["-q", *paths, *pytest_extra]
+    if args.slow:
+        pytest_args += ["-m", ""]  # clear the 'not slow' default selection
     if args.xml_report:
         pytest_args.append(f"--junitxml={args.xml_report}")
     return pytest.main(pytest_args)

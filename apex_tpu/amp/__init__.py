@@ -5,6 +5,17 @@ Public surface mirrors apex.amp (reference: apex/amp/__init__.py:1-4):
 ``register_{half,float,promote}_function`` — re-designed functionally:
 dtype policies instead of monkey-patching, pytree scaler state instead of
 stateful LossScaler objects.
+
+ADR — amp legacy glue not ported (reference apex/amp/{opt,compat,
+rnn_compat}.py, 536 LoC): those modules exist to patch Variable/Tensor
+API splits of pre-1.0 torch (compat.py), to wrap the deprecated
+``amp.half_function(torch.nn.RNN)`` eager-RNN internals (rnn_compat.py),
+and to provide the pre-``initialize`` ``amp.init()``/``OptimWrapper``
+surface (opt.py) that upstream itself deprecates in favor of
+``amp.initialize``. None of these has a JAX analog to patch — tracing
+makes namespace shims meaningless — and the supported reference surface
+(``initialize``-based) is fully covered here. Deliberately omitted, not
+deferred.
 """
 
 from apex_tpu.amp.frontend import (

@@ -97,12 +97,17 @@ def fused_attention(q, k, v, *, causal=False, sm_scale=None,
 
     from jax.experimental.pallas.ops.tpu import flash_attention as fa
 
+    # Tuned on v5e (benchmarks/profile_attention.py, PERF.md): large q
+    # blocks (fewer grid steps per head) with 512-wide k blocks beat the
+    # kernel defaults ~3x at GPT shapes; block_b>1 doesn't help and big
+    # values fail to compile.
+    bq = _block(sq, 1024)
     blk = _block(min(sq, sk), 512)
     bs = fa.BlockSizes(
-        block_q=_block(sq, 512), block_k_major=blk, block_k=blk, block_b=1,
-        block_q_major_dkv=_block(sq, 512), block_k_major_dkv=blk,
-        block_k_dkv=blk, block_q_dkv=_block(sq, 512),
-        block_k_major_dq=blk, block_k_dq=blk, block_q_dq=_block(sq, 512))
+        block_q=bq, block_k_major=blk, block_k=blk, block_b=1,
+        block_q_major_dkv=bq, block_k_major_dkv=blk,
+        block_k_dkv=blk, block_q_dkv=bq,
+        block_k_major_dq=blk, block_k_dq=blk, block_q_dq=bq)
     seg = None
     if segment_ids is not None:
         seg = fa.SegmentIds(q=segment_ids[0].astype(jnp.int32),

@@ -1,14 +1,24 @@
-"""FusedAdam — Adam/AdamW with a single fused flat update.
+"""FusedAdam — Adam/AdamW with a fused single-pass update.
 
 Capability port of apex.optimizers.FusedAdam (reference:
 apex/optimizers/fused_adam.py:4-193; kernel csrc/multi_tensor_adam.cu:23-80,
 fp32 math via MATH_T). Two surfaces:
 
   * ``fused_adam(...)`` — an optax ``GradientTransformation`` whose state is
-    two flat fp32 buffers (m, v) + step count; the whole update is one
-    vectorized pass regardless of parameter count.
+    per-parameter fp32 (m, v) pytrees + step count.
   * ``FusedAdam`` — a torch-like stateful class (param groups, ``step``) for
     API parity and step-by-step tests.
+
+TPU-first note: the reference's multi_tensor kernel exists to amortize CUDA
+launch overhead over thousands of small tensors. Under jit there are no
+launches to amortize — XLA fuses the per-leaf elementwise updates into the
+step program — and a flat-buffer layout (used here through round 2) costs
+an extra concat of (g, p) plus a slice of the updates EVERY step: ~6 extra
+HBM copies of the whole parameter state. Measured on v5e (GPT-2-small,
+124.5M params): flat 14.3 ms/step vs per-leaf ~bandwidth-bound ~5 ms (see
+PERF.md). Per-tensor-reduction optimizers (LAMB etc.) and the ZeRO-sharded
+optimizers still use the flat substrate in ``_fused.py``, where a single
+flat buffer genuinely is the right shard/reduce layout.
 """
 
 from typing import Any, NamedTuple
@@ -18,13 +28,12 @@ import jax.numpy as jnp
 import optax
 
 from apex_tpu.optimizers._base import FusedOptimizerBase
-from apex_tpu.optimizers._fused import FlatMeta, get_meta
 
 
 class FusedAdamState(NamedTuple):
     count: jnp.ndarray  # i32 step counter
-    m: jnp.ndarray  # flat fp32 exp_avg
-    v: jnp.ndarray  # flat fp32 exp_avg_sq
+    m: Any  # fp32 exp_avg pytree (params structure)
+    v: Any  # fp32 exp_avg_sq pytree
 
 
 def _adam_flat(flat_g, flat_p, m, v, count, lr, beta1, beta2, eps,
@@ -55,30 +64,38 @@ def fused_adam(learning_rate=1e-3, betas=(0.9, 0.999), eps=1e-8,
     beta1, beta2 = betas
 
     def init(params):
-        leaves = jax.tree_util.tree_leaves(params)
-        meta = get_meta(leaves)
-        total = meta.total
+        def zeros(p):
+            return jnp.zeros(p.shape, jnp.float32)
+
         return FusedAdamState(
             count=jnp.zeros((), jnp.int32),
-            m=jnp.zeros((total,), jnp.float32),
-            v=jnp.zeros((total,), jnp.float32),
+            m=jax.tree_util.tree_map(zeros, params),
+            v=jax.tree_util.tree_map(zeros, params),
         )
 
     def update(grads, state, params=None):
         assert params is not None, "fused_adam requires params"
         leaves_g, treedef = jax.tree_util.tree_flatten(grads)
         leaves_p = jax.tree_util.tree_leaves(params)
-        meta = get_meta(leaves_p)
-        flat_g = meta.flatten(leaves_g)
-        flat_p = meta.flatten(leaves_p)
+        leaves_m = jax.tree_util.tree_leaves(state.m)
+        leaves_v = jax.tree_util.tree_leaves(state.v)
         count = state.count + 1
         lr = learning_rate(count) if callable(learning_rate) else learning_rate
-        flat_u, m, v = _adam_flat(flat_g, flat_p, state.m, state.v, count,
-                                  lr, beta1, beta2, eps, weight_decay,
-                                  adam_w_mode, bias_correction)
-        updates = jax.tree_util.tree_unflatten(
-            treedef, meta.unflatten(flat_u, [g.dtype for g in leaves_g]))
-        return updates, FusedAdamState(count=count, m=m, v=v)
+        us, ms, vs = [], [], []
+        for g, p, m, v in zip(leaves_g, leaves_p, leaves_m, leaves_v):
+            u, nm, nv = _adam_flat(
+                g.astype(jnp.float32), p.astype(jnp.float32), m, v, count,
+                lr, beta1, beta2, eps, weight_decay, adam_w_mode,
+                bias_correction)
+            us.append(u.astype(g.dtype))
+            ms.append(nm)
+            vs.append(nv)
+
+        def unflat(xs):
+            return jax.tree_util.tree_unflatten(treedef, xs)
+
+        return unflat(us), FusedAdamState(count=count, m=unflat(ms),
+                                          v=unflat(vs))
 
     return optax.GradientTransformation(init, update)
 

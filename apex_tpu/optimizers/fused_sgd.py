@@ -1,24 +1,27 @@
-"""FusedSGD — momentum SGD as one fused flat update.
+"""FusedSGD — momentum SGD as one fused pass.
 
 Capability port of apex.optimizers.FusedSGD (reference:
 apex/optimizers/fused_sgd.py:7-227; kernel csrc/multi_tensor_sgd_kernel.cu).
-Momentum buffer lives as a single flat fp32 array; first-step semantics
+Momentum buffers are per-parameter fp32 pytrees; first-step semantics
 match torch (buf = grad on first momentum use).
+
+TPU-first note: per-leaf elementwise updates fuse under jit with no launch
+overhead; a flat-buffer layout would pay an extra concat+slice of the whole
+parameter state per step (see fused_adam.py and PERF.md).
 """
 
-from typing import NamedTuple
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
 import optax
 
 from apex_tpu.optimizers._base import FusedOptimizerBase
-from apex_tpu.optimizers._fused import FlatMeta, get_meta
 
 
 class FusedSGDState(NamedTuple):
     count: jnp.ndarray
-    momentum_buf: jnp.ndarray  # flat fp32
+    momentum_buf: Any  # fp32 pytree (params structure)
 
 
 def fused_sgd(learning_rate=1e-3, momentum=0.0, dampening=0.0,
@@ -27,35 +30,45 @@ def fused_sgd(learning_rate=1e-3, momentum=0.0, dampening=0.0,
         raise ValueError("Nesterov momentum requires a momentum and zero dampening")
 
     def init(params):
-        meta = get_meta(jax.tree_util.tree_leaves(params))
         return FusedSGDState(
             count=jnp.zeros((), jnp.int32),
-            momentum_buf=jnp.zeros((meta.total,), jnp.float32),
+            momentum_buf=jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params),
         )
 
     def update(grads, state, params=None):
         assert params is not None
-        leaves_g, treedef = jax.tree_util.tree_flatten(grads)
-        leaves_p = jax.tree_util.tree_leaves(params)
-        meta = get_meta(leaves_p)
-        g = meta.flatten(leaves_g)
-        p = meta.flatten(leaves_p)
         count = state.count + 1
         lr = learning_rate(count) if callable(learning_rate) else learning_rate
-        if weight_decay != 0:
-            g = g + weight_decay * p
-        if momentum != 0:
-            # first step: buf = g (torch semantics); after: buf = mu*buf + (1-damp)*g
-            buf = jnp.where(count == 1, g,
-                            momentum * state.momentum_buf + (1.0 - dampening) * g)
-            d = g + momentum * buf if nesterov else buf
-        else:
-            buf = state.momentum_buf
-            d = g
-        flat_u = -lr * d
-        updates = jax.tree_util.tree_unflatten(
-            treedef, meta.unflatten(flat_u, [x.dtype for x in leaves_g]))
-        return updates, FusedSGDState(count=count, momentum_buf=buf)
+
+        def leaf(g, p, buf):
+            g = g.astype(jnp.float32)
+            if weight_decay != 0:
+                g = g + weight_decay * p.astype(jnp.float32)
+            if momentum != 0:
+                # first step: buf = g (torch semantics); after:
+                # buf = mu*buf + (1-damp)*g
+                buf = jnp.where(count == 1, g,
+                                momentum * buf + (1.0 - dampening) * g)
+                d = g + momentum * buf if nesterov else buf
+            else:
+                d = g
+            return -lr * d, buf
+
+        leaves_g, treedef = jax.tree_util.tree_flatten(grads)
+        leaves_p = jax.tree_util.tree_leaves(params)
+        leaves_b = jax.tree_util.tree_leaves(state.momentum_buf)
+        us, bufs = [], []
+        for g, p, b in zip(leaves_g, leaves_p, leaves_b):
+            u, nb = leaf(g, p, b)
+            us.append(u.astype(g.dtype))
+            bufs.append(nb)
+
+        def unflat(xs):
+            return jax.tree_util.tree_unflatten(treedef, xs)
+
+        return unflat(us), FusedSGDState(count=count,
+                                         momentum_buf=unflat(bufs))
 
     return optax.GradientTransformation(init, update)
 

@@ -15,8 +15,17 @@ jitted SPMD program inside ``shard_map`` over the "pp" mesh axis:
   * **the backward schedule is not hand-written at all** — differentiating
     through the scan+ppermute reverses the permutation and replays the
     ticks in reverse order, which IS the mirrored pipeline (cooldown ↔
-    warmup swap). ``jax.checkpoint`` around the trunk bounds activation
-    memory per tick, giving the 1F1B memory profile knob.
+    warmup swap).
+
+Memory (measured — benchmarks/profile_pipeline_memory.py, PERF.md §5):
+AD-of-scan saves residuals for every tick, so activation memory grows
+O(T = M + pp − 1) in the microbatch count — a GPipe-shaped profile, not
+true 1F1B's O(pp) in-flight bound. The ``checkpoint_stages`` knob
+(``jax.checkpoint`` around the trunk) shrinks the per-tick residual to
+the stage-boundary activation — measured 9.9x smaller than the
+uncheckpointed trunk internals (~0.6 MB vs ~6.2 MB per extra microbatch
+at the test shape) — which is what makes long microbatch trains viable;
+the trunk internals are recomputed one tick at a time in backward.
 
 Stage heterogeneity (embedding on the first stage, loss head on the last —
 the reference's ``pre_process``/``post_process``, common.py:30-80) is

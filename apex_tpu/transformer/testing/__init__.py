@@ -5,6 +5,14 @@ plus the global-vars singletons — the models double as the framework's
 flagship benchmark models.
 """
 
+from apex_tpu.transformer.testing.arguments import (  # noqa: F401
+    ArgsError,
+    MegatronArgs,
+    bert_large_lamb_args,
+    gpt_345m_args,
+    parse_args,
+)
+from apex_tpu.transformer.testing import global_vars  # noqa: F401
 from apex_tpu.transformer.testing.standalone_transformer_lm import (  # noqa: F401
     GPTModel,
     BertModel,

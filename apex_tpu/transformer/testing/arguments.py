@@ -1,0 +1,516 @@
+"""Megatron-style configuration bundle.
+
+Capability port of apex/transformer/testing/arguments.py (971 LoC: grouped
+argparse options + the cross-validation/derivation pass at :60-318). The
+TPU-native shape is a validated dataclass:
+
+  * ``MegatronArgs`` — one flat dataclass whose fields mirror the reference
+    argument groups (network size, regularization, training, initialization,
+    learning rate, checkpointing, mixed precision, distributed, validation,
+    data, autoresume, logging). CUDA-runtime knobs that have no TPU meaning
+    (persist_layer_norm, contiguous DDP buffers, cpu-offload) are accepted
+    and recorded but drive nothing; the vision/biencoder/dino/retriever
+    groups (reference :848-969) serve reference-internal example models and
+    are deliberately not ported (ADR: out of framework scope).
+  * ``parse_args`` — the same CLI surface (kebab-case flags, deprecated-flag
+    errors, ``defaults`` override dict, ``extra_args_provider``) producing a
+    finalized ``MegatronArgs``.
+  * ``MegatronArgs.finalize()`` — the reference's derivation/consistency
+    pass (:60-318): dp size from world/tp/pp, global batch, virtual pp,
+    params_dtype, iteration- vs sample-based exclusivity, warmup
+    exclusivity, ffn/kv defaults, seq-length checks, weight-decay
+    increments, mixed-precision implications.
+
+BASELINE configs 3 (BERT-large + FusedLAMB) and 4 (GPT-2 345M TP) are
+expressed with this bundle in ``examples/transformer/pretrain.py`` and
+``tests/test_arguments.py``.
+"""
+
+import argparse
+import dataclasses
+import os
+from typing import Any, List, Optional
+
+import jax.numpy as jnp
+
+
+class ArgsError(ValueError):
+    """Raised when cross-validation fails (reference uses bare asserts)."""
+
+
+@dataclasses.dataclass
+class MegatronArgs:
+    # --- network size (reference :350-394) ---
+    num_layers: Optional[int] = None
+    hidden_size: Optional[int] = None
+    ffn_hidden_size: Optional[int] = None
+    num_attention_heads: Optional[int] = None
+    kv_channels: Optional[int] = None
+    max_position_embeddings: Optional[int] = None
+    make_vocab_size_divisible_by: int = 128
+    layernorm_epsilon: float = 1e-5
+    apply_residual_connection_post_layernorm: bool = False
+    openai_gelu: bool = False
+    onnx_safe: bool = False
+    bert_binary_head: bool = True
+    num_experts: Optional[List[int]] = None
+
+    # --- regularization (reference :434-465) ---
+    attention_dropout: float = 0.1
+    hidden_dropout: float = 0.1
+    weight_decay: float = 0.01
+    start_weight_decay: Optional[float] = None
+    end_weight_decay: Optional[float] = None
+    weight_decay_incr_style: str = "constant"  # constant|linear|cosine
+    clip_grad: float = 1.0
+    adam_beta1: float = 0.9
+    adam_beta2: float = 0.999
+    adam_eps: float = 1e-8
+    sgd_momentum: float = 0.9
+
+    # --- training (reference :467-583) ---
+    micro_batch_size: Optional[int] = None
+    global_batch_size: Optional[int] = None
+    rampup_batch_size: Optional[List[int]] = None
+    recompute_granularity: Optional[str] = None  # full|selective
+    recompute_method: Optional[str] = None  # uniform|block
+    recompute_num_layers: int = 1
+    train_iters: Optional[int] = None
+    train_samples: Optional[int] = None
+    log_interval: int = 100
+    exit_interval: Optional[int] = None
+    exit_duration_in_mins: Optional[int] = None
+    tensorboard_dir: Optional[str] = None
+    masked_softmax_fusion: bool = True
+    bias_gelu_fusion: bool = True
+    bias_dropout_fusion: bool = True
+    optimizer: str = "adam"  # adam|sgd|lamb
+    dataloader_type: Optional[str] = None  # single|cyclic
+    async_tensor_model_parallel_allreduce: bool = True
+    cpu_offload: bool = False
+
+    # --- initialization (reference :585-598) ---
+    seed: int = 1234
+    init_method_std: float = 0.02
+    init_method_xavier_uniform: bool = False
+
+    # --- learning rate (reference :600-644) ---
+    lr: Optional[float] = None
+    lr_decay_style: str = "linear"  # constant|linear|cosine
+    lr_decay_iters: Optional[int] = None
+    lr_decay_samples: Optional[int] = None
+    lr_warmup_fraction: Optional[float] = None
+    lr_warmup_iters: int = 0
+    lr_warmup_samples: int = 0
+    min_lr: float = 0.0
+    override_lr_scheduler: bool = False
+    use_checkpoint_lr_scheduler: bool = False
+
+    # --- checkpointing (reference :646-669) ---
+    save: Optional[str] = None
+    save_interval: Optional[int] = None
+    no_save_optim: bool = False
+    no_save_rng: bool = False
+    load: Optional[str] = None
+    no_load_optim: bool = False
+    no_load_rng: bool = False
+    finetune: bool = False
+
+    # --- mixed precision (reference :671-707) ---
+    fp16: bool = False
+    bf16: bool = False
+    loss_scale: Optional[float] = None
+    initial_loss_scale: float = 2.0 ** 32
+    min_loss_scale: float = 1.0
+    loss_scale_window: float = 1000
+    hysteresis: int = 2
+    fp32_residual_connection: bool = False
+    query_key_layer_scaling: bool = True
+    attention_softmax_in_fp32: bool = False
+    accumulate_allreduce_grads_in_fp32: bool = False
+    fp16_lm_cross_entropy: bool = False
+
+    # --- distributed (reference :709-760) ---
+    tensor_model_parallel_size: int = 1
+    pipeline_model_parallel_size: int = 1
+    pipeline_model_parallel_split_rank: Optional[int] = None
+    num_layers_per_virtual_pipeline_stage: Optional[int] = None
+    distributed_backend: str = "xla"  # nccl/gloo → XLA collectives
+    DDP_impl: str = "local"
+    use_contiguous_buffers_in_local_ddp: bool = True
+    scatter_gather_tensors_in_pipeline: bool = True
+    use_cpu_initialization: bool = False
+    empty_unused_memory_level: int = 0
+    standalone_embedding_stage: bool = False
+    sequence_parallel: bool = False
+    gradient_accumulation_fusion: bool = True
+
+    # --- validation (reference :762-773) ---
+    eval_iters: int = 100
+    eval_interval: int = 1000
+
+    # --- data (reference :775-834, loader-relevant subset) ---
+    data_path: Optional[List[str]] = None
+    split: str = "969, 30, 1"
+    vocab_file: Optional[str] = None
+    merge_file: Optional[str] = None
+    seq_length: Optional[int] = None
+    encoder_seq_length: Optional[int] = None
+    decoder_seq_length: Optional[int] = None
+    retriever_seq_length: int = 256
+    mask_prob: float = 0.15
+    short_seq_prob: float = 0.1
+    mmap_warmup: bool = False
+    num_workers: int = 2
+    tokenizer_type: Optional[str] = None
+    data_impl: str = "infer"
+    reset_position_ids: bool = False
+    reset_attention_mask: bool = False
+    eod_mask_loss: bool = False
+
+    # --- autoresume (reference :836-846) ---
+    adlr_autoresume: bool = False
+    adlr_autoresume_interval: int = 1000
+
+    # --- logging (reference :395-432, subset that drives behaviour) ---
+    log_params_norm: bool = False
+    log_num_zeros_in_grad: bool = False
+    log_timers_to_tensorboard: bool = False
+    log_validation_ppl_to_tensorboard: bool = False
+
+    # --- derived (filled by finalize; reference :60-318) ---
+    rank: int = 0
+    world_size: int = 1
+    data_parallel_size: int = dataclasses.field(default=1)
+    transformer_pipeline_model_parallel_size: int = 1
+    virtual_pipeline_model_parallel_size: Optional[int] = None
+    params_dtype: Any = jnp.float32
+    consumed_train_samples: int = 0
+    consumed_valid_samples: int = 0
+    padded_vocab_size: Optional[int] = None
+
+    def finalize(self, world_size=None, rank=None):
+        """The reference's derivation + consistency pass (arguments.py:60-318).
+        Returns self (mutated) or raises ``ArgsError``."""
+        self.rank = int(os.getenv("RANK", str(rank if rank is not None else 0)))
+        self.world_size = int(os.getenv(
+            "WORLD_SIZE", str(world_size if world_size is not None else 1)))
+
+        # tp/pp clamping and divisibility (reference :60-85)
+        self.tensor_model_parallel_size = min(
+            self.tensor_model_parallel_size, self.world_size)
+        if self.world_size % self.tensor_model_parallel_size != 0:
+            raise ArgsError(
+                f"world size ({self.world_size}) is not divisible by tensor "
+                f"model parallel size ({self.tensor_model_parallel_size})")
+        self.pipeline_model_parallel_size = min(
+            self.pipeline_model_parallel_size,
+            self.world_size // self.tensor_model_parallel_size)
+        self.transformer_pipeline_model_parallel_size = (
+            self.pipeline_model_parallel_size - 1
+            if self.standalone_embedding_stage
+            else self.pipeline_model_parallel_size)
+        model_parallel_size = (self.pipeline_model_parallel_size
+                               * self.tensor_model_parallel_size)
+        if self.world_size % model_parallel_size != 0:
+            raise ArgsError(
+                f"world size ({self.world_size}) is not divisible by "
+                f"tp ({self.tensor_model_parallel_size}) x "
+                f"pp ({self.pipeline_model_parallel_size})")
+        self.data_parallel_size = self.world_size // model_parallel_size
+        if (self.pipeline_model_parallel_size > 1
+                and self.pipeline_model_parallel_split_rank is not None
+                and not (self.pipeline_model_parallel_split_rank
+                         < self.pipeline_model_parallel_size)):
+            raise ArgsError("split rank must be < pipeline parallel size")
+
+        # batch sizes (reference :137-151)
+        if self.micro_batch_size is None or self.micro_batch_size <= 0:
+            raise ArgsError("micro_batch_size must be a positive integer")
+        if self.global_batch_size is None:
+            self.global_batch_size = (self.micro_batch_size
+                                      * self.data_parallel_size)
+        if self.global_batch_size <= 0:
+            raise ArgsError("global_batch_size must be positive")
+
+        # virtual pipeline (reference :152-163)
+        if self.num_layers_per_virtual_pipeline_stage is not None:
+            if self.pipeline_model_parallel_size <= 2:
+                raise ArgsError("interleaved schedule requires pp > 2")
+            if self.num_layers % self.num_layers_per_virtual_pipeline_stage:
+                raise ArgsError(
+                    "num_layers not divisible by layers per virtual stage")
+            self.virtual_pipeline_model_parallel_size = (
+                (self.num_layers // self.pipeline_model_parallel_size)
+                // self.num_layers_per_virtual_pipeline_stage)
+        else:
+            self.virtual_pipeline_model_parallel_size = None
+
+        # params dtype (reference :165-183); bf16 needs fp32 grad allreduce
+        self.params_dtype = jnp.float32
+        if self.fp16:
+            if self.bf16:
+                raise ArgsError("fp16 and bf16 are mutually exclusive")
+            self.params_dtype = jnp.float16
+        if self.bf16:
+            self.params_dtype = jnp.bfloat16
+            self.accumulate_allreduce_grads_in_fp32 = True
+
+        if self.accumulate_allreduce_grads_in_fp32:
+            if self.DDP_impl != "local":
+                raise ArgsError(
+                    "fp32 grad accumulation requires DDP_impl='local'")
+        elif self.gradient_accumulation_fusion:
+            self.gradient_accumulation_fusion = False
+
+        if self.dataloader_type is None:
+            self.dataloader_type = "single"
+
+        self.consumed_train_samples = 0
+        self.consumed_valid_samples = 0
+
+        # iteration- vs sample-based training exclusivity (reference :188-227)
+        if self.train_iters and self.train_samples:
+            raise ArgsError("specify train_iters or train_samples, not both")
+        if self.train_iters:
+            if self.lr_decay_samples is not None:
+                raise ArgsError("iteration-based run: use lr_decay_iters")
+            if self.lr_warmup_samples != 0:
+                raise ArgsError("iteration-based run: use lr_warmup_iters")
+            if self.rampup_batch_size is not None:
+                raise ArgsError("no batch-size rampup with iteration-based "
+                                "training")
+            if (self.lr_warmup_fraction is not None
+                    and self.lr_warmup_iters != 0):
+                raise ArgsError(
+                    "only one of lr_warmup_fraction and lr_warmup_iters")
+        if self.train_samples:
+            if self.lr_decay_iters is not None:
+                raise ArgsError("sample-based run: use lr_decay_samples")
+            if self.lr_warmup_iters != 0:
+                raise ArgsError("sample-based run: use lr_warmup_samples")
+            if (self.lr_warmup_fraction is not None
+                    and self.lr_warmup_samples != 0):
+                raise ArgsError(
+                    "only one of lr_warmup_fraction and lr_warmup_samples")
+
+        # required args (reference :229-233)
+        for req in ("num_layers", "hidden_size", "num_attention_heads",
+                    "max_position_embeddings"):
+            if getattr(self, req) is None:
+                raise ArgsError(f"{req} is required")
+
+        # shape defaults (reference :235-243)
+        if self.ffn_hidden_size is None:
+            self.ffn_hidden_size = 4 * self.hidden_size
+        if self.kv_channels is None:
+            if self.hidden_size % self.num_attention_heads != 0:
+                raise ArgsError("hidden_size not divisible by heads")
+            self.kv_channels = self.hidden_size // self.num_attention_heads
+
+        # sequence lengths (reference :245-258)
+        if self.seq_length is not None:
+            if self.encoder_seq_length is not None:
+                raise ArgsError(
+                    "specify seq_length or encoder_seq_length, not both")
+            self.encoder_seq_length = self.seq_length
+        else:
+            self.seq_length = self.encoder_seq_length
+        if (self.seq_length is not None
+                and self.max_position_embeddings < self.seq_length):
+            raise ArgsError("max_position_embeddings < seq_length")
+        if (self.decoder_seq_length is not None
+                and self.max_position_embeddings < self.decoder_seq_length):
+            raise ArgsError("max_position_embeddings < decoder_seq_length")
+        if self.lr is not None and self.min_lr > self.lr:
+            raise ArgsError("min_lr > lr")
+        if self.save is not None and self.save_interval is None:
+            raise ArgsError("save requires save_interval")
+
+        # mixed precision checks (reference :259-266)
+        if self.fp16_lm_cross_entropy and not self.fp16:
+            raise ArgsError("fp16_lm_cross_entropy requires fp16")
+        if self.fp32_residual_connection and not (self.fp16 or self.bf16):
+            raise ArgsError(
+                "fp32_residual_connection requires fp16 or bf16")
+
+        # weight decay increments (reference :268-276)
+        if self.weight_decay_incr_style == "constant":
+            if (self.start_weight_decay is not None
+                    or self.end_weight_decay is not None):
+                raise ArgsError("constant weight decay style sets "
+                                "start/end automatically")
+            self.start_weight_decay = self.weight_decay
+            self.end_weight_decay = self.weight_decay
+        else:
+            if (self.start_weight_decay is None
+                    or self.end_weight_decay is None):
+                raise ArgsError("non-constant weight decay style requires "
+                                "start_weight_decay and end_weight_decay")
+
+        # recompute rules (reference :291-312)
+        if self.recompute_granularity == "selective":
+            if self.recompute_method is not None:
+                raise ArgsError("selective recompute takes no method")
+
+        # sequence parallel implies no async TP allreduce (reference :314-316)
+        if self.sequence_parallel:
+            self.async_tensor_model_parallel_allreduce = False
+
+        # padded vocab (reference megatron convention; used by pretrain)
+        if self.padded_vocab_size is None and self.vocab_file is None:
+            self.padded_vocab_size = None
+
+        return self
+
+    def pad_vocab_size(self, orig_vocab_size):
+        """Pad to make_vocab_size_divisible_by * tp (megatron convention)."""
+        mult = self.make_vocab_size_divisible_by * \
+            self.tensor_model_parallel_size
+        after = ((orig_vocab_size + mult - 1) // mult) * mult
+        self.padded_vocab_size = after
+        return after
+
+    def to_transformer_config(self):
+        """Bridge to the model-shape dataclass consumed by GPTModel/BertModel
+        (standalone_transformer_lm.TransformerConfig)."""
+        from apex_tpu.transformer.testing.standalone_transformer_lm import (
+            TransformerConfig,
+        )
+
+        return TransformerConfig(
+            hidden_size=self.hidden_size,
+            num_layers=self.num_layers,
+            num_attention_heads=self.num_attention_heads,
+            ffn_hidden_size=self.ffn_hidden_size,
+            vocab_size=self.padded_vocab_size or 50304,
+            max_position_embeddings=self.max_position_embeddings,
+            kv_channels=self.kv_channels,
+            layernorm_epsilon=self.layernorm_epsilon,
+            hidden_dropout=self.hidden_dropout,
+            attention_dropout=self.attention_dropout,
+            apply_query_key_layer_scaling=self.query_key_layer_scaling,
+            attention_softmax_in_fp32=self.attention_softmax_in_fp32,
+            masked_softmax_fusion=self.masked_softmax_fusion,
+            sequence_parallel=self.sequence_parallel,
+            fp16=self.fp16,
+            bf16=self.bf16,
+            init_method_std=self.init_method_std,
+            bert_binary_head=self.bert_binary_head,
+        )
+
+
+_DEPRECATED = {
+    "--batch-size": "--micro-batch-size",
+    "--warmup": "--lr-warmup-fraction",
+    "--model-parallel-size": "--tensor-model-parallel-size",
+    "--checkpoint-activations": "--recompute-granularity full "
+                                "--recompute-method uniform",
+}
+
+
+def build_parser(extra_args_provider=None):
+    """argparse surface mirroring the reference flags (kebab-case)."""
+    parser = argparse.ArgumentParser(description="apex_tpu Megatron Arguments",
+                                     allow_abbrev=False)
+    fields = {f.name: f for f in dataclasses.fields(MegatronArgs)}
+    skip = {"rank", "world_size", "data_parallel_size", "params_dtype",
+            "transformer_pipeline_model_parallel_size",
+            "virtual_pipeline_model_parallel_size",
+            "consumed_train_samples", "consumed_valid_samples",
+            "padded_vocab_size"}
+    for name, f in fields.items():
+        if name in skip:
+            continue
+        flag = "--" + name.replace("_", "-")
+        if f.type in (bool, "bool") or isinstance(f.default, bool):
+            if f.default:
+                # reference exposes true-by-default switches as --no-*
+                parser.add_argument("--no-" + name.replace("_", "-"),
+                                    dest=name, action="store_false")
+            else:
+                parser.add_argument(flag, action="store_true")
+            continue
+        if name in ("data_path",):
+            parser.add_argument(flag, nargs="*", default=f.default)
+            continue
+        if name in ("rampup_batch_size", "num_experts"):
+            parser.add_argument(flag, nargs="*", type=int, default=f.default)
+            continue
+        typ = str
+        for t in (int, float):
+            d = f.default
+            if isinstance(d, t) and not isinstance(d, bool):
+                typ = t
+                break
+        if f.type in ("Optional[int]", Optional[int]):
+            typ = int
+        elif f.type in ("Optional[float]", Optional[float]):
+            typ = float
+        parser.add_argument(flag, type=typ, default=f.default)
+    for dep, repl in _DEPRECATED.items():
+        parser.add_argument(dep, type=str, default=None,
+                            help=argparse.SUPPRESS)
+    if extra_args_provider is not None:
+        parser = extra_args_provider(parser)
+    return parser
+
+
+def parse_args(argv=None, extra_args_provider=None, defaults=None,
+               ignore_unknown_args=False, world_size=None, rank=None):
+    """Reference parse_args (arguments.py:23). Returns a finalized
+    ``MegatronArgs``."""
+    parser = build_parser(extra_args_provider)
+    if ignore_unknown_args:
+        ns, _ = parser.parse_known_args(argv)
+    else:
+        ns = parser.parse_args(argv)
+
+    for dep, repl in _DEPRECATED.items():
+        key = dep.lstrip("-").replace("-", "_")
+        if getattr(ns, key, None) is not None:
+            raise ArgsError(f"{dep} is no longer valid, use {repl} instead")
+        if hasattr(ns, key):
+            delattr(ns, key)
+
+    field_names = {f.name for f in dataclasses.fields(MegatronArgs)}
+    known = {k: v for k, v in vars(ns).items() if k in field_names}
+    extra = {k: v for k, v in vars(ns).items() if k not in field_names}
+    args = MegatronArgs(**known)
+    # defaults dict: only fills values the CLI left at None (reference
+    # :124-136 warns-and-keeps when CLI already set them)
+    for k, v in (defaults or {}).items():
+        if getattr(args, k, None) is None:
+            setattr(args, k, v)
+    args.finalize(world_size=world_size, rank=rank)
+    for k, v in extra.items():  # extra_args_provider fields ride along
+        setattr(args, k, v)
+    return args
+
+
+# ------------------------- canonical BASELINE configs -----------------------
+
+def bert_large_lamb_args(world_size=1, micro_batch_size=4, seq_length=512,
+                         **overrides):
+    """BASELINE config 3: BERT-large pretrain with FusedLAMB +
+    FusedLayerNorm (reference test harness shapes)."""
+    kw = dict(
+        num_layers=24, hidden_size=1024, num_attention_heads=16,
+        max_position_embeddings=512, seq_length=seq_length,
+        micro_batch_size=micro_batch_size, optimizer="lamb", lr=1e-4,
+        bf16=True, train_iters=10)
+    kw.update(overrides)
+    return MegatronArgs(**kw).finalize(world_size=world_size)
+
+
+def gpt_345m_args(world_size=1, micro_batch_size=4, seq_length=1024,
+                  tensor_model_parallel_size=1, **overrides):
+    """BASELINE config 4: GPT-2 345M with tensor parallel + fused softmax."""
+    kw = dict(
+        num_layers=24, hidden_size=1024, num_attention_heads=16,
+        max_position_embeddings=1024, seq_length=seq_length,
+        micro_batch_size=micro_batch_size, optimizer="adam", lr=1.5e-4,
+        bf16=True, train_iters=10,
+        tensor_model_parallel_size=tensor_model_parallel_size)
+    kw.update(overrides)
+    return MegatronArgs(**kw).finalize(world_size=world_size)

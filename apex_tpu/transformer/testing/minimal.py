@@ -150,7 +150,8 @@ def make_gpt_fns(cfg, pp):
     return (stage_fn, embed_fn, loss_fn), init_params
 
 
-def gpt_train_step_fn(cfg, pp, num_microbatches, lr=1e-4):
+def gpt_train_step_fn(cfg, pp, num_microbatches, lr=1e-4,
+                      checkpoint_stages=True):
     """Returns ``(step, tx, scaler)`` where ``step(params, opt_state,
     scaler_state, batch) -> (params, opt_state, scaler_state, loss)`` — to
     be called INSIDE shard_map over the (pp, dp, tp) mesh; ``tx``/``scaler``
@@ -177,7 +178,8 @@ def gpt_train_step_fn(cfg, pp, num_microbatches, lr=1e-4):
     def step(params, opt_state, scaler_state, batch):
         loss, grads = fwd_bwd(
             scaled_loss_fns(scaler.scale(jnp.float32(1.0), scaler_state)),
-            batch, params, num_microbatches=num_microbatches)
+            batch, params, num_microbatches=num_microbatches,
+            checkpoint_stages=checkpoint_stages)
         # DDP: data-parallel gradient averaging (reference
         # apex/parallel/distributed.py:425-475 → one pmean over "dp")
         grads = jax.tree_util.tree_map(
@@ -202,8 +204,11 @@ def gpt_train_step_fn(cfg, pp, num_microbatches, lr=1e-4):
 
 
 def factorize_mesh(n_devices):
-    """Pick (pp, dp, tp) for n devices: prefer tp (ICI-adjacent), then pp,
-    then dp — a 3D sharding whenever n allows."""
+    """Pick (pp, dp, tp) for n devices: prefer tp (ICI-adjacent), then pp
+    — each capped at 2, with dp absorbing the remainder — so all three
+    axes stay active on 8 devices (2, 2, 2). Deeper tp/pp factorizations
+    (tp=4, pp=4) are driven through the explicit ``topology`` argument of
+    ``run_minimal_gpt_training``."""
     def largest_pow2_factor(n, cap):
         f = 1
         while f * 2 <= cap and n % (f * 2) == 0:
@@ -219,9 +224,13 @@ def factorize_mesh(n_devices):
 
 def run_minimal_gpt_training(n_devices=None, cfg=None, num_microbatches=4,
                              micro_batch_size=2, seq_len=16, num_steps=1,
-                             devices=None):
+                             devices=None, topology=None):
     """Build an (pp, dp, tp) mesh over ``n_devices`` and run ``num_steps``
     full GPT training steps. Returns the per-step losses (floats).
+
+    ``topology``: explicit (pp, dp, tp) overriding ``factorize_mesh`` —
+    tests drive tp=4 / pp=4 programs through this (reference grid:
+    parallel_state tests cover the full (pp, dp, tp) factor grid).
 
     This is the dryrun/CI entry: init + steps execute in shard_map with
     real tp/pp/dp shardings; on CPU it runs under
@@ -230,7 +239,9 @@ def run_minimal_gpt_training(n_devices=None, cfg=None, num_microbatches=4,
     if devices is None:
         devices = jax.devices()[:n_devices] if n_devices else jax.devices()
     n = len(devices)
-    pp, dp, tp = factorize_mesh(n)
+    pp, dp, tp = topology or factorize_mesh(n)
+    assert pp * dp * tp == n, (
+        f"topology {(pp, dp, tp)} does not factor {n} devices")
     # apply_query_key_layer_scaling off: its coeff is the GLOBAL layer
     # number, which is stage-dependent — a non-uniform static in the SPMD
     # stage program (every stage runs one compiled trunk here)

@@ -1,25 +1,43 @@
 """Benchmark: flagship GPT training-step throughput on the local device.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "mfu"}.
 
 The measured program is the full apex-equivalent training step — bf16
 forward/backward (amp O2 semantics), dynamic loss scaling, fused Adam —
-on a GPT-2-small-shaped model, single chip. ``vs_baseline`` is the ratio
-against the recorded first-measurement baseline in BENCH_BASELINE.json
-(created on first run; the reference repo publishes no numbers to compare
-against — see BASELINE.md).
+on a GPT-2-small-shaped model, single chip.
+
+Measurement method (see PERF.md for the calibration experiments): K steps
+are chained inside ONE ``lax.scan`` under a single jit dispatch, and
+completion is observed with a 1-element device fetch. On the axon-tunneled
+TPU backend each dispatch costs ~65 ms of fixed relay latency and
+``block_until_ready`` resolves before device execution finishes — a
+per-step dispatch loop therefore measures the tunnel, not the chip (rounds
+1-2 of this repo did exactly that, reporting ~7.6k tokens/s for a program
+whose device time is ~20x faster). The measured per-dispatch overhead is
+subtracted from the scan total.
+
+``vs_baseline`` is the ratio against the recorded first-measurement
+baseline in BENCH_BASELINE.json (created on first run; the reference repo
+publishes no numbers to compare against — see BASELINE.md). The baseline
+key is suffixed with the measurement method (``_scan``) — ratios against
+the rounds-1/2 per-dispatch numbers would be method artifacts, not perf.
+``mfu`` = model FLOPs (6*N*tokens) / step-time / chip bf16 peak.
 """
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
 def main():
     import jax
     import jax.numpy as jnp
+    from jax import lax
     from jax.sharding import Mesh, PartitionSpec as P
 
     from apex_tpu.amp.scaler import LossScaler
@@ -36,13 +54,15 @@ def main():
             hidden_size=768, num_layers=12, num_attention_heads=12,
             vocab_size=50304, max_position_embeddings=1024,
             hidden_dropout=0.0, attention_dropout=0.0, bf16=True)
-        b, s, iters = 8, 1024, 20
+        b, s, iters = 32, 1024, 16
+        peak_flops = 197e12  # v5e bf16
     else:
         cfg = TransformerConfig(
             hidden_size=128, num_layers=2, num_attention_heads=4,
             vocab_size=512, max_position_embeddings=128,
             hidden_dropout=0.0, attention_dropout=0.0, bf16=True)
         b, s, iters = 2, 128, 3
+        peak_flops = None
 
     model = GPTModel(cfg)
     mesh = Mesh(np.asarray(jax.devices()[:1]), (TENSOR_AXIS,))
@@ -54,6 +74,8 @@ def main():
     pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
     labels = jnp.asarray(rs.randint(0, cfg.vocab_size, (b, s)), jnp.int32)
 
+    from benchmarks._timing import measure_dispatch_overhead, sync
+
     def shmap(f, n_in):
         return jax.shard_map(f, mesh=mesh, in_specs=(P(),) * n_in,
                              out_specs=P(), check_vma=False)
@@ -64,49 +86,69 @@ def main():
     opt_state = jax.jit(lambda p: tx.init(p))(params)
     scaler_state = scaler.init()
 
-    def train_step(params, opt_state, scaler_state, ids, pos, labels):
-        def local(params, opt_state, scaler_state, ids, pos, labels):
-            def loss_fn(p):
-                per_tok = model.apply({"params": p}, ids, pos, None, labels)
-                return jnp.mean(per_tok) * scaler_state.loss_scale
+    def one_step(params, opt_state, scaler_state, ids, pos, labels):
+        def loss_fn(p):
+            per_tok = model.apply({"params": p}, ids, pos, None, labels)
+            return jnp.mean(per_tok) * scaler_state.loss_scale
 
-            loss, grads = jax.value_and_grad(loss_fn)(params)
-            grads, found_inf = scaler.unscale(grads, scaler_state)
-            new_scaler_state = scaler.update(scaler_state, found_inf)
-            updates, new_opt_state = tx.update(grads, opt_state, params)
-            new_params = jax.tree_util.tree_map(
-                lambda p, u: jnp.where(found_inf, p, p + u.astype(p.dtype)),
-                params, updates)
-            new_opt_state = jax.tree_util.tree_map(
-                lambda new, old: jnp.where(found_inf, old, new),
-                new_opt_state, opt_state)
-            return (new_params, new_opt_state, new_scaler_state,
-                    loss / scaler_state.loss_scale)
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        grads, found_inf = scaler.unscale(grads, scaler_state)
+        new_scaler_state = scaler.update(scaler_state, found_inf)
+        updates, new_opt_state = tx.update(grads, opt_state, params)
+        new_params = jax.tree_util.tree_map(
+            lambda p, u: jnp.where(found_inf, p, p + u.astype(p.dtype)),
+            params, updates)
+        new_opt_state = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(found_inf, old, new),
+            new_opt_state, opt_state)
+        return (new_params, new_opt_state, new_scaler_state,
+                loss / scaler_state.loss_scale)
+
+    def run(params, opt_state, scaler_state, eps, ids, pos, labels):
+        def local(params, opt_state, scaler_state, eps, ids, pos, labels):
+            def body(carry, _):
+                p, o, ss = carry
+                p, o, ss, loss = one_step(p, o, ss, ids, pos, labels)
+                return (p, o, ss), loss
+
+            (params, opt_state, scaler_state), losses = lax.scan(
+                body, (params, opt_state, scaler_state), jnp.arange(iters))
+            # adding the traced eps (0 warm / 1e-30 timed) to the output
+            # varies the call signature-values between warmup and timing,
+            # defeating any same-args result caching in the relay; the
+            # compute chain itself is kept live by the params carry
+            return params, opt_state, scaler_state, losses + eps
 
         return jax.shard_map(
-            local, mesh=mesh, in_specs=(P(),) * 6, out_specs=P(),
-            check_vma=False)(params, opt_state, scaler_state, ids, pos,
+            local, mesh=mesh, in_specs=(P(),) * 7, out_specs=P(),
+            check_vma=False)(params, opt_state, scaler_state, eps, ids, pos,
                              labels)
 
-    step = jax.jit(train_step, donate_argnums=(0, 1))
+    # donate params/opt/scaler state so XLA updates them in place across
+    # the scan (the training-loop aliasing a real deployment would have)
+    step = jax.jit(run, donate_argnums=(0, 1, 2))
 
-    # warmup / compile
-    params, opt_state, scaler_state, loss = step(
-        params, opt_state, scaler_state, ids, pos, labels)
-    jax.block_until_ready(loss)
+    overhead = measure_dispatch_overhead(iters)
 
+    # compile + warm + drain (donated inputs: rebind the carried state)
+    params, opt_state, scaler_state, losses = step(
+        params, opt_state, scaler_state, jnp.float32(0.0), ids, pos, labels)
+    sync(losses)
     t0 = time.perf_counter()
-    for _ in range(iters):
-        params, opt_state, scaler_state, loss = step(
-            params, opt_state, scaler_state, ids, pos, labels)
-    jax.block_until_ready(loss)
-    dt = (time.perf_counter() - t0) / iters
+    out = step(params, opt_state, scaler_state, jnp.float32(1e-30), ids, pos,
+               labels)
+    sync(out[3])
+    dt = (time.perf_counter() - t0 - overhead) / iters
 
     tokens_per_sec = b * s / dt
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    mfu = None
+    if peak_flops:
+        mfu = round(6.0 * n_params * b * s / dt / peak_flops, 4)
 
     baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  "BENCH_BASELINE.json")
-    key = f"gpt_tokens_per_sec_{platform}"
+    key = f"gpt_tokens_per_sec_{platform}_scan"
     baselines = {}
     if os.path.exists(baseline_path):
         with open(baseline_path) as f:
@@ -122,6 +164,7 @@ def main():
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(vs_baseline, 4),
+        "mfu": mfu,
     }))
 
 

@@ -1,0 +1,109 @@
+"""Flash-attention kernel tuning on TPU: block sizes + splash kernel.
+
+Finds the best configuration for the GPT-2-small shape (b=8, h=12, s=1024,
+d=64) fwd+bwd; results recorded in PERF.md and wired into
+apex_tpu/ops/attention.py.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+from benchmarks._timing import measure_dispatch_overhead, sync  # noqa: E402
+
+B, H, S, D = 8, 12, 1024, 64
+K = 32
+# fwd = 4*b*h*s^2*d/2 (causal); bwd = 2x fwd
+FLOPS = 4 * B * H * S * S * D * 3 // 2
+PEAK = 197e12
+
+
+def measure(name, attn_fn):
+    rs = np.random.RandomState(0)
+    q0 = jnp.asarray(rs.randn(B, H, S, D), jnp.bfloat16)
+    k0 = jnp.asarray(rs.randn(B, H, S, D), jnp.bfloat16)
+    v0 = jnp.asarray(rs.randn(B, H, S, D), jnp.bfloat16)
+
+    def run(q, eps, k0, v0):
+        def body(qc, _):
+            def f(qq):
+                return jnp.sum(attn_fn(qq, k0, v0).astype(jnp.float32))
+            l, g = jax.value_and_grad(f)(qc)
+            return qc - eps.astype(qc.dtype) * g.astype(qc.dtype), l
+        qc, ls = lax.scan(body, q, jnp.arange(K))
+        return qc, ls
+
+    f = jax.jit(run)
+    try:
+        sync(f(q0, jnp.float32(0.0), k0, v0))
+    except Exception as e:
+        print(f"{name:40s} FAILED: {type(e).__name__}: {str(e)[:100]}")
+        return None
+    t0 = time.perf_counter()
+    sync(f(q0, jnp.float32(1e-30), k0, v0))
+    dt = (time.perf_counter() - t0 - OVERHEAD) / K
+    print(f"{name:40s} {dt*1e3:8.3f} ms  {FLOPS/dt/1e12:6.1f} TF/s"
+          f"  MFU={FLOPS/dt/PEAK*100:5.1f}%")
+    return dt
+
+
+OVERHEAD = measure_dispatch_overhead(K)
+print(f"dispatch overhead {OVERHEAD*1e3:.1f} ms; shape b={B} h={H} s={S} d={D}")
+
+from jax.experimental.pallas.ops.tpu import flash_attention as fa
+
+sm = 1.0 / np.sqrt(D)
+
+
+def fa_with_blocks(bq, bk):
+    bs = fa.BlockSizes(
+        block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
+        block_q_major_dkv=bq, block_k_major_dkv=bk, block_k_dkv=bk,
+        block_q_dkv=bq, block_k_major_dq=bk, block_k_dq=bk, block_q_dq=bq)
+    def f(q, k, v):
+        return fa.flash_attention(q, k, v, causal=True, sm_scale=float(sm),
+                                  block_sizes=bs)
+    return f
+
+
+# current repo config (512/512) and alternatives
+for bq, bk in [(512, 512), (512, 256), (256, 512), (256, 256), (128, 256),
+               (256, 128), (128, 128), (1024, 512), (512, 1024)]:
+    measure(f"flash blocks q={bq} k={bk}", fa_with_blocks(bq, bk))
+
+measure("flash default blocks",
+        lambda q, k, v: fa.flash_attention(q, k, v, causal=True,
+                                           sm_scale=float(sm)))
+
+# splash attention (newer kernel)
+try:
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as sk,
+        splash_attention_mask as smask,
+    )
+
+    def splash(q, k, v):
+        mask = smask.CausalMask((S, S))
+        mmask = smask.MultiHeadMask([mask] * H)
+        kernel = sk.make_splash_mha(
+            mask=mmask, head_shards=1, q_seq_shards=1)
+        # splash expects [h, s, d] per batch entry; vmap over batch
+        return jax.vmap(lambda qq, kk, vv: kernel(qq * sm, kk, vv))(
+            q.astype(jnp.float32).astype(jnp.bfloat16), k, v)
+
+    measure("splash attention (default)", splash)
+except Exception as e:
+    print(f"splash attention unavailable: {type(e).__name__}: {str(e)[:120]}")
+
+# XLA dense reference
+from apex_tpu.ops.attention import _dense_attention
+
+measure("XLA dense (materialized scores)",
+        lambda q, k, v: _dense_attention(q, k, v, True, float(sm), None))

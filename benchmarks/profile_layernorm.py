@@ -1,0 +1,81 @@
+"""LayerNorm fwd+bwd vs the HBM-bandwidth roofline on TPU.
+
+Decides the fused_layer_norm kernel question (VERDICT r2 #3): LayerNorm is
+memory-bound — fwd reads x and writes y (2 passes over the row in
+registers), bwd reads (x, dy) and writes dx. If the XLA-fused jnp path
+sustains a large fraction of the chip's HBM bandwidth, a hand-written
+Pallas row kernel has no headroom to win; the reference's
+fast_layer_norm/layer_norm_cuda kernels exist because eager torch would
+otherwise launch ~10 unfused kernels per LN, a problem jit compilation
+does not have.
+
+Roofline: bf16 x, fp32 stats. fwd traffic >= 2*2*N bytes (read x + write
+y, bf16). bwd traffic >= 3*2*N bytes (read x, dy; write dx) + weight-grad
+reduction. v5e HBM ~819 GB/s.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+from benchmarks._timing import measure_dispatch_overhead, sync  # noqa: E402
+
+from apex_tpu.normalization.fused_layer_norm import fused_layer_norm
+
+K = 32
+HBM = 819e9  # v5e
+
+OVERHEAD = measure_dispatch_overhead(K)
+print(f"dispatch overhead {OVERHEAD*1e3:.1f} ms; HBM roofline {HBM/1e9:.0f} GB/s")
+
+ROWS = 8 * 1024  # GPT-2-small b*s
+
+
+def run_case(hidden):
+    rs = np.random.RandomState(0)
+    x0 = jnp.asarray(rs.randn(ROWS, hidden), jnp.bfloat16)
+    w0 = jnp.ones((hidden,), jnp.float32)
+    b0 = jnp.zeros((hidden,), jnp.float32)
+
+    def fb(eps, x0, w0, b0):
+        def body(carry, _):
+            w, b = carry
+
+            def f(w, b):
+                y = fused_layer_norm(x0, (hidden,), w, b)
+                return jnp.sum(y.astype(jnp.float32) ** 2)
+
+            l, (gw, gb) = jax.value_and_grad(f, argnums=(0, 1))(w, b)
+            return (w - eps * gw, b - eps * gb), l
+        return body
+
+    def run(carry, eps, *ops):
+        body = fb(eps, *ops)
+        return lax.scan(body, carry, jnp.arange(K))
+
+    f = jax.jit(run)
+    sync(f((w0, b0), jnp.float32(0.0), x0, w0, b0))
+    t0 = time.perf_counter()
+    sync(f((w0, b0), jnp.float32(1e-30), x0, w0, b0))
+    dt = (time.perf_counter() - t0 - OVERHEAD) / K
+
+    n = ROWS * hidden
+    # fwd: read x, write y; bwd: read x (rematerialized stats), read dy
+    # (fused away here — dy comes from y), write dx. Conservative floor:
+    # 4 bf16 passes over the tensor.
+    bytes_min = 4 * 2 * n
+    print(f"h={hidden:5d}: {dt*1e3:7.3f} ms  "
+          f"{bytes_min/dt/1e9:6.0f} GB/s effective  "
+          f"({bytes_min/dt/HBM*100:5.1f}% of HBM roofline)")
+    return dt
+
+
+for h in (768, 1024, 4096, 8192, 12288):
+    run_case(h)

@@ -96,11 +96,17 @@ def make_synthetic_loader(args, steps):
     return gen
 
 
-def build_train_step(model, opt, mesh):
-    """The whole apex train iteration as one SPMD program."""
+def build_train_step(model, opt, mesh, compute_dtype=jnp.float32):
+    """The whole apex train iteration as one SPMD program.
+
+    ``compute_dtype`` is the amp policy's compute dtype: input images are
+    cast to it on entry (the reference casts incoming fp32 inputs to half
+    under O2/O3 — apex/amp/_initialize.py:176-201)."""
 
     def step(params, batch_stats, amp_state, images, labels):
         def local(params, batch_stats, amp_state, images, labels):
+            images = images.astype(compute_dtype)
+
             def loss_fn(p):
                 logits, new_vars = model.apply(
                     {"params": p, "batch_stats": batch_stats}, images,
@@ -149,8 +155,30 @@ def main(argv=None):
     ndev = len(devices)
     assert args.batch_size % ndev == 0
 
+    # resolve the amp properties ONCE, before building the model: the
+    # policy's compute dtype is the conv/matmul dtype (flax ``dtype=``),
+    # which is what makes O1/O2/O3 actually compute in bf16 on the MXU (the
+    # functional analog of the reference's model cast,
+    # apex/amp/_initialize.py:176-201). The same override values go to
+    # amp.initialize below so there is a single source of truth.
+    from apex_tpu.amp.frontend import Properties, build_policy, opt_levels
+
+    loss_scale = args.loss_scale
+    if loss_scale is not None and loss_scale != "dynamic":
+        loss_scale = float(loss_scale)
+    keep_bn = args.keep_batchnorm_fp32
+    if isinstance(keep_bn, str):
+        keep_bn = {"True": True, "False": False}.get(keep_bn, None)
+
+    properties = opt_levels[args.opt_level](Properties())
+    for name, value in (("keep_batchnorm_fp32", keep_bn),
+                        ("loss_scale", loss_scale)):
+        if value is not None:
+            setattr(properties, name, value)
+    policy = build_policy(properties)
     model = ARCHS[args.arch](num_classes=args.num_classes,
-                             norm_axis_name="data")
+                             norm_axis_name="data",
+                             dtype=policy.compute_dtype)
     rs_img = jnp.zeros((2, args.image_size, args.image_size, 3))
 
     def init(x):
@@ -163,12 +191,6 @@ def main(argv=None):
 
     tx = fused_sgd(learning_rate=args.lr, momentum=args.momentum,
                    weight_decay=args.weight_decay)
-    loss_scale = args.loss_scale
-    if loss_scale is not None and loss_scale != "dynamic":
-        loss_scale = float(loss_scale)
-    keep_bn = args.keep_batchnorm_fp32
-    if isinstance(keep_bn, str):
-        keep_bn = {"True": True, "False": False}.get(keep_bn, None)
     params, opt = amp.initialize(
         params, tx, opt_level=args.opt_level,
         keep_batchnorm_fp32=keep_bn, loss_scale=loss_scale)
@@ -183,7 +205,8 @@ def main(argv=None):
         start_epoch = ckpt["epoch"]
         print(f"=> loaded checkpoint (epoch {start_epoch})")
 
-    train_step = build_train_step(model, opt, mesh)
+    train_step = build_train_step(model, opt, mesh,
+                                  compute_dtype=policy.compute_dtype)
     steps = args.steps or (1281167 // args.batch_size)
 
     batch_time, losses = AverageMeter(), AverageMeter()

@@ -1,0 +1,148 @@
+"""Megatron argument-bundle tests (reference: the consistency checks in
+apex/transformer/testing/arguments.py:60-318 exercised via its CLI surface,
+plus global_vars singleton discipline) and the config-driven pretrain entry
+(BASELINE configs 3 and 4 shapes, shrunk)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from apex_tpu.transformer.testing import (
+    ArgsError,
+    MegatronArgs,
+    bert_large_lamb_args,
+    gpt_345m_args,
+    parse_args,
+)
+from apex_tpu.transformer.testing import global_vars
+
+
+BASE = ["--num-layers", "4", "--hidden-size", "64",
+        "--num-attention-heads", "4", "--max-position-embeddings", "64",
+        "--seq-length", "64", "--micro-batch-size", "2"]
+
+
+def test_parse_args_derivations():
+    a = parse_args(BASE + ["--bf16", "--tensor-model-parallel-size", "2"],
+                   world_size=8)
+    assert a.data_parallel_size == 4
+    assert a.global_batch_size == 8  # mbs * dp
+    assert a.ffn_hidden_size == 256  # 4*h default
+    assert a.kv_channels == 16  # h / heads
+    assert a.params_dtype == jnp.bfloat16
+    # bf16 forces fp32 grad accumulation (reference :174-180)
+    assert a.accumulate_allreduce_grads_in_fp32
+
+
+def test_parse_args_tp_clamped_to_world():
+    a = parse_args(BASE + ["--tensor-model-parallel-size", "16"],
+                   world_size=4)
+    assert a.tensor_model_parallel_size == 4
+
+
+@pytest.mark.parametrize("argv,msg", [
+    (BASE + ["--fp16", "--bf16"], "mutually exclusive"),
+    (BASE + ["--train-iters", "10", "--train-samples", "10"], "not both"),
+    (BASE + ["--train-iters", "10", "--lr-warmup-samples", "5"],
+     "lr_warmup_iters"),
+    (BASE + ["--lr", "1e-4", "--min-lr", "1e-2"], "min_lr"),
+    (BASE + ["--save", "/tmp/x"], "save_interval"),
+    (BASE + ["--fp16-lm-cross-entropy"], "fp16"),
+    (BASE + ["--recompute-granularity", "selective",
+             "--recompute-method", "uniform"], "selective"),
+])
+def test_parse_args_cross_validation_errors(argv, msg):
+    with pytest.raises(ArgsError, match=msg):
+        parse_args(argv)
+
+
+def test_parse_args_seq_length_vs_positions():
+    with pytest.raises(ArgsError, match="max_position_embeddings"):
+        parse_args(["--num-layers", "2", "--hidden-size", "64",
+                    "--num-attention-heads", "4",
+                    "--max-position-embeddings", "32",
+                    "--seq-length", "64", "--micro-batch-size", "1"])
+
+
+def test_deprecated_flags_error():
+    with pytest.raises(ArgsError, match="micro-batch-size"):
+        parse_args(BASE + ["--batch-size", "4"])
+    with pytest.raises(ArgsError, match="tensor-model-parallel-size"):
+        parse_args(BASE + ["--model-parallel-size", "2"])
+
+
+def test_sequence_parallel_disables_async_tp_allreduce():
+    a = parse_args(BASE + ["--sequence-parallel"], world_size=2)
+    assert not a.async_tensor_model_parallel_allreduce
+
+
+def test_weight_decay_incr_style():
+    a = parse_args(BASE + ["--weight-decay", "0.02"])
+    assert a.start_weight_decay == a.end_weight_decay == 0.02
+    with pytest.raises(ArgsError, match="start_weight_decay"):
+        parse_args(BASE + ["--weight-decay-incr-style", "linear"])
+
+
+def test_virtual_pipeline_validation():
+    with pytest.raises(ArgsError, match="pp > 2"):
+        parse_args(BASE + ["--num-layers-per-virtual-pipeline-stage", "1",
+                           "--pipeline-model-parallel-size", "2"],
+                   world_size=8)
+    a = parse_args(BASE + ["--num-layers-per-virtual-pipeline-stage", "1",
+                           "--pipeline-model-parallel-size", "4"],
+                   world_size=8)
+    assert a.virtual_pipeline_model_parallel_size == 1
+
+
+def test_pad_vocab_size():
+    a = gpt_345m_args(world_size=2, tensor_model_parallel_size=2)
+    assert a.pad_vocab_size(50257) % (128 * 2) == 0
+
+
+def test_canonical_baseline_configs():
+    b = bert_large_lamb_args(world_size=8)
+    assert (b.num_layers, b.hidden_size, b.num_attention_heads) == (24, 1024, 16)
+    assert b.optimizer == "lamb" and b.bf16
+    g = gpt_345m_args(world_size=8, tensor_model_parallel_size=2)
+    assert (g.num_layers, g.hidden_size) == (24, 1024)
+    assert g.data_parallel_size == 4
+    cfg = g.to_transformer_config()
+    assert cfg.hidden_size == 1024 and cfg.bf16
+
+
+def test_global_vars_singletons():
+    global_vars.destroy_global_vars()
+    args = global_vars.set_global_variables(
+        BASE + ["--rampup-batch-size", "2", "2", "8"], world_size=1)
+    assert global_vars.get_args() is args
+    # rampup: starts at 2 → 1 microbatch of mbs 2
+    assert global_vars.get_current_global_batch_size() == 2
+    global_vars.update_num_microbatches(8, consistency_check=False)
+    assert global_vars.get_current_global_batch_size() >= 2
+    t = global_vars.get_timers()
+    t("x").start()
+    assert t("x").elapsed() >= 0.0
+    with pytest.raises(RuntimeError, match="already initialized"):
+        global_vars.set_global_variables(BASE, world_size=1)
+    global_vars.destroy_global_vars()
+    with pytest.raises(RuntimeError, match="not initialized"):
+        global_vars.get_args()
+
+
+@pytest.mark.parametrize("model,opt", [("gpt", "adam"), ("bert", "lamb")])
+def test_pretrain_entry_tiny(model, opt):
+    """Config-driven pretrain runs both model families (BASELINE configs
+    3 and 4, shrunk to CPU-mesh size) with decreasing-or-finite loss."""
+    global_vars.destroy_global_vars()
+    from examples.transformer.pretrain import main
+
+    out = main(["--model", model, "--num-layers", "2", "--hidden-size", "64",
+                "--num-attention-heads", "4",
+                "--max-position-embeddings", "64", "--seq-length", "32",
+                "--micro-batch-size", "2", "--vocab-size", "256",
+                "--make-vocab-size-divisible-by", "32",
+                "--tensor-model-parallel-size", "2",
+                "--optimizer", opt, "--lr", "1e-3", "--bf16",
+                "--train-iters", "4", "--log-interval", "2"])
+    assert np.isfinite(out["loss"])

@@ -10,6 +10,7 @@ import pytest
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("opt_level", ["O0", "O1", "O2"])
 def test_imagenet_main_amp_smoke(tmp_path, opt_level):
     """The L1 cross-product, shrunk: tiny resnet18 on synthetic data for a
@@ -26,6 +27,7 @@ def test_imagenet_main_amp_smoke(tmp_path, opt_level):
     assert (tmp_path / "ckpt.pkl").exists()
 
 
+@pytest.mark.slow
 def test_imagenet_resume_roundtrip(tmp_path):
     from examples.imagenet.main_amp import main
 
@@ -36,6 +38,54 @@ def test_imagenet_resume_roundtrip(tmp_path):
                  "-b", "16", "--image-size", "32", "--num-classes", "10",
                  "--checkpoint", ck, "--resume", ck, "--epochs", "2"])
     assert np.isfinite(loss)
+
+
+def _conv_input_dtypes(opt_level):
+    """Dtypes of every conv_general_dilated input in the train-step jaxpr
+    for the ImageNet model wired the way main_amp.main wires it."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu import amp
+    from apex_tpu.amp.frontend import Properties, build_policy, opt_levels
+    from apex_tpu.models import resnet18
+
+    policy = build_policy(opt_levels[opt_level](Properties()))
+    model = resnet18(num_classes=10, dtype=policy.compute_dtype)
+    x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    params = amp.initialize(variables["params"], opt_level=opt_level)
+
+    def fwd(p, x):
+        out, _ = model.apply(
+            {"params": p, "batch_stats": variables["batch_stats"]},
+            x.astype(policy.compute_dtype), train=True,
+            mutable=["batch_stats"])
+        return out
+
+    jaxpr = jax.make_jaxpr(fwd)(params, x)
+    dtypes = set()
+
+    def walk(jpr):
+        for eqn in jpr.eqns:
+            if eqn.primitive.name == "conv_general_dilated":
+                dtypes.update(v.aval.dtype for v in eqn.invars)
+            for v in eqn.params.values():
+                if hasattr(v, "jaxpr"):
+                    walk(v.jaxpr)
+
+    walk(jaxpr.jaxpr)
+    assert dtypes, "no convs found in the jaxpr"
+    return dtypes
+
+
+def test_imagenet_o2_computes_convs_in_bf16():
+    """O2 must actually change the conv compute dtype (the whole point of
+    amp): every conv input under O2 is bf16; under O0 everything is fp32."""
+    import jax.numpy as jnp
+
+    assert _conv_input_dtypes("O2") == {jnp.dtype(jnp.bfloat16)}
+    assert _conv_input_dtypes("O0") == {jnp.dtype(jnp.float32)}
 
 
 def test_dcgan_main_amp_smoke():

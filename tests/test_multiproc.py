@@ -1,0 +1,25 @@
+"""Multi-host (multi-process) smoke: the multiproc launcher spawns 2
+localhost processes that form a jax.distributed cluster over DCN-equivalent
+loopback and psum across it (reference:
+apex/transformer/testing/distributed_test_base.py:27-78 spawns NCCL
+process groups the same way)."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def test_multiproc_two_process_psum():
+    env = dict(os.environ)
+    env["MASTER_PORT"] = "29531"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.parallel.multiproc", "--nproc", "2",
+         os.path.join(REPO, "tests", "multiproc_worker.py")],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, (
+        f"launcher rc={out.returncode}\nstdout:\n{out.stdout}\n"
+        f"stderr:\n{out.stderr}")
+    assert out.stdout.count("MULTIPROC_OK") == 2, out.stdout

@@ -271,3 +271,24 @@ def test_ltor_reset_position_ids():
     _, _, pos = get_ltor_masks_and_position_ids(
         data, eod_token=1, reset_position_ids=True)
     np.testing.assert_array_equal(np.asarray(pos[0]), [0, 1, 0, 1, 2])
+
+
+# ------------------------ deep-factor topologies ---------------------------
+# tp=4 and pp=4 programs have size-dependent behaviour (_sharded_init
+# slicing, ring wraps, per-stage layer counts) that a (2, 2, 2) mesh never
+# compiles — exercise the full factor grid on the 8-device CPU mesh
+# (reference: parallel_state.py initialize grid tests).
+
+@pytest.mark.parametrize("topology", [
+    (4, 1, 2), (2, 1, 4),
+    pytest.param((4, 2, 1), marks=pytest.mark.slow),
+    pytest.param((1, 2, 4), marks=pytest.mark.slow),
+])
+def test_minimal_gpt_training_deep_topologies(topology):
+    from apex_tpu.transformer.testing.minimal import run_minimal_gpt_training
+
+    losses = run_minimal_gpt_training(
+        n_devices=8, topology=topology, num_microbatches=4,
+        micro_batch_size=1, seq_len=16, num_steps=2)
+    assert len(losses) == 2
+    assert all(np.isfinite(l) for l in losses)

@@ -142,6 +142,7 @@ def _gpt_loss_and_grads(tp):
     return np.asarray(loss), np.asarray(pe_grad)
 
 
+@pytest.mark.slow
 def test_gpt_tp_invariance():
     """Loss and grads must not depend on the TP degree."""
     loss1, g1 = _gpt_loss_and_grads(1)
@@ -168,6 +169,7 @@ def test_gpt_logits_shape_and_loss_positive():
 
 # ------------------------------ BERT ---------------------------------------
 
+@pytest.mark.slow
 def test_bert_forward_backward():
     mesh = tp_mesh(4)
     rs = np.random.RandomState(4)
