@@ -1,11 +1,12 @@
 """High-performance layer norm for hidden sizes 768–12288.
 
 Capability port of apex/contrib/layer_norm/layer_norm.py:8-60 over
-``fast_layer_norm`` (2,231 LoC CUDA: one-pass vectorized row norm). On TPU
-the one-pass row norm is the same Pallas/XLA kernel behind
-apex_tpu.normalization.FusedLayerNorm — this is the contrib-surface alias,
-mirroring how the reference ships two generations of LN kernels with
-different ctor conventions (hidden_size instead of normalized_shape).
+``fast_layer_norm`` (2,231 LoC CUDA: one-pass vectorized row norm). The
+TPU counterpart of that kernel is ``apex_tpu.ops.layer_norm_pallas`` — a
+hand-written Pallas row kernel (fp32 stats, per-block affine-grad
+partials) — which this surface selects by default, falling back to the
+XLA-fused jnp path for shapes the kernel doesn't cover. PERF.md §4 records
+the head-to-head timing on TPU.
 """
 
 from apex_tpu.normalization.fused_layer_norm import FusedLayerNorm as _Fused
@@ -14,5 +15,7 @@ from apex_tpu.normalization.fused_layer_norm import FusedLayerNorm as _Fused
 def FastLayerNorm(hidden_size, eps=1e-5, **kwargs):
     """Factory mirroring the reference ctor (layer_norm.py:41-60). Returns
     a FusedLayerNorm module (flax modules are frozen dataclasses, so the
-    ctor adaptation is a factory rather than an __init__ override)."""
+    ctor adaptation is a factory rather than an __init__ override) with the
+    Pallas row kernel enabled."""
+    kwargs.setdefault("use_pallas", True)
     return _Fused(normalized_shape=hidden_size, eps=eps, **kwargs)

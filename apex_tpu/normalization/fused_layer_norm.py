@@ -2,10 +2,16 @@
 
 Capability port of apex.normalization (reference:
 apex/normalization/fused_layer_norm.py:16-437; CUDA
-csrc/layer_norm_cuda_kernel.cu — warp-shuffle Welford row statistics). On
-TPU the forward/backward row reductions fuse natively in XLA; a Pallas row
-kernel (apex_tpu.ops.layer_norm_pallas) is used for large rows on real TPU
-backends, with this jnp path the reference/fallback.
+csrc/layer_norm_cuda_kernel.cu — warp-shuffle Welford row statistics).
+
+Two implementations, both real (measured head-to-head on TPU — PERF.md §4):
+  * this jnp path — XLA fuses the row reductions; the default;
+  * ``apex_tpu.ops.layer_norm_pallas`` — a hand-written Pallas row kernel
+    (fp32 stats, boundary-only residuals, per-block affine-grad partials),
+    selected by setting ``USE_PALLAS = True`` here (or per-call
+    ``use_pallas=``) for shapes the kernel supports. LayerNorm is
+    HBM-bandwidth-bound, so whichever side wins does so by small margins;
+    the dispatch default follows the PERF.md measurement.
 
 Dtype semantics mirror the reference:
   * plain ``FusedLayerNorm``/``FusedRMSNorm``: statistics + affine math in
@@ -20,6 +26,10 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+# Dispatch default for the Pallas row kernel (PERF.md §4 records the
+# measurement this default follows). Overridable per call.
+USE_PALLAS = False
+
 
 def _normalized_axes(x, normalized_shape):
     if isinstance(normalized_shape, numbers.Integral):
@@ -31,12 +41,29 @@ def _normalized_axes(x, normalized_shape):
 
 
 def fused_layer_norm(x, normalized_shape, weight=None, bias=None, eps=1e-5,
-                     memory_efficient=False):
+                     memory_efficient=False, use_pallas=None):
     """Functional layer norm, fp32 statistics (reference autograd fns:
-    fused_layer_norm.py:32,59,84,103)."""
+    fused_layer_norm.py:32,59,84,103). ``use_pallas`` overrides the
+    module-level ``USE_PALLAS`` dispatch to the Pallas row kernel."""
     del memory_efficient  # remat is a jax.checkpoint policy decision here
     axes, _ = _normalized_axes(x, normalized_shape)
     orig_dtype = x.dtype
+
+    if use_pallas is None:
+        use_pallas = USE_PALLAS
+    if use_pallas and len(axes) == 1:
+        from apex_tpu.ops.attention import _tpu_available
+        from apex_tpu.ops import layer_norm_pallas as lnp
+
+        hidden = x.shape[-1]
+        rows = x.size // hidden
+        if _tpu_available() and lnp.supported(rows, hidden):
+            y2d = lnp.layer_norm(
+                x.reshape(rows, hidden),
+                None if weight is None else weight.astype(jnp.float32),
+                None if bias is None else bias.astype(jnp.float32), eps)
+            return y2d.reshape(x.shape)
+
     xf = x.astype(jnp.float32)
     mean = jnp.mean(xf, axis=axes, keepdims=True)
     var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
@@ -82,13 +109,15 @@ def mixed_dtype_fused_rms_norm_affine(x, weight, normalized_shape, eps=1e-5,
 
 class FusedLayerNorm(nn.Module):
     """Module surface of apex.normalization.FusedLayerNorm
-    (fused_layer_norm.py:204)."""
+    (fused_layer_norm.py:204). ``use_pallas=True`` requests the Pallas row
+    kernel (contrib FastLayerNorm sets this)."""
 
     normalized_shape: tuple
     eps: float = 1e-5
     elementwise_affine: bool = True
     memory_efficient: bool = False
     param_dtype: jnp.dtype = jnp.float32
+    use_pallas: bool = None
 
     @nn.compact
     def __call__(self, x):
@@ -104,7 +133,8 @@ class FusedLayerNorm(nn.Module):
             bias = self.param(
                 "bias", nn.initializers.zeros, shape, self.param_dtype)
         return fused_layer_norm(x, shape, weight, bias, self.eps,
-                                self.memory_efficient)
+                                self.memory_efficient,
+                                use_pallas=self.use_pallas)
 
 
 class FusedRMSNorm(nn.Module):

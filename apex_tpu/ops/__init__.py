@@ -7,3 +7,8 @@ the same model code runs on the CPU test mesh and on TPU.
 """
 
 from apex_tpu.ops.attention import fused_attention  # noqa: F401
+from apex_tpu.ops.context_parallel import (  # noqa: F401
+    ring_attention,
+    ulysses_attention,
+)
+from apex_tpu.ops import layer_norm_pallas  # noqa: F401

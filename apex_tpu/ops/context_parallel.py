@@ -1,0 +1,135 @@
+"""Context (sequence) parallel attention: ring + all-to-all (Ulysses).
+
+Long-sequence scaling beyond a single chip's HBM: the sequence dimension
+is sharded over a mesh axis ("cp") and attention runs SPMD. Two canonical
+schemes, both TPU-first (XLA collectives over ICI; no NCCL analog of the
+reference required — the reference scales sequence only via Megatron
+sequence-parallel scatter/gather around the norms, tensor_parallel/
+mappings.py, which apex_tpu also ships):
+
+  * ``ring_attention`` — blockwise online-softmax attention; K/V blocks
+    rotate around the ring via ``lax.ppermute`` while each rank's Q stays
+    resident. O(s_local²·cp) compute per rank, O(s_local) memory. The
+    BACKWARD ring is not hand-written: differentiating through the
+    scan+ppermute reverses the permutation (same design as the pipeline
+    schedules — schedules.py) and replays blocks in reverse.
+  * ``ulysses_attention`` — DeepSpeed-Ulysses-style: ``lax.all_to_all``
+    re-shards [seq-sharded, heads full] into [heads-sharded, seq full],
+    runs ordinary (flash) attention on whole sequences per head group,
+    and all-to-alls back. Needs heads % cp == 0; one pair of all-to-alls
+    per call, attention itself is the single-chip kernel (ops.attention).
+
+Numerics: fp32 online-softmax accumulators (same as the flash kernel);
+causal masking across ring blocks is exact (diagonal block triangular,
+future blocks fully masked). Parity + grad tests vs dense attention on
+the gathered sequence: tests/test_context_parallel.py.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.ops.attention import fused_attention
+
+NEG_INF = -1e30
+
+
+def ring_attention(q, k, v, axis_name, *, causal=True, sm_scale=None):
+    """Ring attention over sequence shards.
+
+    Args:
+      q, k, v: [b, h, s_local, d] — this rank's sequence shard. The global
+        sequence is the axis-order concatenation of shards.
+      axis_name: mesh axis the sequence is sharded over (inside shard_map).
+      causal: apply the global lower-triangular mask.
+      sm_scale: softmax scale; default 1/sqrt(d).
+
+    Returns [b, h, s_local, d] in q.dtype.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    cp = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, h, s, d = q.shape
+    qf = q.astype(jnp.float32) * sm_scale
+
+    # ppermute sends rank i's block to i+1; after r hops this rank holds
+    # the block that originated at rank (idx - r) mod cp
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    def step(carry, r):
+        o, m, l, k_cur, v_cur = carry
+        src = (idx - r) % cp
+
+        scores = lax.dot_general(
+            qf, k_cur.astype(jnp.float32),
+            (((3,), (3,)), ((0, 1), (0, 1))))  # [b, h, s, s]
+        if causal:
+            tri = (jnp.arange(s)[None, :] > jnp.arange(s)[:, None])
+            # src == idx: triangular; src > idx: fully masked (global
+            # future); src < idx: fully visible (global past)
+            block_mask = jnp.where(
+                src == idx, tri,
+                jnp.broadcast_to(src > idx, (s, s)))
+            scores = jnp.where(block_mask[None, None], NEG_INF, scores)
+
+        blk_max = jnp.max(scores, axis=-1)  # [b, h, s]
+        m_new = jnp.maximum(m, blk_max)
+        # renormalize the running accumulator to the new max
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new[..., None])
+        if causal:
+            p = jnp.where(block_mask[None, None], 0.0, p)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        o_new = o * alpha[..., None] + lax.dot_general(
+            p, v_cur.astype(jnp.float32),
+            (((3,), (2,)), ((0, 1), (0, 1))))
+
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (o_new, m_new, l_new, k_nxt, v_nxt), None
+
+    o0 = jnp.zeros((b, h, s, d), jnp.float32)
+    m0 = jnp.full((b, h, s), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s), jnp.float32)
+    (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v), jnp.arange(cp))
+    l = jnp.where(l > 0, l, 1.0)  # fully-masked rows (none when causal)
+    return (o / l[..., None]).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name, *, causal=True, sm_scale=None,
+                      **attn_kwargs):
+    """All-to-all (Ulysses) context-parallel attention.
+
+    Args/returns as ``ring_attention``. Requires ``h % cp == 0``: the
+    all-to-all trades the sequence sharding for a head sharding, each rank
+    then runs the ordinary fused attention kernel over FULL sequences for
+    its h/cp heads, and the reverse all-to-all restores sequence sharding.
+    """
+    cp = lax.axis_size(axis_name)
+    b, h, s, d = q.shape
+    if h % cp != 0:
+        raise ValueError(f"ulysses_attention: heads ({h}) not divisible by "
+                         f"axis size ({cp})")
+    if "segment_ids" in attn_kwargs and attn_kwargs["segment_ids"] is not None:
+        raise NotImplementedError(
+            "ulysses_attention: segment_ids are shard-local and would need "
+            "their own all-to-all re-shard alongside q/k/v; pass packed "
+            "batches through ring_attention or the single-chip kernel")
+
+    def scatter_heads(x):
+        # [b, h, s_loc, d] -> [b, h/cp, s_glob, d]: split heads across the
+        # axis, gather sequence. all_to_all splits dim 1, concats dim 2.
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def gather_heads(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qh, kh, vh = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    ctx = fused_attention(qh, kh, vh, causal=causal, sm_scale=sm_scale,
+                          **attn_kwargs)
+    return gather_heads(ctx)

@@ -1,0 +1,174 @@
+"""Pallas TPU row layer-norm kernel (fwd + bwd).
+
+The TPU counterpart of the reference's hand-written LN kernels
+(csrc/layer_norm_cuda_kernel.cu:68-260 warp-shuffle Welford;
+contrib/csrc/layer_norm/ln_fwd/bwd_kernels.cuh "FastLayerNorm"). One VMEM
+pass per row block: fp32 statistics, normalize, affine — fwd saves only
+the [rows] (mean, rstd) vectors; bwd recomputes x̂ from x and produces dx
+plus per-block (dw, db) partial sums reduced outside the kernel.
+
+LayerNorm is HBM-bandwidth-bound, so the jnp path (XLA-fused) is already
+near the roofline for most shapes (measured — PERF.md §4);
+``fused_layer_norm`` dispatches to whichever side the measurement favors.
+This kernel exists to (a) prove the claim either way with a real
+alternative, (b) serve the very-wide-row regime where XLA's reduction
+splitting is weakest, and (c) back ``contrib.layer_norm.FastLayerNorm``
+with an actual kernel.
+
+Tested against the jnp reference in Pallas interpret mode on CPU
+(tests/test_layer_norm_pallas.py); block sizes sized to VMEM.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+_VMEM_BUDGET = 12 * 1024 * 1024  # total fp32 block bytes (of ~16MB VMEM)
+# resident fp32 [br, hidden] arrays per kernel: fwd holds x, xc, y; bwd
+# holds x, dy, dx, xhat, wg plus headroom — the bwd count sizes smaller
+# blocks, and supported() gates on the bwd (binding) constraint
+_FWD_ARRAYS = 3
+_BWD_ARRAYS = 6
+
+
+def _row_block(rows, hidden, n_arrays):
+    """Largest power-of-two row block such that ``n_arrays`` fp32
+    [block, hidden] arrays fit the VMEM budget and the block divides
+    ``rows`` (0 → no valid blocking; caller falls back)."""
+    cap = max(1, _VMEM_BUDGET // (4 * hidden * n_arrays))
+    b = 1
+    while b * 2 <= cap and rows % (b * 2) == 0:
+        b *= 2
+    # at least 8 rows per block keeps the (8, 128) fp32 tile shape happy
+    return b if b >= 8 else 0
+
+
+def _fwd_kernel(x_ref, w_ref, b_ref, y_ref, mean_ref, rstd_ref, *, eps,
+                has_w, has_b):
+    x = x_ref[...].astype(jnp.float32)
+    mean = jnp.mean(x, axis=1)
+    xc = x - mean[:, None]
+    var = jnp.mean(xc * xc, axis=1)
+    rstd = jax.lax.rsqrt(var + eps)
+    y = xc * rstd[:, None]
+    if has_w:
+        y = y * w_ref[...].astype(jnp.float32)[None, :]
+    if has_b:
+        y = y + b_ref[...].astype(jnp.float32)[None, :]
+    y_ref[...] = y.astype(y_ref.dtype)
+    mean_ref[...] = mean
+    rstd_ref[...] = rstd
+
+
+def _bwd_kernel(x_ref, w_ref, mean_ref, rstd_ref, dy_ref, dx_ref, dw_ref,
+                db_ref, *, has_w):
+    x = x_ref[...].astype(jnp.float32)
+    dy = dy_ref[...].astype(jnp.float32)
+    mean = mean_ref[...]
+    rstd = rstd_ref[...]
+    xhat = (x - mean[:, None]) * rstd[:, None]
+    wg = dy * w_ref[...].astype(jnp.float32)[None, :] if has_w else dy
+    m1 = jnp.mean(wg, axis=1)
+    m2 = jnp.mean(wg * xhat, axis=1)
+    dx = (wg - m1[:, None] - xhat * m2[:, None]) * rstd[:, None]
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+    # per-block affine-grad partials, reduced over blocks by the caller
+    dw_ref[...] = jnp.sum(dy * xhat, axis=0)[None, :]
+    db_ref[...] = jnp.sum(dy, axis=0)[None, :]
+
+
+def supported(rows, hidden):
+    """Whether the kernel handles this shape (else jnp fallback). Gated on
+    the backward kernel's (larger) VMEM footprint so a shape accepted here
+    never fails to compile mid-training."""
+    return hidden % 128 == 0 and _row_block(rows, hidden, _BWD_ARRAYS) != 0
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def layer_norm(x2d, weight, bias, eps=1e-5, interpret=False):
+    """Row layer-norm over the last dim of ``x2d`` [rows, hidden].
+
+    ``weight``/``bias`` may be None (plain normalization). Statistics and
+    affine math in fp32; output in ``x2d.dtype``. Use ``supported`` first;
+    unsupported shapes raise. ``interpret=True`` runs the kernel in Pallas
+    interpret mode (CPU tests).
+    """
+    y, _ = _fwd(x2d, weight, bias, eps, interpret)
+    return y
+
+
+def _fwd(x2d, weight, bias, eps, interpret):
+    rows, hidden = x2d.shape
+    if not supported(rows, hidden):
+        raise ValueError(f"layer_norm_pallas: unsupported shape {x2d.shape}")
+    br = _row_block(rows, hidden, _FWD_ARRAYS)
+    has_w = weight is not None
+    has_b = bias is not None
+    w_in = weight if has_w else jnp.zeros((hidden,), jnp.float32)
+    b_in = bias if has_b else jnp.zeros((hidden,), jnp.float32)
+
+    grid = (rows // br,)
+    y, mean, rstd = pl.pallas_call(
+        functools.partial(_fwd_kernel, eps=eps, has_w=has_w, has_b=has_b),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((br, hidden), lambda i: (i, 0)),
+            pl.BlockSpec((hidden,), lambda i: (0,)),
+            pl.BlockSpec((hidden,), lambda i: (0,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((br, hidden), lambda i: (i, 0)),
+            pl.BlockSpec((br,), lambda i: (i,)),
+            pl.BlockSpec((br,), lambda i: (i,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, hidden), x2d.dtype),
+            jax.ShapeDtypeStruct((rows,), jnp.float32),
+            jax.ShapeDtypeStruct((rows,), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2d, w_in, b_in)
+    return y, (x2d, w_in, mean, rstd, has_w, has_b)
+
+
+def _fwd_rule(x2d, weight, bias, eps, interpret):
+    y, res = _fwd(x2d, weight, bias, eps, interpret)
+    return y, res
+
+
+def _bwd_rule(eps, interpret, res, dy):
+    x2d, w_in, mean, rstd, has_w, has_b = res
+    rows, hidden = x2d.shape
+    br = _row_block(rows, hidden, _BWD_ARRAYS)
+    grid = (rows // br,)
+    dx, dw_part, db_part = pl.pallas_call(
+        functools.partial(_bwd_kernel, has_w=has_w),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((br, hidden), lambda i: (i, 0)),
+            pl.BlockSpec((hidden,), lambda i: (0,)),
+            pl.BlockSpec((br,), lambda i: (i,)),
+            pl.BlockSpec((br,), lambda i: (i,)),
+            pl.BlockSpec((br, hidden), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((br, hidden), lambda i: (i, 0)),
+            pl.BlockSpec((1, hidden), lambda i: (i, 0)),
+            pl.BlockSpec((1, hidden), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, hidden), x2d.dtype),
+            jax.ShapeDtypeStruct((rows // br, hidden), jnp.float32),
+            jax.ShapeDtypeStruct((rows // br, hidden), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2d, w_in, mean, rstd, dy)
+    dw = jnp.sum(dw_part, axis=0) if has_w else None
+    db = jnp.sum(db_part, axis=0) if has_b else None
+    return dx, dw, db
+
+
+layer_norm.defvjp(_fwd_rule, _bwd_rule)

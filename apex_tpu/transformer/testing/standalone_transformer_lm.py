@@ -71,6 +71,11 @@ class TransformerConfig:
     attention_softmax_in_fp32: bool = False
     masked_softmax_fusion: bool = True
     sequence_parallel: bool = False
+    # context parallelism: mesh axis the SEQUENCE dim is sharded over for
+    # the whole model (hidden states are [s/cp, b, h]); attention runs the
+    # ring (ops.context_parallel.ring_attention) so every rank still sees
+    # the full causal context. Orthogonal to tensor parallel.
+    context_parallel_axis: Optional[str] = None
     params_dtype: Any = jnp.float32
     fp16: bool = False
     bf16: bool = False
@@ -239,7 +244,7 @@ class ParallelAttention(nn.Module):
             and (deterministic or cfg.attention_dropout == 0.0)
         )
         if use_flash:
-            from apex_tpu.ops import fused_attention
+            from apex_tpu.ops import fused_attention, ring_attention
 
             # [s, b, np, hd] → [b, np, s, hd]
             qf = q.transpose(1, 2, 0, 3)
@@ -248,8 +253,13 @@ class ParallelAttention(nn.Module):
             # q/norm_factor then softmax×coeff == plain 1/sqrt(hd) scaling
             # (qk-layer-scaling is an fp16-range trick; flash accumulates
             # in fp32 so the composed scale is exact)
-            ctx = fused_attention(qf, kf, vf, causal=True,
-                                  sm_scale=1.0 / math.sqrt(hd))
+            if cfg.context_parallel_axis is not None:
+                ctx = ring_attention(qf, kf, vf, cfg.context_parallel_axis,
+                                     causal=True,
+                                     sm_scale=1.0 / math.sqrt(hd))
+            else:
+                ctx = fused_attention(qf, kf, vf, causal=True,
+                                      sm_scale=1.0 / math.sqrt(hd))
             ctx = ctx.transpose(2, 0, 1, 3).reshape(
                 q.shape[0], q.shape[1], np_local * hd)
             dense = RowParallelLinear(
@@ -261,6 +271,13 @@ class ParallelAttention(nn.Module):
                 params_dtype=cfg.params_dtype, axis_name=self.axis_name,
                 name="dense")
             return dense(ctx)
+
+        if cfg.context_parallel_axis is not None:
+            raise NotImplementedError(
+                "context_parallel_axis requires the ring-attention path "
+                "(causal self-attention, no explicit mask, no attention "
+                "dropout); the local scores path would silently compute "
+                "block-diagonal attention over sequence shards")
 
         # [s, b, np, hd] → [b*np, s, hd] for MXU-batched GEMMs
         def to_bns(x):

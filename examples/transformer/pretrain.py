@@ -169,6 +169,7 @@ def main(argv=None):
     done = 0
     last_loss = float("nan")
     tokens_per_sec = 0.0
+    compile_and_run = None
     timers("interval-time").start()
     while done < args.train_iters:
         params, opt_state, scaler_state, losses = run_chunk(
@@ -190,6 +191,13 @@ def main(argv=None):
             print(f" iter {done}: loss {last_loss:.4f}  "
                   f"{tokens_per_sec:,.0f} tokens/s  "
                   f"({elapsed/log_n*1e3:.1f} ms/iter)", flush=True)
+    if tokens_per_sec == 0.0 and compile_and_run:
+        # single-chunk run: report throughput from the compile chunk rather
+        # than a misleading 0 (flagged as compile-inclusive)
+        tokens_per_sec = log_n * dp * b_local * s / compile_and_run
+        if args.rank == 0:
+            print(f" tokens/s {tokens_per_sec:,.0f} "
+                  "(single chunk, INCLUDES compile)", flush=True)
 
     global_vars.destroy_global_vars()
     from apex_tpu.transformer.pipeline_parallel.utils import (

@@ -130,7 +130,10 @@ def test_global_vars_singletons():
         global_vars.get_args()
 
 
-@pytest.mark.parametrize("model,opt", [("gpt", "adam"), ("bert", "lamb")])
+@pytest.mark.parametrize("model,opt", [
+    ("gpt", "adam"),
+    pytest.param("bert", "lamb", marks=pytest.mark.slow),
+])
 def test_pretrain_entry_tiny(model, opt):
     """Config-driven pretrain runs both model families (BASELINE configs
     3 and 4, shrunk to CPU-mesh size) with decreasing-or-finite loss."""

@@ -52,8 +52,13 @@ def _conv_input_dtypes(opt_level):
 
     policy = build_policy(opt_levels[opt_level](Properties()))
     model = resnet18(num_classes=10, dtype=policy.compute_dtype)
-    x = jnp.zeros((2, 32, 32, 3), jnp.float32)
-    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    x = jnp.zeros((2, 16, 16, 3), jnp.float32)
+    # trace-only: eval_shape the init (no conv compiles), materialize zero
+    # params, and inspect the traced jaxpr — nothing executes on device
+    shapes = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), x, train=False))
+    variables = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes)
     params = amp.initialize(variables["params"], opt_level=opt_level)
 
     def fwd(p, x):
@@ -88,6 +93,7 @@ def test_imagenet_o2_computes_convs_in_bf16():
     assert _conv_input_dtypes("O0") == {jnp.dtype(jnp.float32)}
 
 
+@pytest.mark.slow
 def test_dcgan_main_amp_smoke():
     """Multi-model / multi-optimizer / 3-loss amp path."""
     from examples.dcgan.main_amp import main

@@ -1,0 +1,70 @@
+"""Pallas layer-norm kernel vs the jnp reference (interpret mode on CPU;
+the real-TPU timing comparison lives in benchmarks/profile_layernorm.py).
+Reference envelope: csrc/layer_norm_cuda_kernel.cu fwd/bwd parity tests in
+tests/L0/run_fused_layer_norm."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.normalization.fused_layer_norm import fused_layer_norm
+from apex_tpu.ops import layer_norm_pallas as lnp
+
+
+@pytest.mark.parametrize("rows,hidden", [(64, 128), (32, 768), (16, 1024)])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_fwd_matches_jnp(rows, hidden, dtype):
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(rows, hidden) * 2 + 1, dtype)
+    w = jnp.asarray(rs.rand(hidden) + 0.5, jnp.float32)
+    b = jnp.asarray(rs.randn(hidden), jnp.float32)
+    assert lnp.supported(rows, hidden)
+    got = lnp.layer_norm(x, w, b, 1e-5, True)
+    want = fused_layer_norm(x, (hidden,), w, b, 1e-5)
+    assert got.dtype == x.dtype
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               atol=2e-2 if dtype == jnp.bfloat16 else 1e-5)
+
+
+def test_fwd_no_affine():
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.randn(16, 256), jnp.float32)
+    got = lnp.layer_norm(x, None, None, 1e-5, True)
+    want = fused_layer_norm(x, (256,), None, None, 1e-5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_grads_match_jnp(dtype):
+    rows, hidden = 32, 384
+    rs = np.random.RandomState(2)
+    x = jnp.asarray(rs.randn(rows, hidden), dtype)
+    w = jnp.asarray(rs.rand(hidden) + 0.5, jnp.float32)
+    b = jnp.asarray(rs.randn(hidden), jnp.float32)
+    tgt = jnp.asarray(rs.randn(rows, hidden), jnp.float32)
+
+    def loss_pallas(x, w, b):
+        y = lnp.layer_norm(x, w, b, 1e-5, True)
+        return jnp.sum((y.astype(jnp.float32) - tgt) ** 2)
+
+    def loss_jnp(x, w, b):
+        y = fused_layer_norm(x, (hidden,), w, b, 1e-5)
+        return jnp.sum((y.astype(jnp.float32) - tgt) ** 2)
+
+    gx, gw, gb = jax.grad(loss_pallas, argnums=(0, 1, 2))(x, w, b)
+    rx, rw, rb = jax.grad(loss_jnp, argnums=(0, 1, 2))(x, w, b)
+    tol = 5e-2 if dtype == jnp.bfloat16 else 1e-4
+    np.testing.assert_allclose(np.asarray(gx, np.float32),
+                               np.asarray(rx, np.float32), atol=tol, rtol=tol)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(rw),
+                               atol=tol, rtol=tol)
+    np.testing.assert_allclose(np.asarray(gb), np.asarray(rb),
+                               atol=tol, rtol=tol)
+
+
+def test_unsupported_shapes_detected():
+    assert not lnp.supported(64, 100)  # hidden not 128-aligned
+    assert not lnp.supported(7, 128)   # rows with no pow2 block >= 8

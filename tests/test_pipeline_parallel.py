@@ -280,7 +280,8 @@ def test_ltor_reset_position_ids():
 # (reference: parallel_state.py initialize grid tests).
 
 @pytest.mark.parametrize("topology", [
-    (4, 1, 2), (2, 1, 4),
+    (2, 1, 4),
+    pytest.param((4, 1, 2), marks=pytest.mark.slow),
     pytest.param((4, 2, 1), marks=pytest.mark.slow),
     pytest.param((1, 2, 4), marks=pytest.mark.slow),
 ])
