@@ -1,0 +1,186 @@
+"""Expert-parallel mixture-of-experts MLP (Switch/top-k routing).
+
+The reference exposes MoE only as a config surface (testing/arguments.py
+--num-experts); the capability itself lives outside apex. Here it is a
+first-class TPU component, because expert parallelism shapes the mesh
+design the same way tp/pp do (SURVEY §2.8 scope note):
+
+  * routing (Switch Transformer style): fp32 router softmax, top-1 or
+    top-2 gating, static per-expert ``capacity`` (ceil(tokens/E · factor))
+    so every shape is static under jit — dropped tokens pass through the
+    residual, exactly the Switch semantics;
+  * dispatch/combine are einsums against a [tokens, experts, capacity]
+    one-hot — MXU-friendly, no scatter;
+  * expert parallelism: experts sharded over the ``ep`` mesh axis; token
+    slices travel rank→expert and back via ONE ``lax.all_to_all`` pair
+    (the ICI-native analog of the NCCL all-to-all an expert-parallel
+    GPU stack hand-writes); gradients ride AD through the collective.
+
+Parity is tested against a single-device reference on the CPU mesh
+(tests/test_moe.py) and the ep path is exercised by the driver dryrun.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+from jax import lax
+
+
+def switch_routing(router_logits, num_experts, capacity, num_selected=1):
+    """Top-k routing with static capacity.
+
+    Args:
+      router_logits: [T, E] (any float dtype; softmax in fp32).
+      capacity: max tokens per expert (static).
+      num_selected: 1 (Switch) or 2 (top-2 gating).
+
+    Returns (dispatch [T, E, C] float, combine [T, E, C] float): one-hot
+    dispatch mask and probability-weighted combine weights. Tokens beyond
+    an expert's capacity are dropped (all-zero rows).
+    """
+    T, E = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+
+    dispatch = jnp.zeros((T, E, capacity), jnp.float32)
+    combine = jnp.zeros((T, E, capacity), jnp.float32)
+    remaining = probs
+    # running per-expert occupancy across the k selection rounds
+    base_count = jnp.zeros((E,), jnp.int32)
+    for _ in range(num_selected):
+        expert_idx = jnp.argmax(remaining, axis=-1)  # [T]
+        onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.int32)  # [T, E]
+        # position of each token within its expert (first-come order)
+        pos = (jnp.cumsum(onehot, axis=0) - 1 + base_count[None, :])
+        pos = jnp.sum(pos * onehot, axis=-1)  # [T]
+        keep = pos < capacity
+        gate = jnp.sum(probs * onehot, axis=-1) * keep  # [T]
+        slot = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)  # [T, C]
+        d = onehot.astype(jnp.float32)[:, :, None] * slot[:, None, :]
+        d = d * keep[:, None, None]
+        dispatch = dispatch + d
+        combine = combine + d * gate[:, None, None]
+        base_count = base_count + jnp.sum(onehot, axis=0)
+        remaining = remaining * (1.0 - onehot)  # mask the chosen expert
+    return dispatch, combine
+
+
+def load_balancing_loss(router_logits, dispatch):
+    """Switch aux loss: E · Σ_e f_e · p_e (fraction routed × mean prob)."""
+    T, E = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    frac = jnp.sum(dispatch, axis=(0, 2)) / jnp.maximum(
+        jnp.sum(dispatch), 1.0)
+    mean_prob = jnp.mean(probs, axis=0)
+    return E * jnp.sum(frac * mean_prob)
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    hidden_size: int
+    ffn_hidden_size: int
+    num_experts: int
+    capacity_factor: float = 1.25
+    num_selected: int = 1
+    expert_parallel_axis: Optional[str] = None  # "ep" mesh axis or None
+    params_dtype: Any = jnp.float32
+    init_method_std: float = 0.02
+
+
+class ExpertParallelMLP(nn.Module):
+    """MoE FFN block: route → all_to_all → expert MLPs → all_to_all back.
+
+    Input/output [T, h] (callers flatten [s, b, h]). With
+    ``expert_parallel_axis`` set, this rank holds num_experts/ep experts
+    and runs inside shard_map; without it, all experts are local (the
+    single-device reference).
+    """
+
+    cfg: MoEConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        T, H = x.shape
+        E = cfg.num_experts
+        ep = 1
+        if cfg.expert_parallel_axis is not None:
+            ep = lax.axis_size(cfg.expert_parallel_axis)
+        assert E % ep == 0, f"num_experts {E} not divisible by ep {ep}"
+        e_loc = E // ep
+        capacity = int(np.ceil(T * cfg.capacity_factor * cfg.num_selected
+                               / E))
+
+        router = nn.Dense(E, use_bias=False, name="router",
+                          param_dtype=jnp.float32,
+                          kernel_init=nn.initializers.normal(
+                              cfg.init_method_std))
+        logits = router(x.astype(jnp.float32))
+        dispatch, combine = switch_routing(logits, E, capacity,
+                                           cfg.num_selected)
+        aux = load_balancing_loss(logits, dispatch)
+        self.sow("intermediates", "load_balancing_loss", aux)
+
+        # [T, E, C] x [T, H] -> [E, C, H]
+        expert_in = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), x)
+
+        # expert weights: this rank's e_loc experts. Rank-consistent
+        # sharded init (generate the full [E, ...] tensor, slice this
+        # rank's experts) so ranks hold DISTINCT experts that match the
+        # unsharded reference — same scheme as tensor_parallel.layers.
+        init = nn.initializers.normal(cfg.init_method_std)
+        if ep > 1:
+            from apex_tpu.transformer.tensor_parallel.layers import (
+                _sharded_init,
+            )
+
+            w1 = self.param(
+                "wi", _sharded_init(init, (E, H, cfg.ffn_hidden_size), 0,
+                                    cfg.expert_parallel_axis),
+                (e_loc, H, cfg.ffn_hidden_size), cfg.params_dtype)
+            w2 = self.param(
+                "wo", _sharded_init(init, (E, cfg.ffn_hidden_size, H), 0,
+                                    cfg.expert_parallel_axis),
+                (e_loc, cfg.ffn_hidden_size, H), cfg.params_dtype)
+        else:
+            w1 = self.param("wi", init, (E, H, cfg.ffn_hidden_size),
+                            cfg.params_dtype)
+            w2 = self.param("wo", init, (E, cfg.ffn_hidden_size, H),
+                            cfg.params_dtype)
+
+        if ep > 1:
+            # [E, C, H] = [ep, e_loc, C, H]: slice j goes to rank j; each
+            # rank re-stacks the ep incoming slices along capacity
+            send = expert_in.reshape(ep, e_loc, capacity, H)
+            recv = lax.all_to_all(send, cfg.expert_parallel_axis,
+                                  split_axis=0, concat_axis=0, tiled=False)
+            # [ep, e_loc, C, H] -> [e_loc, ep*C, H]
+            expert_local = recv.transpose(1, 0, 2, 3).reshape(
+                e_loc, ep * capacity, H)
+        else:
+            expert_local = expert_in  # [E, C, H]
+
+        def ffn(w1_e, w2_e, xin):
+            h = lax.dot_general(
+                xin, w1_e.astype(xin.dtype), (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32).astype(xin.dtype)
+            h = nn.gelu(h, approximate=True)
+            return lax.dot_general(
+                h, w2_e.astype(h.dtype), (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32).astype(xin.dtype)
+
+        expert_out = jax.vmap(ffn)(w1, w2, expert_local)
+
+        if ep > 1:
+            back = expert_out.reshape(e_loc, ep, capacity, H).transpose(
+                1, 0, 2, 3)
+            recv = lax.all_to_all(back, cfg.expert_parallel_axis,
+                                  split_axis=0, concat_axis=0, tiled=False)
+            expert_out = recv.reshape(E, capacity, H)
+
+        # [T, E, C] x [E, C, H] -> [T, H]
+        out = jnp.einsum("tec,ech->th", combine.astype(x.dtype), expert_out)
+        return out.astype(x.dtype)

@@ -1,0 +1,111 @@
+"""ResNet-50 ImageNet training throughput (BASELINE configs 1-2).
+
+The apex flagship metric (examples/imagenet/main_amp.py images/sec
+metering): full train step — bf16 convs per amp O2, SyncBatchNorm (local
+on one chip), fused SGD momentum + weight decay, CE loss — on synthetic
+224x224 NHWC data, measured with the calibrated scan methodology
+(benchmarks/_timing.py). Results go to PERF.md §6.
+
+Run:  PYTHONPATH=/root/repo:$PYTHONPATH python benchmarks/profile_resnet.py [batch]
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+from benchmarks._timing import measure_dispatch_overhead, sync  # noqa: E402
+
+from apex_tpu import amp  # noqa: E402
+from apex_tpu.models import resnet50  # noqa: E402
+from apex_tpu.optimizers.fused_sgd import fused_sgd  # noqa: E402
+
+ON_TPU = jax.devices()[0].platform == "tpu"
+B = int(sys.argv[1]) if len(sys.argv) > 1 else (128 if ON_TPU else 8)
+IMG = 224 if ON_TPU else 32
+K = 16 if ON_TPU else 2
+
+mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+model = resnet50(num_classes=1000, norm_axis_name="data",
+                 dtype=jnp.bfloat16)
+tx = fused_sgd(learning_rate=0.1, momentum=0.9, weight_decay=1e-4)
+
+rs = np.random.RandomState(0)
+images = jnp.asarray(rs.rand(B, IMG, IMG, 3), jnp.float32)
+labels = jnp.asarray(rs.randint(0, 1000, (B,)), jnp.int32)
+
+
+def shmap(f, n):
+    return jax.shard_map(f, mesh=mesh, in_specs=(P(),) * n, out_specs=P(),
+                         check_vma=False)
+
+
+variables = jax.jit(shmap(
+    lambda x: model.init(jax.random.PRNGKey(0), x, train=False), 1))(
+    images[:2])
+params0, bstats0 = variables["params"], variables["batch_stats"]
+# Full amp O2 semantics, exactly as the flagship example wires it
+# (examples/imagenet/main_amp.py): bf16 model params + fp32 master weights
+# + dynamic loss scaling + skip-step, via the AmpOptimizer wrapper.
+params0, opt = amp.initialize(params0, tx, opt_level="O2")
+n_params = sum(x.size for x in jax.tree_util.tree_leaves(params0))
+
+amp_state0 = jax.jit(lambda p: opt.init(p))(params0)
+
+OVERHEAD = measure_dispatch_overhead(K)
+print(f"resnet50 b={B} img={IMG} params={n_params/1e6:.1f}M "
+      f"(K={K}, overhead {OVERHEAD*1e3:.1f} ms)")
+
+
+def run(params, amp_state, bstats, eps, images, labels):
+    def local(params, amp_state, bstats, eps, images, labels):
+        x = images.astype(jnp.bfloat16)
+
+        def body(carry, _):
+            p, st, bs = carry
+
+            def loss_fn(p):
+                logits, newv = model.apply(
+                    {"params": p, "batch_stats": bs}, x, train=True,
+                    mutable=["batch_stats"])
+                one_hot = jax.nn.one_hot(labels, 1000)
+                loss = -jnp.mean(jnp.sum(
+                    jax.nn.log_softmax(logits.astype(jnp.float32))
+                    * one_hot, axis=-1))
+                return loss, newv["batch_stats"]
+
+            f = amp.value_and_scaled_grad(loss_fn, opt, has_aux=True)
+            (loss, bs), grads, found_inf = f(p, st)
+            p, st, _info = opt.apply_gradients(
+                grads, st, p, grads_already_unscaled=True,
+                found_inf=found_inf)
+            return (p, st, bs), loss
+
+        (params, amp_state, bstats), losses = lax.scan(
+            body, (params, amp_state, bstats), jnp.arange(K))
+        return params, amp_state, bstats, losses + eps
+
+    return jax.shard_map(
+        local, mesh=mesh, in_specs=(P(),) * 6, out_specs=P(),
+        check_vma=False)(params, amp_state, bstats, eps, images, labels)
+
+
+step = jax.jit(run, donate_argnums=(2,))
+
+t0 = time.perf_counter()
+out = step(params0, amp_state0, bstats0, jnp.float32(0.0), images, labels)
+sync(out[3])
+print(f"compile+first: {time.perf_counter()-t0:.1f}s "
+      f"loss={float(np.asarray(out[3][-1])):.3f}")
+t0 = time.perf_counter()
+out = step(out[0], out[1], out[2], jnp.float32(1e-30), images, labels)
+sync(out[3])
+dt = (time.perf_counter() - t0 - OVERHEAD) / K
+print(f"step {dt*1e3:.1f} ms  ->  {B/dt:,.1f} images/sec")
