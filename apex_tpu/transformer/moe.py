@@ -86,8 +86,26 @@ class MoEConfig:
     capacity_factor: float = 1.25
     num_selected: int = 1
     expert_parallel_axis: Optional[str] = None  # "ep" mesh axis or None
+    # tensor parallelism WITHIN each expert: the ffn dim is column/row
+    # sharded over this axis (same scheme as ParallelMLP) so tp ranks split
+    # each expert's weights and FLOPs instead of replicating them
+    tensor_parallel_axis: Optional[str] = None
     params_dtype: Any = jnp.float32
     init_method_std: float = 0.02
+
+
+def collect_moe_aux(intermediates):
+    """Sum every sown ``load_balancing_loss`` in an ``intermediates``
+    collection (as returned by ``model.apply(..,
+    mutable=['intermediates'])``). Trainers add ``coeff * collect_moe_aux``
+    to the objective — the Switch aux loss is an explicit loss term, not a
+    side effect."""
+    total = jnp.zeros((), jnp.float32)
+    for path, leaf in jax.tree_util.tree_flatten_with_path(intermediates)[0]:
+        names = [getattr(p, "key", getattr(p, "name", "")) for p in path]
+        if any(n == "load_balancing_loss" for n in names):
+            total = total + jnp.sum(leaf)
+    return total
 
 
 class ExpertParallelMLP(nn.Module):
@@ -106,11 +124,17 @@ class ExpertParallelMLP(nn.Module):
         cfg = self.cfg
         T, H = x.shape
         E = cfg.num_experts
+        F = cfg.ffn_hidden_size
         ep = 1
         if cfg.expert_parallel_axis is not None:
             ep = lax.axis_size(cfg.expert_parallel_axis)
+        tp = 1
+        if cfg.tensor_parallel_axis is not None:
+            tp = lax.axis_size(cfg.tensor_parallel_axis)
         assert E % ep == 0, f"num_experts {E} not divisible by ep {ep}"
+        assert F % tp == 0, f"ffn_hidden_size {F} not divisible by tp {tp}"
         e_loc = E // ep
+        f_loc = F // tp
         capacity = int(np.ceil(T * cfg.capacity_factor * cfg.num_selected
                                / E))
 
@@ -127,29 +151,31 @@ class ExpertParallelMLP(nn.Module):
         # [T, E, C] x [T, H] -> [E, C, H]
         expert_in = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), x)
 
-        # expert weights: this rank's e_loc experts. Rank-consistent
-        # sharded init (generate the full [E, ...] tensor, slice this
-        # rank's experts) so ranks hold DISTINCT experts that match the
+        # expert weights: this rank's e_loc experts, each expert's ffn dim
+        # column/row-sharded over tp. Rank-consistent sharded init
+        # (generate the full [E, H, F] tensor, slice this rank's experts
+        # and ffn columns) so ranks hold DISTINCT shards matching the
         # unsharded reference — same scheme as tensor_parallel.layers.
-        init = nn.initializers.normal(cfg.init_method_std)
-        if ep > 1:
-            from apex_tpu.transformer.tensor_parallel.layers import (
-                _sharded_init,
-            )
+        base_init = nn.initializers.normal(cfg.init_method_std)
 
-            w1 = self.param(
-                "wi", _sharded_init(init, (E, H, cfg.ffn_hidden_size), 0,
-                                    cfg.expert_parallel_axis),
-                (e_loc, H, cfg.ffn_hidden_size), cfg.params_dtype)
-            w2 = self.param(
-                "wo", _sharded_init(init, (E, cfg.ffn_hidden_size, H), 0,
-                                    cfg.expert_parallel_axis),
-                (e_loc, cfg.ffn_hidden_size, H), cfg.params_dtype)
-        else:
-            w1 = self.param("wi", init, (E, H, cfg.ffn_hidden_size),
-                            cfg.params_dtype)
-            w2 = self.param("wo", init, (E, cfg.ffn_hidden_size, H),
-                            cfg.params_dtype)
+        def sliced_init(full_shape, e_axis, f_axis):
+            def init(key, local_shape, dtype):
+                master = base_init(key, full_shape, dtype)
+                if ep > 1:
+                    idx = lax.axis_index(cfg.expert_parallel_axis)
+                    master = lax.dynamic_slice_in_dim(
+                        master, idx * e_loc, e_loc, axis=e_axis)
+                if tp > 1:
+                    idx = lax.axis_index(cfg.tensor_parallel_axis)
+                    master = lax.dynamic_slice_in_dim(
+                        master, idx * f_loc, f_loc, axis=f_axis)
+                return master
+            return init
+
+        w1 = self.param("wi", sliced_init((E, H, F), 0, 2),
+                        (e_loc, H, f_loc), cfg.params_dtype)
+        w2 = self.param("wo", sliced_init((E, F, H), 0, 1),
+                        (e_loc, f_loc, H), cfg.params_dtype)
 
         if ep > 1:
             # [E, C, H] = [ep, e_loc, C, H]: slice j goes to rank j; each
@@ -173,6 +199,10 @@ class ExpertParallelMLP(nn.Module):
                 preferred_element_type=jnp.float32).astype(xin.dtype)
 
         expert_out = jax.vmap(ffn)(w1, w2, expert_local)
+        if tp > 1:
+            # row-parallel reduction: each tp rank computed a partial sum
+            # over its ffn columns (same as RowParallelLinear)
+            expert_out = lax.psum(expert_out, cfg.tensor_parallel_axis)
 
         if ep > 1:
             back = expert_out.reshape(e_loc, ep, capacity, H).transpose(

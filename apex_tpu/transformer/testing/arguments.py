@@ -397,6 +397,11 @@ class MegatronArgs:
             bf16=self.bf16,
             init_method_std=self.init_method_std,
             bert_binary_head=self.bert_binary_head,
+            # Megatron's --num-experts is a per-virtual-stage list; the
+            # single-slab models take one expert count
+            num_moe_experts=(self.num_experts[0] if self.num_experts
+                             else None),
+            recompute_granularity=self.recompute_granularity,
         )
 
 

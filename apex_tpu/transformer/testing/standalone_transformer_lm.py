@@ -76,6 +76,21 @@ class TransformerConfig:
     # ring (ops.context_parallel.ring_attention) so every rank still sees
     # the full causal context. Orthogonal to tensor parallel.
     context_parallel_axis: Optional[str] = None
+    # mixture of experts (reference surface: arguments.py --num-experts):
+    # when set, every layer's MLP becomes an ExpertParallelMLP with this
+    # many experts, optionally sharded over ``expert_parallel_axis``
+    num_moe_experts: Optional[int] = None
+    expert_parallel_axis: Optional[str] = None
+    moe_capacity_factor: float = 1.25
+    moe_top_k: int = 1
+    # Switch aux-loss coefficient: trainers collect the sown
+    # load_balancing_loss via mutable=["intermediates"] +
+    # moe.collect_moe_aux and add coeff * aux to the objective
+    moe_aux_loss_coeff: float = 1e-2
+    # activation recompute (reference: --recompute-granularity full →
+    # tensor_parallel.random.checkpoint per layer; here jax.checkpoint
+    # around each transformer layer)
+    recompute_granularity: Optional[str] = None
     params_dtype: Any = jnp.float32
     fp16: bool = False
     bf16: bool = False
@@ -140,6 +155,35 @@ def parallel_lm_logits(hidden, word_embeddings_weight, parallel_output=True,
 # ---------------------------------------------------------------------------
 # transformer blocks
 # ---------------------------------------------------------------------------
+
+class MoEMLP(nn.Module):
+    """MoE drop-in for ParallelMLP: flattens [s, b, h] to tokens, routes
+    through transformer.moe.ExpertParallelMLP (expert ffn dims tp-sharded
+    over ``axis_name``), returns (out, zero-bias) so the layer's
+    bias_dropout_add is unchanged. The sown load_balancing_loss propagates
+    up the module tree — collect with mutable=["intermediates"]."""
+
+    cfg: TransformerConfig
+    axis_name: str = TENSOR_AXIS
+
+    @nn.compact
+    def __call__(self, hidden):
+        from apex_tpu.transformer.moe import ExpertParallelMLP, MoEConfig
+
+        cfg = self.cfg
+        s, b, h = hidden.shape
+        moe = ExpertParallelMLP(MoEConfig(
+            hidden_size=h, ffn_hidden_size=cfg.ffn_size,
+            num_experts=cfg.num_moe_experts,
+            capacity_factor=cfg.moe_capacity_factor,
+            num_selected=cfg.moe_top_k,
+            expert_parallel_axis=cfg.expert_parallel_axis,
+            tensor_parallel_axis=self.axis_name,
+            params_dtype=cfg.params_dtype,
+            init_method_std=cfg.init_method_std), name="moe")
+        out = moe(hidden.reshape(s * b, h)).reshape(s, b, h)
+        return out, jnp.zeros((h,), out.dtype)
+
 
 class ParallelMLP(nn.Module):
     """h → 4h (column) → gelu → h (row) (reference:
@@ -347,15 +391,23 @@ class ParallelTransformerLayer(nn.Module):
         ln = FusedLayerNorm(normalized_shape=cfg.hidden_size,
                             eps=cfg.layernorm_epsilon,
                             name="input_layernorm")
-        attn = ParallelAttention(cfg, self.layer_number,
-                                 AttnType.self_attn,
-                                 self.self_attn_mask_type,
-                                 axis_name=self.axis_name,
-                                 name="self_attention")
+        attn_cls = ParallelAttention
+        if cfg.recompute_granularity == "selective":
+            # reference selective recompute: only the attention core is
+            # recomputed in backward (arguments.py --recompute-activations)
+            attn_cls = nn.remat(ParallelAttention, static_argnums=(4,))
+        attn = attn_cls(cfg, self.layer_number,
+                        AttnType.self_attn,
+                        self.self_attn_mask_type,
+                        axis_name=self.axis_name,
+                        name="self_attention")
         post_ln = FusedLayerNorm(normalized_shape=cfg.hidden_size,
                                  eps=cfg.layernorm_epsilon,
                                  name="post_attention_layernorm")
-        mlp = ParallelMLP(cfg, axis_name=self.axis_name, name="mlp")
+        if cfg.num_moe_experts:
+            mlp = MoEMLP(cfg, axis_name=self.axis_name, name="mlp")
+        else:
+            mlp = ParallelMLP(cfg, axis_name=self.axis_name, name="mlp")
 
         def bias_dropout_add(x, bias, residual):
             # reference: bias_dropout_add fusion (XLA fuses this chain)
@@ -364,8 +416,10 @@ class ParallelTransformerLayer(nn.Module):
                 x, deterministic=deterministic)
             return residual + x
 
-        attn_out, attn_bias = attn(ln(hidden), attention_mask,
-                                   deterministic=deterministic)
+        # positional call: nn.remat's static_argnums counts self at 0, so
+        # deterministic must arrive as positional arg 4
+        attn_out, attn_bias = attn(ln(hidden), attention_mask, None,
+                                   deterministic)
         hidden = bias_dropout_add(attn_out, attn_bias, hidden)
 
         if self.layer_type == LayerType.decoder:
@@ -484,6 +538,7 @@ class GPTModel(nn.Module):
         hidden = ParallelTransformer(
             cfg, self_attn_mask_type=AttnMaskType.causal,
             pre_process=self.pre_process, post_process=self.post_process,
+            recompute_activations=(cfg.recompute_granularity == "full"),
             axis_name=self.axis_name, name="transformer")(
             hidden, attention_mask, deterministic=deterministic)
 
@@ -573,6 +628,7 @@ class BertModel(nn.Module):
         hidden = ParallelTransformer(
             cfg, self_attn_mask_type=AttnMaskType.padding,
             pre_process=self.pre_process, post_process=self.post_process,
+            recompute_activations=(cfg.recompute_granularity == "full"),
             axis_name=self.axis_name, name="transformer")(
             hidden, ext_mask, deterministic=deterministic)
 

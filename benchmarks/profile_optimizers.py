@@ -1,0 +1,84 @@
+"""Fused optimizer step-time on TPU (BASELINE tracked metric: optimizer
+step-time FusedAdam/FusedLAMB).
+
+Measures the pure optimizer update (gradients given) for a GPT-2-small
+sized parameter set, with the calibrated scan methodology, and reports
+achieved HBM bandwidth against the analytic floor:
+
+  Adam:  read g, p, m, v; write p, m, v  ->  7 fp32 passes
+  LAMB:  adds the per-tensor norm reductions (reads dominate the same way)
+  SGD:   read g, p, buf; write p, buf    ->  5 fp32 passes
+
+Results recorded in PERF.md §2/§6.
+Run:  PYTHONPATH=/root/repo:$PYTHONPATH python benchmarks/profile_optimizers.py
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+from benchmarks._timing import measure_dispatch_overhead, sync  # noqa: E402
+
+from apex_tpu.optimizers.fused_adam import fused_adam  # noqa: E402
+from apex_tpu.optimizers.fused_lamb import fused_lamb  # noqa: E402
+from apex_tpu.optimizers.fused_sgd import fused_sgd  # noqa: E402
+
+ON_TPU = jax.devices()[0].platform == "tpu"
+K = 32 if ON_TPU else 2
+HBM = 819e9  # v5e
+
+# GPT-2-small-like parameter set: a few big 2D tensors + many small ones
+rs = np.random.RandomState(0)
+SHAPES = ([(50304, 768), (1024, 768)]
+          + [(768, 2304), (768, 768), (768, 3072), (3072, 768)] * 12
+          + [(768,)] * 50) if ON_TPU else [(256, 256), (256,)]
+params = [jnp.asarray(rs.randn(*s) * 0.02, jnp.float32) for s in SHAPES]
+grads = [jnp.asarray(rs.randn(*s) * 1e-3, jnp.float32) for s in SHAPES]
+n = sum(p.size for p in params)
+OVERHEAD = measure_dispatch_overhead(K)
+print(f"{n/1e6:.1f}M params across {len(SHAPES)} tensors "
+      f"(K={K}, overhead {OVERHEAD*1e3:.1f} ms)")
+
+
+def bench(name, tx, passes):
+    # fresh buffers per optimizer: the scan donates its inputs
+    p0 = jax.tree_util.tree_map(jnp.copy, params)
+    state0 = jax.jit(lambda p: tx.init(p))(p0)
+
+    def run(params, state, eps, grads):
+        def body(carry, _):
+            p, s = carry
+            g = jax.tree_util.tree_map(
+                lambda x: x + eps.astype(x.dtype), grads)
+            u, s = tx.update(g, s, p)
+            p = jax.tree_util.tree_map(
+                lambda a, b: a + b.astype(a.dtype), p, u)
+            return (p, s), p[0].ravel()[0]
+        (params, state), out = lax.scan(body, (params, state),
+                                        jnp.arange(K))
+        return params, state, out
+
+    f = jax.jit(run, donate_argnums=(0, 1))
+    p1, s1, out = f(p0, state0, jnp.float32(0.0), grads)
+    sync(out)
+    t0 = time.perf_counter()
+    _, _, out = f(p1, s1, jnp.float32(1e-30), grads)
+    sync(out)
+    dt = (time.perf_counter() - t0 - OVERHEAD) / K
+    traffic = passes * 4 * n
+    floor = traffic / HBM
+    print(f"{name:12s} {dt*1e3:7.2f} ms/step  "
+          f"{traffic/dt/1e9:6.0f} GB/s effective "
+          f"({floor/dt*100:5.1f}% of the {floor*1e3:.1f} ms HBM floor)")
+
+
+bench("FusedAdam", fused_adam(1e-3), 7)
+bench("FusedLAMB", fused_lamb(1e-3), 7)
+bench("FusedSGD", fused_sgd(1e-2, momentum=0.9), 5)

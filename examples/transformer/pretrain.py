@@ -100,12 +100,24 @@ def main(argv=None):
     tx = make_optimizer(args)
 
     def fwd_loss(p, ids, pos, labels, scale):
+        mutable = ["intermediates"] if cfg.num_moe_experts else False
         if args.model == "gpt":
-            per_tok = model.apply({"params": p}, ids, pos, None, labels)
+            out = model.apply({"params": p}, ids, pos, None, labels,
+                              mutable=mutable)
         else:
-            per_tok, _ = model.apply({"params": p}, ids,
-                                     jnp.ones_like(ids), lm_labels=labels)
-        return jnp.mean(per_tok) * scale
+            out = model.apply({"params": p}, ids, jnp.ones_like(ids),
+                              lm_labels=labels, mutable=mutable)
+        if mutable:
+            out, new_vars = out
+        per_tok = out[0] if args.model == "bert" else out
+        loss = jnp.mean(per_tok)
+        if mutable:
+            # Switch aux loss: explicit objective term, not a side effect
+            from apex_tpu.transformer.moe import collect_moe_aux
+
+            loss = loss + cfg.moe_aux_loss_coeff * collect_moe_aux(
+                new_vars["intermediates"])
+        return loss * scale
 
     def init_fn(ids, pos, labels):
         if args.model == "gpt":

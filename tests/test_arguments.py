@@ -149,3 +149,15 @@ def test_pretrain_entry_tiny(model, opt):
                 "--optimizer", opt, "--lr", "1e-3", "--bf16",
                 "--train-iters", "4", "--log-interval", "2"])
     assert np.isfinite(out["loss"])
+
+
+def test_recompute_granularity_flows_to_model_config():
+    a = parse_args(BASE + ["--recompute-granularity", "full"])
+    cfg = a.to_transformer_config()
+    assert cfg.recompute_granularity == "full"
+
+
+def test_num_experts_flows_to_model_config():
+    a = parse_args(BASE + ["--num-experts", "4"])
+    cfg = a.to_transformer_config()
+    assert cfg.num_moe_experts == 4

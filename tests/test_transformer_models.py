@@ -197,3 +197,38 @@ def test_bert_forward_backward():
         ids, attn_mask, labels)
     assert np.isfinite(np.asarray(loss))
     assert bool(finite)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("granularity", ["full", "selective"])
+def test_recompute_granularity_grads_match(granularity):
+    """Recompute must not change values: grads with full/selective
+    recompute equal the no-recompute grads."""
+    rs = np.random.RandomState(7)
+    b, s = 2, 16
+    ids = jnp.asarray(rs.randint(0, CFG.vocab_size, (b, s)))
+    pos = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+    labels = jnp.asarray(rs.randint(0, CFG.vocab_size, (b, s)))
+    mesh = tp_mesh(1)
+
+    def grads_for(cfg):
+        model = GPTModel(cfg)
+
+        def run(ids, pos, labels):
+            params = model.init(jax.random.PRNGKey(0), ids, pos,
+                                None)["params"]
+            def loss_fn(p):
+                return jnp.mean(model.apply({"params": p}, ids, pos, None,
+                                            labels))
+            loss, g = jax.value_and_grad(loss_fn)(params)
+            return loss, g["position_embeddings"]
+
+        return smap(run, mesh, (P(), P(), P()), (P(), P()))(ids, pos, labels)
+
+    import dataclasses
+    l0, g0 = grads_for(CFG)
+    l1, g1 = grads_for(dataclasses.replace(CFG,
+                                           recompute_granularity=granularity))
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l0), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g0), rtol=1e-5,
+                               atol=1e-7)
