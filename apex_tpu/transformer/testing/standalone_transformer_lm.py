@@ -171,6 +171,13 @@ class MoEMLP(nn.Module):
         from apex_tpu.transformer.moe import ExpertParallelMLP, MoEConfig
 
         cfg = self.cfg
+        if cfg.sequence_parallel:
+            raise NotImplementedError(
+                "num_moe_experts with sequence_parallel: the MLP input is "
+                "sequence-sharded over tp, so routing would operate on "
+                "different token sets per rank while the expert tp-psum "
+                "assumes identical tokens — gather/scatter plumbing for "
+                "this combination is not implemented")
         s, b, h = hidden.shape
         moe = ExpertParallelMLP(MoEConfig(
             hidden_size=h, ffn_hidden_size=cfg.ffn_size,

@@ -1,0 +1,6 @@
+#!/bin/bash
+# Reference: torch.distributed.launch --nproc_per_node=2 → the multiproc
+# launcher spawns one process per (virtual) host and wires the
+# jax.distributed coordinator env.
+exec python -m apex_tpu.parallel.multiproc --nproc 2 \
+    "$(dirname "$0")/distributed_data_parallel.py"

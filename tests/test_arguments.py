@@ -131,7 +131,7 @@ def test_global_vars_singletons():
 
 
 @pytest.mark.parametrize("model,opt", [
-    ("gpt", "adam"),
+    pytest.param("gpt", "adam", marks=pytest.mark.slow),
     pytest.param("bert", "lamb", marks=pytest.mark.slow),
 ])
 def test_pretrain_entry_tiny(model, opt):

@@ -36,7 +36,8 @@ def _run_cp(fn, q, k, v, causal):
     return f(q, k, v)
 
 
-@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("causal", [
+    True, pytest.param(False, marks=pytest.mark.slow)])
 @pytest.mark.parametrize("fn", [ring_attention, ulysses_attention],
                          ids=["ring", "ulysses"])
 def test_matches_dense(fn, causal):

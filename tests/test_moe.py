@@ -141,6 +141,7 @@ def test_dropped_tokens_produce_zero_output():
 
 # ----------------------- MoE inside the GPT stack --------------------------
 
+@pytest.mark.slow
 def test_gpt_with_moe_layers_trains():
     """GPTModel with num_moe_experts routes every layer's MLP through the
     MoE; loss and grads stay finite and loss decreases over a few steps."""

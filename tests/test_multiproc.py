@@ -8,6 +8,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
 
@@ -23,3 +25,26 @@ def test_multiproc_two_process_psum():
         f"launcher rc={out.returncode}\nstdout:\n{out.stdout}\n"
         f"stderr:\n{out.stderr}")
     assert out.stdout.count("MULTIPROC_OK") == 2, out.stdout
+
+
+@pytest.mark.slow
+def test_simple_distributed_example_two_process():
+    """The reference's examples/simple/distributed walkthrough, 2-process:
+    DDP grad averaging + amp O1 must converge (final loss printed by rank
+    0 and well below the ~1.3 starting MSE)."""
+    env = dict(os.environ)
+    env["MASTER_PORT"] = "29537"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # one device per process: the conftest's 8-device flag would make a
+    # 16-device gloo mesh and slow every one of the 500 dispatches
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    out = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.parallel.multiproc", "--nproc", "2",
+         os.path.join(REPO, "examples", "simple", "distributed",
+                      "distributed_data_parallel.py")],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    import re
+    m = re.search(r"final loss = ([0-9.]+)", out.stdout)
+    assert m, out.stdout
+    assert float(m.group(1)) < 1.0

@@ -280,8 +280,10 @@ def test_ltor_reset_position_ids():
 # (reference: parallel_state.py initialize grid tests).
 
 @pytest.mark.parametrize("topology", [
-    (2, 1, 4),
-    pytest.param((4, 1, 2), marks=pytest.mark.slow),
+    # pp=4 stays in the fast set (the dryrun's sp/cp paths already compile
+    # tp=n programs; deep pp only lives here); the rest are slow-marked
+    (4, 1, 2),
+    pytest.param((2, 1, 4), marks=pytest.mark.slow),
     pytest.param((4, 2, 1), marks=pytest.mark.slow),
     pytest.param((1, 2, 4), marks=pytest.mark.slow),
 ])
