@@ -83,6 +83,10 @@ def step(params, state, x, y):
             lambda p: loss_fn(p, x, y), opt)
         loss, grads, found_inf = f(params, state)
         grads = allreduce_gradients(grads, "data")
+        # skip-step must be a GLOBAL decision: one rank's overflow reaches
+        # every rank through the grad allreduce (same rule as
+        # transformer.amp.GradScaler)
+        found_inf = jax.lax.pmax(found_inf, "data")
         params, state, _ = opt.apply_gradients(
             grads, state, params, grads_already_unscaled=True,
             found_inf=found_inf)
