@@ -3,52 +3,58 @@
 Capability port of apex.optimizers.FusedAdagrad (reference:
 apex/optimizers/fused_adagrad.py; kernel csrc/multi_tensor_adagrad.cu).
 ``adagrad_w_mode`` = decoupled weight decay (as in the kernel's ADAGRAD
-MODE_1).
+MODE_1). Per-leaf fp32 state (PERF.md §2: elementwise optimizers pay ~2x
+for a flat-buffer layout on TPU).
 """
 
-from typing import NamedTuple
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
 import optax
 
 from apex_tpu.optimizers._base import FusedOptimizerBase
-from apex_tpu.optimizers._fused import FlatMeta, get_meta
 
 
 class FusedAdagradState(NamedTuple):
     count: jnp.ndarray
-    sum_sq: jnp.ndarray  # flat fp32 accumulated g^2
+    sum_sq: Any  # fp32 pytree of accumulated g^2 (params structure)
 
 
 def fused_adagrad(learning_rate=1e-2, eps=1e-10, weight_decay=0.0,
                   adagrad_w_mode=False):
     def init(params):
-        meta = get_meta(jax.tree_util.tree_leaves(params))
         return FusedAdagradState(
             count=jnp.zeros((), jnp.int32),
-            sum_sq=jnp.zeros((meta.total,), jnp.float32),
+            sum_sq=jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params),
         )
 
     def update(grads, state, params=None):
         assert params is not None
         leaves_g, treedef = jax.tree_util.tree_flatten(grads)
         leaves_p = jax.tree_util.tree_leaves(params)
-        meta = get_meta(leaves_p)
-        g = meta.flatten(leaves_g)
-        p = meta.flatten(leaves_p)
+        leaves_s = jax.tree_util.tree_leaves(state.sum_sq)
         count = state.count + 1
         lr = learning_rate(count) if callable(learning_rate) else learning_rate
-        if weight_decay != 0 and not adagrad_w_mode:
-            g = g + weight_decay * p
-        sum_sq = state.sum_sq + g * g
-        upd = g / (jnp.sqrt(sum_sq) + eps)
-        if weight_decay != 0 and adagrad_w_mode:
-            upd = upd + weight_decay * p
-        flat_u = -lr * upd
-        updates = jax.tree_util.tree_unflatten(
-            treedef, meta.unflatten(flat_u, [x.dtype for x in leaves_g]))
-        return updates, FusedAdagradState(count=count, sum_sq=sum_sq)
+
+        us, ss = [], []
+        for gl, p, s in zip(leaves_g, leaves_p, leaves_s):
+            g = gl.astype(jnp.float32)
+            pf = p.astype(jnp.float32)
+            if weight_decay != 0 and not adagrad_w_mode:
+                g = g + weight_decay * pf
+            s = s + g * g
+            upd = g / (jnp.sqrt(s) + eps)
+            if weight_decay != 0 and adagrad_w_mode:
+                upd = upd + weight_decay * pf
+            us.append((-lr * upd).astype(gl.dtype))
+            ss.append(s)
+
+        def unflat(xs):
+            return jax.tree_util.tree_unflatten(treedef, xs)
+
+        return unflat(us), FusedAdagradState(count=count, sum_sq=unflat(ss))
 
     return optax.GradientTransformation(init, update)
 

@@ -16,9 +16,11 @@ step program — and a flat-buffer layout (used here through round 2) costs
 an extra concat of (g, p) plus a slice of the updates EVERY step: ~6 extra
 HBM copies of the whole parameter state. Measured on v5e (GPT-2-small,
 124.5M params): flat 14.3 ms/step vs per-leaf ~bandwidth-bound ~5 ms (see
-PERF.md). Per-tensor-reduction optimizers (LAMB etc.) and the ZeRO-sharded
-optimizers still use the flat substrate in ``_fused.py``, where a single
-flat buffer genuinely is the right shard/reduce layout.
+PERF.md). Adam, SGD, LAMB, NovoGrad and Adagrad are per-leaf (per-tensor
+trust ratios / layer norms are plain per-leaf reductions); the flat
+substrate in ``_fused.py`` remains where a flat buffer genuinely is the
+right layout — the ZeRO-sharded contrib optimizers (shard/reduce over
+ranks), the MixedPrecisionLamb flat master, and LARC.
 """
 
 from typing import Any, NamedTuple

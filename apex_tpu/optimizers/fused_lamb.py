@@ -3,26 +3,27 @@
 Capability port of apex.optimizers.FusedLAMB (reference:
 apex/optimizers/fused_lamb.py:6-215; kernels csrc/multi_tensor_lamb.cu and
 the two-phase csrc/multi_tensor_l2norm_kernel.cu global-norm pass at
-fused_lamb.py:124-137). TPU design: one flat fp32 buffer per quantity; the
-per-layer trust ratios are segment reductions over the flat buffer
-(one ``segment_sum`` instead of per-tensor kernel blocks), so the entire
-two-phase algorithm is a single fused XLA computation.
+fused_lamb.py:124-137). TPU design: per-leaf fp32 state — the per-tensor
+trust ratios are plain per-leaf norm reductions, and the global grad norm
+is a sum of per-leaf sums; both fuse under jit with no concat/slice of the
+whole parameter state (the flat-buffer layout measured ~2x slower on TPU —
+PERF.md §2; the flat substrate remains for the ZeRO-sharded variants where
+a flat buffer IS the shard layout).
 """
 
-from typing import NamedTuple
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
 import optax
 
 from apex_tpu.optimizers._base import FusedOptimizerBase
-from apex_tpu.optimizers._fused import FlatMeta, get_meta
 
 
 class FusedLAMBState(NamedTuple):
     count: jnp.ndarray
-    m: jnp.ndarray
-    v: jnp.ndarray
+    m: Any  # fp32 pytree (params structure)
+    v: Any
 
 
 def fused_lamb(learning_rate=1e-3, betas=(0.9, 0.999), eps=1e-6,
@@ -31,57 +32,70 @@ def fused_lamb(learning_rate=1e-3, betas=(0.9, 0.999), eps=1e-6,
     beta1, beta2 = betas
 
     def init(params):
-        meta = get_meta(jax.tree_util.tree_leaves(params))
+        def zeros(p):
+            return jnp.zeros(p.shape, jnp.float32)
+
         return FusedLAMBState(
             count=jnp.zeros((), jnp.int32),
-            m=jnp.zeros((meta.total,), jnp.float32),
-            v=jnp.zeros((meta.total,), jnp.float32),
+            m=jax.tree_util.tree_map(zeros, params),
+            v=jax.tree_util.tree_map(zeros, params),
         )
 
     def update(grads, state, params=None):
         assert params is not None
         leaves_g, treedef = jax.tree_util.tree_flatten(grads)
         leaves_p = jax.tree_util.tree_leaves(params)
-        meta = get_meta(leaves_p)
-        g = meta.flatten(leaves_g)
-        p = meta.flatten(leaves_p)
+        leaves_m = jax.tree_util.tree_leaves(state.m)
+        leaves_v = jax.tree_util.tree_leaves(state.v)
         count = state.count + 1
         t = count.astype(jnp.float32)
         lr = learning_rate(count) if callable(learning_rate) else learning_rate
 
+        gs = [g.astype(jnp.float32) for g in leaves_g]
+        ps = [p.astype(jnp.float32) for p in leaves_p]
+
         # phase 1: fused global grad norm (multi_tensor_l2norm analog,
         # fused_lamb.py:124-137)
-        global_norm = jnp.sqrt(jnp.sum(g * g))
+        global_sq = sum(jnp.sum(g * g) for g in gs)
         if max_grad_norm is not None and max_grad_norm > 0:
-            clip = jnp.maximum(global_norm / max_grad_norm, 1.0)
-            g = g / clip
+            clip = jnp.maximum(jnp.sqrt(global_sq) / max_grad_norm, 1.0)
+            gs = [g / clip for g in gs]
 
         # phase 2: multi_tensor_lamb. MOMENT_MODE_0 (adam_w_mode=False, L2)
         # folds decay*p into the gradient before the moments; MODE_1 (adamw)
         # adds decay*p after the moment ratio (multi_tensor_lamb.cu:123-142).
         beta3 = 1.0 - beta1 if grad_averaging else 1.0
-        g_eff = g if adam_w_mode else g + weight_decay * p
-        m = beta1 * state.m + beta3 * g_eff
-        v = beta2 * state.v + (1.0 - beta2) * g_eff * g_eff
         if bias_correction:
             bc1 = 1.0 - beta1 ** t
             bc2 = 1.0 - beta2 ** t
         else:
             bc1 = bc2 = 1.0
-        upd = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
-        if adam_w_mode:
-            upd = upd + weight_decay * p
-        # per-tensor trust ratios via segment reduction
-        w_norm = jnp.sqrt(meta.per_tensor_sq_norms(p))
-        u_norm = jnp.sqrt(meta.per_tensor_sq_norms(upd))
-        ratio = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / (u_norm + 1e-38), 1.0)
-        if weight_decay == 0.0 and not use_nvlamb:
-            # multi_tensor_lamb.cu: adaptive LR only where decay applies
-            ratio = jnp.ones_like(ratio)
-        flat_u = -lr * meta.broadcast_per_tensor(ratio) * upd
-        updates = jax.tree_util.tree_unflatten(
-            treedef, meta.unflatten(flat_u, [x.dtype for x in leaves_g]))
-        return updates, FusedLAMBState(count=count, m=m, v=v)
+
+        us, ms, vs = [], [], []
+        for g, p, m, v, gl in zip(gs, ps, leaves_m, leaves_v, leaves_g):
+            g_eff = g if adam_w_mode else g + weight_decay * p
+            m = beta1 * m + beta3 * g_eff
+            v = beta2 * v + (1.0 - beta2) * g_eff * g_eff
+            upd = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+            if adam_w_mode:
+                upd = upd + weight_decay * p
+            # per-tensor trust ratio: one norm pair per leaf
+            w_norm = jnp.sqrt(jnp.sum(p * p))
+            u_norm = jnp.sqrt(jnp.sum(upd * upd))
+            ratio = jnp.where((w_norm > 0) & (u_norm > 0),
+                              w_norm / (u_norm + 1e-38), 1.0)
+            if weight_decay == 0.0 and not use_nvlamb:
+                # multi_tensor_lamb.cu: adaptive LR only where decay applies
+                ratio = jnp.ones_like(ratio)
+            us.append((-lr * ratio * upd).astype(gl.dtype))
+            ms.append(m)
+            vs.append(v)
+
+        def unflat(xs):
+            return jax.tree_util.tree_unflatten(treedef, xs)
+
+        return unflat(us), FusedLAMBState(count=count, m=unflat(ms),
+                                          v=unflat(vs))
 
     return optax.GradientTransformation(init, update)
 

@@ -18,8 +18,9 @@ exactly:
   * ``init_zero=False``: v initialized with the first step's norm so the
     first blend is a no-op (fused_novograd.py:166-174).
 
-On TPU the per-layer norms are one ``segment_sum``/``segment_max`` over the
-flat buffer.
+Per-leaf fp32 state: the per-layer norms are plain per-leaf reductions
+(``v`` stays one scalar per tensor), fused under jit with no concat/slice
+of the parameter state (PERF.md §2).
 """
 
 from typing import NamedTuple
@@ -29,12 +30,11 @@ import jax.numpy as jnp
 import optax
 
 from apex_tpu.optimizers._base import FusedOptimizerBase
-from apex_tpu.optimizers._fused import FlatMeta, get_meta
 
 
 class FusedNovoGradState(NamedTuple):
     count: jnp.ndarray
-    m: jnp.ndarray  # flat fp32 first moment
+    m: object  # fp32 pytree first moment (params structure)
     v: jnp.ndarray  # [num_tensors] fp32 per-layer grad NORM (not squared)
 
 
@@ -46,29 +46,34 @@ def fused_novograd(learning_rate=1e-3, betas=(0.9, 0.999), eps=1e-8,
         raise RuntimeError("FusedNovoGrad only support l2/inf norm now.")
 
     def init(params):
-        meta = get_meta(jax.tree_util.tree_leaves(params))
+        leaves = jax.tree_util.tree_leaves(params)
         return FusedNovoGradState(
             count=jnp.zeros((), jnp.int32),
-            m=jnp.zeros((meta.total,), jnp.float32),
-            v=jnp.zeros((meta.num_tensors,), jnp.float32),
+            m=jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params),
+            v=jnp.zeros((len(leaves),), jnp.float32),
         )
 
     def update(grads, state, params=None):
         assert params is not None
         leaves_g, treedef = jax.tree_util.tree_flatten(grads)
         leaves_p = jax.tree_util.tree_leaves(params)
-        meta = get_meta(leaves_p)
-        g = meta.flatten(leaves_g)
-        p = meta.flatten(leaves_p)
+        leaves_m = jax.tree_util.tree_leaves(state.m)
         count = state.count + 1
         t = count.astype(jnp.float32)
         lr = learning_rate(count) if callable(learning_rate) else learning_rate
 
+        if not leaves_g:  # empty pytree: nothing to update
+            return grads, FusedNovoGradState(count=count, m=state.m,
+                                             v=state.v)
+
+        gs = [g.astype(jnp.float32) for g in leaves_g]
+        ps = [p.astype(jnp.float32) for p in leaves_p]
+
         if norm_type == 2:
-            step_norm = jnp.sqrt(meta.per_tensor_sq_norms(g))
+            step_norm = jnp.stack([jnp.sqrt(jnp.sum(g * g)) for g in gs])
         else:  # L-inf
-            step_norm = jax.ops.segment_max(
-                jnp.abs(g), meta.seg_ids, num_segments=meta.num_tensors)
+            step_norm = jnp.stack([jnp.max(jnp.abs(g)) for g in gs])
 
         # v init: first step uses the step norm so the first blend is a no-op
         # (unless init_zero, which starts averaging immediately from 0)
@@ -84,19 +89,26 @@ def fused_novograd(learning_rate=1e-3, betas=(0.9, 0.999), eps=1e-8,
             bc2 = jnp.sqrt(1.0 - beta2 ** t)  # sqrt: v is a norm, not a square
         else:
             bc1 = bc2 = 1.0
-        denom = meta.broadcast_per_tensor(v / bc2) + eps
         beta3 = 1.0 - beta1 if grad_averaging else 1.0
 
-        if reg_inside_moment:  # MOMENT_MODE_0
-            r_g = g / denom + weight_decay * p
-            m = beta1 * state.m + beta3 * r_g
-            flat_u = -lr * m / bc1
-        else:  # MOMENT_MODE_1 (decoupled decay)
-            m = beta1 * state.m + beta3 * g
-            flat_u = -lr * ((m / bc1) / denom + weight_decay * p)
-        updates = jax.tree_util.tree_unflatten(
-            treedef, meta.unflatten(flat_u, [x.dtype for x in leaves_g]))
-        return updates, FusedNovoGradState(count=count, m=m, v=v)
+        us, ms = [], []
+        for i, (g, p, m, gl) in enumerate(zip(gs, ps, leaves_m, leaves_g)):
+            denom = v[i] / bc2 + eps
+            if reg_inside_moment:  # MOMENT_MODE_0
+                r_g = g / denom + weight_decay * p
+                m = beta1 * m + beta3 * r_g
+                u = -lr * m / bc1
+            else:  # MOMENT_MODE_1 (decoupled decay)
+                m = beta1 * m + beta3 * g
+                u = -lr * ((m / bc1) / denom + weight_decay * p)
+            us.append(u.astype(gl.dtype))
+            ms.append(m)
+
+        def unflat(xs):
+            return jax.tree_util.tree_unflatten(treedef, xs)
+
+        return unflat(us), FusedNovoGradState(count=count, m=unflat(ms),
+                                              v=v)
 
     return optax.GradientTransformation(init, update)
 

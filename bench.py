@@ -184,11 +184,12 @@ def _watchdog():
     env = dict(os.environ, APEX_BENCH_INNER="1")
     timeout = int(os.environ.get("APEX_BENCH_TIMEOUT", "1800"))
     try:
+        # capture stdout (the JSON line) only; stderr is inherited so the
+        # '# compiling ...' liveness prints stream during the slow compile
         out = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                             env=env, timeout=timeout, capture_output=True,
-                             text=True)
+                             env=env, timeout=timeout,
+                             stdout=subprocess.PIPE, text=True)
         sys.stdout.write(out.stdout)
-        sys.stderr.write(out.stderr[-4000:])
         return out.returncode
     except subprocess.TimeoutExpired as e:
         def as_text(x):
