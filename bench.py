@@ -196,7 +196,7 @@ def _watchdog():
             return x.decode(errors="replace") if isinstance(x, bytes) else (
                 x or "")
 
-        sys.stderr.write(as_text(e.stderr)[-2000:])
+        # (stderr streamed live — only stdout was piped)
         # the child may have printed its result and then wedged in backend
         # teardown — forward a completed JSON line rather than zeroing it
         for line in reversed(as_text(e.stdout).splitlines()):

@@ -56,6 +56,8 @@ def parse_args(argv=None):
     p.add_argument("--prof", type=int, default=-1,
                    help="profile this many steps with jax.profiler")
     p.add_argument("--deterministic", action="store_true")
+    p.add_argument("--evaluate", "-e", action="store_true",
+                   help="evaluate on the validation set and exit")
     p.add_argument("--synthetic", action="store_true",
                    help="random data (no input pipeline)")
     p.add_argument("--steps", type=int, default=None,
@@ -81,6 +83,19 @@ class AverageMeter:
         self.sum += val * n
         self.count += n
         self.avg = self.sum / self.count
+
+
+def _loss_and_metrics(logits, labels):
+    """CE loss + prec@1/5 (shared by the train and eval steps; reference
+    metering main_amp.py:380-420)."""
+    one_hot = jax.nn.one_hot(labels, logits.shape[-1])
+    loss = -jnp.mean(jnp.sum(
+        jax.nn.log_softmax(logits.astype(jnp.float32)) * one_hot, axis=-1))
+    preds = jnp.argsort(logits, axis=-1)[:, -5:]
+    top1 = jnp.mean((preds[:, -1] == labels).astype(jnp.float32))
+    top5 = jnp.mean(jnp.any(preds == labels[:, None],
+                            axis=-1).astype(jnp.float32))
+    return loss, top1, top5
 
 
 def make_synthetic_loader(args, steps):
@@ -111,10 +126,7 @@ def build_train_step(model, opt, mesh, compute_dtype=jnp.float32):
                 logits, new_vars = model.apply(
                     {"params": p, "batch_stats": batch_stats}, images,
                     train=True, mutable=["batch_stats"])
-                one_hot = jax.nn.one_hot(labels, logits.shape[-1])
-                loss = -jnp.mean(jnp.sum(
-                    jax.nn.log_softmax(logits.astype(jnp.float32))
-                    * one_hot, axis=-1))
+                loss = _loss_and_metrics(logits, labels)[0]
                 return loss, (new_vars["batch_stats"], logits)
 
             f = amp.value_and_scaled_grad(loss_fn, opt, has_aux=True)
@@ -128,10 +140,7 @@ def build_train_step(model, opt, mesh, compute_dtype=jnp.float32):
                 grads, amp_state, params, grads_already_unscaled=True,
                 found_inf=found_inf)
 
-            preds = jnp.argsort(logits, axis=-1)[:, -5:]
-            top1 = jnp.mean((preds[:, -1] == labels).astype(jnp.float32))
-            top5 = jnp.mean(jnp.any(preds == labels[:, None],
-                                    axis=-1).astype(jnp.float32))
+            _, top1, top5 = _loss_and_metrics(logits, labels)
             metrics = lax.pmean(
                 jnp.stack([loss, top1 * 100, top5 * 100]), "data")
             return params, new_bstats, amp_state, metrics, info["overflow"]
@@ -148,8 +157,58 @@ def build_train_step(model, opt, mesh, compute_dtype=jnp.float32):
     return jax.jit(step)
 
 
+def build_eval_step(model, mesh, compute_dtype=jnp.float32):
+    """Validation step (reference: main_amp.py validate()/AverageMeter):
+    eval-mode forward (running BN stats), mean loss + prec@1/5 over the
+    data axis."""
+
+    def step(params, batch_stats, images, labels):
+        def local(params, batch_stats, images, labels):
+            images = images.astype(compute_dtype)
+            logits = model.apply(
+                {"params": params, "batch_stats": batch_stats}, images,
+                train=False)
+            loss, top1, top5 = _loss_and_metrics(logits, labels)
+            return lax.pmean(jnp.stack([loss, top1 * 100, top5 * 100]),
+                             "data")
+
+        return shard_map(
+            local, mesh=mesh,
+            in_specs=(P(), P(), P("data"), P("data")), out_specs=P(),
+            check_vma=False)(params, batch_stats, images, labels)
+
+    return jax.jit(step)
+
+
+def validate(args, model, mesh, params, batch_stats, compute_dtype,
+             steps=None):
+    """Reference: main_amp.py validate() — eval loop with metering."""
+    eval_step = build_eval_step(model, mesh, compute_dtype)
+    losses, top1, top5 = AverageMeter(), AverageMeter(), AverageMeter()
+    steps = steps or args.steps or 8
+    loader = make_synthetic_loader(args, steps)()
+    for i, (images, labels) in enumerate(loader):
+        m = np.asarray(eval_step(params, batch_stats, jnp.asarray(images),
+                                 jnp.asarray(labels)))
+        losses.update(float(m[0]), args.batch_size)
+        top1.update(float(m[1]), args.batch_size)
+        top5.update(float(m[2]), args.batch_size)
+        if i % args.print_freq == 0:
+            print(f"Test: [{i}/{steps}]  Loss {losses.val:.4f} "
+                  f"({losses.avg:.4f})  Prec@1 {top1.val:.2f} ({top1.avg:.2f})"
+                  f"  Prec@5 {top5.val:.2f} ({top5.avg:.2f})", flush=True)
+    print(f" * Prec@1 {top1.avg:.3f} Prec@5 {top5.avg:.3f}", flush=True)
+    return losses.avg, top1.avg, top5.avg
+
+
 def main(argv=None):
     args = parse_args(argv)
+    if args.data and not args.synthetic:
+        raise NotImplementedError(
+            "this port ships only the synthetic pipeline (--synthetic); an "
+            "ImageFolder-style numpy loader would plug in at "
+            "make_synthetic_loader — the positional data path is accepted "
+            "for CLI parity but no real loader is implemented")
     devices = jax.devices()
     mesh = Mesh(np.asarray(devices), ("data",))
     ndev = len(devices)
@@ -204,6 +263,10 @@ def main(argv=None):
             ckpt["params"], ckpt["batch_stats"], ckpt["amp_state"])
         start_epoch = ckpt["epoch"]
         print(f"=> loaded checkpoint (epoch {start_epoch})")
+
+    if args.evaluate:
+        return validate(args, model, mesh, params, batch_stats,
+                        policy.compute_dtype)[0]
 
     train_step = build_train_step(model, opt, mesh,
                                   compute_dtype=policy.compute_dtype)

@@ -101,3 +101,15 @@ def test_dcgan_main_amp_smoke():
     loss_d, loss_g = main(["--steps", "3", "-b", "8", "--image-size", "64",
                            "--opt-level", "O1"])
     assert np.isfinite(loss_d) and np.isfinite(loss_g)
+
+
+@pytest.mark.slow
+def test_imagenet_evaluate_path():
+    """--evaluate runs the reference's validate() analog: eval-mode BN,
+    prec@1/5 metering, finite loss."""
+    from examples.imagenet.main_amp import main
+
+    loss = main(["--synthetic", "--evaluate", "--arch", "resnet18",
+                 "--steps", "2", "-b", "16", "--image-size", "32",
+                 "--num-classes", "10", "--opt-level", "O2"])
+    assert np.isfinite(loss)
