@@ -1,0 +1,171 @@
+"""Pallas TPU fused scale + mask + softmax kernel (fwd + bwd).
+
+TPU counterpart of the reference's three megatron softmax kernels
+(csrc/megatron/scaled_upper_triang_masked_softmax.{h,cu},
+scaled_masked_softmax.{h,cu}, generic_scaled_masked_softmax.{h,cu}): one
+VMEM pass per row block computing ``softmax(scale * x + mask)`` in fp32
+with masked positions emitted as exactly 0 (fully-masked rows become
+all-zero rows, matching the CUDA kernels), output in the input dtype.
+
+Layout: ``x`` is [b, np, sq, sk]; the grid tiles (b, np, sq-blocks) and an
+explicit boolean mask of shape [b, 1|np, sq, sk] is broadcast over the
+head axis by the BlockSpec index map — the mask is read once per head
+from HBM but never materialized at [b, np, sq, sk]. The causal variant
+derives its mask from row/col iota in-register (no mask operand at all).
+
+Backward is the softmax VJP on the saved probabilities,
+``dx = scale * y * (g - sum(g * y))``; masked positions have y == 0 so no
+mask is needed in the backward kernel (also exactly how the reference's
+bwd kernels work on the saved softmax results).
+
+The jnp path (transformer/functional/fused_softmax.py) stays the default:
+XLA fuses the same chain into one loop, and softmax is HBM-bound. This
+kernel (a) proves the "XLA fusion is enough" claim with a real
+alternative measured by benchmarks/profile_softmax.py, (b) guarantees the
+fusion (no reliance on XLA heuristics) for the dense-attention path, and
+(c) gives FusedScaleMaskSoftmax a genuine kernel behind its dispatch
+predicate. Tested against the jnp reference in interpret mode
+(tests/test_softmax_pallas.py).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+_VMEM_BUDGET = 12 * 1024 * 1024  # total fp32 block bytes (of ~16MB VMEM)
+_FWD_ARRAYS = 3  # x, exp/y, mask/scratch resident per fwd block
+_BWD_ARRAYS = 4  # y, g, dx + headroom per bwd block
+
+
+def _sq_block(sq, sk, n_arrays):
+    """Largest power-of-two sq block with ``n_arrays`` fp32 [block, sk]
+    arrays inside the VMEM budget, dividing ``sq`` (0 → unsupported)."""
+    cap = max(1, _VMEM_BUDGET // (4 * sk * n_arrays))
+    b = 1
+    while b * 2 <= cap and sq % (b * 2) == 0:
+        b *= 2
+    return b if b >= 8 else 0
+
+
+def supported(sq, sk):
+    """Whether the kernel handles [.., sq, sk] rows (else jnp fallback).
+    Gated on the backward footprint so accepted shapes never fail to
+    compile mid-training; sk must be lane-aligned."""
+    return sk % 128 == 0 and _sq_block(sq, sk, _BWD_ARRAYS) != 0
+
+
+def mask_supported(mask, x_shape):
+    """Whether ``mask`` has one of the two shapes the kernel's BlockSpec
+    broadcast handles ([b, 1, sq, sk] or [b, np, sq, sk]); other
+    broadcastable shapes (e.g. key-padding [b, 1, 1, sk]) need the jnp
+    fallback."""
+    b, np_, sq, sk = x_shape
+    return mask.shape in ((b, 1, sq, sk), (b, np_, sq, sk))
+
+
+def _fwd_kernel(*refs, scale, causal, has_mask, bsq):
+    x_ref, y_ref = refs[0], refs[-1]
+    x = x_ref[...].astype(jnp.float32) * jnp.float32(scale)
+    _, _, rows, sk = x.shape
+    masked = None
+    if has_mask:
+        masked = refs[1][...] != 0
+    if causal:
+        isq = pl.program_id(2)
+        row = isq * bsq + jax.lax.broadcasted_iota(jnp.int32, (rows, sk), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (rows, sk), 1)
+        tri = (col > row)[None, None]
+        masked = tri if masked is None else masked | tri
+    if masked is not None:
+        neg = jnp.asarray(jnp.finfo(jnp.float32).min, jnp.float32)
+        x = jnp.where(masked, neg, x)
+    e = jnp.exp(x - jnp.max(x, axis=-1, keepdims=True))
+    if masked is not None:
+        e = jnp.where(masked, 0.0, e)
+    s = jnp.sum(e, axis=-1, keepdims=True)
+    y = jnp.where(s > 0, e / jnp.where(s > 0, s, 1.0), 0.0)
+    y_ref[...] = y.astype(y_ref.dtype)
+
+
+def _bwd_kernel(y_ref, g_ref, dx_ref, *, scale):
+    y = y_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)
+    dot = jnp.sum(y * g, axis=-1, keepdims=True)
+    dx_ref[...] = (jnp.float32(scale) * y * (g - dot)).astype(dx_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def scaled_masked_softmax(x, mask, scale=1.0, causal=False, interpret=False):
+    """``softmax(scale * x [+ causal/explicit mask])`` over the last dim.
+
+    ``x``: [b, np, sq, sk]. ``mask``: None or a boolean/int array of shape
+    [b, 1, sq, sk] or [b, np, sq, sk] — nonzero = masked out. The causal
+    triangle is generated in-register when ``causal``. Use ``supported``
+    first; unsupported shapes raise. ``interpret=True`` runs in Pallas
+    interpret mode (CPU tests).
+    """
+    y, _ = _fwd(x, mask, scale, causal, interpret)
+    return y
+
+
+def _fwd(x, mask, scale, causal, interpret):
+    b, np_, sq, sk = x.shape
+    if not supported(sq, sk):
+        raise ValueError(f"softmax_pallas: unsupported shape {x.shape}")
+    bsq = _sq_block(sq, sk, _FWD_ARRAYS)
+    has_mask = mask is not None
+    grid = (b, np_, sq // bsq)
+    blk = (1, 1, bsq, sk)
+
+    in_specs = [pl.BlockSpec(blk, lambda ib, ih, js: (ib, ih, js, 0))]
+    ops = [x]
+    if has_mask:
+        assert mask.shape in ((b, 1, sq, sk), (b, np_, sq, sk)), (
+            f"mask shape {mask.shape} does not broadcast to {x.shape}")
+        # head-broadcast happens in the index map: a [b, 1, sq, sk] mask is
+        # re-read per head from HBM, never materialized per-head
+        bcast_h = mask.shape[1] == 1
+        mblk = (1, 1, bsq, sk)
+        in_specs.append(pl.BlockSpec(
+            mblk, (lambda ib, ih, js: (ib, 0, js, 0)) if bcast_h
+            else (lambda ib, ih, js: (ib, ih, js, 0))))
+        ops.append(mask.astype(jnp.int8))
+
+    y = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          has_mask=has_mask, bsq=bsq),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(blk, lambda ib, ih, js: (ib, ih, js, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=interpret,
+    )(*ops)
+    return y, y
+
+
+def _fwd_rule(x, mask, scale, causal, interpret):
+    y, res = _fwd(x, mask, scale, causal, interpret)
+    return y, res
+
+
+def _bwd_rule(scale, causal, interpret, y, g):
+    b, np_, sq, sk = y.shape
+    bsq = _sq_block(sq, sk, _BWD_ARRAYS)
+    blk = (1, 1, bsq, sk)
+    spec = pl.BlockSpec(blk, lambda ib, ih, js: (ib, ih, js, 0))
+    dx = pl.pallas_call(
+        functools.partial(_bwd_kernel, scale=scale),
+        grid=(b, np_, sq // bsq),
+        in_specs=[spec, spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct(y.shape, y.dtype),
+        interpret=interpret,
+    )(y, g)
+    # mask is non-differentiable (None or boolean)
+    return dx, None
+
+
+scaled_masked_softmax.defvjp(_fwd_rule, _bwd_rule)

@@ -92,7 +92,7 @@ class FusedScaleMaskSoftmax:
 
     def __init__(self, input_in_fp16, input_in_bf16, attn_mask_type,
                  scaled_masked_softmax_fusion, mask_func, softmax_in_fp32,
-                 scale):
+                 scale, use_pallas=False, _pallas_interpret=False):
         self.input_in_fp16 = input_in_fp16
         self.input_in_bf16 = input_in_bf16
         assert not (input_in_fp16 and input_in_bf16), \
@@ -103,6 +103,12 @@ class FusedScaleMaskSoftmax:
         self.mask_func = mask_func
         self.softmax_in_fp32 = softmax_in_fp32
         self.scale = scale
+        # guarantee the fusion with the Pallas kernel
+        # (ops/softmax_pallas.py) instead of relying on XLA's fuser; the
+        # jnp path stays the default pending the TPU head-to-head
+        # (benchmarks/profile_softmax.py)
+        self.use_pallas = use_pallas
+        self._pallas_interpret = _pallas_interpret
         assert self.scale is None or softmax_in_fp32, \
             "softmax should be in fp32 when scaled"
 
@@ -135,9 +141,24 @@ class FusedScaleMaskSoftmax:
     def forward_fused_softmax(self, input, mask):
         """Reference: fused_softmax.py:202-223."""
         scale = self.scale if self.scale is not None else 1.0
-        if self.attn_mask_type == AttnMaskType.causal:
+        causal = self.attn_mask_type == AttnMaskType.causal
+        if causal:
+            assert input.shape[-2] == input.shape[-1], \
+                "causal mask is only for self attention"
+        if self.use_pallas:
+            from apex_tpu.ops import softmax_pallas
+            # the fused causal path ignores an explicit mask (the
+            # reference's scaled_upper_triang kernel takes none) — pass
+            # None so toggling use_pallas never changes numerics
+            m = None if causal or mask is None else mask.astype(bool)
+            if softmax_pallas.supported(input.shape[-2], input.shape[-1]) \
+                    and (m is None
+                         or softmax_pallas.mask_supported(m, input.shape)):
+                return softmax_pallas.scaled_masked_softmax(
+                    input, m, scale, causal=causal,
+                    interpret=self._pallas_interpret)
+        if causal:
             b, np_, sq, sk = input.shape
-            assert sq == sk, "causal mask is only for self attention"
             out = scaled_upper_triang_masked_softmax(
                 input.reshape(-1, sq, sk), scale)
             return out.reshape(b, np_, sq, sk)

@@ -1,0 +1,94 @@
+"""Fused scale-mask softmax: Pallas kernel vs the XLA-fused jnp path.
+
+Decides the default for ``FusedScaleMaskSoftmax(use_pallas=)`` the same
+way profile_layernorm.py decides the LN default: softmax over attention
+scores is HBM-bound (read x, write y per row, fp32 math in registers), so
+the question is which side sustains more of the ~819 GB/s roofline. The
+reference needed its three hand-written megatron kernels because eager
+torch launches scale/mask/max/exp/sum/div as separate kernels; XLA fuses
+the same chain, and the Pallas kernel (ops/softmax_pallas.py) pins the
+fusion down deterministically.
+
+Run on TPU: PYTHONPATH=/root/repo python benchmarks/profile_softmax.py
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+from benchmarks._timing import measure_dispatch_overhead, sync  # noqa: E402
+
+from apex_tpu.ops import softmax_pallas
+from apex_tpu.transformer.functional.fused_softmax import (
+    scaled_masked_softmax as jnp_masked,
+    scaled_upper_triang_masked_softmax as jnp_causal,
+)
+
+K = 32
+HBM = 819e9  # v5e
+
+OVERHEAD = measure_dispatch_overhead(K)
+print(f"dispatch overhead {OVERHEAD*1e3:.1f} ms; "
+      f"HBM roofline {HBM/1e9:.0f} GB/s")
+
+
+def run_case(name, b, np_, sq, sk, causal, use_pallas):
+    rs = np.random.RandomState(0)
+    x0 = jnp.asarray(rs.randn(b, np_, sq, sk), jnp.bfloat16)
+    mask = None
+    if not causal:
+        mask = jnp.asarray(rs.rand(b, 1, sq, sk) < 0.2)
+
+    # mask rides as a jit argument — closure capture would inline the
+    # [b, 1, sq, sk] constant into the HLO payload (remote-compile limit)
+    def make_body(eps, m):
+        def body(carry, _):
+            def f(x):
+                if use_pallas:
+                    y = softmax_pallas.scaled_masked_softmax(
+                        x, m, 0.125, causal=causal)
+                elif causal:
+                    y = jnp_causal(x.reshape(-1, sq, sk), 0.125)
+                else:
+                    y = jnp_masked(x, m, 0.125)
+                return jnp.sum(y.astype(jnp.float32) ** 2)
+
+            l, g = jax.value_and_grad(f)(carry)
+            return carry - eps.astype(carry.dtype) * g, l
+        return body
+
+    def run(carry, eps, *ops):
+        m = ops[0] if ops else None
+        return lax.scan(make_body(eps, m), carry, jnp.arange(K))
+
+    mask_ops = () if mask is None else (mask,)
+    f = jax.jit(run)
+    sync(f(x0, jnp.float32(0.0), *mask_ops))
+    t0 = time.perf_counter()
+    sync(f(x0, jnp.float32(1e-30), *mask_ops))
+    dt = (time.perf_counter() - t0 - OVERHEAD) / K
+
+    n = b * np_ * sq * sk
+    # fwd: read x, write y; bwd: read y, read g, write dx → 5 bf16 passes
+    bytes_min = 5 * 2 * n
+    print(f"{name:34s} {dt*1e3:7.3f} ms  {bytes_min/dt/1e9:6.0f} GB/s "
+          f"({bytes_min/dt/HBM*100:5.1f}% roofline)")
+    return dt
+
+
+# GPT-2-small attention-score shape and a longer-seq BERT-ish shape
+for (b, np_, sq, sk) in [(8, 12, 1024, 1024), (8, 16, 512, 512)]:
+    for causal in (True, False):
+        kind = "causal" if causal else "masked"
+        base = run_case(f"jnp   {kind} b{b} h{np_} s{sq}", b, np_, sq, sk,
+                        causal, use_pallas=False)
+        pal = run_case(f"pallas {kind} b{b} h{np_} s{sq}", b, np_, sq, sk,
+                       causal, use_pallas=True)
+        print(f"{'':34s} pallas/jnp = {pal/base:.2f}x")
