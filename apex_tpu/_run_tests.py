@@ -22,16 +22,19 @@ SUITES = {
     "fp16util": ["test_fp16_utils.py"],
     "optimizers": ["test_fused_optimizers.py", "test_multi_tensor.py",
                    "test_distributed_optimizers.py"],
-    "fused_layer_norm": ["test_fused_layer_norm.py"],
+    "fused_layer_norm": ["test_fused_layer_norm.py",
+                         "test_layer_norm_pallas.py"],
     "mlp": ["test_mlp_dense.py"],
     "rnn": ["test_rnn.py"],
-    "parallel": ["test_parallel.py"],
+    "parallel": ["test_parallel.py", "test_multiproc.py"],
     "transformer": ["test_tensor_parallel.py", "test_pipeline_parallel.py",
-                    "test_transformer_models.py"],
+                    "test_transformer_models.py", "test_moe.py",
+                    "test_context_parallel.py", "test_arguments.py"],
     "contrib": ["test_contrib_basic.py", "test_contrib_attn.py",
                 "test_contrib_spatial.py",
                 "test_contrib_sparsity_permutation.py"],
-    "ops": ["test_ops_attention.py"],
+    "ops": ["test_ops_attention.py", "test_softmax_pallas.py"],
+    "checkpoint": ["test_checkpoint.py"],
     "examples": ["test_examples.py"],
 }
 # reference run_test.py:28-33 excludes run_amp/run_fp16util by default;

@@ -1,0 +1,131 @@
+"""Sharded checkpoint / resume for the whole training state.
+
+The reference's checkpoint story (README.md "Checkpointing", lines 57-97)
+is a dict convention: save ``model.state_dict()`` (fp32 via the O2 hook),
+``optimizer.state_dict()`` and ``amp.state_dict()``, restore them after
+re-running ``amp.initialize``. Its only distributed-state handling is
+gather-to-rank-0 (DistributedFusedAdam's gathered ``state_dict`` —
+contrib/optimizers/distributed_fused_adam.py); there is no sharded
+checkpoint format anywhere in the tree.
+
+The TPU build keeps the same three-part recipe — (params, opt_state, amp
+state) as one pytree — and upgrades the mechanism to Orbax: every host
+writes exactly its own shards (no gather), restore places each array
+straight onto its mesh sharding from an abstract template, and a manager
+handles retention/step discovery for resume. ZeRO-sharded optimizer
+state (contrib DistributedFusedAdam/LAMB) round-trips without ever being
+gathered — the capability the reference lacks.
+
+Single-host multi-device and multi-host (``jax.distributed``) use the
+same code path; Orbax coordinates the multi-host commit protocol.
+"""
+
+import os
+
+import jax
+import numpy as np
+
+try:  # orbax is in the baked image; degrade gracefully elsewhere
+    import orbax.checkpoint as ocp
+    HAVE_ORBAX = True
+except Exception:  # pragma: no cover
+    ocp = None
+    HAVE_ORBAX = False
+
+
+def _require_orbax():
+    if not HAVE_ORBAX:
+        raise ImportError(
+            "apex_tpu.checkpoint requires orbax-checkpoint; install it or "
+            "use the in-memory amp.state_dict()/load_state_dict() recipe")
+
+
+def abstract_like(tree, sharding=None):
+    """Abstract template for :func:`restore_checkpoint`: shapes/dtypes of
+    ``tree`` with each leaf's target sharding.
+
+    ``sharding`` may be None (restore to the leaves' current shardings —
+    the resume-in-place case), a single ``jax.sharding.Sharding`` applied
+    to every leaf, or a pytree of shardings matching ``tree``.
+    """
+    if sharding is None or isinstance(sharding, jax.sharding.Sharding):
+        def leaf(x):
+            s = sharding
+            if s is None:
+                s = x.sharding if isinstance(x, jax.Array) else None
+            return jax.ShapeDtypeStruct(np.shape(x), x.dtype, sharding=s)
+        return jax.tree_util.tree_map(leaf, tree)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.ShapeDtypeStruct(np.shape(x), x.dtype, sharding=s),
+        tree, sharding)
+
+
+def save_checkpoint(path, state, force=True):
+    """Write ``state`` (any pytree of arrays — the apex recipe bundles
+    {params, opt_state, amp}) to ``path``. Sharded arrays are written
+    shard-wise by their owning hosts; blocks until the checkpoint is
+    committed."""
+    _require_orbax()
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.abspath(os.fspath(path)), state, force=force)
+
+
+def restore_checkpoint(path, template):
+    """Restore the pytree at ``path``. ``template`` is either a concrete
+    state (restore onto each leaf's current sharding) or the result of
+    :func:`abstract_like` (restore onto explicit target shardings)."""
+    _require_orbax()
+    if any(isinstance(x, jax.Array)
+           for x in jax.tree_util.tree_leaves(template)):
+        template = abstract_like(template)
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(os.path.abspath(os.fspath(path)), template)
+
+
+class CheckpointManager:
+    """Retention + resume bookkeeping over :func:`save_checkpoint`.
+
+    Mirrors the training-loop surface of the reference's save/resume
+    snippets (examples/imagenet/main_amp.py:179-194 "resume from latest"):
+
+        mgr = CheckpointManager(dir, max_to_keep=3)
+        mgr.save(step, state)            # every save_interval steps
+        step = mgr.latest_step()         # None if fresh start
+        state = mgr.restore(step, state_template)
+    """
+
+    def __init__(self, directory, max_to_keep=5, save_interval_steps=1):
+        _require_orbax()
+        self._mgr = ocp.CheckpointManager(
+            os.path.abspath(os.fspath(directory)),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+                enable_async_checkpointing=False,
+            ),
+        )
+
+    def save(self, step, state):
+        return self._mgr.save(step, args=ocp.args.StandardSave(state))
+
+    def restore(self, step, template):
+        if any(isinstance(x, jax.Array)
+               for x in jax.tree_util.tree_leaves(template)):
+            template = abstract_like(template)
+        return self._mgr.restore(
+            step, args=ocp.args.StandardRestore(template))
+
+    def latest_step(self):
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return list(self._mgr.all_steps())
+
+    def close(self):
+        self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

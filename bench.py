@@ -167,9 +167,10 @@ def main():
     if os.path.exists(baseline_path):
         with open(baseline_path) as f:
             baselines = json.load(f)
-    if key not in baselines and not degraded and b >= 8:
+    if key not in baselines and not degraded and (not on_tpu or b >= 8):
         # never seed the recorded baseline from a degraded-relay run, nor
-        # from a sub-calibration batch the degraded detector can't judge
+        # from a sub-calibration TPU batch the degraded detector can't
+        # judge (the CPU smoke's fixed b=2 self-seeds as before)
         baselines[key] = tokens_per_sec
         with open(baseline_path, "w") as f:
             json.dump(baselines, f, indent=1)

@@ -1,0 +1,102 @@
+"""Sharded checkpoint/resume over the 8-device CPU mesh.
+
+Covers the three-part apex recipe (params + optimizer state + amp scaler
+state as one pytree), shard-preserving restore, resharding restore, and
+manager retention — the sharded capability the reference lacks (its only
+distributed-state path is gather-to-rank-0).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from apex_tpu import checkpoint as ckpt
+from apex_tpu.amp.scaler import LossScaler
+from apex_tpu.optimizers.fused_adam import fused_adam
+
+pytestmark = pytest.mark.skipif(not ckpt.HAVE_ORBAX,
+                                reason="orbax not installed")
+
+
+def _mesh():
+    return Mesh(np.asarray(jax.devices()).reshape(4, 2), ("dp", "tp"))
+
+
+def _sharded_state(mesh):
+    rs = np.random.RandomState(0)
+    params = {
+        "w": jax.device_put(jnp.asarray(rs.randn(16, 8), jnp.float32),
+                            NamedSharding(mesh, P("dp", "tp"))),
+        "b": jax.device_put(jnp.asarray(rs.randn(8), jnp.float32),
+                            NamedSharding(mesh, P("tp"))),
+    }
+    tx = fused_adam(learning_rate=1e-3)
+    opt_state = tx.init(params)
+    scaler_state = LossScaler().init()
+    return {"params": params, "opt": opt_state, "amp": scaler_state}
+
+
+def test_sharded_roundtrip_preserves_values_and_sharding(tmp_path):
+    mesh = _mesh()
+    state = _sharded_state(mesh)
+    ckpt.save_checkpoint(tmp_path / "step1", state)
+    restored = ckpt.restore_checkpoint(tmp_path / "step1", state)
+
+    for k in ("w", "b"):
+        np.testing.assert_array_equal(np.asarray(restored["params"][k]),
+                                      np.asarray(state["params"][k]))
+        assert restored["params"][k].sharding == state["params"][k].sharding
+    # optimizer + scaler state ride the same pytree
+    assert int(restored["amp"].unskipped) == int(state["amp"].unskipped)
+    assert float(restored["amp"].loss_scale) == float(state["amp"].loss_scale)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        restored["opt"], state["opt"])
+
+
+def test_restore_onto_different_sharding(tmp_path):
+    """A checkpoint written under one layout restores onto another —
+    e.g. resuming a dp-sharded run with tp sharding (the re-layout case
+    the reference's gather-based state_dict cannot express)."""
+    mesh = _mesh()
+    state = _sharded_state(mesh)
+    ckpt.save_checkpoint(tmp_path / "c", state)
+
+    new_shard = NamedSharding(mesh, P("tp", "dp"))
+    template = {
+        "params": {
+            "w": jax.ShapeDtypeStruct((16, 8), jnp.float32,
+                                      sharding=new_shard),
+            "b": jax.ShapeDtypeStruct((8,), jnp.float32,
+                                      sharding=NamedSharding(mesh, P())),
+        },
+        "opt": ckpt.abstract_like(state["opt"]),
+        "amp": ckpt.abstract_like(state["amp"]),
+    }
+    restored = ckpt.restore_checkpoint(tmp_path / "c", template)
+    np.testing.assert_array_equal(np.asarray(restored["params"]["w"]),
+                                  np.asarray(state["params"]["w"]))
+    assert restored["params"]["w"].sharding == new_shard
+    assert restored["params"]["b"].sharding.is_fully_replicated
+
+
+def test_manager_retention_and_resume(tmp_path):
+    mesh = _mesh()
+    state = _sharded_state(mesh)
+    with ckpt.CheckpointManager(tmp_path / "run", max_to_keep=2) as mgr:
+        assert mgr.latest_step() is None
+        for step in (1, 2, 3):
+            scaled = jax.tree_util.tree_map(
+                lambda x: (x * (1.0 + step)).astype(x.dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                state)
+            assert mgr.save(step, scaled)
+        assert mgr.latest_step() == 3
+        assert mgr.all_steps() == [2, 3]  # max_to_keep=2 dropped step 1
+        restored = mgr.restore(3, state)
+    np.testing.assert_allclose(np.asarray(restored["params"]["w"]),
+                               np.asarray(state["params"]["w"]) * 4.0)

@@ -96,12 +96,22 @@ def test_supported_predicate():
             jnp.zeros((1, 1, 7, SK)), None, 1.0, False, True)
 
 
-def test_fused_scale_mask_softmax_pallas_dispatch():
+def test_fused_scale_mask_softmax_pallas_dispatch(monkeypatch):
     """FusedScaleMaskSoftmax(use_pallas=) routes the fused path through the
-    kernel and matches the jnp fused path bit-for-bit shape/dtype-wise."""
+    kernel (spied — the test must not pass vacuously via the fallback) and
+    matches the jnp fused path."""
     from apex_tpu.transformer.enums import AttnMaskType
     from apex_tpu.transformer.functional.fused_softmax import (
         FusedScaleMaskSoftmax)
+
+    calls = []
+    real = softmax_pallas.scaled_masked_softmax
+
+    def spy(*args, **kwargs):
+        calls.append(1)
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(softmax_pallas, "scaled_masked_softmax", spy)
 
     def mask_func(x, m):
         return jnp.where(m, -10000.0, x)
@@ -111,19 +121,22 @@ def test_fused_scale_mask_softmax_pallas_dispatch():
     b, np_, sq = 4, 2, SK
     rs = np.random.RandomState(6)
     x = jnp.asarray(rs.randn(b, np_, sq, SK) * 2.0, jnp.bfloat16)
-    for fs_kwargs, mask in [
-        (dict(attn_mask_type=AttnMaskType.causal), None),
+    for fs_kwargs, mask, expect_kernel in [
+        (dict(attn_mask_type=AttnMaskType.causal), None, True),
         # causal + explicit mask: both paths must ignore the mask (the
         # reference's causal kernel takes none) — toggling use_pallas
         # must never change numerics
         (dict(attn_mask_type=AttnMaskType.causal),
-         jnp.asarray(np.random.RandomState(8).rand(b, 1, sq, SK) < 0.3)),
+         jnp.asarray(np.random.RandomState(8).rand(b, 1, sq, SK) < 0.3),
+         True),
         (dict(attn_mask_type=AttnMaskType.padding),
-         jnp.asarray(np.random.RandomState(7).rand(b, 1, sq, SK) < 0.3)),
+         jnp.asarray(np.random.RandomState(7).rand(b, 1, sq, SK) < 0.3),
+         True),
         # key-padding-shaped mask: unsupported by the kernel's BlockSpec
         # broadcast — must fall back to the jnp path, not crash
         (dict(attn_mask_type=AttnMaskType.padding),
-         jnp.asarray(np.random.RandomState(9).rand(b, 1, 1, SK) < 0.3)),
+         jnp.asarray(np.random.RandomState(9).rand(b, 1, 1, SK) < 0.3),
+         False),
     ]:
         fs_jnp = FusedScaleMaskSoftmax(
             input_in_fp16=False, input_in_bf16=True,
@@ -135,7 +148,10 @@ def test_fused_scale_mask_softmax_pallas_dispatch():
             softmax_in_fp32=True, scale=0.25, use_pallas=True,
             _pallas_interpret=True, **fs_kwargs)
         assert fs_jnp.is_kernel_available(mask, b, np_, sq, SK)
+        before = len(calls)
         got, want = fs_pl(x, mask), fs_jnp(x, mask)
+        assert (len(calls) > before) == expect_kernel, \
+            f"unexpected dispatch for {fs_kwargs}, mask={getattr(mask, 'shape', None)}"
         assert got.dtype == want.dtype == x.dtype
         np.testing.assert_allclose(np.asarray(got, np.float32),
                                    np.asarray(want, np.float32), atol=2e-2)
