@@ -7,6 +7,8 @@ produce identical loss — the substance of test_layers.py's parity asserts,
 composed through a whole model).
 """
 
+import dataclasses
+
 import numpy as np
 import pytest
 
@@ -150,6 +152,58 @@ def test_gpt_tp_invariance():
     assert np.isfinite(loss1)
     np.testing.assert_allclose(loss1, loss4, rtol=1e-4)
     np.testing.assert_allclose(g1, g4, rtol=5e-3, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_gpt_dropout_training_mode():
+    """Train-mode dropout (the flax "dropout" rng collection): finite loss
+    and grads, key-dependent stochasticity, and deterministic=True exactly
+    recovers the dropout-free numerics — the eval/train split the
+    reference gets from module.train()/eval()."""
+    cfg = TransformerConfig(
+        hidden_size=32, num_layers=1, num_attention_heads=2, vocab_size=64,
+        max_position_embeddings=16, hidden_dropout=0.3,
+        attention_dropout=0.3)
+    nodrop_cfg = dataclasses.replace(cfg, hidden_dropout=0.0,
+                                     attention_dropout=0.0)
+    mesh = tp_mesh(2)
+    rs = np.random.RandomState(5)
+    b, s = 2, 8
+    ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (b, s)))
+    pos = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+    labels = jnp.asarray(rs.randint(0, cfg.vocab_size, (b, s)))
+    model = GPTModel(cfg)
+    model_nodrop = GPTModel(nodrop_cfg)
+
+    def run(ids, pos, labels, seed):
+        params = model.init(jax.random.PRNGKey(0), ids, pos, None)["params"]
+
+        def loss_fn(p):
+            per_tok = model.apply(
+                {"params": p}, ids, pos, None, labels,
+                deterministic=False,
+                rngs={"dropout": jax.random.fold_in(
+                    jax.random.PRNGKey(7), seed)})
+            return jnp.mean(per_tok)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        eval_loss = jnp.mean(model.apply(
+            {"params": params}, ids, pos, None, labels))
+        nodrop_loss = jnp.mean(model_nodrop.apply(
+            {"params": params}, ids, pos, None, labels))
+        gleaf = grads["position_embeddings"]
+        return loss, eval_loss, nodrop_loss, gleaf
+
+    f = smap(run, mesh, (P(), P(), P(), P()), (P(), P(), P(), P()))
+    loss_a, eval_loss, nodrop_loss, g = f(ids, pos, labels,
+                                          jnp.int32(0))
+    loss_b, _, _, _ = f(ids, pos, labels, jnp.int32(1))
+    assert np.isfinite(float(loss_a)) and np.isfinite(float(loss_b))
+    assert float(loss_a) != float(loss_b), "dropout ignored the rng key"
+    assert np.all(np.isfinite(np.asarray(g)))
+    # deterministic(default) path == a dropout-free config, bitwise
+    np.testing.assert_array_equal(np.asarray(eval_loss),
+                                  np.asarray(nodrop_loss))
 
 
 def test_gpt_logits_shape_and_loss_positive():
