@@ -99,7 +99,7 @@ def _loss_and_metrics(logits, labels):
 
 
 def make_synthetic_loader(args, steps):
-    rs = np.random.RandomState(0)
+    rs = np.random.RandomState(0 if args.deterministic else None)
     h = args.image_size
 
     def gen():
@@ -240,8 +240,12 @@ def main(argv=None):
                              dtype=policy.compute_dtype)
     rs_img = jnp.zeros((2, args.image_size, args.image_size, 3))
 
+    # --deterministic: fixed init/data seeds -> bitwise-reproducible runs
+    # (the reference flag sets cudnn.deterministic + torch.manual_seed)
+    init_seed = 0 if args.deterministic else np.random.randint(2 ** 31)
+
     def init(x):
-        return model.init(jax.random.PRNGKey(0), x, train=False)
+        return model.init(jax.random.PRNGKey(init_seed), x, train=False)
 
     variables = jax.jit(shard_map(
         init, mesh=mesh, in_specs=(P(),), out_specs=P(),
