@@ -84,6 +84,58 @@ def test_restore_onto_different_sharding(tmp_path):
     assert restored["params"]["b"].sharding.is_fully_replicated
 
 
+def test_zero_sharded_optimizer_state_roundtrip(tmp_path):
+    """ZeRO-2 (DistributedFusedAdam) state — per-rank flat shards living
+    on a dp axis — checkpoints and resumes WITHOUT a gather: saved as a
+    P('dp')-sharded global array, restored onto the same sharding, and
+    training continues bitwise-identically to an uninterrupted run (the
+    capability the reference's gather-based state_dict lacks)."""
+    from apex_tpu.contrib.optimizers.distributed_fused_adam import (
+        DistAdamState, distributed_fused_adam)
+    from jax import shard_map
+
+    n = len(jax.devices())
+    mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+    rs = np.random.RandomState(1)
+    params = {"w": jnp.asarray(rs.randn(24, 4), jnp.float32),
+              "b": jnp.asarray(rs.randn(4), jnp.float32)}
+    grads = {"w": jnp.asarray(rs.randn(24, 4) * 0.1, jnp.float32),
+             "b": jnp.asarray(rs.randn(4) * 0.1, jnp.float32)}
+    tx = distributed_fused_adam(learning_rate=0.05, num_shards=n,
+                                axis_name="dp")
+
+    state_specs = DistAdamState(count=P(), m=P("dp"), v=P("dp"),
+                                master=P("dp"))
+
+    init = shard_map(lambda p: tx.init(p), mesh=mesh, in_specs=(P(),),
+                     out_specs=state_specs, check_vma=False)
+
+    def steps2(params, grads, state):
+        for _ in range(2):
+            updates, state = tx.update(grads, state, params)
+            params = jax.tree_util.tree_map(jnp.add, params, updates)
+        return params, state
+
+    step = shard_map(steps2, mesh=mesh,
+                     in_specs=(P(), P(), state_specs),
+                     out_specs=(P(), state_specs), check_vma=False)
+
+    s0 = init(params)
+    assert s0.m.shape[0] % n == 0 and s0.m.sharding.spec == P("dp")
+
+    p2, s2 = step(params, grads, s0)
+    ckpt.save_checkpoint(tmp_path / "zero", {"params": p2, "opt": s2})
+    p4_direct, _ = step(p2, grads, s2)
+
+    restored = ckpt.restore_checkpoint(tmp_path / "zero",
+                                       {"params": p2, "opt": s2})
+    assert restored["opt"].m.sharding == s2.m.sharding
+    p4_resumed, _ = step(restored["params"], grads, restored["opt"])
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(p4_direct[k]),
+                                      np.asarray(p4_resumed[k]))
+
+
 def test_manager_retention_and_resume(tmp_path):
     mesh = _mesh()
     state = _sharded_state(mesh)
