@@ -64,7 +64,6 @@ def __getattr__(name):
         "mlp",
         "RNN",
         "ops",
-        "utils",
         "checkpoint",
     ):
         return importlib.import_module(f"apex_tpu.{name}")

@@ -53,7 +53,8 @@ class EncdecMultiheadAttn(nn.Module):
 
         drop = nn.Dropout(rate=self.dropout)
         ctx = _attn_core(q, k, v, scaling, h, key_padding_mask, attn_mask,
-                         False, self.dropout, not is_training, drop)
+                         False, self.dropout, not is_training, drop,
+                         fast=self.impl == "fast")
         out = nn.DenseGeneral(e, use_bias=self.bias, name="out_proj",
                               param_dtype=self.param_dtype,
                               kernel_init=nn.initializers.xavier_uniform())(

@@ -7,9 +7,12 @@ over ``fast_multihead_attn`` (8,010 LoC CUDA).
 
 The CUDA "fast" path removes transposes/copies, fuses mask+softmax+dropout,
 and batches the GEMMs via cublasLt strided-batch; the "norm_add" variants
-prepend a fused LayerNorm and append the residual add. On TPU every one of
-those fusions is XLA's job — ``impl="fast"`` and ``impl="default"`` run the
-same program (the flag is kept so call sites port unchanged), and
+prepend a fused LayerNorm and append the residual add. On TPU the
+elementwise fusions are XLA's job; ``impl="fast"`` additionally routes the
+unmasked/no-dropout case through ``ops.fused_attention`` (one Pallas flash
+kernel on TPU — no materialized scores), while ``impl="default"`` always
+runs the unfused composition with materialized [b*h, sq, sk] scores
+(fp32-accumulated — the reference "default" autograd-function semantics).
 ``include_norm_add`` composes the same LN → attn → dropout → +residual
 chain the fused kernel hardcodes.
 
@@ -25,12 +28,27 @@ from jax import lax
 
 
 def _attn_core(q, k, v, scaling, heads, key_padding_mask, attn_mask,
-               mask_additive, dropout, deterministic, dropout_module):
+               mask_additive, dropout, deterministic, dropout_module,
+               fast=True):
     """Batched [b*h, s, d] attention with fp32-accumulated GEMMs and fp32
     softmax (the CUDA kernels' internal accumulation)."""
     sq, b, e = q.shape
     sk = k.shape[0]
     d = e // heads
+
+    if (fast and attn_mask is None and key_padding_mask is None
+            and (dropout == 0.0 or deterministic)):
+        # the genuinely fast path: flash attention (one Pallas kernel on
+        # TPU — no materialized [b*h, sq, sk] scores), the TPU analog of
+        # what fast_multihead_attn's fused CUDA path buys
+        from apex_tpu.ops import fused_attention
+
+        def to_bhsd(x):
+            return x.reshape(x.shape[0], b, heads, d).transpose(1, 2, 0, 3)
+
+        ctx = fused_attention(to_bhsd(q), to_bhsd(k), to_bhsd(v),
+                              sm_scale=scaling)
+        return ctx.transpose(2, 0, 1, 3).reshape(sq, b, e)
 
     def split_heads(x):
         # [s, b, e] → [b*h, s, d]
@@ -74,7 +92,8 @@ class SelfMultiheadAttn(nn.Module):
     dropout: float = 0.0
     bias: bool = False
     include_norm_add: bool = False
-    impl: str = "fast"  # parity flag; both impls are the same XLA program
+    impl: str = "fast"  # "fast": flash kernel for unmasked/no-dropout
+    # attention; "default": always the materialized-scores composition
     separate_qkv_params: bool = False
     mask_additive: bool = False
     param_dtype: Any = jnp.float32
@@ -109,7 +128,7 @@ class SelfMultiheadAttn(nn.Module):
         drop = nn.Dropout(rate=self.dropout)
         ctx = _attn_core(q, k, v, scaling, h, key_padding_mask, attn_mask,
                          self.mask_additive, self.dropout,
-                         not is_training, drop)
+                         not is_training, drop, fast=self.impl == "fast")
         out = nn.DenseGeneral(e, use_bias=self.bias, name="out_proj",
                               param_dtype=self.param_dtype,
                               kernel_init=nn.initializers.xavier_uniform())(

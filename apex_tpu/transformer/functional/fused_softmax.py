@@ -207,13 +207,19 @@ class GenericFusedScaleMaskSoftmax(FusedScaleMaskSoftmax):
     fused_softmax.py:240-264)."""
 
     def __init__(self, input_in_fp16, input_in_bf16, mask_func,
-                 softmax_in_fp32, scale):
+                 softmax_in_fp32, scale, use_pallas=False,
+                 _pallas_interpret=False):
         super().__init__(input_in_fp16, input_in_bf16, AttnMaskType.padding,
-                         True, mask_func, softmax_in_fp32, scale)
+                         True, mask_func, softmax_in_fp32, scale,
+                         use_pallas=use_pallas,
+                         _pallas_interpret=_pallas_interpret)
 
     def is_kernel_available(self, mask, b, np_, sq, sk):
         return self.scaled_masked_softmax_fusion and self.input_in_float16
 
     def forward_fused_softmax(self, input, mask):
+        if self.use_pallas:
+            # same kernel dispatch (and fallback rules) as the base class
+            return super().forward_fused_softmax(input, mask)
         scale = self.scale if self.scale is not None else 1.0
         return generic_scaled_masked_softmax(input, mask, scale)

@@ -61,6 +61,27 @@ def test_self_multihead_attn_matches_naive():
     np.testing.assert_allclose(np.asarray(out), want, atol=1e-4)
 
 
+def test_self_multihead_attn_fast_matches_default_with_grads():
+    """impl="fast" (flash route for the unmasked/no-dropout case) and
+    impl="default" (materialized scores) are the same math — values and
+    input grads must agree."""
+    rs = np.random.RandomState(2)
+    s, b, e, h = 8, 2, 16, 4
+    x = jnp.asarray(rs.randn(s, b, e), jnp.float32)
+    fast = SelfMultiheadAttn(embed_dim=e, num_heads=h, impl="fast")
+    slow = SelfMultiheadAttn(embed_dim=e, num_heads=h, impl="default")
+    variables = fast.init(jax.random.PRNGKey(0), x, x, x)
+
+    def loss(mod, x):
+        out, _ = mod.apply(variables, x, x, x, is_training=False)
+        return jnp.sum(out ** 2)
+
+    lf, gf = jax.value_and_grad(lambda x: loss(fast, x))(x)
+    ls, gs = jax.value_and_grad(lambda x: loss(slow, x))(x)
+    np.testing.assert_allclose(float(lf), float(ls), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gs), atol=1e-4)
+
+
 def test_self_multihead_attn_norm_add_residual():
     rs = np.random.RandomState(1)
     x = jnp.asarray(rs.randn(4, 2, 8), jnp.float32)

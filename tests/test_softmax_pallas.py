@@ -155,3 +155,21 @@ def test_fused_scale_mask_softmax_pallas_dispatch(monkeypatch):
         assert got.dtype == want.dtype == x.dtype
         np.testing.assert_allclose(np.asarray(got, np.float32),
                                    np.asarray(want, np.float32), atol=2e-2)
+
+    # the Generic (unbounded-seq) variant shares the kernel dispatch
+    from apex_tpu.transformer.functional.fused_softmax import (
+        GenericFusedScaleMaskSoftmax)
+
+    mask = jnp.asarray(np.random.RandomState(10).rand(b, 1, sq, SK) < 0.3)
+    gen_jnp = GenericFusedScaleMaskSoftmax(
+        input_in_fp16=False, input_in_bf16=True, mask_func=mask_func,
+        softmax_in_fp32=True, scale=0.25)
+    gen_pl = GenericFusedScaleMaskSoftmax(
+        input_in_fp16=False, input_in_bf16=True, mask_func=mask_func,
+        softmax_in_fp32=True, scale=0.25, use_pallas=True,
+        _pallas_interpret=True)
+    before = len(calls)
+    got, want = gen_pl(x, mask), gen_jnp(x, mask)
+    assert len(calls) > before
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32), atol=2e-2)
