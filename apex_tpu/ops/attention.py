@@ -68,6 +68,14 @@ def _block(n, cap):
     return b
 
 
+def flash_supported(sq, sk):
+    """Whether ``fused_attention`` will take the Pallas flash path for
+    these sequence lengths on the current backend (else the XLA-fused
+    dense path). Public so harnesses/labels stay truthful by
+    construction."""
+    return _tpu_available() and sq % 128 == 0 and sk % 128 == 0
+
+
 def fused_attention(q, k, v, *, causal=False, sm_scale=None,
                     segment_ids=None, force_dense=None):
     """Flash attention.
@@ -87,11 +95,7 @@ def fused_attention(q, k, v, *, causal=False, sm_scale=None,
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     sq, sk = q.shape[2], k.shape[2]
-    use_flash = (
-        _tpu_available()
-        and not force_dense
-        and sq % 128 == 0 and sk % 128 == 0
-    )
+    use_flash = flash_supported(sq, sk) and not force_dense
     if not use_flash:
         return _dense_attention(q, k, v, causal, sm_scale, segment_ids)
 

@@ -1,0 +1,19 @@
+"""Shared smoke-mode switch for the benchmark harnesses.
+
+Call :func:`smoke_mode` BEFORE any jax.numpy / backend-touching import:
+when the given env var is "1" it forces the CPU backend via
+``jax.config.update`` — the axon TPU plugin overrides the ``JAX_PLATFORMS``
+env var, so the config update is the only reliable switch (same rule as
+tests/conftest.py).
+"""
+
+import os
+
+import jax
+
+
+def smoke_mode(env_var):
+    on = os.environ.get(env_var) == "1"
+    if on:
+        jax.config.update("jax_platforms", "cpu")
+    return on

@@ -1,0 +1,129 @@
+"""DCGAN training-step throughput — BASELINE config 5.
+
+The amp multi-model / multi-optimizer / three-loss path (reference:
+examples/dcgan/main_amp.py with ``amp.initialize(num_losses=3)``) timed
+with the calibrated scan method (PERF.md §0): K full steps — D-real,
+D-fake and G backward passes, two Adam updates, three loss scalers —
+chained in one ``lax.scan`` dispatch; reports steps/s and images/s.
+
+Run on TPU: PYTHONPATH=/root/repo python benchmarks/profile_dcgan.py
+Smoke on CPU: APEX_DCGAN_SMOKE=1 python benchmarks/profile_dcgan.py
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+import jax
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+from benchmarks._smoke import smoke_mode  # noqa: E402
+
+SMOKE = smoke_mode("APEX_DCGAN_SMOKE")
+
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+from jax import lax  # noqa: E402
+
+from benchmarks._timing import measure_dispatch_overhead, sync  # noqa: E402
+
+from apex_tpu import amp  # noqa: E402
+from apex_tpu.models import Discriminator, Generator  # noqa: E402
+from examples.dcgan.main_amp import bce_logits  # noqa: E402
+
+K = 2 if SMOKE else 16
+# the DCGAN topology needs 64x64 images (4 stride-2 stages); smoke only
+# shrinks batch and filter counts
+BATCH, NZ, IMG = (2, 16, 64) if SMOKE else (128, 100, 64)
+NGF = NDF = 8 if SMOKE else 64
+
+OVERHEAD = measure_dispatch_overhead(K)
+print(f"dispatch overhead {OVERHEAD*1e3:.1f} ms")
+
+netG = Generator(nz=NZ, ngf=NGF)
+netD = Discriminator(ndf=NDF)
+key = jax.random.PRNGKey(0)
+rs = np.random.RandomState(0)
+z0 = jnp.asarray(rs.randn(BATCH, 1, 1, NZ), jnp.float32)
+x0 = jnp.asarray(rs.rand(BATCH, IMG, IMG, 3) * 2 - 1, jnp.float32)
+
+varsG = netG.init(key, z0, train=False)
+varsD = netD.init(key, x0, train=False)
+pG, sG = varsG["params"], varsG["batch_stats"]
+pD, sD = varsD["params"], varsD["batch_stats"]
+pG, optG = amp.initialize(pG, optax.adam(2e-4, b1=0.5), opt_level="O2",
+                          num_losses=3)
+pD, optD = amp.initialize(pD, optax.adam(2e-4, b1=0.5), opt_level="O2",
+                          num_losses=3)
+stG, stD = optG.init(pG), optD.init(pD)
+
+
+def one_step(pG, sG, stG, pD, sD, stD, real, z):
+    """The example's full step (examples/dcgan/main_amp.py:70-118)."""
+    def d_loss_real(p):
+        out, newv = netD.apply({"params": p, "batch_stats": sD}, real,
+                               train=True, mutable=["batch_stats"])
+        return bce_logits(out, 1.0), newv["batch_stats"]
+
+    f0 = amp.value_and_scaled_grad(d_loss_real, optD, loss_id=0,
+                                   has_aux=True)
+    (lossD_real, sD1), g0, inf0 = f0(pD, stD)
+
+    def d_loss_fake(p, fake):
+        out, newv = netD.apply({"params": p, "batch_stats": sD1}, fake,
+                               train=True, mutable=["batch_stats"])
+        return bce_logits(out, 0.0), newv["batch_stats"]
+
+    fake, newsG = netG.apply({"params": pG, "batch_stats": sG}, z,
+                             train=True, mutable=["batch_stats"])
+    newsG = newsG["batch_stats"]
+    f1 = amp.value_and_scaled_grad(
+        lambda p: d_loss_fake(p, jax.lax.stop_gradient(fake)), optD,
+        loss_id=1, has_aux=True)
+    (lossD_fake, sD2), g1, inf1 = f1(pD, stD)
+    gD = jax.tree_util.tree_map(jnp.add, g0, g1)
+    pD, stD, _ = optD.apply_gradients(
+        gD, stD, pD, loss_id=0, grads_already_unscaled=True,
+        found_inf=inf0 | inf1)
+
+    def g_loss(p):
+        fake, newv = netG.apply({"params": p, "batch_stats": newsG}, z,
+                                train=True, mutable=["batch_stats"])
+        out, _ = netD.apply({"params": pD, "batch_stats": sD2}, fake,
+                            train=True, mutable=["batch_stats"])
+        return bce_logits(out, 1.0), newv["batch_stats"]
+
+    f2 = amp.value_and_scaled_grad(g_loss, optG, loss_id=2, has_aux=True)
+    (lossG, sG2), gG, inf2 = f2(pG, stG)
+    pG, stG, _ = optG.apply_gradients(
+        gG, stG, pG, loss_id=2, grads_already_unscaled=True,
+        found_inf=inf2)
+    return pG, sG2, stG, pD, sD2, stD, lossD_real + lossD_fake + lossG
+
+
+def run(carry, eps, real, z):
+    def body(carry, _):
+        pG, sG, stG, pD, sD, stD = carry
+        pG, sG, stG, pD, sD, stD, loss = one_step(
+            pG, sG, stG, pD, sD, stD, real, z)
+        # traced-eps chaining (see benchmarks/_timing.py)
+        pG = jax.tree_util.tree_map(
+            lambda a: a + eps.astype(a.dtype) * loss.astype(a.dtype), pG)
+        return (pG, sG, stG, pD, sD, stD), loss
+
+    return lax.scan(body, carry, jnp.arange(K))
+
+
+f = jax.jit(run, donate_argnums=(0,))
+carry = (pG, sG, stG, pD, sD, stD)
+carry, losses = f(carry, jnp.float32(0.0), x0, z0)
+sync(losses)
+t0 = time.perf_counter()
+carry, losses = f(carry, jnp.float32(1e-30), x0, z0)
+sync(losses)
+dt = (time.perf_counter() - t0 - OVERHEAD) / K
+print(f"DCGAN full step (b={BATCH}, img={IMG}): {dt*1e3:.2f} ms  "
+      f"{1/dt:.1f} steps/s  {BATCH/dt:.0f} images/s  "
+      f"final loss {float(np.asarray(losses)[-1]):.3f}")

@@ -21,20 +21,19 @@ import time
 import numpy as np
 import jax
 
-SMOKE = os.environ.get("APEX_MHA_SMOKE") == "1"  # tiny CPU sanity mode
-if SMOKE:
-    # force the CPU backend BEFORE it initializes — the axon TPU plugin
-    # overrides JAX_PLATFORMS (same rule as tests/conftest.py)
-    jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+from benchmarks._smoke import smoke_mode  # noqa: E402
+
+SMOKE = smoke_mode("APEX_MHA_SMOKE")  # tiny CPU sanity mode
 
 import jax.numpy as jnp  # noqa: E402
 from jax import lax  # noqa: E402
 
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                ".."))
 from benchmarks._timing import measure_dispatch_overhead, sync  # noqa: E402
 
 from apex_tpu.contrib.multihead_attn import SelfMultiheadAttn
+from apex_tpu.ops.attention import flash_supported  # noqa: E402
 
 K = 2 if SMOKE else 16
 PEAK = 197e12  # v5e bf16
@@ -117,9 +116,9 @@ def run_case(name, seq, fwd_only, fast):
 
 
 for seq in SEQS:
-    # fused_attention's flash kernel needs seq % 128 == 0; say so instead
-    # of letting the s=64 row silently compare dense vs dense
-    flash = "" if seq % 128 == 0 else " [dense-fallback: s%128!=0]"
+    # say when the fast side cannot take the flash kernel (e.g. the
+    # reference's s=64 shape) instead of silently comparing dense vs dense
+    flash = "" if flash_supported(seq, seq) else " [dense-fallback]"
     for fwd_only in (True, False):
         kind = "fwd" if fwd_only else "fwd+bwd"
         fast = run_case(f"fast   {kind} s={seq}{flash}", seq, fwd_only, True)
