@@ -74,6 +74,20 @@ class AmpOptimizer:
         grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
         return grads, found_inf
 
+    def update_scaler(self, state, found_inf, loss_id=0):
+        """Advance ONE loss's dynamic-scale state without stepping.
+
+        The reference updates each loss's scaler on its own
+        ``scale_loss`` context exit (handle.py:118-154); when several
+        backward passes share one ``apply_gradients`` (which only
+        advances ``loss_id``'s scaler), the other losses' scalers must be
+        advanced with this — otherwise an overflowing loss can never back
+        its scale off."""
+        new_sstate = self.scaler.update(state.scalers[loss_id], found_inf)
+        scalers = tuple(new_sstate if i == loss_id else s
+                        for i, s in enumerate(state.scalers))
+        return state.replace(scalers=scalers)
+
     def apply_gradients(self, grads, state, params, loss_id=0,
                         grads_already_unscaled=False, found_inf=None):
         """One optimizer step with amp semantics.

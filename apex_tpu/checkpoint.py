@@ -108,10 +108,27 @@ class CheckpointManager:
     def save(self, step, state):
         return self._mgr.save(step, args=ocp.args.StandardSave(state))
 
-    def restore(self, step, template):
+    def restore(self, step, template, partial=False):
+        """``partial=True`` restores only the subtree named by
+        ``template`` (e.g. params-only from a {params, opt, amp}
+        checkpoint — the ``--no-load-optim`` case). Orbax pins one
+        handler type per manager instance, so a partial restore must use
+        a manager that has not saved in this process (a real resume
+        naturally does)."""
         if any(isinstance(x, jax.Array)
                for x in jax.tree_util.tree_leaves(template)):
             template = abstract_like(template)
+        if partial:
+            # PyTreeRestore ignores ShapeDtypeStruct shardings unless they
+            # arrive as explicit restore_args (StandardRestore honors them
+            # directly) — without this, arrays come back with the SAVED
+            # topology's shardings, breaking cross-topology resume
+            restore_args = ocp.checkpoint_utils.construct_restore_args(
+                template)
+            return self._mgr.restore(
+                step, args=ocp.args.PyTreeRestore(
+                    template, restore_args=restore_args,
+                    partial_restore=True))
         return self._mgr.restore(
             step, args=ocp.args.StandardRestore(template))
 

@@ -170,6 +170,69 @@ def main(argv=None):
     opt_state = jax.jit(lambda p: tx.init(p))(params)
     scaler_state = scaler.init()
 
+    # --- checkpoint/resume (reference checkpointing args :646-669) ---
+    start_iter = 0
+    if args.load:
+        from apex_tpu import checkpoint as ckpt_mod
+        from jax.sharding import NamedSharding
+
+        # everything in this (dp, tp) entry is replicated outside
+        # shard_map — restore directly onto the replicated mesh sharding
+        # (a plain concrete template would inherit whatever mix of
+        # committed devices each state happened to be created on)
+        repl = NamedSharding(mesh, P())
+
+        restored = None
+        with ckpt_mod.CheckpointManager(args.load) as lm:
+            step0 = lm.latest_step()
+            if step0 is not None and not args.no_load_optim:
+                tmpl = {"params": ckpt_mod.abstract_like(params, repl),
+                        "opt": ckpt_mod.abstract_like(opt_state, repl),
+                        "scaler": ckpt_mod.abstract_like(scaler_state,
+                                                         repl)}
+                try:
+                    restored = lm.restore(step0, tmpl)
+                except ValueError:
+                    # checkpoint written with --no-save-optim: fall back
+                    # to params-only (megatron's warn-and-continue
+                    # posture for missing optimizer state)
+                    if args.rank == 0:
+                        print("checkpoint has no optimizer state (saved "
+                              "with --no-save-optim?); loading params "
+                              "only", flush=True)
+        if step0 is not None:
+            if restored is not None:
+                params = restored["params"]
+                opt_state = restored["opt"]
+                scaler_state = restored["scaler"]
+            else:
+                # params-only path: a FRESH manager — orbax pins one
+                # handler type per manager instance
+                with ckpt_mod.CheckpointManager(args.load) as lm:
+                    params = lm.restore(
+                        step0,
+                        {"params": ckpt_mod.abstract_like(params, repl)},
+                        partial=True)["params"]
+            if not args.finetune:
+                start_iter = step0
+            if args.rank == 0:
+                print(f"loaded checkpoint {args.load} @ iter {step0}"
+                      f"{' (finetune: iter reset)' if args.finetune else ''}",
+                      flush=True)
+
+    save_mgr = None
+    if args.save:
+        from apex_tpu import checkpoint as ckpt_mod
+
+        save_mgr = ckpt_mod.CheckpointManager(args.save)
+
+    def save_state(step):
+        if save_mgr is None:
+            return
+        state = {"params": params} if args.no_save_optim else {
+            "params": params, "opt": opt_state, "scaler": scaler_state}
+        save_mgr.save(step, state)
+
     log_n = max(1, min(args.log_interval, args.train_iters))
     run_chunk = chunk_fn(log_n)
 
@@ -178,7 +241,8 @@ def main(argv=None):
               f"mesh dp={dp} tp={tp} | mbs {b_local} seq {s} | "
               f"opt {args.optimizer}", flush=True)
 
-    done = 0
+    done = start_iter
+    first_chunk = True
     last_loss = float("nan")
     tokens_per_sec = 0.0
     compile_and_run = None
@@ -189,8 +253,12 @@ def main(argv=None):
         # 1-element fetch = device sync (axon block_until_ready caveat)
         last_loss = float(np.asarray(losses[-1]))
         done += log_n
+        if (args.save_interval
+                and done % args.save_interval < log_n):
+            save_state(done)
         elapsed = timers("interval-time").elapsed()
-        if done == log_n:
+        if first_chunk:
+            first_chunk = False
             # first chunk includes compile; don't count it in throughput
             compile_and_run = elapsed
             if args.rank == 0:
@@ -210,6 +278,12 @@ def main(argv=None):
         if args.rank == 0:
             print(f" tokens/s {tokens_per_sec:,.0f} "
                   "(single chunk, INCLUDES compile)", flush=True)
+
+    if save_mgr is not None:
+        # truthiness guard: --save-interval 0 means "final save only"
+        if not args.save_interval or done % args.save_interval != 0:
+            save_state(done)  # final state (unless just saved)
+        save_mgr.close()
 
     global_vars.destroy_global_vars()
     from apex_tpu.transformer.pipeline_parallel.utils import (

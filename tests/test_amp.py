@@ -226,6 +226,20 @@ def test_multi_loss_scalers():
     assert float(state.scalers[0].loss_scale) == 2.0 ** 16  # untouched
 
 
+def test_update_scaler_advances_one_loss():
+    """update_scaler: the shared-apply multi-loss pattern (DCGAN D step)
+    — each loss's scale advances from its own overflow flag."""
+    params = {"w": jnp.full((4,), 3.0, jnp.float32)}
+    params, opt = amp.initialize(params, optax.sgd(0.1), opt_level="O2",
+                                 num_losses=2, verbosity=0)
+    state = opt.init(params)
+    state = opt.update_scaler(state, jnp.bool_(True), loss_id=1)
+    assert float(state.scalers[1].loss_scale) == 2.0 ** 15  # backed off
+    assert float(state.scalers[0].loss_scale) == 2.0 ** 16  # untouched
+    state = opt.update_scaler(state, jnp.bool_(False), loss_id=0)
+    assert int(state.scalers[0].unskipped) == 1
+
+
 def test_amp_state_dict_roundtrip():
     params = {"w": jnp.ones((2,), jnp.float32)}
     params, opt = amp.initialize(params, optax.sgd(0.1), opt_level="O2",
