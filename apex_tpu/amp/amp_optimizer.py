@@ -89,13 +89,20 @@ class AmpOptimizer:
         return state.replace(scalers=scalers)
 
     def apply_gradients(self, grads, state, params, loss_id=0,
-                        grads_already_unscaled=False, found_inf=None):
+                        grads_already_unscaled=False, found_inf=None,
+                        scaler_found_inf=None):
         """One optimizer step with amp semantics.
 
         Args:
           grads: gradient pytree wrt the *scaled* loss (unless
             ``grads_already_unscaled``).
           state: AmpOptState. params: current (model-dtype) params.
+          found_inf: the skip-step predicate (may OR several losses'
+            flags when their backward passes share this step).
+          scaler_found_inf: the flag that advances ``loss_id``'s dynamic
+            scale — defaults to ``found_inf``; pass the loss's OWN
+            overflow flag when ``found_inf`` is a combined one, so
+            another loss's overflow never backs this loss's scale off.
         Returns (new_params, new_state, info dict with 'overflow' and
         'loss_scale').
         """
@@ -108,7 +115,9 @@ class AmpOptimizer:
             fp32_grads, found_inf = self.scaler.unscale(grads, sstate)
             fp32_grads = jax.tree_util.tree_map(
                 lambda g: g.astype(jnp.float32), fp32_grads)
-        new_sstate = self.scaler.update(sstate, found_inf)
+        new_sstate = self.scaler.update(
+            sstate,
+            found_inf if scaler_found_inf is None else scaler_found_inf)
 
         opt_params = state.master_params if self.master_weights else params
         updates, new_inner = self.tx.update(fp32_grads, state.inner, opt_params)

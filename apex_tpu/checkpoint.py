@@ -105,8 +105,11 @@ class CheckpointManager:
             ),
         )
 
-    def save(self, step, state):
-        return self._mgr.save(step, args=ocp.args.StandardSave(state))
+    def save(self, step, state, force=False):
+        """``force=True`` bypasses the ``save_interval_steps`` throttle
+        (e.g. the final state of a run)."""
+        return self._mgr.save(step, args=ocp.args.StandardSave(state),
+                              force=force)
 
     def restore(self, step, template, partial=False):
         """``partial=True`` restores only the subtree named by
@@ -134,6 +137,16 @@ class CheckpointManager:
 
     def latest_step(self):
         return self._mgr.latest_step()
+
+    def tree_keys(self, step):
+        """Top-level keys of the pytree saved at ``step`` — lets a loader
+        distinguish a params-only checkpoint (saved with no_save_optim)
+        from a full {params, opt, amp} one before building the restore
+        template."""
+        path = os.path.join(self._mgr.directory, str(step), "default")
+        with ocp.StandardCheckpointer() as ckptr:
+            md = ckptr.metadata(path)
+        return sorted(md.item_metadata.tree.keys())
 
     def all_steps(self):
         return list(self._mgr.all_steps())

@@ -84,11 +84,11 @@ def one_step(pG, sG, stG, pD, sD, stD, real, z):
         loss_id=1, has_aux=True)
     (lossD_fake, sD2), g1, inf1 = f1(pD, stD)
     gD = jax.tree_util.tree_map(jnp.add, g0, g1)
-    # advance loss 1's scaler from its own overflow flag (see the example)
+    # per-loss scaler discipline under a shared step (see the example)
     stD = optD.update_scaler(stD, inf1, loss_id=1)
     pD, stD, _ = optD.apply_gradients(
         gD, stD, pD, loss_id=0, grads_already_unscaled=True,
-        found_inf=inf0 | inf1)
+        found_inf=inf0 | inf1, scaler_found_inf=inf0)
 
     def g_loss(p):
         fake, newv = netG.apply({"params": p, "batch_stats": newsG}, z,

@@ -94,13 +94,13 @@ def main(argv=None):
             loss_id=1, has_aux=True)
         (lossD_fake, sD2), g1, inf1 = f1(pD, stD)
         gD = jax.tree_util.tree_map(jnp.add, g0, g1)
-        # loss 1's dynamic scale must advance from its own overflow flag
-        # (apply_gradients below only advances loss 0's) — else a
-        # D-fake overflow could never back its scale off
+        # per-loss scaler discipline under a shared step: the skip
+        # predicate ORs both flags, but each loss's dynamic scale
+        # advances from its OWN overflow only
         stD = optD.update_scaler(stD, inf1, loss_id=1)
         pD, stD, _ = optD.apply_gradients(
             gD, stD, pD, loss_id=0, grads_already_unscaled=True,
-            found_inf=inf0 | inf1)
+            found_inf=inf0 | inf1, scaler_found_inf=inf0)
 
         # --- G step (loss_id 2): non-saturating loss through D; G stats
         # continue from the D-step forward (newsG), as in the reference ---

@@ -182,37 +182,37 @@ def main(argv=None):
         # committed devices each state happened to be created on)
         repl = NamedSharding(mesh, P())
 
-        restored = None
         with ckpt_mod.CheckpointManager(args.load) as lm:
             step0 = lm.latest_step()
-            if step0 is not None and not args.no_load_optim:
+            full = (step0 is not None and not args.no_load_optim
+                    and "opt" in lm.tree_keys(step0))
+            if step0 is not None and full:
                 tmpl = {"params": ckpt_mod.abstract_like(params, repl),
                         "opt": ckpt_mod.abstract_like(opt_state, repl),
                         "scaler": ckpt_mod.abstract_like(scaler_state,
                                                          repl)}
-                try:
-                    restored = lm.restore(step0, tmpl)
-                except ValueError:
-                    # checkpoint written with --no-save-optim: fall back
-                    # to params-only (megatron's warn-and-continue
-                    # posture for missing optimizer state)
-                    if args.rank == 0:
-                        print("checkpoint has no optimizer state (saved "
-                              "with --no-save-optim?); loading params "
-                              "only", flush=True)
-        if step0 is not None:
-            if restored is not None:
+                restored = lm.restore(step0, tmpl)
                 params = restored["params"]
                 opt_state = restored["opt"]
                 scaler_state = restored["scaler"]
-            else:
-                # params-only path: a FRESH manager — orbax pins one
-                # handler type per manager instance
-                with ckpt_mod.CheckpointManager(args.load) as lm:
-                    params = lm.restore(
-                        step0,
-                        {"params": ckpt_mod.abstract_like(params, repl)},
-                        partial=True)["params"]
+            elif step0 is not None:
+                # params-only: checkpoint was written with
+                # --no-save-optim, or --no-load-optim was passed
+                # (megatron's warn-and-continue posture)
+                if args.rank == 0 and not args.no_load_optim:
+                    print("checkpoint has no optimizer state (saved with "
+                          "--no-save-optim); loading params only",
+                          flush=True)
+                params = lm.restore(
+                    step0,
+                    {"params": ckpt_mod.abstract_like(params, repl)},
+                    partial=True)["params"]
+        if step0 is None:
+            # the Megatron posture: warn loudly, start from scratch
+            if args.rank == 0:
+                print(f"WARNING: no checkpoint found in {args.load}; "
+                      "training from random initialization", flush=True)
+        else:
             if not args.finetune:
                 start_iter = step0
             if args.rank == 0:
@@ -224,14 +224,18 @@ def main(argv=None):
     if args.save:
         from apex_tpu import checkpoint as ckpt_mod
 
-        save_mgr = ckpt_mod.CheckpointManager(args.save)
+        # orbax owns the every-N throttle; --save-interval 0 (or a huge
+        # interval) means final-save only (the force=True at the end)
+        save_mgr = ckpt_mod.CheckpointManager(
+            args.save,
+            save_interval_steps=args.save_interval or 10 ** 9)
 
-    def save_state(step):
+    def save_state(step, force=False):
         if save_mgr is None:
             return
         state = {"params": params} if args.no_save_optim else {
             "params": params, "opt": opt_state, "scaler": scaler_state}
-        save_mgr.save(step, state)
+        save_mgr.save(step, state, force=force)
 
     log_n = max(1, min(args.log_interval, args.train_iters))
     run_chunk = chunk_fn(log_n)
@@ -242,6 +246,10 @@ def main(argv=None):
               f"opt {args.optimizer}", flush=True)
 
     done = start_iter
+    if done >= args.train_iters and args.rank == 0:
+        print(f"checkpoint iter {done} >= --train-iters "
+              f"{args.train_iters}: nothing left to train (pass "
+              "--finetune to reset the iteration count)", flush=True)
     first_chunk = True
     last_loss = float("nan")
     tokens_per_sec = 0.0
@@ -253,9 +261,7 @@ def main(argv=None):
         # 1-element fetch = device sync (axon block_until_ready caveat)
         last_loss = float(np.asarray(losses[-1]))
         done += log_n
-        if (args.save_interval
-                and done % args.save_interval < log_n):
-            save_state(done)
+        save_state(done)  # orbax's save_interval_steps throttles
         elapsed = timers("interval-time").elapsed()
         if first_chunk:
             first_chunk = False
@@ -280,9 +286,8 @@ def main(argv=None):
                   "(single chunk, INCLUDES compile)", flush=True)
 
     if save_mgr is not None:
-        # truthiness guard: --save-interval 0 means "final save only"
-        if not args.save_interval or done % args.save_interval != 0:
-            save_state(done)  # final state (unless just saved)
+        if save_mgr.latest_step() != done:
+            save_state(done, force=True)  # final state (unless just saved)
         save_mgr.close()
 
     global_vars.destroy_global_vars()

@@ -100,6 +100,52 @@ def test_ddp_grad_math_check():
         np.testing.assert_allclose(out[i], want, rtol=1e-6)
 
 
+def test_amp_o2_master_params_identical_across_ranks():
+    """Port of tests/distributed/amp_master_params/: after DDP-averaged
+    O2 training steps on rank-DIFFERENT data, the fp32 master params (and
+    the bf16 model params) must be bitwise identical on every rank."""
+    from apex_tpu import amp
+    from apex_tpu.optimizers import fused_sgd
+
+    mesh = _mesh()
+    rs = np.random.RandomState(0)
+    params = {"w": jnp.asarray(rs.randn(4, 2), jnp.float32)}
+    params, opt = amp.initialize(params, fused_sgd(learning_rate=0.1),
+                                 opt_level="O2", verbosity=0)
+    state = opt.init(params)
+    xs = jnp.asarray(rs.randn(NDEV, 3, 4), jnp.float32)  # per-rank data
+
+    def steps(params, state, x):
+        params = pvary(params, "data")
+        state = pvary(state, "data")
+        for _ in range(3):
+            def loss_fn(p):
+                return jnp.sum((x.astype(p["w"].dtype) @ p["w"])
+                               .astype(jnp.float32) ** 2)
+
+            f = amp.value_and_scaled_grad(loss_fn, opt)
+            _, grads, found_inf = f(params, state)
+            grads = allreduce_gradients(grads, "data")
+            params, state, _ = opt.apply_gradients(
+                grads, state, params, grads_already_unscaled=True,
+                found_inf=found_inf)
+        # leading rank axis so out_specs=P("data") stacks all ranks
+        return (params["w"][None], state.master_params["w"][None])
+
+    f = shard_map(steps, mesh=mesh, in_specs=(P(), P(), P("data")),
+                  out_specs=(P("data"), P("data")), check_vma=False)
+    model_w, master_w = f(params, state, xs)
+    model_w, master_w = np.asarray(model_w), np.asarray(master_w)
+    assert master_w.dtype == np.float32
+    assert model_w.dtype == jnp.bfloat16
+    for r in range(1, NDEV):
+        np.testing.assert_array_equal(master_w[r], master_w[0])
+        np.testing.assert_array_equal(model_w[r], model_w[0])
+    # and training actually moved them
+    assert not np.array_equal(master_w[0],
+                              np.asarray(state.master_params["w"]))
+
+
 # ------------------------------ SyncBatchNorm ------------------------------
 
 def test_syncbn_matches_full_batch_bn():
