@@ -142,11 +142,17 @@ class CheckpointManager:
         """Top-level keys of the pytree saved at ``step`` — lets a loader
         distinguish a params-only checkpoint (saved with no_save_optim)
         from a full {params, opt, amp} one before building the restore
-        template."""
+        template. Returns None when the metadata is missing or unreadable
+        (callers fall back to attempting the restore); assumes the
+        default step layout (no ``step_prefix``/name formats, which this
+        wrapper never sets)."""
         path = os.path.join(self._mgr.directory, str(step), "default")
-        with ocp.StandardCheckpointer() as ckptr:
-            md = ckptr.metadata(path)
-        return sorted(md.item_metadata.tree.keys())
+        try:
+            with ocp.StandardCheckpointer() as ckptr:
+                md = ckptr.metadata(path)
+            return sorted(md.item_metadata.tree.keys())
+        except Exception:
+            return None
 
     def all_steps(self):
         return list(self._mgr.all_steps())

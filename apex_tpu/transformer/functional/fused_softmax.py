@@ -147,13 +147,16 @@ class FusedScaleMaskSoftmax:
                 "causal mask is only for self attention"
         if self.use_pallas:
             from apex_tpu.ops import softmax_pallas
+            from apex_tpu.ops.attention import _tpu_available
             # the fused causal path ignores an explicit mask (the
             # reference's scaled_upper_triang kernel takes none) — pass
             # None so toggling use_pallas never changes numerics
             m = None if causal or mask is None else mask.astype(bool)
-            if softmax_pallas.supported(input.shape[-2], input.shape[-1]) \
+            if ((self._pallas_interpret or _tpu_available())
+                    and softmax_pallas.supported(input.shape[-2],
+                                                 input.shape[-1])
                     and (m is None
-                         or softmax_pallas.mask_supported(m, input.shape)):
+                         or softmax_pallas.mask_supported(m, input.shape))):
                 return softmax_pallas.scaled_masked_softmax(
                     input, m, scale, causal=causal,
                     interpret=self._pallas_interpret)

@@ -70,6 +70,9 @@ class TransformerConfig:
     apply_query_key_layer_scaling: bool = True
     attention_softmax_in_fp32: bool = False
     masked_softmax_fusion: bool = True
+    # route the fused scale-mask-softmax (non-flash scores path) through
+    # the Pallas kernel (ops/softmax_pallas.py) instead of the jnp path
+    softmax_use_pallas: bool = False
     sequence_parallel: bool = False
     # context parallelism: mesh axis the SEQUENCE dim is sharded over for
     # the whole model (hidden states are [s/cp, b, h]); attention runs the
@@ -348,7 +351,7 @@ class ParallelAttention(nn.Module):
         scale_mask_softmax = FusedScaleMaskSoftmax(
             cfg.fp16, cfg.bf16, self.attn_mask_type,
             cfg.masked_softmax_fusion, attention_mask_func,
-            softmax_in_fp32, coeff)
+            softmax_in_fp32, coeff, use_pallas=cfg.softmax_use_pallas)
         probs = scale_mask_softmax(scores, attention_mask)
 
         probs = nn.Dropout(rate=cfg.attention_dropout)(

@@ -184,8 +184,11 @@ def main(argv=None):
 
         with ckpt_mod.CheckpointManager(args.load) as lm:
             step0 = lm.latest_step()
+            # keys None = metadata unreadable → optimistically try the
+            # full restore (a failure there surfaces, as it should)
+            keys = lm.tree_keys(step0) if step0 is not None else None
             full = (step0 is not None and not args.no_load_optim
-                    and "opt" in lm.tree_keys(step0))
+                    and (keys is None or "opt" in keys))
             if step0 is not None and full:
                 tmpl = {"params": ckpt_mod.abstract_like(params, repl),
                         "opt": ckpt_mod.abstract_like(opt_state, repl),
@@ -224,18 +227,19 @@ def main(argv=None):
     if args.save:
         from apex_tpu import checkpoint as ckpt_mod
 
-        # orbax owns the every-N throttle; --save-interval 0 (or a huge
-        # interval) means final-save only (the force=True at the end)
-        save_mgr = ckpt_mod.CheckpointManager(
-            args.save,
-            save_interval_steps=args.save_interval or 10 ** 9)
+        save_mgr = ckpt_mod.CheckpointManager(args.save)
 
-    def save_state(step, force=False):
-        if save_mgr is None:
+    def save_state(step):
+        # orbax's FixedIntervalPolicy saves only at step % N == 0, which
+        # a chunked step grid (done = start + k*log_n) can miss forever —
+        # the interval-crossing check below throttles instead, so the
+        # manager itself is un-throttled; skip steps that already exist
+        # (e.g. rerunning into a dir left by a longer previous run)
+        if save_mgr is None or step in save_mgr.all_steps():
             return
         state = {"params": params} if args.no_save_optim else {
             "params": params, "opt": opt_state, "scaler": scaler_state}
-        save_mgr.save(step, state, force=force)
+        save_mgr.save(step, state)
 
     log_n = max(1, min(args.log_interval, args.train_iters))
     run_chunk = chunk_fn(log_n)
@@ -261,7 +265,10 @@ def main(argv=None):
         # 1-element fetch = device sync (axon block_until_ready caveat)
         last_loss = float(np.asarray(losses[-1]))
         done += log_n
-        save_state(done)  # orbax's save_interval_steps throttles
+        # save when a multiple of save_interval falls inside this chunk
+        # (correct on any chunk grid, aligned or not)
+        if args.save_interval and done % args.save_interval < log_n:
+            save_state(done)
         elapsed = timers("interval-time").elapsed()
         if first_chunk:
             first_chunk = False
@@ -286,8 +293,7 @@ def main(argv=None):
                   "(single chunk, INCLUDES compile)", flush=True)
 
     if save_mgr is not None:
-        if save_mgr.latest_step() != done:
-            save_state(done, force=True)  # final state (unless just saved)
+        save_state(done)  # final state (no-op if that step exists)
         save_mgr.close()
 
     global_vars.destroy_global_vars()

@@ -84,6 +84,27 @@ def test_restore_onto_different_sharding(tmp_path):
     assert restored["params"]["b"].sharding.is_fully_replicated
 
 
+def test_manager_tree_keys_and_force_save(tmp_path):
+    """tree_keys reads the saved pytree's top-level keys (None for a
+    missing step); save(force=True) bypasses the interval throttle."""
+    mesh = _mesh()
+    state = _sharded_state(mesh)
+    with ckpt.CheckpointManager(tmp_path / "k",
+                                save_interval_steps=100) as mgr:
+        assert mgr.save(1, state)          # InitialSavePolicy: first save
+        assert not mgr.save(2, state)      # throttled (interval 100)
+        assert mgr.save(2, state, force=True)
+        assert mgr.all_steps() == [1, 2]
+    with ckpt.CheckpointManager(tmp_path / "k") as mgr:
+        assert mgr.tree_keys(1) == ["amp", "opt", "params"]
+        assert mgr.tree_keys(99) is None   # missing step → None
+    # params-only checkpoint advertises only its params
+    with ckpt.CheckpointManager(tmp_path / "slim") as mgr:
+        mgr.save(1, {"params": state["params"]})
+    with ckpt.CheckpointManager(tmp_path / "slim") as mgr:
+        assert mgr.tree_keys(1) == ["params"]
+
+
 def test_manager_partial_restore(tmp_path):
     """partial=True restores a named subtree (params-only from a full
     {params, opt, amp} checkpoint — the --no-load-optim case)."""

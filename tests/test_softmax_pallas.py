@@ -156,6 +156,19 @@ def test_fused_scale_mask_softmax_pallas_dispatch(monkeypatch):
         np.testing.assert_allclose(np.asarray(got, np.float32),
                                    np.asarray(want, np.float32), atol=2e-2)
 
+    # use_pallas WITHOUT interpret on a non-TPU backend must silently
+    # fall back to the jnp path (the cfg.softmax_use_pallas knob set on
+    # a CPU run), never crash in pallas_call
+    fs_cpu = FusedScaleMaskSoftmax(
+        input_in_fp16=False, input_in_bf16=True,
+        attn_mask_type=AttnMaskType.causal,
+        scaled_masked_softmax_fusion=True, mask_func=mask_func,
+        softmax_in_fp32=True, scale=0.25, use_pallas=True)
+    before = len(calls)
+    got = fs_cpu(x, None)
+    assert len(calls) == before, "kernel must not run on CPU w/o interpret"
+    assert got.shape == x.shape
+
     # the Generic (unbounded-seq) variant shares the kernel dispatch
     from apex_tpu.transformer.functional.fused_softmax import (
         GenericFusedScaleMaskSoftmax)
