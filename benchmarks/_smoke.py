@@ -13,7 +13,10 @@ import jax
 
 
 def smoke_mode(env_var):
-    on = os.environ.get(env_var) == "1"
+    """True when ``env_var`` (or the generic ``APEX_BENCH_SMOKE``) is
+    "1"; also forces the CPU backend in that case."""
+    on = (os.environ.get(env_var) == "1"
+          or os.environ.get("APEX_BENCH_SMOKE") == "1")
     if on:
         jax.config.update("jax_platforms", "cpu")
     return on

@@ -28,6 +28,10 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
+from benchmarks._smoke import smoke_mode  # noqa: E402
+
+SMOKE = smoke_mode("APEX_BENCH_SMOKE")  # force-CPU tiny sanity mode
+
 from benchmarks._timing import measure_dispatch_overhead, sync  # noqa: E402
 
 from apex_tpu.amp.scaler import LossScaler
@@ -35,13 +39,16 @@ from apex_tpu.optimizers.fused_adam import fused_adam
 from apex_tpu.transformer.parallel_state import TENSOR_AXIS
 from apex_tpu.transformer.testing import GPTModel, TransformerConfig
 
-B, S = 8, 1024
-K = 32  # scan length
+B, S = (2, 128) if SMOKE else (8, 1024)
+K = 2 if SMOKE else 32  # scan length
 PEAK = 197e12  # v5e bf16 peak FLOP/s
 
 cfg = TransformerConfig(
-    hidden_size=768, num_layers=12, num_attention_heads=12,
-    vocab_size=50304, max_position_embeddings=1024,
+    hidden_size=128 if SMOKE else 768,
+    num_layers=2 if SMOKE else 12,
+    num_attention_heads=4 if SMOKE else 12,
+    vocab_size=512 if SMOKE else 50304,
+    max_position_embeddings=S,
     hidden_dropout=0.0, attention_dropout=0.0, bf16=True)
 model = GPTModel(cfg)
 mesh = Mesh(np.asarray(jax.devices()[:1]), (TENSOR_AXIS,))

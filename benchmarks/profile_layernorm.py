@@ -25,17 +25,21 @@ from jax import lax
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
+from benchmarks._smoke import smoke_mode  # noqa: E402
+
+SMOKE = smoke_mode("APEX_BENCH_SMOKE")  # force-CPU tiny sanity mode
+
 from benchmarks._timing import measure_dispatch_overhead, sync  # noqa: E402
 
 from apex_tpu.normalization.fused_layer_norm import fused_layer_norm
 
-K = 32
+K = 2 if SMOKE else 32
 HBM = 819e9  # v5e
 
 OVERHEAD = measure_dispatch_overhead(K)
 print(f"dispatch overhead {OVERHEAD*1e3:.1f} ms; HBM roofline {HBM/1e9:.0f} GB/s")
 
-ROWS = 8 * 1024  # GPT-2-small b*s
+ROWS = 256 if SMOKE else 8 * 1024  # GPT-2-small b*s
 
 
 def run_case(hidden):
@@ -77,5 +81,5 @@ def run_case(hidden):
     return dt
 
 
-for h in (768, 1024, 4096, 8192, 12288):
+for h in ((256,) if SMOKE else (768, 1024, 4096, 8192, 12288)):
     run_case(h)

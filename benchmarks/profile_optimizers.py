@@ -24,13 +24,18 @@ from jax import lax
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
+from benchmarks._smoke import smoke_mode  # noqa: E402
+
+SMOKE = smoke_mode("APEX_BENCH_SMOKE")  # force-CPU tiny sanity mode
+
 from benchmarks._timing import measure_dispatch_overhead, sync  # noqa: E402
 
 from apex_tpu.optimizers.fused_adam import fused_adam  # noqa: E402
 from apex_tpu.optimizers.fused_lamb import fused_lamb  # noqa: E402
 from apex_tpu.optimizers.fused_sgd import fused_sgd  # noqa: E402
 
-ON_TPU = jax.devices()[0].platform == "tpu"
+# SMOKE forces the CPU backend, so it implies the tiny branches
+ON_TPU = not SMOKE and jax.devices()[0].platform == "tpu"
 K = 32 if ON_TPU else 2
 HBM = 819e9  # v5e
 

@@ -21,13 +21,18 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
+from benchmarks._smoke import smoke_mode  # noqa: E402
+
+SMOKE = smoke_mode("APEX_BENCH_SMOKE")  # force-CPU tiny sanity mode
+
 from benchmarks._timing import measure_dispatch_overhead, sync  # noqa: E402
 
 from apex_tpu import amp  # noqa: E402
 from apex_tpu.models import resnet50  # noqa: E402
 from apex_tpu.optimizers.fused_sgd import fused_sgd  # noqa: E402
 
-ON_TPU = jax.devices()[0].platform == "tpu"
+# SMOKE forces the CPU backend, so it implies the tiny branches
+ON_TPU = not SMOKE and jax.devices()[0].platform == "tpu"
 B = int(sys.argv[1]) if len(sys.argv) > 1 else (128 if ON_TPU else 8)
 IMG = 224 if ON_TPU else 32
 K = 16 if ON_TPU else 2

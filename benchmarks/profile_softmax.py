@@ -23,6 +23,10 @@ from jax import lax
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
+from benchmarks._smoke import smoke_mode  # noqa: E402
+
+SMOKE = smoke_mode("APEX_BENCH_SMOKE")  # force-CPU tiny sanity mode
+
 from benchmarks._timing import measure_dispatch_overhead, sync  # noqa: E402
 
 from apex_tpu.ops import softmax_pallas
@@ -31,7 +35,7 @@ from apex_tpu.transformer.functional.fused_softmax import (
     scaled_upper_triang_masked_softmax as jnp_causal,
 )
 
-K = 32
+K = 2 if SMOKE else 32
 HBM = 819e9  # v5e
 
 OVERHEAD = measure_dispatch_overhead(K)
@@ -53,7 +57,7 @@ def run_case(name, b, np_, sq, sk, causal, use_pallas):
             def f(x):
                 if use_pallas:
                     y = softmax_pallas.scaled_masked_softmax(
-                        x, m, 0.125, causal=causal)
+                        x, m, 0.125, causal=causal, interpret=SMOKE)
                 elif causal:
                     y = jnp_causal(x.reshape(-1, sq, sk), 0.125)
                 else:
@@ -84,7 +88,9 @@ def run_case(name, b, np_, sq, sk, causal, use_pallas):
 
 
 # GPT-2-small attention-score shape and a longer-seq BERT-ish shape
-for (b, np_, sq, sk) in [(8, 12, 1024, 1024), (8, 16, 512, 512)]:
+SHAPES = ([(2, 2, 128, 128)] if SMOKE
+          else [(8, 12, 1024, 1024), (8, 16, 512, 512)])
+for (b, np_, sq, sk) in SHAPES:
     for causal in (True, False):
         kind = "causal" if causal else "masked"
         base = run_case(f"jnp   {kind} b{b} h{np_} s{sq}", b, np_, sq, sk,
