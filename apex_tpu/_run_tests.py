@@ -35,6 +35,7 @@ SUITES = {
                 "test_contrib_sparsity_permutation.py"],
     "ops": ["test_ops_attention.py", "test_softmax_pallas.py"],
     "checkpoint": ["test_checkpoint.py"],
+    "data": ["test_data.py"],
     "examples": ["test_examples.py"],
 }
 # reference run_test.py:28-33 excludes run_amp/run_fp16util by default;

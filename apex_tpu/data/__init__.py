@@ -1,0 +1,16 @@
+"""apex_tpu.data — host-side input pipelines.
+
+The reference delegates data loading to torchvision's multi-worker
+``DataLoader`` (examples/imagenet/main_amp.py builds ImageFolder +
+RandomResizedCrop pipelines and hides decode latency behind worker
+processes). The TPU-side equivalent: decode/augment on the host with a
+thread pool, prefetch ahead of the device step, hand the step contiguous
+NHWC numpy batches.
+"""
+
+from apex_tpu.data.imagefolder import (  # noqa: F401
+    ImageFolder,
+    eval_transform,
+    prefetch,
+    train_transform,
+)

@@ -111,6 +111,60 @@ def make_synthetic_loader(args, steps):
     return gen
 
 
+_DATASETS = {}  # root -> ImageFolder (the ~1.28M-entry scan runs once)
+
+
+def _image_folder(root):
+    from apex_tpu import data as apex_data
+
+    if root not in _DATASETS:
+        _DATASETS[root] = apex_data.ImageFolder(root)
+    return _DATASETS[root]
+
+
+def make_loader(args, steps, train=True, epoch=0):
+    """Dispatch: synthetic pipeline, or the real ImageFolder pipeline
+    (apex_tpu.data — the torchvision ImageFolder/DataLoader analog of the
+    reference's main_amp.py) when a data path is given. Returns
+    (generator, steps)."""
+    if args.synthetic or not args.data:
+        return make_synthetic_loader(args, steps)(), steps
+
+    from apex_tpu import data as apex_data
+
+    split = "train" if train else "val"
+    root = os.path.join(args.data, split)
+    if not os.path.isdir(root):
+        root = args.data  # flat layout: root/<class>/<images>
+    ds = _image_folder(root)
+    # main() resolves num_classes from the train folder before building
+    # the model; a mismatch here (e.g. a val tree with different classes)
+    # would silently mis-index labels against the model head
+    if len(ds.classes) != args.num_classes:
+        raise ValueError(
+            f"{len(ds.classes)} classes under {root} vs --num-classes "
+            f"{args.num_classes}")
+    tf = (apex_data.train_transform(args.image_size) if train
+          else apex_data.eval_transform(max(args.image_size + 32, 256),
+                                        args.image_size))
+    n = len(ds) // args.batch_size
+    if n == 0:
+        raise ValueError(f"{len(ds)} images under {root} is fewer than "
+                         f"batch size {args.batch_size}")
+    tail = len(ds) - n * args.batch_size
+    if not train and tail and epoch == 0:
+        print(f"NOTE: {tail} tail validation samples are not evaluated "
+              f"({len(ds)} images, batch {args.batch_size})", flush=True)
+    steps = min(steps, n) if steps else n
+    gen = apex_data.prefetch(
+        ds, args.batch_size, tf, shuffle=train, drop_last=True,
+        seed=0 if args.deterministic else np.random.randint(2 ** 31),
+        epoch=epoch)
+    import itertools
+
+    return itertools.islice(gen, steps), steps
+
+
 def build_train_step(model, opt, mesh, compute_dtype=jnp.float32):
     """The whole apex train iteration as one SPMD program.
 
@@ -185,8 +239,12 @@ def validate(args, model, mesh, params, batch_stats, compute_dtype,
     """Reference: main_amp.py validate() — eval loop with metering."""
     eval_step = build_eval_step(model, mesh, compute_dtype)
     losses, top1, top5 = AverageMeter(), AverageMeter(), AverageMeter()
-    steps = steps or args.steps or 8
-    loader = make_synthetic_loader(args, steps)()
+    # synthetic: default 8 smoke batches; real data: the FULL val set
+    # unless --steps caps it
+    steps = steps or args.steps
+    if args.synthetic or not args.data:
+        steps = steps or 8
+    loader, steps = make_loader(args, steps, train=False)
     for i, (images, labels) in enumerate(loader):
         m = np.asarray(eval_step(params, batch_stats, jnp.asarray(images),
                                  jnp.asarray(labels)))
@@ -204,11 +262,16 @@ def validate(args, model, mesh, params, batch_stats, compute_dtype,
 def main(argv=None):
     args = parse_args(argv)
     if args.data and not args.synthetic:
-        raise NotImplementedError(
-            "this port ships only the synthetic pipeline (--synthetic); an "
-            "ImageFolder-style numpy loader would plug in at "
-            "make_synthetic_loader — the positional data path is accepted "
-            "for CLI parity but no real loader is implemented")
+        # resolve the real class count BEFORE the model is built
+        troot = os.path.join(args.data, "train")
+        if not os.path.isdir(troot):
+            troot = args.data
+        found = len(_image_folder(troot).classes)
+        if found != args.num_classes:
+            print(f"NOTE: {found} classes under {troot} "
+                  f"(--num-classes {args.num_classes}); using the folder "
+                  "count", flush=True)
+            args.num_classes = found
     devices = jax.devices()
     mesh = Mesh(np.asarray(devices), ("data",))
     ndev = len(devices)
@@ -280,7 +343,7 @@ def main(argv=None):
     top1, top5 = AverageMeter(), AverageMeter()
     for epoch in range(start_epoch, args.epochs):
         batch_time.reset(), losses.reset(), top1.reset(), top5.reset()
-        loader = make_synthetic_loader(args, steps)()
+        loader, steps = make_loader(args, steps, train=True, epoch=epoch)
         end = time.perf_counter()
         for i, (images, labels) in enumerate(loader):
             if i == args.prof:
