@@ -14,6 +14,7 @@ Real data expects an ImageFolder-style numpy loader — see make_loader.
 """
 
 import argparse
+import itertools
 import os
 import pickle
 import time
@@ -122,6 +123,13 @@ def _image_folder(root):
     return _DATASETS[root]
 
 
+def _split_root(data, split):
+    """torchvision convention root/<split>/<class>/... with a fallback to
+    the flat root/<class>/... layout."""
+    root = os.path.join(data, split)
+    return root if os.path.isdir(root) else data
+
+
 def make_loader(args, steps, train=True, epoch=0):
     """Dispatch: synthetic pipeline, or the real ImageFolder pipeline
     (apex_tpu.data — the torchvision ImageFolder/DataLoader analog of the
@@ -132,10 +140,7 @@ def make_loader(args, steps, train=True, epoch=0):
 
     from apex_tpu import data as apex_data
 
-    split = "train" if train else "val"
-    root = os.path.join(args.data, split)
-    if not os.path.isdir(root):
-        root = args.data  # flat layout: root/<class>/<images>
+    root = _split_root(args.data, "train" if train else "val")
     ds = _image_folder(root)
     # main() resolves num_classes from the train folder before building
     # the model; a mismatch here (e.g. a val tree with different classes)
@@ -160,8 +165,6 @@ def make_loader(args, steps, train=True, epoch=0):
         ds, args.batch_size, tf, shuffle=train, drop_last=True,
         seed=0 if args.deterministic else np.random.randint(2 ** 31),
         epoch=epoch)
-    import itertools
-
     return itertools.islice(gen, steps), steps
 
 
@@ -263,9 +266,7 @@ def main(argv=None):
     args = parse_args(argv)
     if args.data and not args.synthetic:
         # resolve the real class count BEFORE the model is built
-        troot = os.path.join(args.data, "train")
-        if not os.path.isdir(troot):
-            troot = args.data
+        troot = _split_root(args.data, "train")
         found = len(_image_folder(troot).classes)
         if found != args.num_classes:
             print(f"NOTE: {found} classes under {troot} "
