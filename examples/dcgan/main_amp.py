@@ -26,6 +26,9 @@ from apex_tpu.models import Discriminator, Generator
 
 def parse_args(argv=None):
     p = argparse.ArgumentParser()
+    p.add_argument("data", nargs="?", default=None,
+                   help="image-folder root (reference: --dataset folder; "
+                        "omit for synthetic data)")
     p.add_argument("-b", "--batch-size", type=int, default=16)
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--nz", type=int, default=100)
@@ -121,11 +124,38 @@ def main(argv=None):
                 jnp.stack([lossD_real + lossD_fake, lossG]))
 
     rs = np.random.RandomState(0)
+
+    def real_batches():
+        """Synthetic noise images, or the reference's image-folder path
+        (dcgan/main_amp.py --dataset folder: ImageFolder + resize/crop +
+        [-1, 1] normalization) via apex_tpu.data."""
+        if not args.data:
+            while True:
+                yield jnp.asarray(
+                    rs.rand(args.batch_size, args.image_size,
+                            args.image_size, 3) * 2 - 1, jnp.float32)
+        from apex_tpu import data as apex_data
+
+        ds = apex_data.ImageFolder(args.data)
+        if len(ds) < args.batch_size:
+            raise ValueError(
+                f"{len(ds)} images under {args.data} is fewer than batch "
+                f"size {args.batch_size}")
+        # reference pipeline: Resize(image_size) + CenterCrop(image_size)
+        # — no resize headroom
+        tf = apex_data.eval_transform(args.image_size, args.image_size)
+        epoch = 0
+        while True:  # cycle epochs until the step budget is spent
+            for images, _ in apex_data.prefetch(
+                    ds, args.batch_size, tf, shuffle=True, drop_last=True,
+                    seed=0, epoch=epoch):
+                yield jnp.asarray(images * 2.0 - 1.0)  # [0,1) → [-1,1)
+            epoch += 1
+
+    reals = real_batches()
     t0 = time.perf_counter()
     for i in range(args.steps):
-        real = jnp.asarray(rs.rand(args.batch_size, args.image_size,
-                                   args.image_size, 3) * 2 - 1,
-                           jnp.float32)
+        real = next(reals)
         z = jnp.asarray(rs.randn(args.batch_size, 1, 1, args.nz),
                         jnp.float32)
         pG, sG, stG, pD, sD, stD, losses = train_step(

@@ -75,6 +75,18 @@ def test_prefetch_batches_and_determinism(fake_tree):
 
 
 @pytest.mark.slow
+def test_dcgan_example_trains_on_real_images(fake_tree):
+    """The DCGAN example's image-folder path (reference --dataset folder):
+    two steps on PIL-decoded reals, finite D/G losses."""
+    from examples.dcgan.main_amp import main
+
+    lossD, lossG = main([str(fake_tree / "train"), "--steps", "2",
+                         "-b", "8", "--image-size", "64",
+                         "--ngf", "8", "--ndf", "8", "--nz", "16"])
+    assert np.isfinite(lossD) and np.isfinite(lossG)
+
+
+@pytest.mark.slow
 def test_imagenet_example_trains_on_real_images(fake_tree):
     """The example's real-data path end to end: train 2 steps + the
     --evaluate path on the PIL-decoded fake tree (2 classes; the NOTE
