@@ -1,0 +1,30 @@
+#!/bin/bash
+# One-shot collection of every queued TPU measurement (PERF.md §6).
+# Run when the axon relay is healthy:  bash benchmarks/run_all_tpu.sh [outdir]
+# Each harness gets its own timeout so one wedged run cannot sink the rest.
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-/tmp/apex_tpu_bench_$(date +%Y%m%d_%H%M)}"
+mkdir -p "$OUT"
+echo "collecting into $OUT"
+
+run() {  # run <name> <timeout_s> <cmd...>
+    local name="$1" t="$2"; shift 2
+    echo "=== $name (timeout ${t}s)"
+    timeout "$t" "$@" >"$OUT/$name.log" 2>&1
+    local rc=$?
+    tail -3 "$OUT/$name.log" | sed 's/^/    /'
+    [ $rc -ne 0 ] && echo "    rc=$rc (see $OUT/$name.log)"
+}
+
+run bench            1900 python bench.py
+run gpt              1200 python benchmarks/profile_gpt.py
+run layernorm         900 python benchmarks/profile_layernorm.py
+run softmax           900 python benchmarks/profile_softmax.py
+run attention         900 python benchmarks/profile_attention.py
+run optimizers        900 python benchmarks/profile_optimizers.py
+run resnet           1200 python benchmarks/profile_resnet.py
+run multihead_attn    900 python benchmarks/profile_multihead_attn.py
+run dcgan             900 python benchmarks/profile_dcgan.py
+
+echo "=== done; feed the logs into PERF.md"
