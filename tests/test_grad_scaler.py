@@ -1,0 +1,75 @@
+"""transformer.amp.GradScaler: the model-parallel found_inf MAX reduction
+(reference: apex/transformer/amp/grad_scaler.py:38-49) and the torch-shaped
+constructor mapping."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.transformer.amp import GradScaler
+
+NDEV = 8
+
+
+def test_constructor_mapping_and_validation():
+    gs = GradScaler(init_scale=2.0 ** 10, growth_interval=500,
+                    axis_names=("tp",))
+    assert gs.init_scale == 2.0 ** 10
+    assert gs.scale_window == 500
+    assert gs.axis_names == ("tp",)
+    state = gs.init()
+    assert float(state.loss_scale) == 2.0 ** 10
+    with pytest.raises(AssertionError, match="growth factor"):
+        GradScaler(growth_factor=1.0, axis_names=())
+    with pytest.raises(AssertionError, match="backoff"):
+        GradScaler(backoff_factor=1.5, axis_names=())
+
+
+def test_found_inf_syncs_over_model_parallel_axes():
+    """One tp rank's overflow must make EVERY rank skip: without the pmax,
+    TP peers would desynchronize (the bug the reference class exists to
+    prevent)."""
+    mesh = Mesh(np.asarray(jax.devices()).reshape(2, 4), ("pp", "tp"))
+    gs = GradScaler(axis_names=("pp", "tp"))
+    state = gs.init()
+
+    def run(state):
+        # only (pp=0, tp=0)'s shard overflows
+        rank = jax.lax.axis_index("pp") * 4 + jax.lax.axis_index("tp")
+        g = {"w": jnp.where(rank == 0, jnp.inf, 1.0)
+             * jnp.ones((2,)) * state.loss_scale}
+        _, found_inf = gs.unscale(g, state)
+        new_state = gs.update(state, found_inf)
+        return found_inf[None], new_state.loss_scale[None]
+
+    found, scales = shard_map(
+        run, mesh=mesh, in_specs=(P(),),
+        out_specs=(P(("pp", "tp")), P(("pp", "tp"))),
+        check_vma=False)(state)
+    # every rank observed the overflow and every rank halved its scale
+    assert np.all(np.asarray(found))
+    np.testing.assert_array_equal(np.asarray(scales),
+                                  np.full(NDEV, 2.0 ** 15, np.float32))
+
+
+def test_found_inf_false_grows_after_window():
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("tp",))
+    gs = GradScaler(growth_interval=2, axis_names=("tp",))
+    state = gs.init()
+
+    def run(state):
+        for _ in range(2):
+            g = {"w": jnp.ones((2,)) * state.loss_scale}
+            _, found_inf = gs.unscale(g, state)
+            state = gs.update(state, found_inf)
+        return state.loss_scale[None]
+
+    scale = shard_map(run, mesh=mesh, in_specs=(P(),), out_specs=P("tp"),
+                      check_vma=False)(state)
+    # 2 clean steps at growth_interval=2 -> one doubling
+    np.testing.assert_array_equal(np.asarray(scale),
+                                  np.full(2, 2.0 ** 17, np.float32))
