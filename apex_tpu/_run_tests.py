@@ -30,7 +30,7 @@ SUITES = {
     "transformer": ["test_tensor_parallel.py", "test_pipeline_parallel.py",
                     "test_transformer_models.py", "test_moe.py",
                     "test_context_parallel.py", "test_arguments.py",
-                    "test_grad_scaler.py"],
+                    "test_grad_scaler.py", "test_batch_sampler.py"],
     "contrib": ["test_contrib_basic.py", "test_contrib_attn.py",
                 "test_contrib_spatial.py",
                 "test_contrib_sparsity_permutation.py"],

@@ -1,0 +1,73 @@
+"""DP-sharded pretraining batch samplers (port of the reference's
+tests/L0/run_transformer/test_batch_sampler.py coverage: sharding
+disjointness, drop_last, consumed-samples resume, per-epoch shuffles)."""
+
+import numpy as np
+
+from apex_tpu.transformer._data import (
+    MegatronPretrainingRandomSampler,
+    MegatronPretrainingSampler,
+)
+
+
+def _all_rank_batches(cls, total, consumed, mbs, dp, **kw):
+    return [list(cls(total_samples=total, consumed_samples=consumed,
+                     micro_batch_size=mbs, data_parallel_rank=r,
+                     data_parallel_size=dp, **kw))
+            for r in range(dp)]
+
+
+def test_sequential_sampler_shards_disjoint_and_complete():
+    per_rank = _all_rank_batches(MegatronPretrainingSampler, 24, 0, 3, 4)
+    # every rank: 2 micro-batches of 3
+    assert all(len(b) == 2 and all(len(mb) == 3 for mb in b)
+               for b in per_rank)
+    flat = sorted(i for b in per_rank for mb in b for i in mb)
+    assert flat == list(range(24))  # disjoint + complete
+
+
+def test_sequential_sampler_drop_last_and_tail():
+    tail = _all_rank_batches(MegatronPretrainingSampler, 26, 0, 3, 4)
+    flat = sorted(i for b in tail for mb in b for i in mb)
+    assert flat == list(range(24))  # 2 tail samples dropped
+    keep = _all_rank_batches(MegatronPretrainingSampler, 26, 0, 3, 4,
+                             drop_last=False)
+    # the 2 tail samples surface as one final short global batch
+    assert any(len(mb) < 3 for b in keep for mb in b)
+    flat_keep = sorted(i for b in keep for mb in b for i in mb)
+    assert set(range(24)) <= set(flat_keep)
+
+
+def test_sequential_sampler_resume():
+    full = list(MegatronPretrainingSampler(
+        total_samples=24, consumed_samples=0, micro_batch_size=3,
+        data_parallel_rank=1, data_parallel_size=4))
+    resumed = list(MegatronPretrainingSampler(
+        total_samples=24, consumed_samples=12, micro_batch_size=3,
+        data_parallel_rank=1, data_parallel_size=4))
+    assert resumed == full[1:]  # 12 consumed == one global batch skipped
+
+
+def test_random_sampler_epoch_determinism_and_disjoint():
+    per_rank = _all_rank_batches(
+        MegatronPretrainingRandomSampler, 48, 0, 4, 2)
+    again = _all_rank_batches(
+        MegatronPretrainingRandomSampler, 48, 0, 4, 2)
+    assert per_rank == again  # same epoch -> same permutation
+    flat = sorted(i for b in per_rank for mb in b for i in mb)
+    assert flat == list(range(48))  # rank buckets are disjoint + complete
+    # next epoch (consumed == one full pass) shuffles differently
+    nxt = _all_rank_batches(MegatronPretrainingRandomSampler, 48, 48, 4, 2)
+    assert nxt != per_rank
+    flat_nxt = sorted(i for b in nxt for mb in b for i in mb)
+    assert flat_nxt == list(range(48))
+
+
+def test_random_sampler_mid_epoch_resume():
+    full = list(MegatronPretrainingRandomSampler(
+        total_samples=48, consumed_samples=0, micro_batch_size=4,
+        data_parallel_rank=0, data_parallel_size=2))
+    resumed = list(MegatronPretrainingRandomSampler(
+        total_samples=48, consumed_samples=16, micro_batch_size=4,
+        data_parallel_rank=0, data_parallel_size=2))
+    assert resumed == full[2:]  # 16 consumed == 2 global batches skipped
