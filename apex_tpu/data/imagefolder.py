@@ -121,7 +121,7 @@ def eval_transform(resize=256, crop=224):
 
 def prefetch(dataset, batch_size, transform, *, shuffle=True,
              drop_last=True, seed=0, epoch=0, num_workers=8,
-             prefetch_batches=4):
+             prefetch_batches=4, shard=(0, 1)):
     """Generator of (images [b,h,w,3] float32, labels [b] int32) batches.
 
     The DataLoader analog: per-epoch deterministic shuffle
@@ -129,10 +129,17 @@ def prefetch(dataset, batch_size, transform, *, shuffle=True,
     ``prefetch_batches`` batches decoded ahead of the consumer so the
     device step never waits on PIL. ``drop_last`` mirrors the reference's
     training loader (static batch shapes — no recompiles).
+
+    ``shard=(rank, world)``: the DistributedSampler analog — all ranks
+    shuffle with the SAME seed, then rank takes every world-th index, so
+    an epoch partitions the dataset across processes with no overlap.
     """
+    rank, world = shard
     order = list(range(len(dataset)))
     if shuffle:
         random.Random(seed + epoch).shuffle(order)
+    if world > 1:
+        order = order[rank::world]
     n_batches = (len(order) // batch_size if drop_last
                  else (len(order) + batch_size - 1) // batch_size)
     if n_batches == 0:

@@ -29,6 +29,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from apex_tpu import amp
 from apex_tpu.models import resnet18, resnet50
+from apex_tpu.parallel.multiproc import init_distributed
 from apex_tpu.optimizers.fused_sgd import fused_sgd
 from apex_tpu.parallel.distributed import (
     allreduce_gradients,
@@ -45,7 +46,9 @@ def parse_args(argv=None):
     p.add_argument("--arch", "-a", default="resnet50", choices=ARCHS)
     p.add_argument("--epochs", type=int, default=1)
     p.add_argument("-b", "--batch-size", type=int, default=256,
-                   help="GLOBAL batch size across the data axis")
+                   help="PER-PROCESS batch size (one process per host; "
+                        "the global batch is batch_size x processes, "
+                        "split across the data mesh axis)")
     p.add_argument("--lr", type=float, default=0.1)
     p.add_argument("--momentum", type=float, default=0.9)
     p.add_argument("--weight-decay", "--wd", type=float, default=1e-4)
@@ -99,8 +102,32 @@ def _loss_and_metrics(logits, labels):
     return loss, top1, top5
 
 
+_COMMON_SEED = None
+
+
+def _common_seed(args):
+    """One seed shared by EVERY process (init params, shuffle order):
+    entropy from process 0 broadcast to all — divergent seeds would break
+    the replicated-params DDP invariant. --deterministic pins it to 0."""
+    if args.deterministic:
+        return 0  # never the cached entropy seed of an earlier run
+    global _COMMON_SEED
+    if _COMMON_SEED is None:
+        seed = np.random.randint(2 ** 31)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            seed = int(multihost_utils.broadcast_one_to_all(
+                np.int32(seed)))
+        _COMMON_SEED = seed
+    return _COMMON_SEED
+
+
 def make_synthetic_loader(args, steps):
-    rs = np.random.RandomState(0 if args.deterministic else None)
+    # rank-distinct synthetic data (each process is its own DDP shard);
+    # --deterministic keeps it reproducible per rank
+    rs = np.random.RandomState(jax.process_index() if args.deterministic
+                               else None)
     h = args.image_size
 
     def gen():
@@ -123,6 +150,20 @@ def _image_folder(root):
     return _DATASETS[root]
 
 
+def _to_global_batch(mesh, x):
+    """Single-process: plain device array. Multi-process (launched via
+    apex_tpu.parallel.multiproc): stitch each process's local batch into
+    the data-sharded GLOBAL batch the jitted step takes — the functional
+    analog of the reference's DistributedSampler feeding per-rank shards
+    (examples/imagenet/main_amp.py --local_rank path)."""
+    if jax.process_count() == 1:
+        return jnp.asarray(x)
+    from jax.sharding import NamedSharding
+
+    return jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data")), np.asarray(x))
+
+
 def _split_root(data, split):
     """torchvision convention root/<split>/<class>/... with a fallback to
     the flat root/<class>/... layout."""
@@ -140,6 +181,7 @@ def make_loader(args, steps, train=True, epoch=0):
 
     from apex_tpu import data as apex_data
 
+    rank, world = jax.process_index(), jax.process_count()
     root = _split_root(args.data, "train" if train else "val")
     ds = _image_folder(root)
     # main() resolves num_classes from the train folder before building
@@ -152,19 +194,22 @@ def make_loader(args, steps, train=True, epoch=0):
     tf = (apex_data.train_transform(args.image_size) if train
           else apex_data.eval_transform(max(args.image_size + 32, 256),
                                         args.image_size))
-    n = len(ds) // args.batch_size
+    # per-RANK step count: every process feeds batch_size of the global
+    # batch and the common-shuffle shard partitions the dataset
+    n = len(ds) // (args.batch_size * world)
     if n == 0:
-        raise ValueError(f"{len(ds)} images under {root} is fewer than "
-                         f"batch size {args.batch_size}")
-    tail = len(ds) - n * args.batch_size
-    if not train and tail and epoch == 0:
+        raise ValueError(
+            f"{len(ds)} images under {root} is fewer than the global "
+            f"batch ({args.batch_size} x {world} processes)")
+    tail = len(ds) - n * args.batch_size * world
+    if not train and tail and epoch == 0 and rank == 0:
         print(f"NOTE: {tail} tail validation samples are not evaluated "
-              f"({len(ds)} images, batch {args.batch_size})", flush=True)
+              f"({len(ds)} images, global batch "
+              f"{args.batch_size * world})", flush=True)
     steps = min(steps, n) if steps else n
     gen = apex_data.prefetch(
         ds, args.batch_size, tf, shuffle=train, drop_last=True,
-        seed=0 if args.deterministic else np.random.randint(2 ** 31),
-        epoch=epoch)
+        seed=_common_seed(args), epoch=epoch, shard=(rank, world))
     return itertools.islice(gen, steps), steps
 
 
@@ -249,8 +294,9 @@ def validate(args, model, mesh, params, batch_stats, compute_dtype,
         steps = steps or 8
     loader, steps = make_loader(args, steps, train=False)
     for i, (images, labels) in enumerate(loader):
-        m = np.asarray(eval_step(params, batch_stats, jnp.asarray(images),
-                                 jnp.asarray(labels)))
+        m = np.asarray(eval_step(params, batch_stats,
+                                 _to_global_batch(mesh, images),
+                                 _to_global_batch(mesh, labels)))
         losses.update(float(m[0]), args.batch_size)
         top1.update(float(m[1]), args.batch_size)
         top5.update(float(m[2]), args.batch_size)
@@ -263,6 +309,10 @@ def validate(args, model, mesh, params, batch_stats, compute_dtype,
 
 
 def main(argv=None):
+    # no-op unless launched by ``python -m apex_tpu.parallel.multiproc``
+    # (the torch.distributed.launch analog); afterwards jax.devices() is
+    # the GLOBAL device list and the mesh below spans all hosts
+    init_distributed()
     args = parse_args(argv)
     if args.data and not args.synthetic:
         # resolve the real class count BEFORE the model is built
@@ -276,7 +326,10 @@ def main(argv=None):
     devices = jax.devices()
     mesh = Mesh(np.asarray(devices), ("data",))
     ndev = len(devices)
-    assert args.batch_size % ndev == 0
+    nproc = jax.process_count()
+    # -b is the PER-PROCESS batch (reference: per-rank batch under
+    # torch.distributed.launch); the global batch must split over devices
+    assert (args.batch_size * nproc) % ndev == 0
 
     # resolve the amp properties ONCE, before building the model: the
     # policy's compute dtype is the conv/matmul dtype (flax ``dtype=``),
@@ -302,11 +355,14 @@ def main(argv=None):
     model = ARCHS[args.arch](num_classes=args.num_classes,
                              norm_axis_name="data",
                              dtype=policy.compute_dtype)
-    rs_img = jnp.zeros((2, args.image_size, args.image_size, 3))
+    # numpy (not device-committed): multi-process jit accepts host arrays
+    # as replicated inputs; a process-local jnp array would not be global
+    rs_img = np.zeros((2 * nproc, args.image_size, args.image_size, 3),
+                      np.float32)
 
     # --deterministic: fixed init/data seeds -> bitwise-reproducible runs
     # (the reference flag sets cudnn.deterministic + torch.manual_seed)
-    init_seed = 0 if args.deterministic else np.random.randint(2 ** 31)
+    init_seed = _common_seed(args)
 
     def init(x):
         return model.init(jax.random.PRNGKey(init_seed), x, train=False)
@@ -321,7 +377,9 @@ def main(argv=None):
     params, opt = amp.initialize(
         params, tx, opt_level=args.opt_level,
         keep_batchnorm_fp32=keep_bn, loss_scale=loss_scale)
-    amp_state = opt.init(params)
+    # jitted so the state inherits the params' (global) sharding — eager
+    # init would make process-local scalars a multi-host jit rejects
+    amp_state = jax.jit(opt.init)(params)
 
     start_epoch = 0
     if args.resume and os.path.isfile(args.resume):
@@ -338,7 +396,7 @@ def main(argv=None):
 
     train_step = build_train_step(model, opt, mesh,
                                   compute_dtype=policy.compute_dtype)
-    steps = args.steps or (1281167 // args.batch_size)
+    steps = args.steps or (1281167 // (args.batch_size * nproc))
 
     batch_time, losses = AverageMeter(), AverageMeter()
     top1, top5 = AverageMeter(), AverageMeter()
@@ -350,8 +408,9 @@ def main(argv=None):
             if i == args.prof:
                 jax.profiler.start_trace("/tmp/jax_trace")
             params, batch_stats, amp_state, metrics, overflow = train_step(
-                params, batch_stats, amp_state, jnp.asarray(images),
-                jnp.asarray(labels))
+                params, batch_stats, amp_state,
+                _to_global_batch(mesh, images),
+                _to_global_batch(mesh, labels))
             if i == 0:
                 jax.block_until_ready(metrics)  # exclude compile
                 end = time.perf_counter()
@@ -364,7 +423,7 @@ def main(argv=None):
             top1.update(float(m[1]), args.batch_size)
             top5.update(float(m[2]), args.batch_size)
             if i % args.print_freq == 0:
-                ips = args.batch_size / batch_time.avg
+                ips = args.batch_size * nproc / batch_time.avg
                 print(f"Epoch: [{epoch}][{i}/{steps}]  "
                       f"Time {batch_time.val:.3f} ({batch_time.avg:.3f})  "
                       f"Speed {ips:.1f} img/s  "
@@ -374,12 +433,14 @@ def main(argv=None):
                       flush=True)
         if args.prof >= 0 and args.prof < steps:
             jax.profiler.stop_trace()
-        with open(args.checkpoint, "wb") as f:
-            pickle.dump({"params": jax.device_get(params),
-                         "batch_stats": jax.device_get(batch_stats),
-                         "amp_state": jax.device_get(amp_state),
-                         "epoch": epoch + 1}, f)
-    ips = (args.batch_size / batch_time.avg) if batch_time.count else 0.0
+        if jax.process_index() == 0:  # rank-0 save, as the reference
+            with open(args.checkpoint, "wb") as f:
+                pickle.dump({"params": jax.device_get(params),
+                             "batch_stats": jax.device_get(batch_stats),
+                             "amp_state": jax.device_get(amp_state),
+                             "epoch": epoch + 1}, f)
+    ips = (args.batch_size * nproc / batch_time.avg) if batch_time.count \
+        else 0.0
     print(f"DONE images/sec={ips:.1f} loss={losses.avg:.4f}")
     return losses.avg
 

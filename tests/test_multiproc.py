@@ -28,6 +28,25 @@ def test_multiproc_two_process_psum():
 
 
 @pytest.mark.slow
+def test_imagenet_example_two_process():
+    """The flagship example multi-host: 2 processes x 1 device, global
+    mesh, cross-process DDP psum + SyncBatchNorm stats, rank-0 checkpoint
+    (the reference's 2-GPU torch.distributed.launch L1 configuration)."""
+    env = dict(os.environ)
+    env["MASTER_PORT"] = "29541"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    out = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.parallel.multiproc", "--nproc", "2",
+         os.path.join(REPO, "tests", "imagenet_multiproc_worker.py")],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, (
+        f"rc={out.returncode}\nstdout:\n{out.stdout[-3000:]}\n"
+        f"stderr:\n{out.stderr[-3000:]}")
+    assert out.stdout.count("IMAGENET_MULTIPROC_OK") == 2, out.stdout
+
+
+@pytest.mark.slow
 def test_simple_distributed_example_two_process():
     """The reference's examples/simple/distributed walkthrough, 2-process:
     DDP grad averaging + amp O1 must converge (final loss printed by rank
