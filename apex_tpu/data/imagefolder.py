@@ -139,7 +139,10 @@ def prefetch(dataset, batch_size, transform, *, shuffle=True,
     if shuffle:
         random.Random(seed + epoch).shuffle(order)
     if world > 1:
-        order = order[rank::world]
+        # equalize BEFORE sharding (DistributedSampler discipline): every
+        # rank must see the same batch count or an SPMD consumer running
+        # one collective per batch deadlocks on the longer rank
+        order = order[:world * (len(order) // world)][rank::world]
     n_batches = (len(order) // batch_size if drop_last
                  else (len(order) + batch_size - 1) // batch_size)
     if n_batches == 0:

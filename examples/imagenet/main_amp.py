@@ -382,13 +382,28 @@ def main(argv=None):
     amp_state = jax.jit(opt.init)(params)
 
     start_epoch = 0
-    if args.resume and os.path.isfile(args.resume):
-        with open(args.resume, "rb") as f:
-            ckpt = pickle.load(f)
-        params, batch_stats, amp_state = (
-            ckpt["params"], ckpt["batch_stats"], ckpt["amp_state"])
-        start_epoch = ckpt["epoch"]
-        print(f"=> loaded checkpoint (epoch {start_epoch})")
+    if args.resume:
+        have = os.path.isfile(args.resume)
+        if nproc > 1:
+            # checkpoints are rank-0-written: every process must see the
+            # same file (shared filesystem) or resume silently
+            # desynchronizes the replicas — fail loudly instead
+            from jax.experimental import multihost_utils
+
+            have0 = bool(multihost_utils.broadcast_one_to_all(
+                np.int32(have)))
+            if have0 != have:
+                raise RuntimeError(
+                    f"--resume {args.resume} visible on some processes "
+                    "only; checkpoints must live on a shared filesystem")
+            have = have0
+        if have:
+            with open(args.resume, "rb") as f:
+                ckpt = pickle.load(f)
+            params, batch_stats, amp_state = (
+                ckpt["params"], ckpt["batch_stats"], ckpt["amp_state"])
+            start_epoch = ckpt["epoch"]
+            print(f"=> loaded checkpoint (epoch {start_epoch})")
 
     if args.evaluate:
         return validate(args, model, mesh, params, batch_stats,

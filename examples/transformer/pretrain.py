@@ -68,10 +68,17 @@ def make_optimizer(args):
 
 
 def main(argv=None):
+    # no-op unless launched by ``python -m apex_tpu.parallel.multiproc``;
+    # afterwards jax.devices() is the GLOBAL list and the (dp, tp) mesh
+    # spans hosts (collectives ride ICI within a host, DCN across)
+    from apex_tpu.parallel.multiproc import init_distributed
+
+    init_distributed()
     devices = jax.devices()
     args = global_vars.set_global_variables(
         argv, extra_args_provider=_extra_args,
         world_size=len(devices), ignore_unknown_args=False)
+    args.rank = jax.process_index()
     timers = global_vars.get_timers()
 
     tp = args.tensor_model_parallel_size
@@ -90,10 +97,20 @@ def main(argv=None):
     model_cls = GPTModel if args.model == "gpt" else BertModel
     model = model_cls(cfg)
 
+    # every process builds the same full batch (same seed) and places it
+    # ONCE onto the global dp-sharded layout — host numpy is a valid
+    # multi-process input but would re-stage host->device every chunk
+    from jax.sharding import NamedSharding
+
     rs = np.random.RandomState(args.seed)
-    ids = jnp.asarray(rs.randint(0, vocab, (dp * b_local, s)), jnp.int32)
-    labels = jnp.asarray(rs.randint(0, vocab, (dp * b_local, s)), jnp.int32)
-    pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None], ids.shape)
+    sh_data = NamedSharding(mesh, P(DATA_AXIS))
+    ids = jax.device_put(
+        rs.randint(0, vocab, (dp * b_local, s)).astype(np.int32), sh_data)
+    labels = jax.device_put(
+        rs.randint(0, vocab, (dp * b_local, s)).astype(np.int32), sh_data)
+    pos = jax.device_put(
+        np.ascontiguousarray(np.broadcast_to(
+            np.arange(s, dtype=np.int32)[None], ids.shape)), sh_data)
 
     scaler = LossScaler(loss_scale="dynamic" if args.fp16
                         else float(args.loss_scale or 1.0))
@@ -168,7 +185,8 @@ def main(argv=None):
         out_specs=P(), check_vma=False))(ids, pos, labels)
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
     opt_state = jax.jit(lambda p: tx.init(p))(params)
-    scaler_state = scaler.init()
+    # host scalars (replicated-consistent multi-process jit inputs)
+    scaler_state = jax.tree_util.tree_map(np.asarray, scaler.init())
 
     # --- checkpoint/resume (reference checkpointing args :646-669) ---
     start_iter = 0

@@ -47,6 +47,25 @@ def test_imagenet_example_two_process():
 
 
 @pytest.mark.slow
+def test_pretrain_example_two_process():
+    """The transformer pretrain entry multi-host: (dp=2, tp=1) mesh over
+    2 processes, grad pmean + found_inf pmax across DCN-equivalent
+    loopback."""
+    env = dict(os.environ)
+    env["MASTER_PORT"] = "29543"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    out = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.parallel.multiproc", "--nproc", "2",
+         os.path.join(REPO, "tests", "pretrain_multiproc_worker.py")],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, (
+        f"rc={out.returncode}\nstdout:\n{out.stdout[-3000:]}\n"
+        f"stderr:\n{out.stderr[-3000:]}")
+    assert out.stdout.count("PRETRAIN_MULTIPROC_OK") == 2, out.stdout
+
+
+@pytest.mark.slow
 def test_simple_distributed_example_two_process():
     """The reference's examples/simple/distributed walkthrough, 2-process:
     DDP grad averaging + amp O1 must converge (final loss printed by rank
