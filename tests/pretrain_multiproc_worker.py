@@ -22,12 +22,14 @@ def run():
     from apex_tpu.transformer.testing import global_vars
     from examples.transformer.pretrain import main
 
+    tp = os.environ.get("APEX_TEST_TP", "1")  # tp=2 -> TP over DCN
     global_vars.destroy_global_vars()
     out = main(["--model", "gpt", "--num-layers", "2", "--hidden-size",
                 "64", "--num-attention-heads", "4",
                 "--max-position-embeddings", "64", "--seq-length", "32",
                 "--micro-batch-size", "2", "--vocab-size", "256",
                 "--make-vocab-size-divisible-by", "32",
+                "--tensor-model-parallel-size", tp,
                 "--optimizer", "adam", "--lr", "1e-3", "--bf16",
                 "--train-iters", "4", "--log-interval", "2"])
     assert np.isfinite(out["loss"]), out

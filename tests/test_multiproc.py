@@ -47,12 +47,15 @@ def test_imagenet_example_two_process():
 
 
 @pytest.mark.slow
-def test_pretrain_example_two_process():
-    """The transformer pretrain entry multi-host: (dp=2, tp=1) mesh over
-    2 processes, grad pmean + found_inf pmax across DCN-equivalent
-    loopback."""
+@pytest.mark.parametrize("tp,port", [("1", "29543"), ("2", "29545")])
+def test_pretrain_example_two_process(tp, port):
+    """The transformer pretrain entry multi-host over 2 processes:
+    tp=1 -> (dp=2, tp=1): grad pmean + found_inf pmax cross the
+    DCN-equivalent loopback; tp=2 -> (dp=1, tp=2): the TENSOR-parallel
+    collectives (TP all-reduces, vocab-parallel CE) cross it."""
     env = dict(os.environ)
-    env["MASTER_PORT"] = "29543"
+    env["MASTER_PORT"] = port
+    env["APEX_TEST_TP"] = tp
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
     out = subprocess.run(
