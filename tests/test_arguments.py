@@ -152,6 +152,24 @@ def test_pretrain_entry_tiny(model, opt):
 
 
 @pytest.mark.slow
+def test_pretrain_fp16_dynamic_scaling():
+    """--fp16 trains with true float16 params + dynamic loss scaling (the
+    reference's mixed-precision group); loss stays finite."""
+    global_vars.destroy_global_vars()
+    from examples.transformer.pretrain import main
+
+    out = main(["--model", "gpt", "--num-layers", "2", "--hidden-size",
+                "64", "--num-attention-heads", "4",
+                "--max-position-embeddings", "64", "--seq-length", "32",
+                "--micro-batch-size", "2", "--vocab-size", "256",
+                "--make-vocab-size-divisible-by", "32",
+                "--optimizer", "adam", "--lr", "1e-3", "--fp16",
+                "--train-iters", "4", "--log-interval", "2"])
+    assert np.isfinite(out["loss"])
+    global_vars.destroy_global_vars()
+
+
+@pytest.mark.slow
 def test_pretrain_save_load_resume(tmp_path):
     """--save / --save-interval / --load drive the sharded checkpoint
     manager (reference checkpointing args :646-669): a killed run resumes
