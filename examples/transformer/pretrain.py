@@ -50,19 +50,51 @@ def _extra_args(parser):
     return parser
 
 
+def make_lr_schedule(args):
+    """Warmup + {constant|linear|cosine} decay to min_lr, driven by the
+    Megatron lr arg group (reference: the AnnealingLR scheduler those
+    args configure). Returns a jit-safe ``step -> lr`` callable; the
+    fused optimizers call it with their on-device step count."""
+    base, mn = args.lr, args.min_lr
+    decay_iters = args.lr_decay_iters or args.train_iters
+    warmup = args.lr_warmup_iters
+    if args.lr_warmup_fraction is not None:
+        warmup = int(args.lr_warmup_fraction * decay_iters)
+    style = args.lr_decay_style
+
+    def sched(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm_lr = base * step / max(warmup, 1)
+        frac = jnp.clip((step - warmup) / max(decay_iters - warmup, 1),
+                        0.0, 1.0)
+        if style == "constant":
+            decayed = jnp.asarray(base, jnp.float32)
+        elif style == "linear":
+            decayed = base - (base - mn) * frac
+        elif style == "cosine":
+            decayed = mn + (base - mn) * 0.5 * (1.0 + jnp.cos(jnp.pi * frac))
+        else:
+            raise ValueError(f"unknown lr_decay_style {style!r}")
+        return jnp.where(step < warmup, warm_lr, decayed)
+
+    return sched
+
+
 def make_optimizer(args):
     """args.optimizer → fused transform (reference _add_training_args
-    --optimizer {adam,sgd} + the LAMB path of the BERT recipe)."""
+    --optimizer {adam,sgd} + the LAMB path of the BERT recipe), with the
+    lr arg group's warmup/decay schedule."""
+    lr = make_lr_schedule(args)
     if args.optimizer == "adam":
-        return fused_adam(learning_rate=args.lr, betas=(args.adam_beta1,
-                                                        args.adam_beta2),
+        return fused_adam(learning_rate=lr, betas=(args.adam_beta1,
+                                                   args.adam_beta2),
                           eps=args.adam_eps, weight_decay=args.weight_decay)
     if args.optimizer == "lamb":
-        return fused_lamb(learning_rate=args.lr, betas=(args.adam_beta1,
-                                                        args.adam_beta2),
+        return fused_lamb(learning_rate=lr, betas=(args.adam_beta1,
+                                                   args.adam_beta2),
                           eps=args.adam_eps, weight_decay=args.weight_decay)
     if args.optimizer == "sgd":
-        return fused_sgd(learning_rate=args.lr, momentum=args.sgd_momentum,
+        return fused_sgd(learning_rate=lr, momentum=args.sgd_momentum,
                          weight_decay=args.weight_decay)
     raise ValueError(f"unknown optimizer {args.optimizer}")
 
@@ -205,7 +237,11 @@ def main(argv=None):
             # keys None = metadata unreadable → optimistically try the
             # full restore (a failure there surfaces, as it should)
             keys = lm.tree_keys(step0) if step0 is not None else None
+            # --finetune loads weights ONLY (megatron semantics): a
+            # restored optimizer count would pin the lr schedule at the
+            # old run's decay floor
             full = (step0 is not None and not args.no_load_optim
+                    and not args.finetune
                     and (keys is None or "opt" in keys))
             if step0 is not None and full:
                 tmpl = {"params": ckpt_mod.abstract_like(params, repl),

@@ -151,6 +151,34 @@ def test_pretrain_entry_tiny(model, opt):
     assert np.isfinite(out["loss"])
 
 
+def test_lr_schedule_warmup_and_decay():
+    """make_lr_schedule: the Megatron lr group semantics — linear warmup,
+    then constant/linear/cosine decay to min_lr over lr_decay_iters."""
+    import jax.numpy as jnp
+
+    from examples.transformer.pretrain import make_lr_schedule
+
+    a = parse_args(BASE + ["--lr", "1.0", "--min-lr", "0.1",
+                           "--train-iters", "100",
+                           "--lr-warmup-iters", "10",
+                           "--lr-decay-style", "cosine"])
+    s = make_lr_schedule(a)
+    assert float(s(jnp.int32(5))) == pytest.approx(0.5)      # warmup
+    assert float(s(jnp.int32(10))) == pytest.approx(1.0)     # peak
+    assert float(s(jnp.int32(55))) == pytest.approx(0.55, abs=1e-6)  # mid
+    assert float(s(jnp.int32(100))) == pytest.approx(0.1)    # floor
+    assert float(s(jnp.int32(500))) == pytest.approx(0.1)    # clamped
+
+    lin = make_lr_schedule(parse_args(
+        BASE + ["--lr", "1.0", "--train-iters", "100",
+                "--lr-decay-style", "linear"]))
+    assert float(lin(jnp.int32(50))) == pytest.approx(0.5)
+    const = make_lr_schedule(parse_args(
+        BASE + ["--lr", "1.0", "--train-iters", "100",
+                "--lr-decay-style", "constant"]))
+    assert float(const(jnp.int32(99))) == pytest.approx(1.0)
+
+
 @pytest.mark.slow
 def test_pretrain_fp16_dynamic_scaling():
     """--fp16 trains with true float16 params + dynamic loss scaling (the

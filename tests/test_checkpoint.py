@@ -180,7 +180,6 @@ def test_3d_parallel_state_checkpoint_roundtrip(tmp_path):
     P('pp','tp')-sharded global arrays and resumes bitwise-identically to
     an uninterrupted run: the 3D-parallel version of the no-gather
     checkpoint story."""
-    from jax import shard_map
     from apex_tpu.transformer.parallel_state import (
         DATA_AXIS, PIPELINE_AXIS, TENSOR_AXIS)
     from apex_tpu.transformer.testing import TransformerConfig
