@@ -89,6 +89,23 @@ class AverageMeter:
         self.avg = self.sum / self.count
 
 
+def make_lr_schedule(base_lr, len_epoch):
+    """The reference example's adjust_learning_rate (main_amp.py): /10
+    step decay at epochs 30/60/80 with a 5-epoch linear warmup, as a
+    jit-safe step->lr callable for the fused optimizer."""
+
+    def sched(step):
+        step = jnp.asarray(step, jnp.float32)
+        epoch = step / float(len_epoch)
+        factor = (jnp.floor(epoch / 30.0)
+                  + (epoch >= 80.0).astype(jnp.float32))
+        lr = base_lr * jnp.power(0.1, factor)
+        warm = base_lr * (1.0 + step) / (5.0 * len_epoch)
+        return jnp.where(epoch < 5.0, jnp.minimum(warm, lr), lr)
+
+    return sched
+
+
 def _loss_and_metrics(logits, labels):
     """CE loss + prec@1/5 (shared by the train and eval steps; reference
     metering main_amp.py:380-420)."""
@@ -372,7 +389,18 @@ def main(argv=None):
         check_vma=False))(rs_img)
     params, batch_stats = variables["params"], variables["batch_stats"]
 
-    tx = fused_sgd(learning_rate=args.lr, momentum=args.momentum,
+    # steps/epoch feeds the reference lr schedule (warmup epochs 0-5,
+    # /10 decay at 30/60/80); the optimizer reads lr(count) on-device.
+    # Use the REAL epoch length (the reference passes len(train_loader)):
+    # the actual dataset size for real data, the loader's cap otherwise.
+    if args.data and not args.synthetic:
+        full_len = len(_image_folder(_split_root(args.data, "train"))) \
+            // (args.batch_size * nproc)
+    else:
+        full_len = 1281167 // (args.batch_size * nproc)
+    steps = min(args.steps, full_len) if args.steps else full_len
+    tx = fused_sgd(learning_rate=make_lr_schedule(args.lr, steps),
+                   momentum=args.momentum,
                    weight_decay=args.weight_decay)
     params, opt = amp.initialize(
         params, tx, opt_level=args.opt_level,
@@ -411,7 +439,6 @@ def main(argv=None):
 
     train_step = build_train_step(model, opt, mesh,
                                   compute_dtype=policy.compute_dtype)
-    steps = args.steps or (1281167 // (args.batch_size * nproc))
 
     batch_time, losses = AverageMeter(), AverageMeter()
     top1, top5 = AverageMeter(), AverageMeter()

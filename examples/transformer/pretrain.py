@@ -256,7 +256,10 @@ def main(argv=None):
                 # params-only: checkpoint was written with
                 # --no-save-optim, or --no-load-optim was passed
                 # (megatron's warn-and-continue posture)
-                if args.rank == 0 and not args.no_load_optim:
+                if (args.rank == 0 and not args.no_load_optim
+                        and not args.finetune):
+                    # reached without an explicit weights-only flag: the
+                    # checkpoint itself lacks the opt subtree
                     print("checkpoint has no optimizer state (saved with "
                           "--no-save-optim); loading params only",
                           flush=True)

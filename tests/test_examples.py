@@ -27,6 +27,21 @@ def test_imagenet_main_amp_smoke(tmp_path, opt_level):
     assert (tmp_path / "ckpt.pkl").exists()
 
 
+def test_imagenet_lr_schedule_matches_reference_shape():
+    """make_lr_schedule: linear 5-epoch warmup, /10 step decay at epochs
+    30/60/80 (the reference adjust_learning_rate)."""
+    import jax.numpy as jnp
+
+    from examples.imagenet.main_amp import make_lr_schedule
+
+    s = make_lr_schedule(1.0, 100)  # 100 steps/epoch
+    assert float(s(jnp.int32(249))) == pytest.approx(0.5, abs=0.01)
+    assert float(s(jnp.int32(600))) == pytest.approx(1.0)      # post-warm
+    assert float(s(jnp.int32(31 * 100))) == pytest.approx(0.1)
+    assert float(s(jnp.int32(61 * 100))) == pytest.approx(0.01)
+    assert float(s(jnp.int32(81 * 100))) == pytest.approx(0.001)
+
+
 @pytest.mark.slow
 def test_imagenet_l1_cross_product(tmp_path):
     """The L1 cross-product (reference: tests/L1/common/run_test.sh:22-47
