@@ -174,6 +174,7 @@ def test_zero_sharded_optimizer_state_roundtrip(tmp_path):
                                       np.asarray(p4_resumed[k]))
 
 
+@pytest.mark.slow  # compile-heavy end-to-end variant
 def test_3d_parallel_state_checkpoint_roundtrip(tmp_path):
     """Full (pp=2, dp=2, tp=2) GPT training state — stage-local,
     tp-sharded params and optimizer moments — checkpoints as

@@ -6,6 +6,7 @@ optimizer state 1/N per shard.
 """
 
 import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -82,6 +83,7 @@ def test_distributed_adam_matches_fused_adam():
     assert shard_len == (total + NDEV - 1) // NDEV * NDEV // NDEV
 
 
+@pytest.mark.slow  # compile-heavy; the fwd/adam parity siblings stay fast
 def test_distributed_lamb_matches_fused_lamb():
     params, grads = _params(), _grads()
     dist = distributed_fused_lamb(learning_rate=0.01, weight_decay=0.01,

@@ -106,6 +106,7 @@ def test_expert_parallel_matches_reference():
                                atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow  # compile-heavy; the fwd/adam parity siblings stay fast
 def test_expert_parallel_grads_match_reference():
     params, x, ref_fwd, run_ep = _moe_ref_and_ep(1)
     g = jnp.asarray(np.random.RandomState(9).randn(*x.shape) * 0.1,

@@ -100,6 +100,7 @@ def test_ddp_grad_math_check():
         np.testing.assert_allclose(out[i], want, rtol=1e-6)
 
 
+@pytest.mark.slow  # compile-heavy end-to-end variant
 def test_amp_o2_master_params_identical_across_ranks():
     """Port of tests/distributed/amp_master_params/: after DDP-averaged
     O2 training steps on rank-DIFFERENT data, the fp32 master params (and
