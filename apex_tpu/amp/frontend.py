@@ -38,6 +38,9 @@ class Properties(object):
             "master_weights": None,
             "loss_scale": 1.0,
             "half_dtype": jnp.bfloat16,  # TPU extension: which half type
+            # reference kwarg parity (frontend.py:203); advisory here —
+            # functional models return outputs directly
+            "cast_model_outputs": None,
         }
 
     def _update_options_dict(self, new_options):
@@ -241,6 +244,7 @@ def initialize(
     half_dtype=None,
     bn_predicate=_default_bn_predicate,
     verbosity=1,
+    cast_model_outputs=None,
 ):
     """Functional ``amp.initialize`` (reference: apex/amp/frontend.py:195-358).
 
@@ -252,6 +256,12 @@ def initialize(
         bf16 (default) or fp16.
       num_losses / min_loss_scale / max_loss_scale: per-loss scalers
         (frontend.py:195-210).
+      cast_model_outputs: accepted for reference-kwarg parity
+        (frontend.py:203 — the patched forward casts outputs to this
+        dtype). Functional models return values directly; wrap the model
+        output yourself or rely on loss computation in fp32 (the policy's
+        FP32 list covers losses). A non-None value is recorded on the
+        Properties for introspection.
 
     Returns (cast_params, amp_optimizer) — or just cast_params if no
     optimizer given. Policy + properties are recorded in amp._amp_state.
@@ -270,6 +280,7 @@ def initialize(
         ("master_weights", master_weights),
         ("loss_scale", loss_scale),
         ("half_dtype", half_dtype),
+        ("cast_model_outputs", cast_model_outputs),
     ):
         if value is not None:
             setattr(properties, name, value)
