@@ -1,45 +1,111 @@
-"""Peer-memory pool — CUDA-IPC buffer compat surface.
+"""Peer-memory pool — arena accounting over ICI neighbor transfer.
 
-Capability port of apex/contrib/peer_memory/peer_memory.py:5-80 over
-``peer_memory_cuda`` (709 LoC). The reference mmaps raw CUDA allocations
-into sibling processes so halo pushes bypass NCCL. On TPU there is no
-process-addressable peer memory: direct neighbor transfers over ICI are
-what ``lax.ppermute`` compiles to, which is strictly the same capability
-(the kernel-bypass fast path) with no buffer management at all.
+Behavioral port of apex/contrib/peer_memory/peer_memory.py:1-90 (backed
+there by ``peer_memory_cuda``, 709 LoC). The reference carves fp16/fp32/
+int32 views out of one raw CUDA allocation whose pointer is IPC-mapped
+into every sibling process, so halo pushes write straight into a
+neighbor's HBM. On TPU there is no process-addressable peer memory: the
+kernel-bypass neighbor push is what ``lax.ppermute`` compiles to (direct
+ICI DMA), and XLA owns all device allocation under jit.
 
-The pool is therefore a thin allocator of ordinary device arrays that
-keeps the reference's call surface (allocate_peer_tensors) so ported code
-runs; the "peer" aspect is realized by the collectives that consume these
-buffers (see PeerHaloExchanger1d).
+What this class keeps from the reference is everything that is *not* the
+CUDA mapping — the arena bookkeeping that ported callers depend on:
+
+* a static region (signal flags, long-lived buffers) and a dynamic
+  region (per-iteration halo staging), each rounded up to the 256-byte
+  alignment (reference :23-25);
+* per-allocation offset bump with 256-byte alignment and exhaustion
+  asserts carrying the reference's messages (:50-63) — including the
+  reference's exact edge semantics: the bound check is strict ``<`` (an
+  allocation that exactly fills a region trips the assert) and the
+  offset is bumped *before* the assert (a failed static allocation is
+  not rewound; ``reset()`` rewinds only the dynamic region);
+* ``reset()`` rewinding only the dynamic offset (:45-46);
+* peer-rank group validation (:19-21);
+* the fp16 / fp32 / int32 dtype whitelist (:51-89), extended with
+  bfloat16 — the dtype halo buffers actually carry on TPU.
+
+``allocate_peer_tensors`` returns one zeroed device array per peer rank
+(the reference returns mapped views of each peer's arena); the "peer"
+transfer itself is realized by the collectives that consume the buffers
+(see PeerHaloExchanger1d and contrib.bottleneck.halo_exchangers).
 """
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
+_SUPPORTED = tuple(
+    jnp.dtype(s) for s in (jnp.float16, jnp.float32, jnp.int32,
+                           jnp.bfloat16))
+
+
+def _align_up(nbytes, alignment):
+    return ((nbytes + alignment - 1) // alignment) * alignment
+
 
 class PeerMemoryPool:
-    """Reference ctor: peer_memory.py:8 (static_size, dynamic_size,
-    peer_ranks)."""
+    """Reference ctor: peer_memory.py:7 (static_size, dynamic_size,
+    peer_ranks). Sizes are in bytes, as in the reference."""
 
-    def __init__(self, static_size=0, dynamic_size=0, peer_ranks=None):
-        self.static_size = static_size
-        self.dynamic_size = dynamic_size
+    alignment = 256
+
+    def __init__(self, static_size, dynamic_size, peer_ranks=None,
+                 rank=None, peer_group_size=None):
+        # sizes are required, as in the reference — a 0-byte region
+        # rejects every allocation (the strict-< bound), so an unsized
+        # pool would be a silent footgun rather than a compat surface
+        self.static_size = _align_up(static_size, self.alignment)
+        self.dynamic_size = _align_up(dynamic_size, self.alignment)
+        if peer_ranks is not None:
+            # reference peer_memory.py:19-21 — peers must sit in this
+            # rank's node-local group; the reference derives the group
+            # size from the node's device count, so do the same when
+            # the caller doesn't pass one
+            if peer_group_size is None:
+                peer_group_size = jax.local_device_count()
+            if rank is None:
+                # reference: torch.distributed.get_rank(); the global
+                # device-rank of this process's first local device
+                rank = jax.process_index() * jax.local_device_count()
+            base = (rank // peer_group_size) * peer_group_size
+            for pr in peer_ranks:
+                if not base <= pr < base + peer_group_size:
+                    raise AssertionError(
+                        "%d :: peer_rank %d not on same node (ranks=[%d,%d])"
+                        % (rank, pr, base, base + peer_group_size - 1))
         self.peer_ranks = peer_ranks
-        self._dynamic_allocated = 0
+        self.static_offset = 0
+        self.dynamic_offset = 0
 
     def __del__(self):
-        pass
+        pass  # reference frees the raw CUDA arena; XLA owns ours
 
     def reset(self):
-        """Reference: reset dynamic offset (peer_memory.py:40)."""
-        self._dynamic_allocated = 0
+        """Rewind the dynamic region only (reference peer_memory.py:45)."""
+        self.dynamic_offset = 0
 
-    def allocate_peer_tensors(self, shape, dtype, channels_last,
-                              dynamic):
-        """Returns one zeroed buffer per peer rank (reference returns a
-        list of mapped peer tensors, peer_memory.py:50-80)."""
-        n = len(self.peer_ranks) if self.peer_ranks is not None else 1
-        size = int(np.prod(shape))
+    def allocate_peer_tensors(self, shape, dtype, channels_last, dynamic):
+        """Carve one buffer per peer rank out of the arena.
+
+        Mirrors reference peer_memory.py:48-89: align the region offset
+        to 256, bump it by the buffer's byte size, assert on exhaustion.
+        ``channels_last`` is accepted for call compatibility (layout is
+        XLA's concern on TPU).
+        """
+        dt = jnp.dtype(dtype)
+        if dt not in _SUPPORTED:
+            raise AssertionError("dtype %s not supported" % (dtype,))
+        nbytes = int(np.prod(shape)) * dt.itemsize
         if dynamic:
-            self._dynamic_allocated += size * jnp.dtype(dtype).itemsize
-        return [jnp.zeros(tuple(shape), dtype) for _ in range(n)]
+            start = _align_up(self.dynamic_offset, self.alignment)
+            self.dynamic_offset = start + nbytes
+            assert self.dynamic_offset < self.dynamic_size, \
+                "Dynamic peer memory pool exhausted"
+        else:
+            start = _align_up(self.static_offset, self.alignment)
+            self.static_offset = start + nbytes
+            assert self.static_offset < self.static_size, \
+                "Static peer memory pool exhausted"
+        n = len(self.peer_ranks) if self.peer_ranks is not None else 1
+        return [jnp.zeros(tuple(shape), dt) for _ in range(n)]

@@ -16,7 +16,9 @@ from apex_tpu.contrib.bottleneck import (
     HaloExchangerSendRecv,
     SpatialBottleneck,
 )
-from apex_tpu.contrib.peer_memory import PeerHaloExchanger1d
+import pytest
+
+from apex_tpu.contrib.peer_memory import PeerHaloExchanger1d, PeerMemoryPool
 
 NDEV = 8
 
@@ -110,3 +112,40 @@ def test_peer_halo_exchanger_1d_fills_padding():
     np.testing.assert_allclose(out[1, 0], yn[0, -2 * hh])
     # rank 0's top padding zero-filled
     np.testing.assert_array_equal(out[0, 0], 0)
+
+
+def test_peer_memory_pool_arena_accounting():
+    """Port of the reference pool's bookkeeping semantics
+    (apex/contrib/peer_memory/peer_memory.py:23-63): 256-byte alignment,
+    static/dynamic regions, exhaustion asserts, reset()."""
+    pool = PeerMemoryPool(static_size=1000, dynamic_size=2000,
+                          peer_ranks=[0, 1, 2, 3])
+    # sizes round up to the alignment
+    assert pool.static_size == 1024 and pool.dynamic_size == 2048
+
+    bufs = pool.allocate_peer_tensors([2, 4], jnp.int32, False, False)
+    assert len(bufs) == 4 and bufs[0].shape == (2, 4)
+    assert pool.static_offset == 32        # 8 * 4 bytes, from offset 0
+    pool.allocate_peer_tensors([2, 4], jnp.int32, False, False)
+    assert pool.static_offset == 256 + 32  # next alloc aligns up to 256
+
+    # dynamic region: independent offset, rewound by reset()
+    pool.allocate_peer_tensors([100], jnp.float32, False, True)
+    assert pool.dynamic_offset == 400 and pool.static_offset == 288
+    pool.reset()
+    assert pool.dynamic_offset == 0 and pool.static_offset == 288
+
+    with pytest.raises(AssertionError, match="Dynamic peer memory pool"):
+        pool.allocate_peer_tensors([600], jnp.float32, False, True)
+    with pytest.raises(AssertionError, match="Static peer memory pool"):
+        pool.allocate_peer_tensors([300], jnp.float32, False, False)
+    with pytest.raises(AssertionError, match="not supported"):
+        pool.allocate_peer_tensors([4], jnp.int8, False, False)
+
+
+def test_peer_memory_pool_rank_group_validation():
+    """Reference peer_memory.py:19-21 — peers must be node-local."""
+    PeerMemoryPool(256, 256, peer_ranks=[4, 5], rank=5, peer_group_size=4)
+    with pytest.raises(AssertionError, match="not on same node"):
+        PeerMemoryPool(256, 256, peer_ranks=[3, 4], rank=5,
+                       peer_group_size=4)
