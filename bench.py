@@ -35,6 +35,10 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
 def main():
+    # smoke_mode BEFORE any backend-touching import (_smoke.py contract)
+    from benchmarks._smoke import smoke_mode
+    smoke_mode("APEX_BENCH_SMOKE")  # force-CPU tiny sanity mode
+
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -147,6 +151,18 @@ def main():
     sync(out[3])
     dt = (time.perf_counter() - t0 - overhead) / iters
 
+    if dt <= 0:
+        # the dispatch-overhead calibration ran in a slower relay regime
+        # than the timed scan (the relay flaps) — the subtraction went
+        # negative and no throughput can be derived from this run
+        print(json.dumps({
+            "metric": f"gpt2s_train_tokens_per_sec ({platform})",
+            "value": 0, "unit": "tokens/s", "vs_baseline": 0, "mfu": None,
+            "error": "non-positive step time after overhead subtraction "
+                     "(relay flap straddled the calibration); "
+                     "measurement unusable"}), flush=True)
+        return
+
     tokens_per_sec = b * s / dt
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
     mfu = None
@@ -159,6 +175,12 @@ def main():
     # Only meaningful at MXU-feeding batch sizes (the threshold was
     # calibrated at b=8/16) — tiny APEX_BENCH_BATCH overrides are exempt.
     degraded = on_tpu and mfu is not None and mfu < 0.05 and b >= 8
+    # the opposite flap order inflates the number instead: an MFU beyond
+    # any physically plausible value means the overhead calibration ran
+    # in a slower regime than the timed scan — flag it like a degraded
+    # run (kept out of the baseline and the healthy gate)
+    implausible = on_tpu and mfu is not None and mfu > 0.6
+    degraded = degraded or implausible
 
     baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  "BENCH_BASELINE.json")
@@ -187,43 +209,105 @@ def main():
         "dispatch_overhead_ms": round(overhead * 1e3, 1),
     }
     if degraded:
+        # structured kind alongside the prose note: the watchdog's
+        # best-selection tiers on this, never on the wording
+        result["degraded_kind"] = ("implausible" if implausible
+                                   else "relay")
         result["note"] = (
+            "implausible MFU — the relay flap straddled the dispatch-"
+            "overhead calibration and inflated the number; unreliable"
+            if implausible else
             "TPU relay degraded during this run (per-step time far outside "
             "the device envelope measured in PERF.md §1: 82.5 ms/step, "
             "37.6% MFU at b=8); value reflects tunnel latency, not the chip")
-    print(json.dumps(result))
+    print(json.dumps(result), flush=True)
 
 
-def _watchdog():
-    """Run main() in a subprocess with a hard timeout: a wedged TPU relay
-    (observed round 3 — even backend init hangs, PERF.md §6) must produce
-    an honest JSON error line, not hang the caller forever."""
+def _last_json(text):
+    """(line, record) of the last PARSEABLE JSON line in *text*, skipping
+    brace-delimited non-JSON noise (e.g. a repr dict printed during relay
+    teardown); (None, None) when there is none. The one scanner behind
+    the watchdog, the timeout path, and the collection gate."""
+    for line in reversed((text or "").splitlines()):
+        if line.startswith("{") and line.rstrip().endswith("}"):
+            try:
+                return line, json.loads(line)
+            except ValueError:
+                continue
+    return None, None
+
+
+def _requested_backend(rec, smoke=False):
+    """True when *rec* was measured on the requested backend: the TPU,
+    unless *smoke* (where CPU is the requested backend). The load-bearing
+    guard keeping silent-CPU-fallback numbers out of the headline — used
+    by the watchdog's best-selection, its exit code, and the collection
+    gate alike."""
+    return "(tpu)" in rec.get("metric", "") or smoke
+
+
+def _healthy_record(rec, smoke=False):
+    """True when *rec* (a parsed result line) is a healthy measurement on
+    the requested backend: no degraded 'note', no 'error', a positive
+    value, and `_requested_backend`. Single source of truth for the
+    watchdog's stop condition and benchmarks/probe_and_collect.sh's
+    collection gate."""
+    return ("error" not in rec and "note" not in rec
+            and (rec.get("value") or 0) > 0
+            and _requested_backend(rec, smoke))
+
+
+def _healthy_json_line(text, smoke=False):
+    """The last JSON record of *text* when `_healthy_record` accepts it,
+    else None."""
+    _, rec = _last_json(text)
+    return rec if rec is not None and _healthy_record(rec, smoke) else None
+
+
+def _attempt_once(state):
+    """One watchdogged run of main() in a subprocess.
+
+    Returns ``(line, record, returncode_or_None)`` — line and record are
+    None when the child produced no parseable JSON (only possible for a
+    crash: the timeout path always fabricates an error record, and
+    returns returncode None). A wedged
+    TPU relay — observed round 3, even backend init hangs, PERF.md §6 —
+    must produce an honest error line, not hang the caller forever, so
+    the child gets a hard timeout. The live Popen handle is parked in
+    ``state["child"]`` so the SIGTERM handler can take down exactly the
+    in-flight attempt (not the whole process group, which may be shared
+    with a supervising driver).
+    """
     import subprocess
 
     env = dict(os.environ, APEX_BENCH_INNER="1")
     timeout = int(os.environ.get("APEX_BENCH_TIMEOUT", "1800"))
-    try:
-        # capture stdout (the JSON line) only; stderr is inherited so the
-        # '# compiling ...' liveness prints stream during the slow compile
-        out = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                             env=env, timeout=timeout,
-                             stdout=subprocess.PIPE, text=True)
-        sys.stdout.write(out.stdout)
-        return out.returncode
-    except subprocess.TimeoutExpired as e:
-        def as_text(x):
-            return x.decode(errors="replace") if isinstance(x, bytes) else (
-                x or "")
+    label = ("cpu" if os.environ.get("APEX_BENCH_SMOKE") == "1"
+             else "tpu")
 
-        # (stderr streamed live — only stdout was piped)
-        # the child may have printed its result and then wedged in backend
-        # teardown — forward a completed JSON line rather than zeroing it
-        for line in reversed(as_text(e.stdout).splitlines()):
-            if line.startswith("{") and line.rstrip().endswith("}"):
-                print(line)
-                return 0
-        print(json.dumps({
-            "metric": "gpt2s_train_tokens_per_sec (tpu)",
+    # capture stdout (the JSON line) only; stderr is inherited so the
+    # '# compiling ...' liveness prints stream during the slow compile
+    proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
+                            env=env, stdout=subprocess.PIPE, text=True)
+    state["child"] = proc
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+        line, rec = _last_json(out)
+        return line, rec, proc.returncode
+    except subprocess.TimeoutExpired:
+        proc.terminate()
+        try:
+            out, _ = proc.communicate(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, _ = proc.communicate()
+        # the child may have printed its result and then wedged in
+        # backend teardown — forward a completed JSON line over nothing
+        line, rec = _last_json(out)
+        if rec is not None:
+            return line, rec, None
+        rec = {
+            "metric": f"gpt2s_train_tokens_per_sec ({label})",
             "value": 0,
             "unit": "tokens/s",
             "vs_baseline": 0,
@@ -231,8 +315,159 @@ def _watchdog():
             "error": f"bench timed out after {timeout}s (TPU relay "
                      "unresponsive — see PERF.md §6; device-side numbers "
                      "for this tree are in PERF.md §1)",
-        }))
-        return 0
+        }
+        return json.dumps(rec), rec, None
+    finally:
+        state["child"] = None
+
+
+def _watchdog():
+    """Retry through relay flaps, report the best attempt.
+
+    The round-3 relay alternates between healthy, degraded (~40x slow),
+    and wedged within minutes (PERF.md §6) — one unlucky attempt must not
+    be the recorded number. Attempts stop at the first healthy run (no
+    'note'/'error') on the requested backend; otherwise the
+    highest-throughput line is printed, falling back to a cpu-fallback
+    or error line when nothing better exists. A child crash (non-zero
+    exit, no JSON) is retried too — relay-init failures can crash
+    instead of hang — but with a short wait, so a deterministic crash
+    (e.g. an import error, whose traceback already streamed on stderr)
+    re-fails in seconds rather than burning the relay-flap backoff.
+
+    Exactly ONE JSON line goes to stdout. If an outer timeout kills us
+    mid-retry (run_all_tpu.sh budgets bench generously, but the driver's
+    budget is unknown), the SIGTERM handler flushes the best line seen so
+    far instead of dying silently and discarding every measurement.
+    Returns 0 when a real measurement (healthy or degraded) was
+    produced on the requested backend; the child's exit code when every
+    attempt crashed; 1 otherwise.
+    """
+    import signal
+
+    attempts = max(1, int(os.environ.get("APEX_BENCH_ATTEMPTS", "3")))
+    retry_wait = int(os.environ.get("APEX_BENCH_RETRY_WAIT", "120"))
+    smoke = os.environ.get("APEX_BENCH_SMOKE") == "1"
+    # "best"/"fallback" hold (line, record) pairs; best_rank orders
+    # candidates as (healthy?, value) so a healthy measurement always
+    # beats a degraded/implausible one regardless of its (possibly
+    # inflated) tokens/s value
+    state = {"best": None, "best_rank": (-1, -1.0), "fallback": None,
+             "printed": False, "child": None}
+
+    def flush_best():
+        if state["printed"]:
+            return
+        state["printed"] = True
+        pair = state["best"] or state["fallback"]
+        label = "cpu" if smoke else "tpu"
+        print(pair[0] if pair is not None else json.dumps({
+            "metric": f"gpt2s_train_tokens_per_sec ({label})",
+            "value": 0, "unit": "tokens/s", "vs_baseline": 0, "mfu": None,
+            "error": "all bench attempts failed to produce a JSON line"}),
+            flush=True)
+
+    def ok_rc():
+        # 0 only for a real measurement (healthy or degraded) on the
+        # requested backend — a cpu-fallback or error line is a failure
+        pair = state["best"] or state["fallback"]
+        if pair is None:
+            return 1
+        rec = pair[1]
+        return 0 if ("error" not in rec
+                     and _requested_backend(rec, smoke)) else 1
+
+    def on_term(signum, frame):
+        flush_best()
+        child = state["child"]
+        if child is not None:
+            # SIGKILL, not SIGTERM: the observed wedge is a child stuck
+            # in native relay code that never runs Python signal
+            # handling, and this handler cannot wait around to escalate
+            # — an orphaned wedged child would keep the device busy for
+            # every subsequent harness in a collection pass
+            try:
+                child.kill()
+            except OSError:
+                pass
+        os._exit(ok_rc())
+
+    signal.signal(signal.SIGTERM, on_term)
+
+    next_wait = retry_wait
+    last_outcome = "relay-bound"
+    for i in range(attempts):
+        if i:
+            print(f"# attempt {i} was {last_outcome}; retrying in "
+                  f"{next_wait}s ({i + 1}/{attempts})",
+                  file=sys.stderr, flush=True)
+            time.sleep(next_wait)
+            next_wait = retry_wait
+        line, rec, rc = _attempt_once(state)
+        if rec is None:
+            # only a crash lands here (the timeout path always
+            # fabricates an error record): the child exited with no
+            # JSON — deterministic (an import error, traceback already
+            # streamed on stderr) or a transient relay-init failure
+            # (connection reset instead of a hang). Retry either way,
+            # but with a short wait for the NEXT attempt only, so a
+            # deterministic crash re-fails in seconds while later
+            # non-crash retries keep the full relay-flap backoff
+            print(f"# inner bench process crashed (rc={rc}); "
+                  f"attempt {i + 1}/{attempts}", file=sys.stderr,
+                  flush=True)
+            state["crash_rc"] = rc
+            last_outcome = "a crash"
+            next_wait = min(retry_wait, 15)
+            continue
+        value = rec.get("value") or 0
+        # a real measurement is one from the requested backend: when a
+        # relay flap during backend init silently falls back to the CPU
+        # path, that tiny-config smoke number must not be declared the
+        # headline (nor value-compared against TPU tokens/s). Smoke mode
+        # aside, where CPU is the requested backend.
+        requested_backend = _requested_backend(rec, smoke)
+        # a clean CPU line on the FIRST attempt (no crash/timeout seen)
+        # means a host without TPU hardware — main()'s supported local
+        # path — not a mid-flap fallback: accept it as the requested
+        # backend so a CPU-only box runs once and exits 0, as before.
+        # After any failed attempt the strict rule stands (and the
+        # metric label stays an honest "(cpu)" either way).
+        if (not requested_backend and i == 0
+                and "note" not in rec and "error" not in rec):
+            requested_backend = True
+            smoke = True  # ok_rc/tiering follow the same acceptance
+        last_outcome = "relay-bound"
+        # tier 2: healthy; tier 1: degraded (real, tunnel-bound); tier
+        # 0: implausible calibration artifact — its inflated value must
+        # never outrank an honest measurement
+        if _healthy_record(rec, smoke):
+            tier = 2
+        elif rec.get("degraded_kind") == "implausible":
+            tier = 0
+        else:
+            tier = 1
+        rank = (tier, value)
+        if "error" not in rec and requested_backend and \
+                rank > state["best_rank"]:
+            state["best"], state["best_rank"] = (line, rec), rank
+        elif state["best"] is None:
+            # last-resort slot: prefer a non-error (cpu-fallback) line
+            # over an error line
+            prev = state["fallback"]
+            if (prev is None or ("error" in prev[1]
+                                 and "error" not in rec)):
+                state["fallback"] = (line, rec)
+        if _healthy_record(rec, smoke):
+            break  # healthy measurement — done
+    flush_best()
+    if state["best"] is None and state["fallback"] is None:
+        # every attempt crashed or produced nothing: surface the child's
+        # exit code as a small honest diagnostic (rc can be negative for
+        # a signal-killed child)
+        rc = state.get("crash_rc")
+        return rc if isinstance(rc, int) and 0 < rc < 128 else 1
+    return ok_rc()
 
 
 if __name__ == "__main__":

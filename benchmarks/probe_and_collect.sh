@@ -1,47 +1,120 @@
 #!/bin/bash
-# Probe the axon relay; when it answers with a healthy device envelope,
-# collect every queued TPU measurement (run_all_tpu.sh) exactly once.
-# Usage: bash benchmarks/probe_and_collect.sh [interval_s] [outdir]
+# Probe the axon relay; each time it answers at device speed, run a
+# collection pass (run_all_tpu.sh) into a fresh $OUT/passN directory.
+# Passes repeat — the relay can flap mid-collection — until the headline
+# bench measures at device speed on the TPU, or MAX_PASSES is reached.
+# Each pass can take hours (bench retry envelope 5900s + 8 harnesses).
+# Usage: bash benchmarks/probe_and_collect.sh [interval_s] [outdir] [max_passes]
 set -u
 cd "$(dirname "$0")/.."
 INTERVAL="${1:-600}"
 OUT="${2:-/tmp/apex_tpu_collect}"
+MAX_PASSES="${3:-8}"
 mkdir -p "$OUT"
 
 probe() {
-    # Healthy == a 16x(4096^3) bf16 matmul scan runs near the device
-    # envelope (~12 ms marginal => >100 TF/s). Returns 0 when healthy.
+    # Healthy == the MARGINAL bf16 matmul rate between a K=8 and a K=64
+    # scan is near the device envelope (~186 TF/s healthy, PERF.md §0).
+    # The two-K difference cancels the relay's fixed per-dispatch
+    # overhead (~30-90 ms), which a single-scan threshold does not.
     timeout 300 python - <<'EOF'
 import time, sys
 import jax, jax.numpy as jnp
 from jax import lax
 
 x = jnp.ones((4096, 4096), jnp.bfloat16)
-
-def run(c, eps):
-    def body(c, _):
-        return (c @ x) * eps + c, None
-    return lax.scan(body, c, None, length=16)[0]
-
-f = jax.jit(run)
 eps = jnp.bfloat16(1e-8)
-r = f(x, eps); float(r[0, 0])        # compile + warm
-t0 = time.perf_counter(); r = f(x, eps); float(r[0, 0])
-dt = time.perf_counter() - t0
-tf = 16 * 2 * 4096**3 / dt / 1e12
-print(f"probe: {dt*1e3:.1f} ms for 16 matmuls -> {tf:.1f} TF/s", flush=True)
-sys.exit(0 if tf > 100 else 1)
+
+def timed(K):
+    def run(c, eps):
+        def body(c, _):
+            return (c @ x) * eps + c, None
+        return lax.scan(body, c, None, length=K)[0]
+    f = jax.jit(run)
+    r = f(x, eps); float(r[0, 0])        # compile + warm
+    best = float("inf")
+    for i in range(3):
+        # vary eps per call: identical args could be served from a
+        # relay-side result cache without touching the device (the same
+        # defence bench.py uses between warmup and timing)
+        e = jnp.bfloat16(1e-8 * (2 + i))
+        t0 = time.perf_counter(); r = f(x, e); float(r[0, 0])
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+t8, t64 = timed(8), timed(64)
+if t64 <= t8:
+    # a non-positive marginal is itself evidence of relay instability
+    # (flap between the two timings), not of an infinitely fast chip
+    print(f"probe: K=8 {t8*1e3:.1f} ms, K=64 {t64*1e3:.1f} ms "
+          "-> non-positive marginal; unstable", flush=True)
+    sys.exit(1)
+tf = 56 * 2 * 4096**3 / (t64 - t8) / 1e12
+print(f"probe: K=8 {t8*1e3:.1f} ms, K=64 {t64*1e3:.1f} ms "
+      f"-> marginal {tf:.1f} TF/s", flush=True)
+# healthy band: the chip's measured marginal is ~186 TF/s (peak 197);
+# anything far above peak means a flap inflated t8 relative to t64
+# (a too-small positive marginal), not an infinitely fast device
+sys.exit(0 if 100 < tf < 250 else 1)
 EOF
 }
 
+bench_healthy() {  # bench_healthy <bench.log> — bench.py's own health gate
+    python - "$1" <<'EOF'
+import sys
+sys.path.insert(0, ".")   # cwd is the repo root (cd at script top)
+import bench
+try:
+    text = open(sys.argv[1]).read()
+except OSError:
+    sys.exit(1)
+sys.exit(0 if bench._healthy_json_line(text) else 1)
+EOF
+}
+
+# resume the pass numbering across invocations: a rerun into the same
+# outdir must extend, never clobber, earlier passN logs
+PASS=0
+for d in "$OUT"/pass*; do
+    [ -d "$d" ] || continue
+    n="${d##*pass}"
+    case "$n" in (*[!0-9]*|'') continue ;; esac
+    [ "$n" -gt "$PASS" ] && PASS=$n
+done
+[ "$PASS" -gt 0 ] && echo "resuming after existing pass$PASS in $OUT"
+if [ "$PASS" -gt 0 ] && bench_healthy "$OUT/pass$PASS/bench.log"; then
+    echo "pass$PASS already holds a device-speed bench; nothing to do"
+    exit 0
+fi
+if [ "$PASS" -ge "$MAX_PASSES" ]; then
+    echo "already at max passes ($MAX_PASSES) on resume; giving up"
+    exit 1
+fi
 while true; do
     echo "[$(date +%H:%M:%S)] probing relay..."
     if probe; then
-        echo "[$(date +%H:%M:%S)] relay HEALTHY - collecting"
-        bash benchmarks/run_all_tpu.sh "$OUT"
-        echo "[$(date +%H:%M:%S)] collection complete -> $OUT"
-        exit 0
+        PASS=$((PASS + 1))
+        # fresh outdir per pass: a retry must never clobber an earlier
+        # pass's device-speed profile logs with relay-degraded ones
+        PASS_OUT="$OUT/pass$PASS"
+        echo "[$(date +%H:%M:%S)] relay HEALTHY - collecting (pass $PASS)"
+        bash benchmarks/run_all_tpu.sh "$PASS_OUT"
+        echo "[$(date +%H:%M:%S)] collection pass $PASS done -> $PASS_OUT"
+        # the relay flaps: a healthy probe does not guarantee a healthy
+        # collection. Keep looping until the headline bench ran at
+        # device speed (bench.py stamps relay-degraded runs with a
+        # 'note' and outright failures with an 'error').
+        if bench_healthy "$PASS_OUT/bench.log"; then
+            echo "[$(date +%H:%M:%S)] bench is device-speed; done"
+            exit 0
+        fi
+        if [ "$PASS" -ge "$MAX_PASSES" ]; then
+            echo "[$(date +%H:%M:%S)] max passes ($MAX_PASSES) reached; giving up"
+            exit 1
+        fi
+        echo "[$(date +%H:%M:%S)] bench still relay-bound; next pass in ${INTERVAL}s"
+    else
+        echo "[$(date +%H:%M:%S)] degraded/unreachable; retry in ${INTERVAL}s"
     fi
-    echo "[$(date +%H:%M:%S)] degraded/unreachable; retry in ${INTERVAL}s"
     sleep "$INTERVAL"
 done
