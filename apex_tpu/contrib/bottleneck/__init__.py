@@ -11,4 +11,5 @@ from apex_tpu.contrib.bottleneck.halo_exchangers import (  # noqa: F401
     HaloExchangerNoComm,
     HaloExchangerPeer,
     HaloExchangerSendRecv,
+    HaloPadder,
 )

@@ -95,3 +95,38 @@ class HaloExchangerPeer(HaloExchanger):
         self.peer_pool = peer_pool
         self.explicit_nhwc = explicit_nhwc
         self.numSM = numSM
+
+
+class HaloPadder:
+    """Pad a spatial shard with neighbor halo rows/cols in one shot
+    (reference: halo_exchangers.py:118-165 — allocates the padded
+    buffer on side streams and fills the edges from the exchanger).
+    Functional here: returns a new array of the padded shape.
+
+    ``y`` is the UNPADDED per-rank NHWC shard; the result has
+    ``2*half_halo`` extra rows (H_split) or cols filled from the
+    neighbors, zeros at the outer edges. ``explicit_nhwc`` is accepted
+    for call parity (layout is XLA's concern on TPU); ``wait()`` is a
+    no-op — there are no side streams to synchronize."""
+
+    def __init__(self, halo_ex):
+        self.halo_ex = halo_ex
+
+    def __call__(self, y, half_halo, explicit_nhwc=False, H_split=True):
+        hh = half_halo
+        axis = 1 if H_split else 2
+
+        def take(arr, start, size):
+            idx = [slice(None)] * arr.ndim
+            idx[axis] = slice(start, start + size)
+            return arr[tuple(idx)]
+
+        n = y.shape[axis]
+        top_out = take(y, 0, hh)          # first rows → previous rank
+        bot_out = take(y, n - hh, hh)     # last rows → next rank
+        left_in, right_in = self.halo_ex.left_right_halo_exchange(
+            top_out, bot_out)
+        return jnp.concatenate([left_in, y, right_in], axis=axis)
+
+    def wait(self):
+        pass

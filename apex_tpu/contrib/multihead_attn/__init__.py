@@ -6,3 +6,7 @@ from apex_tpu.contrib.multihead_attn.self_multihead_attn import (  # noqa: F401
 from apex_tpu.contrib.multihead_attn.encdec_multihead_attn import (  # noqa: F401
     EncdecMultiheadAttn,
 )
+from apex_tpu.contrib.multihead_attn.mask_softmax_dropout import (  # noqa: F401
+    MaskSoftmaxDropout,
+    mask_softmax_dropout,
+)

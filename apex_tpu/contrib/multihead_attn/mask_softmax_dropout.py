@@ -1,0 +1,74 @@
+"""Fused mask + softmax + dropout building block.
+
+Capability port of apex/contrib/multihead_attn/mask_softmax_dropout_func.py
+(:6-96, over ``fast_multihead_attn.mask_softmax_dropout_*`` CUDA kernels).
+The reference exposes the attention-probability sub-step of the fast MHA
+path as its own autograd Function so models can fuse just the
+mask/softmax/dropout portion; the backward recomputes from the stashed
+softmax results. Under XLA the fusion and the recompute policy are the
+compiler's job — the port is the numerics: additive or boolean padding
+mask, fp32 softmax, train-time dropout with inverted scaling.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.functional.fused_softmax import (
+    scaled_masked_softmax,
+)
+
+
+def mask_softmax_dropout(is_training, heads, inputs, pad_mask=None,
+                         mask_additive=False, dropout_prob=0.0,
+                         dropout_rng=None):
+    """Returns dropout(softmax(mask(inputs))).
+
+    ``inputs``: [b*heads, sq, sk] attention scores (the reference's
+    shape, mask_softmax_dropout_func.py:8). ``pad_mask``: [b, 1, sq, sk]
+    or broadcastable; additive (added to the scores) when
+    ``mask_additive``, else boolean True == masked (reference: the
+    byte-mask fill path). fp32 softmax, output in the input dtype.
+    """
+    dtype = inputs.dtype
+    b_heads, sq, sk = inputs.shape
+    mask = pad_mask
+    if mask is not None and mask.ndim == 4:
+        # [b, 1 or heads, sq, sk] → per-(batch·head) rows
+        mask = jnp.broadcast_to(
+            mask, (b_heads // heads, heads, sq, sk)
+        ).reshape(b_heads, sq, sk)
+    if mask is not None and mask_additive:
+        x = inputs.astype(jnp.float32) + mask.astype(jnp.float32)
+        probs = jax.nn.softmax(x, axis=-1).astype(dtype)
+    else:
+        # boolean path: shared fp32 masked softmax — fully-masked rows
+        # emit zeros, the reference kernels' semantics (and the repo's
+        # FusedScaleMaskSoftmax's, functional/fused_softmax.py:30-51)
+        probs = scaled_masked_softmax(inputs, mask)
+    if is_training and dropout_prob > 0.0:
+        if dropout_rng is None:
+            raise ValueError(
+                "mask_softmax_dropout: dropout_rng is required when "
+                "training with dropout_prob > 0")
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_prob,
+                                    probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_prob),
+                          jnp.zeros((), dtype))
+    return probs
+
+
+class MaskSoftmaxDropout:
+    """Class-shaped surface mirroring the reference autograd Function's
+    ``apply(is_training, heads, inputs, pad_mask, mask_additive,
+    dropout_prob)`` calling convention; JAX AD replaces the hand-written
+    backward (which recomputes through the stashed softmax)."""
+
+    @staticmethod
+    def apply(is_training, heads, inputs, pad_mask, mask_additive,
+              dropout_prob, dropout_rng=None):
+        return mask_softmax_dropout(is_training, heads, inputs, pad_mask,
+                                    mask_additive, dropout_prob,
+                                    dropout_rng)
+
+    def __call__(self, *args, **kwargs):
+        return self.apply(*args, **kwargs)

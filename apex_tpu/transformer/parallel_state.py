@@ -95,6 +95,13 @@ def model_parallel_is_initialized():
     return _STATE.mesh is not None
 
 
+def is_unitialized():
+    """Reference: parallel_state.py:76 (sic — the reference's spelling is
+    kept for call compatibility). Useful for code segments that may be
+    accessed with or without parallel-state initialization."""
+    return _STATE.mesh is None
+
+
 def get_mesh():
     assert _STATE.mesh is not None, "model parallel is not initialized"
     return _STATE.mesh
@@ -138,6 +145,26 @@ def get_embedding_group():
     """First+last pipeline stages (tied embeddings). On TPU the tied-weight
     grad sync is a masked psum over the pp axis — see
     pipeline_parallel.schedules.allreduce_embedding_grads."""
+    return PIPELINE_AXIS
+
+
+def get_position_embedding_group():
+    """Stages holding position embeddings: first stage (+ decoder's first
+    stage when a split rank is set). Like the embedding group, realized
+    as a masked collective over the pp axis (reference:
+    parallel_state.py:370 returns a dedicated process group)."""
+    return PIPELINE_AXIS
+
+
+def get_encoder_relative_position_embedding_group():
+    """Encoder stages (pp ranks [0, split)); reference
+    parallel_state.py:377. Masked collective over the pp axis."""
+    return PIPELINE_AXIS
+
+
+def get_decoder_relative_position_embedding_group():
+    """Decoder stages (pp ranks [split, pp)); reference
+    parallel_state.py:383. Masked collective over the pp axis."""
     return PIPELINE_AXIS
 
 
@@ -227,9 +254,142 @@ def get_tensor_model_parallel_src_rank():
     return 0
 
 
+def get_data_parallel_src_rank():
+    """Index 0 along dp (reference: parallel_state.py:586 computes the
+    global rank of the first dp-group member)."""
+    return 0
+
+
 def get_pipeline_model_parallel_first_rank():
     return 0
 
 
 def get_pipeline_model_parallel_last_rank():
     return _STATE.pipeline_model_parallel_size - 1
+
+
+def get_pipeline_model_parallel_next_rank():
+    """Traced: the pp index of the next stage, ring-wrapped (reference:
+    parallel_state.py:602 computes the global rank)."""
+    pp = _STATE.pipeline_model_parallel_size
+    return (jax.lax.axis_index(PIPELINE_AXIS) + 1) % pp
+
+
+def get_pipeline_model_parallel_prev_rank():
+    """Traced: the pp index of the previous stage, ring-wrapped
+    (reference: parallel_state.py:609)."""
+    pp = _STATE.pipeline_model_parallel_size
+    return (jax.lax.axis_index(PIPELINE_AXIS) - 1) % pp
+
+
+def get_rank_info():
+    """(dp, tp, pp, vpp)-rank tuple for loggers (reference:
+    parallel_state.py:313). Traced entries inside shard_map; (0, 0, 0, 0)
+    when uninitialized (the reference's sentinel) and zeros with the
+    host-side vpp rank (None when vpp is unset, as in the reference)
+    in a host context."""
+    if not model_parallel_is_initialized():
+        return (0, 0, 0, 0)
+    try:
+        return (
+            get_data_parallel_rank(),
+            get_tensor_model_parallel_rank(),
+            get_pipeline_model_parallel_rank(),
+            get_virtual_pipeline_model_parallel_rank(),
+        )
+    except NameError:  # axis names unbound: host context
+        return (0, 0, 0, _STATE.virtual_pipeline_model_parallel_rank)
+
+
+# ---------------------------------------------------------------------------
+# encoder/decoder split predicates (reference: parallel_state.py:389-460).
+# Traced where they depend on the stage index; concrete True for the
+# degenerate cases, exactly as the reference short-circuits them.
+# ---------------------------------------------------------------------------
+
+def is_rank_in_embedding_group(ignore_virtual=False):
+    """First or last pipeline stage (reference: parallel_state.py:389 —
+    _EMBEDDING_GLOBAL_RANKS = [first, (split,) last])."""
+    del ignore_virtual  # virtual chunks share the stage's devices on TPU
+    pp = _STATE.pipeline_model_parallel_size
+    if pp == 1:
+        return True
+    rank = jax.lax.axis_index(PIPELINE_AXIS)
+    in_group = (rank == 0) | (rank == pp - 1)
+    split = _STATE.pipeline_model_parallel_split_rank
+    if split is not None:
+        in_group = in_group | (rank == split)
+    return in_group
+
+
+def is_rank_in_position_embedding_group():
+    """First stage, plus the decoder's first stage under a split
+    (reference: parallel_state.py:405 — _POSITION_EMBEDDING_GLOBAL_RANKS
+    = [0] or [0, split])."""
+    pp = _STATE.pipeline_model_parallel_size
+    if pp == 1:
+        return True
+    rank = jax.lax.axis_index(PIPELINE_AXIS)
+    in_group = rank == 0
+    split = _STATE.pipeline_model_parallel_split_rank
+    if split is not None:
+        in_group = in_group | (rank == split)
+    return in_group
+
+
+def is_rank_in_encoder_relative_position_embedding_group():
+    """Encoder stages: pp rank < split (reference:
+    parallel_state.py:411); every stage when no split is set."""
+    split = _STATE.pipeline_model_parallel_split_rank
+    if split is None or _STATE.pipeline_model_parallel_size == 1:
+        return True
+    return jax.lax.axis_index(PIPELINE_AXIS) < split
+
+
+def is_rank_in_decoder_relative_position_embedding_group():
+    """Decoder stages: pp rank >= split (reference:
+    parallel_state.py:417); every stage when no split is set."""
+    split = _STATE.pipeline_model_parallel_split_rank
+    if split is None or _STATE.pipeline_model_parallel_size == 1:
+        return True
+    return jax.lax.axis_index(PIPELINE_AXIS) >= split
+
+
+def is_pipeline_stage_before_split(rank=None):
+    """True if this stage executes the encoder of an encoder-decoder
+    model (reference: parallel_state.py:423)."""
+    if _STATE.pipeline_model_parallel_size == 1:
+        return True
+    split = _STATE.pipeline_model_parallel_split_rank
+    if split is None:
+        return True
+    if rank is None:
+        rank = jax.lax.axis_index(PIPELINE_AXIS)
+    return rank < split
+
+
+def is_pipeline_stage_after_split(rank=None):
+    """True if this stage executes the decoder of an encoder-decoder
+    model (reference: parallel_state.py:438)."""
+    if _STATE.pipeline_model_parallel_size == 1:
+        return True
+    split = _STATE.pipeline_model_parallel_split_rank
+    if split is None:
+        return True
+    if rank is None:
+        rank = jax.lax.axis_index(PIPELINE_AXIS)
+    return rank >= split
+
+
+def is_pipeline_stage_at_split():
+    """True on the last encoder stage: it runs the encoder and the next
+    stage runs the decoder. Defined exactly as the reference composes it
+    (parallel_state.py:453: before_split(rank) and after_split(rank+1)),
+    including the degenerate short-circuits (True when pp == 1 or no
+    split rank is set)."""
+    if (_STATE.pipeline_model_parallel_size == 1
+            or _STATE.pipeline_model_parallel_split_rank is None):
+        return True
+    rank = jax.lax.axis_index(PIPELINE_AXIS)
+    return (is_pipeline_stage_before_split(rank)
+            & is_pipeline_stage_after_split(rank + 1))

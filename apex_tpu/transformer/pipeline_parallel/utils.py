@@ -168,3 +168,32 @@ def get_ltor_masks_and_position_ids(data, eod_token, reset_position_ids=False,
     # reference convention: mask value <0.5 means masked
     attention_mask = attention_mask < 0.5
     return attention_mask, loss_mask, position_ids
+
+
+def param_is_not_shared(param):
+    """True when *param* is not a shared (tied) parameter. Upstream
+    Megatron semantics — a leaf without a ``shared`` attribute, or with
+    ``shared == False``, counts once. (The reference's own body,
+    utils.py:181-182, returns ``getattr(param, "shared", False)`` —
+    inverted relative to its name and upstream; plain jnp leaves carry
+    no attributes, so the faithful-to-intent form is implemented.)"""
+    return not getattr(param, "shared", False)
+
+
+def unwrap_model(model, module_instances=()):
+    """Strip wrapper modules (reference: utils.py:185-196 unwraps
+    DistributedDataParallel around each chunk). apex_tpu's DDP wraps
+    gradients functionally rather than the module, so there is usually
+    nothing to strip — wrappers listed in *module_instances* are
+    unwrapped via their ``module`` attribute, preserving the reference's
+    list-in/list-out convention."""
+    return_list = True
+    if not isinstance(model, list):
+        model = [model]
+        return_list = False
+    unwrapped = []
+    for m in model:
+        while module_instances and isinstance(m, tuple(module_instances)):
+            m = m.module
+        unwrapped.append(m)
+    return unwrapped if return_list else unwrapped[0]

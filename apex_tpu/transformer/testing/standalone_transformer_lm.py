@@ -125,6 +125,62 @@ def scaled_init_method_normal(sigma, num_layers):
     return nn.initializers.normal(stddev=sigma / math.sqrt(2.0 * num_layers))
 
 
+def init_method_normal(sigma):
+    """N(0, sigma) initializer (reference parity name,
+    standalone_transformer_lm.py:146; same object as ``init_normal``)."""
+    return init_normal(sigma)
+
+
+def get_linear_layer(rows, columns, init_method):
+    """A plain Dense(rows→columns) with the given kernel init and zero
+    bias (reference: standalone_transformer_lm.py:130-136)."""
+    del rows  # flax infers the input width at first call
+    return nn.Dense(columns, kernel_init=init_method,
+                    bias_init=nn.initializers.zeros)
+
+
+def get_num_layers(args, is_encoder_and_decoder_model,
+                   pipeline_rank=0, before_split=True):
+    """Transformer layers resident on one pipeline stage (reference:
+    standalone_transformer_lm.py:1038-1096). The reference reads the
+    stage index from the process's rank; in SPMD the caller passes the
+    static ``pipeline_rank`` (and, for encoder-decoder models, whether
+    that stage sits before the split) when building the per-stage
+    program."""
+    pp = args.pipeline_model_parallel_size
+    if pp <= 1:
+        return args.num_layers
+    if is_encoder_and_decoder_model:
+        assert args.pipeline_model_parallel_split_rank is not None
+        # with a standalone embedding stage, the encoder loses one rank
+        # to the embedding so the split rank keeps its meaning
+        num_ranks_in_encoder = (
+            args.pipeline_model_parallel_split_rank - 1
+            if args.standalone_embedding_stage
+            else args.pipeline_model_parallel_split_rank)
+        num_ranks_in_decoder = (
+            args.transformer_pipeline_model_parallel_size
+            - num_ranks_in_encoder)
+        assert args.num_layers % num_ranks_in_encoder == 0, (
+            f"num_layers ({args.num_layers}) must be divisible by number "
+            f"of ranks given to encoder ({num_ranks_in_encoder})")
+        assert args.num_layers % num_ranks_in_decoder == 0, (
+            f"num_layers ({args.num_layers}) must be divisible by number "
+            f"of ranks given to decoder ({num_ranks_in_decoder})")
+        if before_split:
+            return (0 if args.standalone_embedding_stage
+                    and pipeline_rank == 0
+                    else args.num_layers // num_ranks_in_encoder)
+        return args.num_layers // num_ranks_in_decoder
+    assert (args.num_layers
+            % args.transformer_pipeline_model_parallel_size == 0), (
+        "num_layers must be divisible by "
+        "transformer_pipeline_model_parallel_size")
+    return (0 if args.standalone_embedding_stage and pipeline_rank == 0
+            else args.num_layers
+            // args.transformer_pipeline_model_parallel_size)
+
+
 # ---------------------------------------------------------------------------
 # functional logits (explicit weight tying; embedding core lives in
 # tensor_parallel.layers.vocab_parallel_embed)

@@ -4,6 +4,7 @@ Capability port of apex/transformer/utils.py and
 apex/transformer/tensor_parallel/utils.py:22-100.
 """
 
+import jax
 import jax.numpy as jnp
 
 
@@ -28,6 +29,30 @@ def split_tensor_along_last_dim(tensor, num_partitions):
         jnp.asarray(t)
         for t in jnp.split(tensor, num_partitions, axis=-1)
     ] if last_dim_size else []
+
+
+def split_tensor_into_1d_equal_chunks(tensor, new_buffer=False, *,
+                                      axis_name="tp"):
+    """This tp-rank's equal 1D chunk of *tensor* (reference:
+    transformer/utils.py:21-29 — the sequence-parallel flatten/scatter
+    used for distributed activation storage). Traced: call inside
+    ``shard_map`` over the tp axis. ``new_buffer`` is the upstream
+    Megatron signature's copy-vs-view knob, accepted as a no-op (JAX
+    arrays are immutable; there is no aliasing to opt out of)."""
+    del new_buffer
+    data = tensor.reshape(-1)
+    world = jax.lax.axis_size(axis_name)
+    partition = data.shape[0] // world
+    start = partition * jax.lax.axis_index(axis_name)
+    return jax.lax.dynamic_slice(data, (start,), (partition,))
+
+
+def gather_split_1d_tensor(tensor, *, axis_name="tp"):
+    """Inverse of :func:`split_tensor_into_1d_equal_chunks`: all-gather
+    the chunks back into the full flat tensor (reference:
+    transformer/utils.py:32-48, `_all_gather_base` over the tp group)."""
+    return jax.lax.all_gather(tensor.reshape(-1), axis_name,
+                              tiled=True)
 
 
 class VocabUtility:
