@@ -29,3 +29,16 @@ GRU = _factory("GRU")
 ReLU = _factory("ReLU")
 Tanh = _factory("Tanh")
 mLSTM = _factory("mLSTM")
+
+
+def toRNNBackend(cell_type, input_size, hidden_size, num_layers=1,
+                 bidirectional=False, dropout=0, **kwargs):
+    """Build a stacked (optionally bidirectional) RNN from a cell type
+    (reference: apex/RNN/models.py:19-27 — wraps a cell instance in
+    bidirectionalRNN/stackedRNN + RNNBackend). The functional port takes
+    the cell *type* plus sizes, since cells here are parameterless
+    functions rather than modules."""
+    from apex_tpu.RNN.rnn_backend import bidirectionalRNN, stackedRNN
+    build = bidirectionalRNN if bidirectional else stackedRNN
+    return build(cell_type, input_size, hidden_size, num_layers=num_layers,
+                 dropout=dropout, **kwargs)

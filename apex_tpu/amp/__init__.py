@@ -28,7 +28,8 @@ from apex_tpu.amp.frontend import (
 )
 from apex_tpu.amp.scaler import LossScaler, LossScalerState
 from apex_tpu.amp.amp_optimizer import AmpOptimizer, AmpOptState
-from apex_tpu.amp.handle import scale_loss, value_and_scaled_grad, disable_casts
+from apex_tpu.amp.handle import (scale_loss, value_and_scaled_grad,
+                                 disable_casts, AmpHandle, NoOpHandle)
 from apex_tpu.amp.policy import (
     Policy,
     autocast,
@@ -54,6 +55,7 @@ __all__ = [
     "initialize", "state_dict", "load_state_dict", "opt_levels", "Properties",
     "build_policy", "LossScaler", "LossScalerState", "AmpOptimizer",
     "AmpOptState", "scale_loss", "value_and_scaled_grad", "disable_casts",
+    "AmpHandle", "NoOpHandle",
     "Policy", "autocast", "current_policy", "compute_dtype", "half_function",
     "float_function", "promote_function", "register_half_function",
     "register_float_function", "register_promote_function", "cast_for_op",

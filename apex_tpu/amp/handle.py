@@ -60,3 +60,131 @@ def disable_casts():
 
     with _policy.disable_casts():
         yield
+
+
+class AmpHandle:
+    """Legacy handle object (reference: apex/amp/handle.py:22-160,
+    returned by the deprecated ``amp.init()``). The reference handle
+    owns the loss scaler and a cache of casted weights; here it wraps
+    an ``(amp_optimizer, state)`` pair and exposes the same control
+    surface. The ``scale_loss`` context yields the scaled loss; the
+    caller differentiates it and passes the grads through
+    ``amp_optimizer.apply_gradients`` as usual — single-controller JAX
+    has no backward() side effect to hook.
+    """
+
+    def __init__(self, amp_optimizer=None, state=None, enable_caching=True,
+                 verbose=False):
+        self._amp_optimizer = amp_optimizer
+        self._state = state
+        self._cache = {}
+        self._enable_caching = enable_caching
+        self._verbose = verbose
+        self._is_active = True
+
+    def is_active(self):
+        """Reference: handle.py:179 — a method, not a property."""
+        return self._is_active
+
+    @property
+    def has_cache(self):
+        return self._enable_caching
+
+    @property
+    def cache(self):
+        return self._cache
+
+    def remove_cache(self, param):
+        if self._enable_caching and param in self._cache:
+            del self._cache[param]
+
+    @property
+    def verbose(self):
+        return self._verbose
+
+    @property
+    def state(self):
+        return self._state
+
+    def update_state(self, state):
+        """Thread the latest AmpOptState into the handle. Dynamic loss
+        scaling mutates the scale inside the state the caller threads
+        through ``apply_gradients``; a handle holding the construction-
+        time state would scale by a stale factor."""
+        self._state = state
+        return state
+
+    @contextlib.contextmanager
+    def scale_loss(self, loss, optimizer=None, loss_id=0, state=None):
+        """Reference: handle.py:84-157. Yields loss * current scale.
+
+        ``optimizer`` may carry the amp optimizer when the handle was
+        built bare (the reference amp.init() pattern passes it per
+        call); ``state`` overrides the handle's threaded state for this
+        call."""
+        if not self._is_active:
+            yield loss
+            return
+        amp_opt = self._amp_optimizer
+        if amp_opt is None and optimizer is not None and hasattr(
+                optimizer, "scale_loss"):
+            amp_opt = optimizer
+        if amp_opt is None:
+            raise RuntimeError(
+                "AmpHandle has no amp optimizer: construct it as "
+                "AmpHandle(amp_optimizer, state) or pass the wrapped "
+                "optimizer to scale_loss — silently skipping loss "
+                "scaling would underflow fp16 gradients")
+        use_state = state if state is not None else self._state
+        if use_state is None:
+            raise RuntimeError(
+                "AmpHandle has no amp state: pass state= or call "
+                "update_state() with the state threaded through "
+                "apply_gradients")
+        yield scale_loss(loss, amp_opt, use_state, loss_id=loss_id)
+
+    def wrap_optimizer(self, optimizer, num_loss=1):
+        """Reference: handle.py:66-72 — here amp.initialize already
+        returns the wrapped optimizer; passthrough for ported code."""
+        return optimizer
+
+    def _clear_cache(self):
+        self._cache.clear()
+
+    @contextlib.contextmanager
+    def _disable_casts(self):
+        """Reference: handle.py:183 — casts are policy-driven here."""
+        from apex_tpu.amp import policy as _policy
+        with _policy.disable_casts():
+            yield
+
+    def _deactivate(self):
+        self._is_active = False
+
+
+class NoOpHandle:
+    """Reference: apex/amp/handle.py:250-281 — the disabled-amp handle."""
+
+    has_cache = False
+    verbose = False
+
+    def is_active(self):
+        return False
+
+    @contextlib.contextmanager
+    def scale_loss(self, loss, optimizer=None, loss_id=0, state=None):
+        del optimizer, loss_id, state  # same surface as AmpHandle
+        yield loss
+
+    def wrap_optimizer(self, optimizer, num_loss=1):
+        return optimizer
+
+    @contextlib.contextmanager
+    def _disable_casts(self):
+        yield
+
+    def _clear_cache(self):
+        pass
+
+    def _deactivate(self):
+        pass

@@ -103,18 +103,23 @@ class HaloPadder:
     buffer on side streams and fills the edges from the exchanger).
     Functional here: returns a new array of the padded shape.
 
-    ``y`` is the UNPADDED per-rank NHWC shard; the result has
-    ``2*half_halo`` extra rows (H_split) or cols filled from the
-    neighbors, zeros at the outer edges. ``explicit_nhwc`` is accepted
-    for call parity (layout is XLA's concern on TPU); ``wait()`` is a
-    no-op — there are no side streams to synchronize."""
+    ``y`` is the UNPADDED per-rank shard; the result has ``2*half_halo``
+    extra rows (H_split) or cols filled from the neighbors, zeros at the
+    outer edges. ``explicit_nhwc`` selects the layout exactly as in the
+    reference: True → NHWC (H at dim 1), False → NCHW (H at dim 2) —
+    but this codebase is NHWC throughout (see bottleneck.py), so the
+    default here is True, a documented divergence from the reference's
+    False. ``wait()`` is a no-op — no side streams to synchronize."""
 
     def __init__(self, halo_ex):
         self.halo_ex = halo_ex
 
-    def __call__(self, y, half_halo, explicit_nhwc=False, H_split=True):
+    def __call__(self, y, half_halo, explicit_nhwc=True, H_split=True):
         hh = half_halo
-        axis = 1 if H_split else 2
+        if explicit_nhwc:
+            axis = 1 if H_split else 2    # N H W C
+        else:
+            axis = 2 if H_split else 3    # N C H W
 
         def take(arr, start, size):
             idx = [slice(None)] * arr.ndim

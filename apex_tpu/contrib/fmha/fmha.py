@@ -16,6 +16,8 @@ long totals, identical semantics.
 
 import jax
 import jax.numpy as jnp
+
+from apex_tpu.utils import train_dropout
 import numpy as np
 from flax import linen as nn
 from jax import lax
@@ -74,8 +76,7 @@ def fmha_varlen(qkv, cu_seqlens, p_dropout=0.0, max_s=512,
     if is_training and p_dropout > 0.0:
         if rng is None:
             raise ValueError("dropout requires an rng key")
-        keep = jax.random.bernoulli(rng, 1.0 - p_dropout, probs.shape)
-        probs = jnp.where(keep, probs / (1.0 - p_dropout), 0.0)
+        probs = train_dropout(rng, probs, p_dropout)
 
     ctx = lax.dot_general(probs, v.transpose(1, 0, 2),
                           (((2,), (1,)), ((0,), (0,))),

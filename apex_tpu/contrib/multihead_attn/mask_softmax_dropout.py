@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from apex_tpu.transformer.functional.fused_softmax import (
     scaled_masked_softmax,
 )
+from apex_tpu.utils import train_dropout
 
 
 def mask_softmax_dropout(is_training, heads, inputs, pad_mask=None,
@@ -50,10 +51,8 @@ def mask_softmax_dropout(is_training, heads, inputs, pad_mask=None,
             raise ValueError(
                 "mask_softmax_dropout: dropout_rng is required when "
                 "training with dropout_prob > 0")
-        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_prob,
-                                    probs.shape)
-        probs = jnp.where(keep, probs / (1.0 - dropout_prob),
-                          jnp.zeros((), dtype))
+        probs = train_dropout(dropout_rng, probs, dropout_prob,
+                              zero=jnp.zeros((), dtype))
     return probs
 
 

@@ -54,3 +54,19 @@ def create_mask(tensor, pattern="m4n2_1d", density=0.5):
         m_str, n_str = body[1:].split("n")
         return _nm_mask(tensor, int(n_str), int(m_str))
     raise ValueError(f"unsupported sparsity pattern: {pattern}")
+
+
+# named pattern entry points (reference: sparse_masklib.py:90-143 —
+# `mn_1d_best` searches the best n-of-m column mask per group, and the
+# m4n2_* wrappers pin (m, n); the 2d variants apply the same selection
+# to 4x4 blocks on magnitude-transposed views)
+def mn_1d_best(matrix, m, n):
+    """Best n:m 1D mask (reference: sparse_masklib.py:90-104). The jnp
+    top-k selection in `_nm_mask` IS the best-per-group choice."""
+    return _nm_mask(matrix, n, m)
+
+
+def m4n2_1d(mat, density=None):
+    """Reference: sparse_masklib.py:106-107."""
+    del density  # fixed by the pattern, kept for the reference signature
+    return mn_1d_best(mat, 4, 2)

@@ -21,6 +21,8 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+from apex_tpu.utils import train_dropout
 from jax import lax
 
 _NEG = -1e30
@@ -42,8 +44,7 @@ def transducer_joint(f, g, f_len, g_len, pack_output=False, relu=False,
     if dropout and dropout_prob > 0.0:
         if rng is None:
             raise ValueError("dropout requires an rng key")
-        keep = jax.random.bernoulli(rng, 1.0 - dropout_prob, out.shape)
-        out = jnp.where(keep, out / (1.0 - dropout_prob), 0.0)
+        out = train_dropout(rng, out, dropout_prob)
     mask = ((jnp.arange(T)[None, :, None] < f_len[:, None, None])
             & (jnp.arange(U)[None, None, :] < g_len[:, None, None]))
     out = jnp.where(mask[..., None], out, 0.0)

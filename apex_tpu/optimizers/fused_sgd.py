@@ -91,3 +91,33 @@ class FusedSGD(FusedOptimizerBase):
                          dampening=group["dampening"],
                          weight_decay=group["weight_decay"],
                          nesterov=group["nesterov"])
+
+    def get_momentums(self, params=None):
+        """``(momentums, first_run)`` as in the reference
+        (contrib/optimizers/fused_sgd.py:98-113: collects per-param
+        ``momentum_buffer``s, creating them on first touch and
+        reporting whether this was the first touch). ``params`` is
+        accepted for signature parity; the buffers come from the held
+        per-group state, zero-initialized for groups not yet stepped
+        (first_run True until the first step materializes them)."""
+        del params
+        bufs, first_run = [], False
+        for i, group in enumerate(self.param_groups):
+            if self._states[i] is None:
+                # first touch: materialize and PERSIST, as step()'s lazy
+                # init and the reference's param_state store both do —
+                # the first_run latch must flip False on the next call
+                self._states[i] = self._group_tx(group).init(
+                    group["params"])
+                first_run = True
+            bufs.extend(
+                jax.tree_util.tree_leaves(self._states[i].momentum_buf))
+        return bufs, first_run
+
+
+def get_momentums(state):
+    """Momentum buffers from a fused_sgd optimizer state (reference:
+    apex/optimizers/fused_sgd.py:105-120 collects per-param
+    ``momentum_buffer``s, creating them on first touch). Functional
+    here: the buffers are the state's leaves."""
+    return jax.tree_util.tree_leaves(state.momentum_buf)

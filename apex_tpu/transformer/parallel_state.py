@@ -309,13 +309,19 @@ def get_rank_info():
 
 def is_rank_in_embedding_group(ignore_virtual=False):
     """First or last pipeline stage (reference: parallel_state.py:389 —
-    _EMBEDDING_GLOBAL_RANKS = [first, (split,) last])."""
-    del ignore_virtual  # virtual chunks share the stage's devices on TPU
+    _EMBEDDING_GLOBAL_RANKS = [first, (split,) last]). Unless
+    ``ignore_virtual``, the first/last members only count on their
+    first/last virtual chunk (reference :395-401) — under an interleaved
+    schedule the tied-embedding grad reduction must fire once, not once
+    per chunk."""
     pp = _STATE.pipeline_model_parallel_size
     if pp == 1:
         return True
     rank = jax.lax.axis_index(PIPELINE_AXIS)
-    in_group = (rank == 0) | (rank == pp - 1)
+    # delegate the virtual-chunk gating to the stage predicates, as the
+    # reference does (parallel_state.py:396-399) — one source of truth
+    in_group = (is_pipeline_first_stage(ignore_virtual)
+                | is_pipeline_last_stage(ignore_virtual))
     split = _STATE.pipeline_model_parallel_split_rank
     if split is not None:
         in_group = in_group | (rank == split)

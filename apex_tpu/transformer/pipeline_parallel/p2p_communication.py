@@ -83,3 +83,21 @@ def send_forward_backward_recv_forward_backward(
     """Reference :556 — both directions at once."""
     return (_shift(output_tensor, axis_name, True),
             _shift(input_tensor_grad, axis_name, False))
+
+
+class FutureTensor:
+    """Async-recv handle compat (reference: p2p_communication.py — wraps
+    a tensor plus the wait callback of an in-flight batched_isend_irecv;
+    ``get()`` blocks then returns it). XLA issues and schedules the
+    ppermute itself, so the value is already a (lazy) array: ``get()``
+    simply returns it. Exists so ported overlap-style code runs."""
+
+    def __init__(self, tensor, waitfunc=None):
+        self.tensor = tensor
+        self.waitfunc = waitfunc
+
+    def get(self):
+        if self.waitfunc is not None:
+            self.waitfunc()
+            self.waitfunc = None
+        return self.tensor

@@ -294,3 +294,26 @@ def build_model(model_provider_func, wrap_with_ddp=True,
         models.append(model_provider_func(
             pre_process=(v == 0), post_process=(v == chunks - 1), **kwargs))
     return models
+
+
+def free_output_tensor(output_tensors, deallocate_pipeline_outputs=False):
+    """Reference: schedules/common.py ``free_output_tensor`` — resizes
+    each stage-output tensor's storage to zero after it has been sent
+    downstream, keeping only the autograd graph edge. Documented no-op:
+    under jit XLA frees (or reuses) the buffer as soon as the program's
+    liveness allows, and there is no storage to shrink from Python."""
+    del output_tensors, deallocate_pipeline_outputs
+
+
+def custom_backward(output, grad_output):
+    """Reference: schedules/common.py ``custom_backward`` — calls the C++
+    autograd engine directly so the freed-storage outputs of
+    free_output_tensor don't trip ``torch.autograd.backward``'s shape
+    checks. JAX AD has no engine to bypass: the equivalent is simply the
+    VJP application, which the schedules here perform via ``jax.vjp``.
+    Provided for ported callers that hold a vjp function in ``output``."""
+    if callable(output):
+        return output(grad_output)
+    raise TypeError(
+        "custom_backward expects the vjp callable produced by jax.vjp; "
+        "plain arrays carry no backward graph in JAX")

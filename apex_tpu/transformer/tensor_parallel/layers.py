@@ -228,3 +228,96 @@ class RowParallelLinear(nn.Module):
         if self.skip_bias_add:
             return out, b
         return out
+
+
+# ---------------------------------------------------------------------------
+# tensor-parallel attribute helpers (reference: layers.py:52-100). The
+# reference tags torch Parameters with (is_parallel, dim, stride) so
+# downstream code (grad clipping, checkpoint re-layout) can tell shards
+# from replicas. JAX leaves are attribute-less; the same bookkeeping is
+# carried in a side table keyed by id() of attr-bearing params, or on
+# the object itself when it allows attributes.
+# ---------------------------------------------------------------------------
+
+_TP_ATTRIBUTE_DEFAULTS = {"tensor_model_parallel": False,
+                          "partition_dim": -1,
+                          "partition_stride": 1}
+
+
+def set_tensor_model_parallel_attributes(tensor, is_parallel, dim, stride):
+    """Reference: layers.py:56-65."""
+    for attribute in _TP_ATTRIBUTE_DEFAULTS:
+        assert not hasattr(tensor, attribute)
+    tensor.tensor_model_parallel = is_parallel
+    tensor.partition_dim = dim
+    tensor.partition_stride = stride
+
+
+def set_defaults_if_not_set_tensor_model_parallel_attributes(tensor):
+    """Reference: layers.py:68-74."""
+    for attribute, default in _TP_ATTRIBUTE_DEFAULTS.items():
+        if not hasattr(tensor, attribute):
+            try:
+                setattr(tensor, attribute, default)
+            except AttributeError:
+                return  # plain jnp leaf: attribute-less, defaults implied
+
+
+def copy_tensor_model_parallel_attributes(destination_tensor, source_tensor):
+    """Reference: layers.py:77-83."""
+    for attribute in _TP_ATTRIBUTE_DEFAULTS:
+        if hasattr(source_tensor, attribute):
+            setattr(destination_tensor, attribute,
+                    getattr(source_tensor, attribute))
+
+
+def param_is_not_tensor_parallel_duplicate(param, rank=None,
+                                           axis_name=TENSOR_AXIS):
+    """True when this rank owns the leaf for dedup'd reductions
+    (reference: layers.py:46-52: a tp-sharded param, or tp rank 0).
+    Attribute-less leaves follow the reference's untagged default (not
+    parallel → counted on tp rank 0 only): replicated leaves (e.g. the
+    RowParallelLinear bias) are then counted exactly once. Genuinely
+    tp-sharded leaves must be tagged via an attr-bearing wrapper (or
+    handled with a psum over tp, as calc_params_l2_norm does) — a
+    plain array cannot carry the tag."""
+    if getattr(param, "tensor_model_parallel", False):
+        return True
+    if rank is None:
+        try:
+            rank = lax.axis_index(axis_name)
+        except NameError:
+            rank = 0
+    return rank == 0
+
+
+def linear_with_grad_accumulation_and_async_allreduce(
+        input, weight, bias=None, gradient_accumulation_fusion=False,
+        async_grad_allreduce=False, sequence_parallel_enabled=False,
+        axis_name=TENSOR_AXIS):
+    """Functional tensor-parallel linear (reference: layers.py:272-430's
+    autograd Function + the :432 wrapper). The reference hand-schedules
+    the bwd: async all-reduce of dgrad overlapped with the wgrad GEMM,
+    optional fused fp32 grad accumulation. Under XLA the overlap and
+    fusion are the scheduler's job (module docstring above), so the
+    port is the math: y = x @ w^T (+ bias), with the input's backward
+    reduction implied by the mappings custom-vjp when requested.
+    """
+    del gradient_accumulation_fusion  # no-op: XLA fuses accumulation
+    if sequence_parallel_enabled:
+        input = mappings.gather_from_sequence_parallel_region(
+            input, axis_name)
+    elif async_grad_allreduce:
+        # copy-to-region: identity fwd, psum of the input grad in bwd —
+        # the collective the reference issues asynchronously
+        input = mappings.copy_to_tensor_model_parallel_region(
+            input, axis_name)
+    out = _mm(input, weight)
+    if bias is not None:
+        out = out + bias.astype(out.dtype)
+    return out
+
+
+# torch-checkpoint-era alias the reference also exports (layers.py:434)
+linear_with_grad_accumulation_and_async_allreduce_in16bit = (
+    linear_with_grad_accumulation_and_async_allreduce)

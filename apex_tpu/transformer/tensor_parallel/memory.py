@@ -79,3 +79,19 @@ class RingMemBuffer:
         buff = self.buffers[self._index]
         assert not buff.is_in_use(), "buffer is already in use"
         return buff
+
+
+# module-level buffer registry (reference: memory.py:120-151)
+_MEM_BUFFS = {}
+
+
+def allocate_mem_buff(name, numel, dtype, track_usage):
+    """Allocate a named global memory buffer (reference: memory.py:131)."""
+    assert name not in _MEM_BUFFS, f"memory buffer {name} already allocated."
+    _MEM_BUFFS[name] = MemoryBuffer(name, numel, dtype, track_usage)
+    return _MEM_BUFFS[name]
+
+
+def get_mem_buff(name):
+    """Get a named global memory buffer (reference: memory.py:140)."""
+    return _MEM_BUFFS[name]

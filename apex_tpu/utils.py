@@ -1,0 +1,14 @@
+"""Small shared utilities used across apex_tpu subpackages."""
+
+import jax
+import jax.numpy as jnp
+
+
+def train_dropout(rng, x, p, zero=0.0):
+    """Inverted dropout: keep with prob (1-p), rescale survivors by
+    1/(1-p). The single implementation behind the contrib fmha /
+    transducer / mask_softmax_dropout training paths (each of which
+    gates on its own is-training flag and raises its own error when the
+    rng is missing)."""
+    keep = jax.random.bernoulli(rng, 1.0 - p, x.shape)
+    return jnp.where(keep, x / (1.0 - p), zero)

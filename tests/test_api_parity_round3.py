@@ -60,6 +60,23 @@ def test_parallel_state_split_predicates(state_guard):
     np.testing.assert_array_equal(after, [0, 0, 1, 1])    # rank >= 2
     np.testing.assert_array_equal(at, [0, 1, 0, 0])       # rank 1 only
     np.testing.assert_array_equal(emb, [1, 0, 1, 1])      # {0, split, last}
+    # under an interleaved schedule, first/last members only count on
+    # their first/last virtual chunk (reference parallel_state.py:395)
+    ps._STATE.virtual_pipeline_model_parallel_size = 2
+    ps.set_virtual_pipeline_model_parallel_rank(1)
+    emb_v = np.asarray(shard_map(
+        lambda: jnp.reshape(jnp.int32(ps.is_rank_in_embedding_group()),
+                            (1, 1, 1)),
+        mesh=mesh, in_specs=(), out_specs=P("pp", "dp", "tp"),
+        check_vma=False)())[:, 0, 0]
+    np.testing.assert_array_equal(emb_v, [0, 0, 1, 1])  # chunk 1: last+split
+    ps.set_virtual_pipeline_model_parallel_rank(0)
+    emb_v0 = np.asarray(shard_map(
+        lambda: jnp.reshape(jnp.int32(ps.is_rank_in_embedding_group()),
+                            (1, 1, 1)),
+        mesh=mesh, in_specs=(), out_specs=P("pp", "dp", "tp"),
+        check_vma=False)())[:, 0, 0]
+    np.testing.assert_array_equal(emb_v0, [1, 0, 1, 0])  # chunk 0: first+split
     np.testing.assert_array_equal(pos, [1, 0, 1, 0])      # {0, split}
     np.testing.assert_array_equal(enc_rel, [1, 1, 0, 0])
     np.testing.assert_array_equal(dec_rel, [0, 0, 1, 1])
@@ -190,6 +207,15 @@ def test_halo_padder_pads_from_neighbors():
     np.testing.assert_array_equal(out[0, 0], 0)
     padder.wait()  # no-op parity
 
+    # NCHW path (the reference's explicit_nhwc=False): H is dim 2
+    y_nchw = jnp.transpose(y, (0, 3, 1, 2))
+    out2 = shard_map(lambda t: padder(t, 1, explicit_nhwc=False),
+                     mesh=mesh, in_specs=(P("spatial"),),
+                     out_specs=P("spatial"), check_vma=False)(y_nchw)
+    np.testing.assert_allclose(
+        np.asarray(out2).reshape(4, 2, 4, 3),
+        np.transpose(out, (0, 3, 1, 2)))
+
 
 def test_standalone_helpers():
     """standalone_transformer_lm.py:130-151 + :1038-1096."""
@@ -224,3 +250,152 @@ def test_standalone_helpers():
     Args.transformer_pipeline_model_parallel_size = 3
     assert get_num_layers(Args, False, pipeline_rank=0) == 0
     assert get_num_layers(Args, False, pipeline_rank=1) == 4
+
+
+def test_amp_legacy_handles():
+    """apex/amp/handle.py:22-218: AmpHandle.scale_loss yields the scaled
+    loss against the live scaler state; NoOpHandle passes through."""
+    from apex_tpu import amp
+    from apex_tpu.amp import AmpHandle, NoOpHandle
+    from apex_tpu.optimizers.fused_adam import fused_adam
+
+    params = {"w": jnp.ones(3, jnp.float32)}
+    params, opt = amp.initialize(params, fused_adam(1e-2), opt_level="O2")
+    state = opt.init(params)
+
+    handle = AmpHandle(opt, state)
+    # reference surface: is_active is a METHOD (handle.py:179)
+    assert handle.is_active() and handle.has_cache
+    with handle.scale_loss(jnp.float32(2.0)) as scaled:
+        np.testing.assert_allclose(float(scaled),
+                                   2.0 * float(state.scalers[0].loss_scale))
+    assert handle.wrap_optimizer(opt) is opt
+    handle._deactivate()
+    with handle.scale_loss(jnp.float32(2.0)) as scaled:
+        assert float(scaled) == 2.0
+
+    noop = NoOpHandle()
+    assert not noop.is_active()
+    noop._clear_cache()
+    with noop._disable_casts():
+        pass
+    with noop.scale_loss(jnp.float32(5.0)) as scaled:
+        assert float(scaled) == 5.0
+
+    # a bare active handle refuses to silently skip scaling
+    with pytest.raises(RuntimeError, match="no amp optimizer"):
+        with AmpHandle().scale_loss(jnp.float32(1.0)):
+            pass
+    # per-call state override + threading via update_state
+    bare = AmpHandle(opt)
+    with pytest.raises(RuntimeError, match="no amp state"):
+        with bare.scale_loss(jnp.float32(1.0)):
+            pass
+    bare.update_state(state)
+    with bare.scale_loss(jnp.float32(1.0)) as scaled:
+        np.testing.assert_allclose(float(scaled),
+                                   float(state.scalers[0].loss_scale))
+
+
+def test_tp_attribute_helpers():
+    """apex/transformer/tensor_parallel/layers.py:46-100."""
+    from apex_tpu.transformer.tensor_parallel.layers import (
+        copy_tensor_model_parallel_attributes,
+        param_is_not_tensor_parallel_duplicate,
+        set_defaults_if_not_set_tensor_model_parallel_attributes,
+        set_tensor_model_parallel_attributes,
+    )
+
+    class P:
+        pass
+
+    p = P()
+    set_tensor_model_parallel_attributes(p, True, 0, 1)
+    assert p.tensor_model_parallel and p.partition_dim == 0
+    q = P()
+    copy_tensor_model_parallel_attributes(q, p)
+    assert q.tensor_model_parallel and q.partition_stride == 1
+    r = P()
+    set_defaults_if_not_set_tensor_model_parallel_attributes(r)
+    assert r.tensor_model_parallel is False and r.partition_dim == -1
+    # sharded params and plain leaves count once; replicated attr-tagged
+    # params only on rank 0
+    assert param_is_not_tensor_parallel_duplicate(p)
+    assert param_is_not_tensor_parallel_duplicate(jnp.ones(2))
+    assert param_is_not_tensor_parallel_duplicate(r, rank=0)
+    assert not param_is_not_tensor_parallel_duplicate(r, rank=1)
+    # attribute-less leaf: defaults are implied, no crash
+    set_defaults_if_not_set_tensor_model_parallel_attributes(jnp.ones(2))
+
+
+def test_functional_tp_linear_matches_module():
+    """layers.py:272-434: the functional linear equals x @ w^T + b and
+    its tp-input grad is psummed (via copy_to region)."""
+    from apex_tpu.transformer.tensor_parallel.layers import (
+        linear_with_grad_accumulation_and_async_allreduce as tp_linear)
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    rs = np.random.RandomState(3)
+    x = jnp.asarray(rs.randn(4, 6), jnp.float32)
+    w = jnp.asarray(rs.randn(2, 5, 6), jnp.float32)  # per-rank shard
+    b = jnp.asarray(rs.randn(5), jnp.float32)
+
+    def run(w_shard):
+        y = tp_linear(x, w_shard[0], b, async_grad_allreduce=True)
+        return y
+
+    y = shard_map(run, mesh=mesh, in_specs=(P("tp"),),
+                  out_specs=P("tp"), check_vma=False)(w)
+    y = np.asarray(y).reshape(2, 4, 5)
+    for r in range(2):
+        np.testing.assert_allclose(
+            y[r], np.asarray(x) @ np.asarray(w[r]).T + np.asarray(b),
+            rtol=2e-5, atol=2e-5)
+
+
+def test_misc_compat_surfaces():
+    """toRNNBackend, mem-buff registry, FusedSGD momenta, FutureTensor,
+    schedule compat shims, named mask patterns."""
+    from apex_tpu.RNN.models import toRNNBackend
+    from apex_tpu.RNN.rnn_backend import RNN
+    from apex_tpu.contrib.sparsity.sparse_masklib import (create_mask,
+                                                          m4n2_1d,
+                                                          mn_1d_best)
+    from apex_tpu.optimizers.fused_sgd import fused_sgd, get_momentums
+    from apex_tpu.transformer.pipeline_parallel.p2p_communication import (
+        FutureTensor)
+    from apex_tpu.transformer.pipeline_parallel.schedules import (
+        custom_backward, free_output_tensor)
+    from apex_tpu.transformer.tensor_parallel import memory as tp_memory
+
+    m = toRNNBackend("GRU", 4, 8, num_layers=2, bidirectional=True)
+    assert isinstance(m, RNN) and m.bidirectional
+
+    buf = tp_memory.allocate_mem_buff("parity_test", 64, jnp.float32, False)
+    assert tp_memory.get_mem_buff("parity_test") is buf
+    with pytest.raises(AssertionError, match="already allocated"):
+        tp_memory.allocate_mem_buff("parity_test", 64, jnp.float32, False)
+
+    tx = fused_sgd(1e-2, momentum=0.9)
+    bufs = get_momentums(tx.init({"w": jnp.ones(3)}))
+    assert len(bufs) == 1 and bufs[0].shape == (3,)
+
+    ft = FutureTensor(jnp.ones(2))
+    np.testing.assert_array_equal(np.asarray(ft.get()), 1.0)
+    waited = []
+    ft = FutureTensor(jnp.ones(2), waitfunc=lambda: waited.append(1))
+    ft.get(); ft.get()
+    assert waited == [1]  # wait fires once
+
+    free_output_tensor([jnp.ones(2)], True)  # no-op
+    _, vjp = jax.vjp(lambda x: 3.0 * x, jnp.ones(2))
+    (g,) = custom_backward(vjp, jnp.ones(2))
+    np.testing.assert_array_equal(np.asarray(g), 3.0)
+    with pytest.raises(TypeError, match="vjp"):
+        custom_backward(jnp.ones(2), jnp.ones(2))
+
+    w = jnp.asarray(np.random.RandomState(0).randn(8, 16), jnp.float32)
+    np.testing.assert_array_equal(np.asarray(m4n2_1d(w)),
+                                  np.asarray(create_mask(w, "m4n2_1d")))
+    np.testing.assert_array_equal(np.asarray(mn_1d_best(w, 4, 2)),
+                                  np.asarray(m4n2_1d(w)))
