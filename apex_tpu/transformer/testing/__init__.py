@@ -25,4 +25,22 @@ from apex_tpu.transformer.testing.standalone_transformer_lm import (  # noqa: F4
     vocab_parallel_embed,
     gpt_model_provider,
     bert_model_provider,
+    BertLMHead,
+    NoopTransformerLayer,
+    Pooler,
+    bert_extended_attention_mask,
+    bert_position_ids,
+    bias_dropout_add,
+    get_bias_dropout_add,
+    get_linear_layer,
+    get_num_layers,
+    init_method_normal,
+    scaled_init_method_normal,
+)
+from apex_tpu.transformer.testing.commons import (  # noqa: F401
+    IdentityLayer,
+    ToyParallelMLP,
+    initialize_distributed,
+    print_separator,
+    set_random_seed,
 )

@@ -1,0 +1,108 @@
+"""Shared test scaffolding for the transformer test-suite.
+
+Capability port of apex/transformer/testing/commons.py (IdentityLayer
+:233, ToyParallelMLP :83, set_random_seed :242, initialize_distributed
+:250, print_separator :290). The reference spawns NCCL process groups;
+here "distributed" is a mesh over the available devices, and the RNG
+seeding routes through the tensor-parallel RNG tracker exactly as the
+reference's set_random_seed calls model_parallel_cuda_manual_seed.
+"""
+
+import random
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+from apex_tpu.transformer.tensor_parallel.layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+)
+from apex_tpu.transformer.tensor_parallel.random import (
+    model_parallel_rng_seed,
+)
+
+
+class IdentityLayer(nn.Module):
+    """A module whose forward returns its (randomly initialized) weight
+    (reference: commons.py:233-239) — the canonical grad-flow probe."""
+
+    size: tuple
+    scale: float = 1.0
+
+    @nn.compact
+    def __call__(self):
+        w = self.param(
+            "weight",
+            lambda key, shape: self.scale * jax.random.normal(key, shape),
+            self.size)
+        return w
+
+
+class ToyParallelMLP(nn.Module):
+    """Column→gelu→Row toy MLP (reference: commons.py:83-140), the
+    minimal model the reference's pipeline/TP sanity tests push batches
+    through. Input [s, b, h]; runs inside shard_map over ``axis_name``.
+    ``pre_process``/``post_process`` mirror the reference fields (which
+    its forward also never branches on, commons.py:92-95): they mark the
+    chunk's pipeline position for build_model-style providers."""
+
+    hidden_size: int
+    pre_process: bool = False
+    post_process: bool = False
+    sequence_parallel_enabled: bool = False
+    axis_name: str = TENSOR_AXIS
+
+    @nn.compact
+    def __call__(self, x):
+        ffn = 4 * self.hidden_size
+        # reference: skip_bias_add on the column linear, bias applied
+        # WITH the activation (commons.py:125-139 gelu(x + bias))
+        h, b = ColumnParallelLinear(
+            input_size=self.hidden_size, output_size=ffn,
+            gather_output=False,
+            sequence_parallel_enabled=self.sequence_parallel_enabled,
+            skip_bias_add=True, axis_name=self.axis_name,
+            name="dense_h_to_4h")(x)
+        h = nn.gelu(h + b.astype(h.dtype), approximate=True)
+        out = RowParallelLinear(
+            input_size=ffn, output_size=self.hidden_size,
+            input_is_parallel=True,
+            sequence_parallel_enabled=self.sequence_parallel_enabled,
+            axis_name=self.axis_name, name="dense_4h_to_h")(x=h)
+        return out
+
+
+def set_random_seed(seed):
+    """Seed every RNG source for reproducibility (reference:
+    commons.py:242-247 — python, numpy, torch, and the model-parallel
+    tracker). Returns a jax PRNGKey derived from the seed for the
+    caller's functional RNG needs."""
+    random.seed(seed)
+    np.random.seed(seed)
+    model_parallel_rng_seed(seed)
+    return jax.random.PRNGKey(seed)
+
+
+def initialize_distributed(backend="xla"):
+    """Reference: commons.py:250-287 — spins up torch.distributed from
+    RANK/WORLD_SIZE env. The JAX analog: multi-process setups call
+    ``jax.distributed.initialize`` (see apex_tpu.parallel.multiproc);
+    within a process, "distributed" is the device mesh. Ensures the
+    parallel state holds a mesh and returns it."""
+    if parallel_state.model_parallel_is_initialized():
+        return parallel_state.get_mesh()
+    return parallel_state.initialize_model_parallel()
+
+
+def print_separator(message):
+    """Reference: commons.py:290-296."""
+    filler_len = (78 - len(message)) // 2
+    filler = "-" * filler_len
+    string = "\n" + filler + " {} ".format(message) + filler
+    if jax.process_index() == 0:
+        print(string, flush=True)

@@ -50,6 +50,7 @@ from apex_tpu.transformer.tensor_parallel.layers import (
     vocab_parallel_embed,
 )
 from apex_tpu.transformer.utils import divide
+from apex_tpu.utils import train_dropout
 
 
 @dataclasses.dataclass(frozen=True)
@@ -475,8 +476,12 @@ class ParallelTransformerLayer(nn.Module):
         else:
             mlp = ParallelMLP(cfg, axis_name=self.axis_name, name="mlp")
 
-        def bias_dropout_add(x, bias, residual):
-            # reference: bias_dropout_add fusion (XLA fuses this chain)
+        def _layer_bias_dropout_add(x, bias, residual):
+            # reference: bias_dropout_add fusion (XLA fuses this chain).
+            # Distinct from the module-level parity helper
+            # ``bias_dropout_add`` (explicit-rng form): this closure uses
+            # flax's "dropout" rng collection via nn.Dropout, the
+            # convention every layer in this file follows.
             x = x + bias.astype(x.dtype)
             x = nn.Dropout(rate=cfg.hidden_dropout)(
                 x, deterministic=deterministic)
@@ -486,7 +491,7 @@ class ParallelTransformerLayer(nn.Module):
         # deterministic must arrive as positional arg 4
         attn_out, attn_bias = attn(ln(hidden), attention_mask, None,
                                    deterministic)
-        hidden = bias_dropout_add(attn_out, attn_bias, hidden)
+        hidden = _layer_bias_dropout_add(attn_out, attn_bias, hidden)
 
         if self.layer_type == LayerType.decoder:
             cross_ln = FusedLayerNorm(normalized_shape=cfg.hidden_size,
@@ -500,13 +505,13 @@ class ParallelTransformerLayer(nn.Module):
             c_out, c_bias = cross(post_ln(hidden), enc_dec_attn_mask,
                                   encoder_output=encoder_output,
                                   deterministic=deterministic)
-            hidden = bias_dropout_add(c_out, c_bias, hidden)
+            hidden = _layer_bias_dropout_add(c_out, c_bias, hidden)
             mlp_in = cross_ln(hidden)
         else:
             mlp_in = post_ln(hidden)
 
         mlp_out, mlp_bias = mlp(mlp_in)
-        hidden = bias_dropout_add(mlp_out, mlp_bias, hidden)
+        hidden = _layer_bias_dropout_add(mlp_out, mlp_bias, hidden)
         return hidden
 
 
@@ -631,9 +636,99 @@ def gpt_model_provider(cfg, pre_process=True, post_process=True, **kwargs):
                     **kwargs)
 
 
+def bias_dropout_add(x, bias, residual, prob, training, rng=None):
+    """residual + dropout(x + bias) (reference:
+    standalone_transformer_lm.py:585-588)."""
+    out = x + bias
+    if training and prob > 0.0:
+        if rng is None:
+            raise ValueError("bias_dropout_add: rng required in training")
+        out = train_dropout(rng, out, prob)
+    return residual + out
+
+
+def get_bias_dropout_add(training):
+    """Reference: standalone_transformer_lm.py:591-595."""
+    def _bias_dropout_add(x, bias, residual, prob, rng=None):
+        return bias_dropout_add(x, bias, residual, prob, training, rng)
+    return _bias_dropout_add
+
+
+class NoopTransformerLayer(nn.Module):
+    """Identity stage filler for uneven pipeline splits (reference:
+    standalone_transformer_lm.py:1099-1124 — used when a stage carries
+    zero real layers, e.g. the standalone embedding stage)."""
+
+    layer_number: int = 1
+
+    @nn.compact
+    def __call__(self, hidden_states, *args, **kwargs):
+        return hidden_states
+
+
+class Pooler(nn.Module):
+    """First-token (or ``sequence_index``) tanh pooler (reference:
+    standalone_transformer_lm.py:1208-1236). Input [s, b, h]."""
+
+    hidden_size: int
+    init_method: Any = None
+    params_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, hidden_states, sequence_index=0):
+        dense = nn.Dense(
+            self.hidden_size,
+            kernel_init=self.init_method or init_normal(0.02),
+            param_dtype=self.params_dtype, name="dense")
+        return jnp.tanh(dense(hidden_states[sequence_index]))
+
+
 # ---------------------------------------------------------------------------
 # BERT
 # ---------------------------------------------------------------------------
+
+
+def bert_extended_attention_mask(attention_mask):
+    """[b, s] (1 = attend) → [b, 1, s, s] boolean, True = masked out
+    (reference: standalone_bert.py bert_extended_attention_mask — builds
+    the same pairwise mask then inverts to the <0.5 convention)."""
+    m = attention_mask.astype(bool)
+    return ~(m[:, None, None, :] & m[:, None, :, None])
+
+
+def bert_position_ids(token_ids):
+    """[b, s] position ids (reference: standalone_bert.py
+    bert_position_ids)."""
+    b, s = token_ids.shape
+    return jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
+
+
+class BertLMHead(nn.Module):
+    """Masked-LM head: dense + gelu + layernorm, then logits against the
+    tied word embeddings (reference: standalone_bert.py BertLMHead —
+    dense/LN/gelu with the output weight shared with the embedding).
+    Input [s, b, h]; returns [s, b, vocab/tp]."""
+
+    cfg: TransformerConfig
+    parallel_output: bool = True
+    axis_name: str = TENSOR_AXIS
+
+    @nn.compact
+    def __call__(self, hidden, word_embeddings):
+        cfg = self.cfg
+        dense = nn.Dense(cfg.hidden_size, name="dense",
+                         param_dtype=cfg.params_dtype)
+        ln = FusedLayerNorm(normalized_shape=cfg.hidden_size,
+                            eps=cfg.layernorm_epsilon, name="layernorm")
+        h = ln(nn.gelu(dense(hidden), approximate=True))
+        # reference: a zero-init learnable bias over this rank's vocab
+        # shard, applied with the tied-embedding logits
+        bias = self.param("bias", nn.initializers.zeros,
+                          (word_embeddings.shape[0],), cfg.params_dtype)
+        return parallel_lm_logits(
+            h, word_embeddings, parallel_output=self.parallel_output,
+            bias=bias, axis_name=self.axis_name)
+
 
 class BertModel(nn.Module):
     """Bidirectional encoder with MLM head + optional binary (NSP) head
@@ -654,12 +749,8 @@ class BertModel(nn.Module):
                  lm_labels=None, deterministic=True, hidden_state=None):
         cfg = self.cfg
         tp_world = lax.axis_size(self.axis_name)
-        b, s = input_ids.shape
-        position_ids = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
-
-        # extended attention mask [b, 1, s, s]: True = masked out
-        m = attention_mask.astype(bool)
-        ext_mask = ~(m[:, None, None, :] & m[:, None, :, None])
+        position_ids = bert_position_ids(input_ids)
+        ext_mask = bert_extended_attention_mask(attention_mask)
 
         word_embeddings = self.param(
             "word_embeddings",
@@ -701,21 +792,17 @@ class BertModel(nn.Module):
         if not self.post_process:
             return hidden
 
-        # LM head: dense + gelu + LN, then logits vs tied embeddings
-        lm_dense = nn.Dense(cfg.hidden_size, name="lm_head_dense",
-                            param_dtype=cfg.params_dtype)
-        lm_ln = FusedLayerNorm(normalized_shape=cfg.hidden_size,
-                               eps=cfg.layernorm_epsilon, name="lm_head_ln")
-        hidden_lm = lm_ln(nn.gelu(lm_dense(hidden), approximate=True))
-        lm_logits = parallel_lm_logits(
-            hidden_lm, word_embeddings, parallel_output=self.parallel_output,
-            axis_name=self.axis_name).transpose(1, 0, 2)
+        lm_logits = BertLMHead(
+            cfg, parallel_output=self.parallel_output,
+            axis_name=self.axis_name, name="lm_head")(
+            hidden, word_embeddings).transpose(1, 0, 2)
 
         binary_logits = None
         if cfg.bert_binary_head:
-            pooled = jnp.tanh(nn.Dense(cfg.hidden_size, name="pooler",
-                                       param_dtype=cfg.params_dtype)(
-                hidden[0]))  # first token, [b, h]
+            pooled = Pooler(cfg.hidden_size,
+                            init_normal(cfg.init_method_std),
+                            params_dtype=cfg.params_dtype,
+                            name="pooler")(hidden)
             binary_logits = nn.Dense(2, name="binary_head",
                                      param_dtype=cfg.params_dtype)(pooled)
 

@@ -399,3 +399,98 @@ def test_misc_compat_surfaces():
                                   np.asarray(create_mask(w, "m4n2_1d")))
     np.testing.assert_array_equal(np.asarray(mn_1d_best(w, 4, 2)),
                                   np.asarray(m4n2_1d(w)))
+
+
+def test_testing_commons(state_guard):
+    """apex/transformer/testing/commons.py:83-296: IdentityLayer,
+    ToyParallelMLP, set_random_seed, initialize_distributed,
+    print_separator; plus the standalone-model building blocks extracted
+    with reference names (NoopTransformerLayer, Pooler,
+    bias_dropout_add, bert mask/position helpers)."""
+    from apex_tpu.transformer.testing import (
+        IdentityLayer, NoopTransformerLayer, Pooler, ToyParallelMLP,
+        bert_extended_attention_mask, bert_position_ids,
+        get_bias_dropout_add, initialize_distributed, print_separator,
+        set_random_seed)
+
+    key = set_random_seed(123)
+    mesh = initialize_distributed()
+    assert mesh is ps.get_mesh()
+    print_separator("commons parity")
+
+    il = IdentityLayer(size=(4,))
+    v = il.init(key)
+    np.testing.assert_array_equal(np.asarray(il.apply(v)),
+                                  np.asarray(v["params"]["weight"]))
+
+    mlp = ToyParallelMLP(hidden_size=8)
+    x = jnp.ones((4, 2, 8), jnp.float32)
+    mesh2 = Mesh(np.array(jax.devices()[:2]), ("tp",))
+
+    def run(x):
+        variables = mlp.init(jax.random.PRNGKey(0), x)
+        return mlp.apply(variables, x)
+
+    y = shard_map(run, mesh=mesh2, in_specs=(P(),), out_specs=P(),
+                  check_vma=False)(x)
+    assert y.shape == x.shape and np.isfinite(np.asarray(y)).all()
+
+    h = jnp.ones((3, 2, 8))
+    assert (NoopTransformerLayer().apply({}, h) == h).all()
+
+    # eval-mode bias_dropout_add: residual + (x + bias)
+    f = get_bias_dropout_add(False)
+    np.testing.assert_allclose(
+        np.asarray(f(h, jnp.zeros(8), h, 0.1)), 2 * np.asarray(h))
+    # training without an rng is loud
+    with pytest.raises(ValueError, match="rng"):
+        get_bias_dropout_add(True)(h, jnp.zeros(8), h, 0.5)
+
+    ids = jnp.zeros((2, 5), jnp.int32)
+    np.testing.assert_array_equal(
+        np.asarray(bert_position_ids(ids)),
+        np.broadcast_to(np.arange(5), (2, 5)))
+    em = bert_extended_attention_mask(
+        jnp.asarray([[1, 1, 0]], jnp.int32))
+    assert em.shape == (1, 1, 3, 3)
+    assert not em[0, 0, 0, 1] and em[0, 0, 0, 2]  # pad key masked
+
+    # pooler: tanh(dense(first token))
+    pooler = Pooler(8)
+    hv = jnp.asarray(np.random.RandomState(0).randn(3, 2, 8), jnp.float32)
+    pv = pooler.init(jax.random.PRNGKey(0), hv)
+    out = pooler.apply(pv, hv)
+    assert out.shape == (2, 8)
+    assert np.abs(np.asarray(out)).max() <= 1.0
+
+
+def test_decoder_layer_cross_attention_path():
+    """The LayerType.decoder branch (cross-attention + its
+    bias_dropout_add) — previously uncovered."""
+    from apex_tpu.transformer.enums import LayerType
+    from apex_tpu.transformer.testing import (ParallelTransformerLayer,
+                                              TransformerConfig)
+
+    cfg = TransformerConfig(hidden_size=16, num_layers=1,
+                            num_attention_heads=2, vocab_size=32,
+                            max_position_embeddings=8,
+                            hidden_dropout=0.0, attention_dropout=0.0)
+    layer = ParallelTransformerLayer(cfg, layer_type=LayerType.decoder)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    s, b = 6, 2
+    rs = np.random.RandomState(0)
+    hidden = jnp.asarray(rs.randn(s, b, 16), jnp.float32)
+    enc_out = jnp.asarray(rs.randn(s, b, 16), jnp.float32)
+    causal = jnp.triu(jnp.ones((s, s), bool), 1)[None, None]
+    no_mask = jnp.zeros((1, 1, s, s), bool)
+
+    def run(hidden, enc_out):
+        variables = layer.init(jax.random.PRNGKey(0), hidden, causal,
+                               enc_out, no_mask, True)
+        return layer.apply(variables, hidden, causal, enc_out, no_mask,
+                           True)
+
+    out = shard_map(run, mesh=mesh, in_specs=(P(), P()),
+                    out_specs=P(), check_vma=False)(hidden, enc_out)
+    assert out.shape == (s, b, 16)
+    assert np.isfinite(np.asarray(out)).all()
