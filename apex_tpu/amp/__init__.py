@@ -10,12 +10,14 @@ ADR — amp legacy glue not ported (reference apex/amp/{opt,compat,
 rnn_compat}.py, 536 LoC): those modules exist to patch Variable/Tensor
 API splits of pre-1.0 torch (compat.py), to wrap the deprecated
 ``amp.half_function(torch.nn.RNN)`` eager-RNN internals (rnn_compat.py),
-and to provide the pre-``initialize`` ``amp.init()``/``OptimWrapper``
-surface (opt.py) that upstream itself deprecates in favor of
-``amp.initialize``. None of these has a JAX analog to patch — tracing
-makes namespace shims meaningless — and the supported reference surface
-(``initialize``-based) is fully covered here. Deliberately omitted, not
-deferred.
+and to provide the ``OptimWrapper`` plumbing (opt.py) that upstream
+itself deprecates in favor of ``amp.initialize``. None of these has a
+JAX analog to patch — tracing makes namespace shims meaningless — and
+the supported reference surface (``initialize``-based) is fully covered
+here. Deliberately omitted, not deferred. The deprecated ``amp.init()``
+handle ENTRY itself (amp.py:68) IS provided — ``init`` returns an
+AmpHandle/NoOpHandle over the functional machinery (handle.py) — it is
+only the monkey-patch registry behind it that has no analog.
 """
 
 from apex_tpu.amp.frontend import (
@@ -29,7 +31,8 @@ from apex_tpu.amp.frontend import (
 from apex_tpu.amp.scaler import LossScaler, LossScalerState
 from apex_tpu.amp.amp_optimizer import AmpOptimizer, AmpOptState
 from apex_tpu.amp.handle import (scale_loss, value_and_scaled_grad,
-                                 disable_casts, AmpHandle, NoOpHandle)
+                                 disable_casts, AmpHandle, NoOpHandle,
+                                 init)
 from apex_tpu.amp.policy import (
     Policy,
     autocast,
@@ -55,7 +58,7 @@ __all__ = [
     "initialize", "state_dict", "load_state_dict", "opt_levels", "Properties",
     "build_policy", "LossScaler", "LossScalerState", "AmpOptimizer",
     "AmpOptState", "scale_loss", "value_and_scaled_grad", "disable_casts",
-    "AmpHandle", "NoOpHandle",
+    "AmpHandle", "NoOpHandle", "init",
     "Policy", "autocast", "current_policy", "compute_dtype", "half_function",
     "float_function", "promote_function", "register_half_function",
     "register_float_function", "register_promote_function", "cast_for_op",

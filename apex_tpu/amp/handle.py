@@ -188,3 +188,26 @@ class NoOpHandle:
 
     def _deactivate(self):
         pass
+
+
+def init(enabled=True, loss_scale="dynamic", enable_caching=True,
+         verbose=False, allow_banned=False):
+    """Deprecated amp entry (reference: apex/amp/amp.py:68-96 — returns
+    a handle; the modern path is ``amp.initialize``). Returns a
+    NoOpHandle when disabled, else a bare AmpHandle: thread the wrapped
+    optimizer/state in via ``AmpHandle.update_state`` /
+    ``scale_loss(optimizer=...)`` (the reference's monkey-patch
+    registry has no JAX analog — casts are policy-driven, see
+    amp/policy.py). ``loss_scale``/``allow_banned`` are accepted for
+    the reference signature; the scale lives in the optimizer state."""
+    del allow_banned
+    if loss_scale != "dynamic":
+        import warnings
+        warnings.warn(
+            "amp.init(loss_scale=...) has no effect here: the loss scale "
+            "lives in the optimizer state produced by amp.initialize "
+            "(configure it there via LossScaler(loss_scale=...))",
+            stacklevel=2)
+    if not enabled:
+        return NoOpHandle()
+    return AmpHandle(enable_caching=enable_caching, verbose=verbose)

@@ -3,6 +3,9 @@
 from apex_tpu.transformer.functional.fused_softmax import (  # noqa: F401
     FusedScaleMaskSoftmax,
     GenericFusedScaleMaskSoftmax,
+    GenericScaledMaskedSoftmax,
+    ScaledMaskedSoftmax,
+    ScaledUpperTriangMaskedSoftmax,
     generic_scaled_masked_softmax,
     scaled_masked_softmax,
     scaled_upper_triang_masked_softmax,

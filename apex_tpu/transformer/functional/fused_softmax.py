@@ -226,3 +226,30 @@ class GenericFusedScaleMaskSoftmax(FusedScaleMaskSoftmax):
             return super().forward_fused_softmax(input, mask)
         scale = self.scale if self.scale is not None else 1.0
         return generic_scaled_masked_softmax(input, mask, scale)
+
+
+class ScaledUpperTriangMaskedSoftmax:
+    """autograd-Function-shaped surface (reference: fused_softmax.py:21-66
+    — ``ScaledUpperTriangMaskedSoftmax.apply(x, scale)``). JAX AD
+    differentiates through the function; the class exists so ported
+    ``.apply`` call sites run."""
+
+    @staticmethod
+    def apply(x, scale=1.0):
+        return scaled_upper_triang_masked_softmax(x, scale)
+
+
+class ScaledMaskedSoftmax:
+    """Reference: fused_softmax.py:71-98 — ``apply(x, mask, scale)``."""
+
+    @staticmethod
+    def apply(x, mask, scale=1.0):
+        return scaled_masked_softmax(x, mask, scale)
+
+
+class GenericScaledMaskedSoftmax:
+    """Reference: fused_softmax.py:101-125 — ``apply(x, mask, scale)``."""
+
+    @staticmethod
+    def apply(x, mask, scale=1.0):
+        return generic_scaled_masked_softmax(x, mask, scale)

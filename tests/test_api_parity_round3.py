@@ -464,6 +464,7 @@ def test_testing_commons(state_guard):
     assert np.abs(np.asarray(out)).max() <= 1.0
 
 
+@pytest.mark.slow
 def test_decoder_layer_cross_attention_path():
     """The LayerType.decoder branch (cross-attention + its
     bias_dropout_add) — previously uncovered."""
@@ -494,3 +495,41 @@ def test_decoder_layer_cross_attention_path():
                     out_specs=P(), check_vma=False)(hidden, enc_out)
     assert out.shape == (s, b, 16)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_softmax_function_class_surface():
+    """apex/transformer/functional/fused_softmax.py:21-125: the
+    autograd-Function class names dispatch to the same math as the
+    functional forms."""
+    from apex_tpu.transformer.functional import (
+        GenericScaledMaskedSoftmax, ScaledMaskedSoftmax,
+        ScaledUpperTriangMaskedSoftmax, scaled_masked_softmax,
+        scaled_upper_triang_masked_softmax)
+
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(3, 4, 4), jnp.float32)
+    np.testing.assert_array_equal(
+        np.asarray(ScaledUpperTriangMaskedSoftmax.apply(x, 0.5)),
+        np.asarray(scaled_upper_triang_masked_softmax(x, 0.5)))
+    x4 = x[:, None]
+    mask = jnp.zeros_like(x4, bool).at[..., -1].set(True)
+    np.testing.assert_array_equal(
+        np.asarray(ScaledMaskedSoftmax.apply(x4, mask, 2.0)),
+        np.asarray(scaled_masked_softmax(x4, mask, 2.0)))
+    np.testing.assert_array_equal(
+        np.asarray(GenericScaledMaskedSoftmax.apply(x4, mask, 2.0)),
+        np.asarray(scaled_masked_softmax(x4, mask, 2.0)))
+
+
+def test_amp_init_legacy_entry():
+    """apex/amp/amp.py:68-96: amp.init returns a handle; disabled ->
+    NoOpHandle passthrough."""
+    from apex_tpu import amp
+
+    h = amp.init(enabled=False)
+    assert not h.is_active()
+    with h.scale_loss(jnp.float32(3.0)) as s:
+        assert float(s) == 3.0
+    with pytest.warns(UserWarning, match="no effect"):
+        h2 = amp.init(loss_scale=128.0, verbose=True)
+    assert isinstance(h2, amp.AmpHandle) and h2.is_active() and h2.verbose
