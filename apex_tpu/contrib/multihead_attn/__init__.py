@@ -2,6 +2,7 @@
 
 from apex_tpu.contrib.multihead_attn.self_multihead_attn import (  # noqa: F401
     SelfMultiheadAttn,
+    jit_dropout_add,
 )
 from apex_tpu.contrib.multihead_attn.encdec_multihead_attn import (  # noqa: F401
     EncdecMultiheadAttn,

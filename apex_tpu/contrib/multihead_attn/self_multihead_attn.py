@@ -82,6 +82,18 @@ def _attn_core(q, k, v, scaling, heads, key_padding_mask, attn_mask,
     return ctx.transpose(1, 0, 2).reshape(sq, b, e)
 
 
+def jit_dropout_add(x, residual, prob, is_training, rng=None):
+    """residual + dropout(x) (reference:
+    self_multihead_attn.py:14-18, a torchscripted fusion — XLA fuses the
+    chain without annotation)."""
+    if is_training and prob > 0.0:
+        from apex_tpu.utils import train_dropout
+        if rng is None:
+            raise ValueError("jit_dropout_add: rng required in training")
+        x = train_dropout(rng, x, prob)
+    return residual + x
+
+
 class SelfMultiheadAttn(nn.Module):
     """Reference ctor: self_multihead_attn.py:27-50 (embed_dim, num_heads,
     dropout, bias, include_norm_add, impl, separate_qkv_params,

@@ -1,7 +1,16 @@
 """apex_tpu.contrib.sparsity (reference: apex/contrib/sparsity)."""
 
 from apex_tpu.contrib.sparsity.asp import ASP  # noqa: F401
-from apex_tpu.contrib.sparsity.sparse_masklib import create_mask  # noqa: F401
+from apex_tpu.contrib.sparsity.sparse_masklib import (  # noqa: F401
+    compute_valid_2d_patterns,
+    create_mask,
+    m4n2_1d,
+    m4n2_2d_best,
+    m4n2_2d_greedy,
+    mn_1d_best,
+    mn_2d_best,
+    mn_2d_greedy,
+)
 from apex_tpu.contrib.sparsity.permutation_search import (  # noqa: F401
     accelerated_search_for_good_permutation,
     efficacy,

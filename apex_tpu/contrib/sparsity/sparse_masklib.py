@@ -12,6 +12,11 @@ GPU-serving, or for FLOP reduction via mask-aware kernels) — ports
 directly; the mask math is pure tensor ops and jit-safe.
 """
 
+import functools
+import itertools
+
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
@@ -45,6 +50,7 @@ def create_mask(tensor, pattern="m4n2_1d", density=0.5):
     """Reference: sparse_masklib.py:145 ``create_mask(tensor, pattern)``.
 
     Supported patterns: "m4n2_1d" (and the general "mMnN_1d" family),
+    "m4n2_2d_best" / "m4n2_2d_greedy" (and their mMnN families),
     "unstructured".
     """
     if pattern == "unstructured":
@@ -53,6 +59,33 @@ def create_mask(tensor, pattern="m4n2_1d", density=0.5):
         body = pattern[: pattern.index("_1d")]  # e.g. "m4n2"
         m_str, n_str = body[1:].split("n")
         return _nm_mask(tensor, int(n_str), int(m_str))
+    if pattern.startswith("m") and "_2d_" in pattern:
+        body = pattern[: pattern.index("_2d_")]
+        m_str, n_str = body[1:].split("n")
+        if pattern.endswith("_2d_best"):
+            fn = mn_2d_best
+        elif pattern.endswith("_2d_greedy"):
+            fn = mn_2d_greedy
+        else:
+            raise ValueError(f"unsupported sparsity pattern: {pattern}")
+        m_, n_ = int(m_str), int(n_str)
+        shape = tensor.shape
+        # reshape to 2D per the reference's rules (sparse_masklib.py:
+        # 150-183): 1d -> [1, d]; 3d (batch, in, out) -> [b*in, out];
+        # 4d convs -> channels-minor [h*w*out, in], permuted back
+        if tensor.ndim == 1:
+            return fn(tensor.reshape(1, -1), m_, n_).reshape(shape)
+        if tensor.ndim == 2:
+            return fn(tensor, m_, n_)
+        if tensor.ndim == 3:
+            return fn(tensor.reshape(-1, shape[-1]), m_, n_).reshape(shape)
+        if tensor.ndim == 4:
+            t = tensor.transpose(2, 3, 0, 1).reshape(-1, shape[1])
+            mask = fn(t, m_, n_)
+            return mask.reshape(shape[2], shape[3], shape[0],
+                                shape[1]).transpose(2, 3, 0, 1)
+        raise ValueError(
+            f"unsupported tensor rank {tensor.ndim} for 2d pruning")
     raise ValueError(f"unsupported sparsity pattern: {pattern}")
 
 
@@ -70,3 +103,121 @@ def m4n2_1d(mat, density=None):
     """Reference: sparse_masklib.py:106-107."""
     del density  # fixed by the pattern, kept for the reference signature
     return mn_1d_best(mat, 4, 2)
+
+
+# ---------------------------------------------------------------------------
+# 2D n:m pruning (reference: sparse_masklib.py:53-141). A weight tensor
+# masked "2d" is n:m sparse along BOTH rows and columns of every mxm
+# block, so its TRANSPOSE is also n:m sparse — the property that
+# accelerates DGRAD on sparse tensor cores. The reference drives a
+# host loop (greedy) or a cuda pattern-matmul (best); both are realized
+# here as one batched jnp program over all blocks at once.
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _valid_2d_patterns_cached(m, n):
+    row = np.zeros(m)
+    row[:n] = 1
+    rows = sorted(set(itertools.permutations(row.tolist())))
+    valid = []
+    for combo in itertools.product(range(len(rows)), repeat=m):
+        p = np.asarray([rows[i] for i in combo])
+        if (p.sum(0) <= n).all():
+            valid.append(p)
+    out = np.stack(valid).astype(np.float32)
+    out.flags.writeable = False  # shared cache: callers get a copy
+    return out
+
+
+def compute_valid_2d_patterns(m, n):
+    """All mxm 0/1 patterns with exactly n ones per row and <= n per
+    column (reference: sparse_masklib.py:103-118; with m rows of n ones
+    the column bound makes every column exactly n). Returns a host
+    ndarray [num_patterns, m, m] — 90 patterns for m=4, n=2. A fresh
+    copy each call: the cached array must not be mutable through the
+    public boundary."""
+    return _valid_2d_patterns_cached(m, n).copy()
+
+
+def _blocks(matrix, m):
+    """[R, C] -> [R/m * C/m, m, m] row-major blocks (+ inverse info)."""
+    R, C = matrix.shape
+    assert R % m == 0 and C % m == 0, (
+        f"2d pruning needs shapes divisible by {m}, got {matrix.shape}")
+    b = matrix.reshape(R // m, m, C // m, m).transpose(0, 2, 1, 3)
+    return b.reshape(-1, m, m)
+
+
+def _unblocks(blocks, R, C, m):
+    return blocks.reshape(R // m, C // m, m, m).transpose(0, 2, 1, 3) \
+        .reshape(R, C)
+
+
+def mn_2d_best(matrix, m, n):
+    """Exhaustive best 2D n:m mask (reference: sparse_masklib.py:121-138):
+    for every mxm block pick the valid pattern maximizing the kept
+    magnitude — one [blocks, m*m] x [m*m, patterns] matmul. Trailing
+    rows/cols beyond the last full block stay unmasked, the same ragged
+    contract as :func:`mn_2d_greedy`."""
+    R, C = matrix.shape
+    Rf, Cf = (R // m) * m, (C // m) * m
+    patterns = jnp.asarray(_valid_2d_patterns_cached(m, n))   # [P, m, m]
+    blocks = jnp.abs(_blocks(matrix[:Rf, :Cf], m)).reshape(-1, m * m)
+    scores = blocks @ patterns.reshape(-1, m * m).T           # [B, P]
+    best = jnp.argmax(scores, axis=-1)
+    chosen = jnp.take(patterns.reshape(-1, m * m), best, axis=0)
+    full = jnp.ones((R, C), matrix.dtype)
+    return full.at[:Rf, :Cf].set(
+        _unblocks(chosen.reshape(-1, m, m), Rf, Cf, m).astype(matrix.dtype))
+
+
+def m4n2_2d_best(mat, density=None):
+    """Reference: sparse_masklib.py:139-140."""
+    del density
+    return mn_2d_best(mat, 4, 2)
+
+
+def mn_2d_greedy(matrix, m, n):
+    """Greedy 2D n:m mask (reference: sparse_masklib.py:68-96): per
+    block, admit entries in descending |w| order while their row and
+    column budgets (n each) last. The reference's per-block host loop
+    becomes one lax.scan over the m*m magnitude ranks, batched over all
+    blocks. Trailing rows/cols beyond the last full block stay unmasked
+    (reference behavior). NB (also true of the reference): greedy
+    admission can strand a row/column below n entries — only the row
+    and column UPPER bound n is guaranteed; ``mn_2d_best`` gives the
+    exact-n property."""
+    R, C = matrix.shape
+    Rf, Cf = (R // m) * m, (C // m) * m
+    sub = matrix[:Rf, :Cf]
+    blocks = jnp.abs(_blocks(sub, m)).reshape(-1, m * m)     # [B, m*m]
+    order = jnp.argsort(-blocks, axis=-1)                     # desc
+    rows = order // m                                         # [B, m*m]
+    cols = order % m
+
+    def step(carry, idx):
+        mask, rcnt, ccnt = carry
+        r = jnp.take_along_axis(rows, idx[:, None], 1)[:, 0]  # [B]
+        c = jnp.take_along_axis(cols, idx[:, None], 1)[:, 0]
+        r1 = jax.nn.one_hot(r, m, dtype=jnp.int32)            # [B, m]
+        c1 = jax.nn.one_hot(c, m, dtype=jnp.int32)
+        take = ((jnp.sum(rcnt * r1, -1) < n)
+                & (jnp.sum(ccnt * c1, -1) < n))               # [B]
+        t = take.astype(jnp.int32)
+        mask = mask + (r1[:, :, None] * c1[:, None, :]) * t[:, None, None]
+        return (mask, rcnt + r1 * t[:, None], ccnt + c1 * t[:, None]), None
+
+    B = blocks.shape[0]
+    init = (jnp.zeros((B, m, m), jnp.int32),
+            jnp.zeros((B, m), jnp.int32), jnp.zeros((B, m), jnp.int32))
+    idxs = jnp.broadcast_to(jnp.arange(m * m)[:, None], (m * m, B))
+    (mask, _, _), _ = jax.lax.scan(step, init, idxs)
+    full = jnp.ones((R, C), matrix.dtype)
+    return full.at[:Rf, :Cf].set(
+        _unblocks(mask, Rf, Cf, m).astype(matrix.dtype))
+
+
+def m4n2_2d_greedy(mat, density=None):
+    """Reference: sparse_masklib.py:98-99."""
+    del density
+    return mn_2d_greedy(mat, 4, 2)

@@ -90,6 +90,13 @@ def fused_rms_norm(x, normalized_shape, weight=None, eps=1e-5,
     return y.astype(orig_dtype)
 
 
+def manual_rms_norm(input, normalized_shape, weight, eps):
+    """Reference: fused_layer_norm.py:16-29 — the pure-python RMS-norm
+    fallback; identical math to :func:`fused_rms_norm` here (XLA fuses
+    both the same way)."""
+    return fused_rms_norm(input, normalized_shape, weight, eps)
+
+
 # aliases matching the reference's functional names
 fused_layer_norm_affine = fused_layer_norm
 fused_rms_norm_affine = fused_rms_norm

@@ -44,6 +44,8 @@ class _ParallelState:
     virtual_pipeline_model_parallel_size = None
     virtual_pipeline_model_parallel_rank = None
     pipeline_model_parallel_split_rank = None
+    tensor_model_parallel_rank_override = None
+    pipeline_model_parallel_rank_override = None
 
 
 _STATE = _ParallelState()
@@ -87,6 +89,13 @@ def initialize_model_parallel(tensor_model_parallel_size_=1,
         0 if virtual_pipeline_model_parallel_size_ is not None else None)
     _STATE.pipeline_model_parallel_split_rank = (
         pipeline_model_parallel_split_rank_)
+    # clear stale host-side rank overrides for code traced AFTER this
+    # point. NB: an override active while a jitted program was traced is
+    # baked into that executable as a constant — XLA's compilation cache
+    # cannot be invalidated from here (the setters' docstrings carry the
+    # same warning)
+    _STATE.tensor_model_parallel_rank_override = None
+    _STATE.pipeline_model_parallel_rank_override = None
     return _STATE.mesh
 
 
@@ -116,6 +125,8 @@ def destroy_model_parallel():
     _STATE.virtual_pipeline_model_parallel_size = None
     _STATE.virtual_pipeline_model_parallel_rank = None
     _STATE.pipeline_model_parallel_split_rank = None
+    _STATE.tensor_model_parallel_rank_override = None
+    _STATE.pipeline_model_parallel_rank_override = None
 
 
 # ---------------------------------------------------------------------------
@@ -201,10 +212,14 @@ def set_pipeline_model_parallel_split_rank(rank):
 # ---------------------------------------------------------------------------
 
 def get_tensor_model_parallel_rank():
+    if _STATE.tensor_model_parallel_rank_override is not None:
+        return _STATE.tensor_model_parallel_rank_override
     return jax.lax.axis_index(TENSOR_AXIS)
 
 
 def get_pipeline_model_parallel_rank():
+    if _STATE.pipeline_model_parallel_rank_override is not None:
+        return _STATE.pipeline_model_parallel_rank_override
     return jax.lax.axis_index(PIPELINE_AXIS)
 
 
@@ -231,7 +246,7 @@ def is_pipeline_first_stage(ignore_virtual=False):
             return False
     if _STATE.pipeline_model_parallel_size == 1:
         return True
-    return jax.lax.axis_index(PIPELINE_AXIS) == 0
+    return get_pipeline_model_parallel_rank() == 0
 
 
 def is_pipeline_last_stage(ignore_virtual=False):
@@ -243,7 +258,7 @@ def is_pipeline_last_stage(ignore_virtual=False):
             return False
     if _STATE.pipeline_model_parallel_size == 1:
         return True
-    return (jax.lax.axis_index(PIPELINE_AXIS)
+    return (get_pipeline_model_parallel_rank()
             == _STATE.pipeline_model_parallel_size - 1)
 
 
@@ -272,14 +287,14 @@ def get_pipeline_model_parallel_next_rank():
     """Traced: the pp index of the next stage, ring-wrapped (reference:
     parallel_state.py:602 computes the global rank)."""
     pp = _STATE.pipeline_model_parallel_size
-    return (jax.lax.axis_index(PIPELINE_AXIS) + 1) % pp
+    return (get_pipeline_model_parallel_rank() + 1) % pp
 
 
 def get_pipeline_model_parallel_prev_rank():
     """Traced: the pp index of the previous stage, ring-wrapped
     (reference: parallel_state.py:609)."""
     pp = _STATE.pipeline_model_parallel_size
-    return (jax.lax.axis_index(PIPELINE_AXIS) - 1) % pp
+    return (get_pipeline_model_parallel_rank() - 1) % pp
 
 
 def get_rank_info():
@@ -290,15 +305,20 @@ def get_rank_info():
     in a host context."""
     if not model_parallel_is_initialized():
         return (0, 0, 0, 0)
-    try:
-        return (
-            get_data_parallel_rank(),
-            get_tensor_model_parallel_rank(),
-            get_pipeline_model_parallel_rank(),
-            get_virtual_pipeline_model_parallel_rank(),
-        )
-    except NameError:  # axis names unbound: host context
-        return (0, 0, 0, _STATE.virtual_pipeline_model_parallel_rank)
+
+    def or_zero(getter):
+        # per-element fallback: an override-aware getter may succeed on
+        # the host while a sibling axis is unbound
+        try:
+            return getter()
+        except NameError:  # axis name unbound: host context
+            return 0
+    return (
+        or_zero(get_data_parallel_rank),
+        or_zero(get_tensor_model_parallel_rank),
+        or_zero(get_pipeline_model_parallel_rank),
+        get_virtual_pipeline_model_parallel_rank(),
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -317,7 +337,7 @@ def is_rank_in_embedding_group(ignore_virtual=False):
     pp = _STATE.pipeline_model_parallel_size
     if pp == 1:
         return True
-    rank = jax.lax.axis_index(PIPELINE_AXIS)
+    rank = get_pipeline_model_parallel_rank()
     # delegate the virtual-chunk gating to the stage predicates, as the
     # reference does (parallel_state.py:396-399) — one source of truth
     in_group = (is_pipeline_first_stage(ignore_virtual)
@@ -335,7 +355,7 @@ def is_rank_in_position_embedding_group():
     pp = _STATE.pipeline_model_parallel_size
     if pp == 1:
         return True
-    rank = jax.lax.axis_index(PIPELINE_AXIS)
+    rank = get_pipeline_model_parallel_rank()
     in_group = rank == 0
     split = _STATE.pipeline_model_parallel_split_rank
     if split is not None:
@@ -349,7 +369,7 @@ def is_rank_in_encoder_relative_position_embedding_group():
     split = _STATE.pipeline_model_parallel_split_rank
     if split is None or _STATE.pipeline_model_parallel_size == 1:
         return True
-    return jax.lax.axis_index(PIPELINE_AXIS) < split
+    return get_pipeline_model_parallel_rank() < split
 
 
 def is_rank_in_decoder_relative_position_embedding_group():
@@ -358,7 +378,7 @@ def is_rank_in_decoder_relative_position_embedding_group():
     split = _STATE.pipeline_model_parallel_split_rank
     if split is None or _STATE.pipeline_model_parallel_size == 1:
         return True
-    return jax.lax.axis_index(PIPELINE_AXIS) >= split
+    return get_pipeline_model_parallel_rank() >= split
 
 
 def is_pipeline_stage_before_split(rank=None):
@@ -370,7 +390,7 @@ def is_pipeline_stage_before_split(rank=None):
     if split is None:
         return True
     if rank is None:
-        rank = jax.lax.axis_index(PIPELINE_AXIS)
+        rank = get_pipeline_model_parallel_rank()
     return rank < split
 
 
@@ -383,7 +403,7 @@ def is_pipeline_stage_after_split(rank=None):
     if split is None:
         return True
     if rank is None:
-        rank = jax.lax.axis_index(PIPELINE_AXIS)
+        rank = get_pipeline_model_parallel_rank()
     return rank >= split
 
 
@@ -396,6 +416,31 @@ def is_pipeline_stage_at_split():
     if (_STATE.pipeline_model_parallel_size == 1
             or _STATE.pipeline_model_parallel_split_rank is None):
         return True
-    rank = jax.lax.axis_index(PIPELINE_AXIS)
+    rank = get_pipeline_model_parallel_rank()
     return (is_pipeline_stage_before_split(rank)
             & is_pipeline_stage_after_split(rank + 1))
+
+
+def set_tensor_model_parallel_world_size(world_size):
+    """Reference: parallel_state.py:463-466 — manual override for tests
+    and checkpoint re-layout tooling."""
+    _STATE.tensor_model_parallel_size = world_size
+
+
+def set_pipeline_model_parallel_world_size(world_size):
+    """Reference: parallel_state.py:469-472."""
+    _STATE.pipeline_model_parallel_size = world_size
+
+
+def set_tensor_model_parallel_rank(rank):
+    """Reference: parallel_state.py:484-487 — overrides what
+    ``get_tensor_model_parallel_rank`` returns (``None`` restores the
+    traced axis index). Host-side bookkeeping/tests only: inside a
+    traced program the override is a constant across all devices."""
+    _STATE.tensor_model_parallel_rank_override = rank
+
+
+def set_pipeline_model_parallel_rank(rank):
+    """Reference: parallel_state.py:490-493 — same override contract as
+    :func:`set_tensor_model_parallel_rank`."""
+    _STATE.pipeline_model_parallel_rank_override = rank

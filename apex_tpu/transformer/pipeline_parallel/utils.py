@@ -197,3 +197,29 @@ def unwrap_model(model, module_instances=()):
             m = m.module
         unwrapped.append(m)
     return unwrapped if return_list else unwrapped[0]
+
+
+def get_micro_batch_size():
+    """Reference: utils.py:88."""
+    _ensure_var_is_initialized(_GLOBAL_NUM_MICROBATCHES_CALCULATOR,
+                               "num microbatches calculator")
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.micro_batch_size
+
+
+def is_last_rank():
+    """Reference: utils.py:168 — last GLOBAL rank. Host-level: the last
+    process (per-device ranks have no host value in single-controller
+    JAX; the judge of "last" for logging is the process)."""
+    return jax.process_index() == jax.process_count() - 1
+
+
+def print_rank_0(message):
+    """Print on (process) rank 0 only (reference: utils.py:159)."""
+    if jax.process_index() == 0:
+        print(message, flush=True)
+
+
+def print_rank_last(message):
+    """Print on the last (process) rank only (reference: utils.py:172)."""
+    if is_last_rank():
+        print(message, flush=True)

@@ -533,3 +533,112 @@ def test_amp_init_legacy_entry():
     with pytest.warns(UserWarning, match="no effect"):
         h2 = amp.init(loss_scale=128.0, verbose=True)
     assert isinstance(h2, amp.AmpHandle) and h2.is_active() and h2.verbose
+
+
+def test_2d_sparsity_patterns():
+    """apex/contrib/sparsity/sparse_masklib.py:53-141: 2D n:m masks —
+    every 4x4 block 2:4 sparse along BOTH rows and columns (so the
+    transpose is also 2:4), best >= greedy magnitude, best block choice
+    brute-force optimal, create_mask dispatch."""
+    from apex_tpu.contrib.sparsity import (compute_valid_2d_patterns,
+                                           create_mask, m4n2_2d_best,
+                                           m4n2_2d_greedy, mn_2d_greedy)
+
+    pats = compute_valid_2d_patterns(4, 2)
+    assert pats.shape[0] == 90
+    assert (pats.sum(1) == 2).all() and (pats.sum(2) == 2).all()
+
+    rs = np.random.RandomState(0)
+    w = jnp.asarray(rs.randn(8, 12), jnp.float32)
+    mb = np.asarray(m4n2_2d_best(w))
+    mg = np.asarray(m4n2_2d_greedy(w))
+    # best guarantees exactly 2 per row AND column of every block;
+    # greedy (like the reference's) only guarantees the upper bound —
+    # admission can strand a row/column below n
+    blocks = mb.reshape(2, 4, 3, 4).transpose(0, 2, 1, 3).reshape(-1, 4, 4)
+    assert (blocks.sum(1) == 2).all() and (blocks.sum(2) == 2).all()
+    gblocks = mg.reshape(2, 4, 3, 4).transpose(0, 2, 1, 3).reshape(-1, 4, 4)
+    assert (gblocks.sum(1) <= 2).all() and (gblocks.sum(2) <= 2).all()
+    aw = np.abs(np.asarray(w))
+    assert (aw * mb).sum() >= (aw * mg).sum() - 1e-5
+    best_manual = max((aw[:4, :4] * p).sum() for p in pats)
+    np.testing.assert_allclose((aw[:4, :4] * mb[:4, :4]).sum(),
+                               best_manual, rtol=1e-6)
+    # greedy leaves the ragged tail unmasked (reference behavior)
+    g2 = np.asarray(mn_2d_greedy(jnp.asarray(rs.randn(6, 10),
+                                             jnp.float32), 4, 2))
+    assert (g2[4:, :] == 1).all() and (g2[:, 8:] == 1).all()
+    np.testing.assert_array_equal(
+        np.asarray(create_mask(w, "m4n2_2d_best")), mb)
+    np.testing.assert_array_equal(
+        np.asarray(create_mask(w, "m4n2_2d_greedy")), mg)
+    # typo'd algorithm suffix is loud, not silently greedy
+    with pytest.raises(ValueError, match="unsupported"):
+        create_mask(w, "m4n2_2d_bset")
+    # 4D conv weights dispatch through the reference's channels-minor
+    # reshape (mask shape matches; each flattened row group 2:4 along C_in)
+    w4 = jnp.asarray(rs.randn(8, 8, 3, 3), jnp.float32)
+    m4 = np.asarray(create_mask(w4, "m4n2_2d_best"))
+    assert m4.shape == w4.shape
+    flat = m4.transpose(2, 3, 0, 1).reshape(-1, 8)
+    fb = flat.reshape(-1, 4, 2, 4).transpose(0, 2, 1, 3).reshape(-1, 4, 4)
+    assert (fb.sum(1) == 2).all() and (fb.sum(2) == 2).all()
+
+
+def test_small_reference_helpers(state_guard):
+    """print_rank_0/print_rank_last/is_last_rank/get_micro_batch_size,
+    manual_rms_norm, jit_dropout_add, parallel_state rank/world-size
+    setters."""
+    import io
+    from contextlib import redirect_stdout
+
+    from apex_tpu.contrib.multihead_attn import jit_dropout_add
+    from apex_tpu.normalization.fused_layer_norm import (fused_rms_norm,
+                                                         manual_rms_norm)
+    from apex_tpu.transformer.pipeline_parallel.utils import (
+        destroy_microbatch_calculator, get_micro_batch_size, is_last_rank,
+        print_rank_0, print_rank_last, setup_microbatch_calculator)
+
+    # single-process: rank 0 IS the last rank; both printers fire
+    assert is_last_rank()
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        print_rank_0("hello-r0")
+        print_rank_last("hello-rl")
+    assert "hello-r0" in buf.getvalue() and "hello-rl" in buf.getvalue()
+
+    setup_microbatch_calculator(0, None, 16, 2, 2)
+    try:
+        assert get_micro_batch_size() == 2
+    finally:
+        destroy_microbatch_calculator()
+
+    x = jnp.asarray(np.random.RandomState(0).randn(3, 8), jnp.float32)
+    wgt = jnp.ones(8, jnp.float32)
+    np.testing.assert_array_equal(
+        np.asarray(manual_rms_norm(x, 8, wgt, 1e-5)),
+        np.asarray(fused_rms_norm(x, 8, wgt, 1e-5)))
+
+    out = jit_dropout_add(x, x, 0.0, False)
+    np.testing.assert_allclose(np.asarray(out), 2 * np.asarray(x))
+    with pytest.raises(ValueError, match="rng"):
+        jit_dropout_add(x, x, 0.5, True)
+
+    # rank/world-size setter overrides round-trip on the host
+    ps.set_tensor_model_parallel_world_size(4)
+    ps.set_pipeline_model_parallel_world_size(2)
+    assert ps.get_tensor_model_parallel_world_size() == 4
+    assert ps.get_pipeline_model_parallel_world_size() == 2
+    ps.set_tensor_model_parallel_rank(3)
+    ps.set_pipeline_model_parallel_rank(1)
+    assert ps.get_tensor_model_parallel_rank() == 3
+    assert ps.get_pipeline_model_parallel_rank() == 1
+    # the overrides propagate into the derived predicates host-side
+    # (reference: predicates route through get_*_rank)
+    assert ps.is_pipeline_last_stage() is True          # rank 1 of pp=2
+    assert not ps.is_pipeline_first_stage()
+    assert ps.get_pipeline_model_parallel_next_rank() == 0
+    assert ps.get_pipeline_model_parallel_prev_rank() == 0
+    # get_rank_info still gates on full initialization, as the
+    # reference does (returns the zero tuple when no mesh exists)
+    assert ps.get_rank_info() == (0, 0, 0, 0)
