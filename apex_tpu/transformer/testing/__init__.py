@@ -37,6 +37,11 @@ from apex_tpu.transformer.testing.standalone_transformer_lm import (  # noqa: F4
     init_method_normal,
     scaled_init_method_normal,
 )
+from apex_tpu.transformer.testing.distributed_test_base import (  # noqa: F401
+    DistributedTestBase,
+    NcclDistributedTestBase,
+    UccDistributedTestBase,
+)
 from apex_tpu.transformer.testing.commons import (  # noqa: F401
     IdentityLayer,
     ToyParallelMLP,

@@ -642,3 +642,35 @@ def test_small_reference_helpers(state_guard):
     # get_rank_info still gates on full initialization, as the
     # reference does (returns the zero tuple when no mesh exists)
     assert ps.get_rank_info() == (0, 0, 0, 0)
+
+
+def test_distributed_test_base():
+    """apex/transformer/testing/distributed_test_base.py:27-130: the
+    unittest base drives an in-process SPMD test on the virtual mesh."""
+    import unittest
+
+    from apex_tpu.transformer.testing import (DistributedTestBase,
+                                              NcclDistributedTestBase,
+                                              UccDistributedTestBase)
+
+    class MyDistTest(NcclDistributedTestBase):
+        def test_psum_over_tp(self):
+            mesh = self.initialize_model_parallel(
+                tensor_model_parallel_size=self.world_size)
+            out = shard_map(
+                lambda: jnp.reshape(
+                    jax.lax.psum(jnp.float32(1.0), "tp"), (1, 1, 1)),
+                mesh=mesh, in_specs=(), out_specs=P("pp", "dp", "tp"),
+                check_vma=False)()
+            assert float(np.asarray(out)[0, 0, 0]) == self.world_size
+
+    suite = unittest.defaultTestLoader.loadTestsFromTestCase(MyDistTest)
+    result = unittest.TextTestRunner(verbosity=0).run(suite)
+    assert result.wasSuccessful(), result.failures + result.errors
+    assert not ps.model_parallel_is_initialized()  # tearDown cleaned up
+
+    assert NcclDistributedTestBase.DISTRIBUTED_BACKEND == "nccl"
+    assert UccDistributedTestBase.DISTRIBUTED_BACKEND == "ucc"
+    assert DistributedTestBase.DISTRIBUTED_BACKEND == "xla"
+    t = MyDistTest("test_psum_over_tp")
+    assert t.world_size == 4  # min(devices, 4), reference rule
