@@ -28,6 +28,9 @@ from apex_tpu.transformer.testing.standalone_transformer_lm import (  # noqa: F4
     BertLMHead,
     NoopTransformerLayer,
     Pooler,
+    Embedding,
+    TransformerLanguageModel,
+    get_language_model,
     bert_extended_attention_mask,
     bert_position_ids,
     bias_dropout_add,

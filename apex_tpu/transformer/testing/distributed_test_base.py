@@ -63,7 +63,7 @@ class DistributedTestBase(unittest.TestCase):
     def init_method(self):
         """The reference's file/tcp rendezvous string; here the analog
         is the coordinator address the multiproc launcher uses."""
-        return "localhost:" + os.environ.get("MASTER_PORT", "29500")
+        return "localhost:" + os.environ.get("MASTER_PORT", "29530")
 
     def initialize_model_parallel(self, tensor_model_parallel_size=1,
                                   pipeline_model_parallel_size=1,
@@ -83,8 +83,8 @@ class DistributedTestBase(unittest.TestCase):
         run_env = dict(os.environ)
         # explicit arg > configured environment (e.g. Ucc setUp's port)
         # > default
-        run_env["MASTER_PORT"] = (master_port
-                                  or os.environ.get("MASTER_PORT", "29530"))
+        run_env["MASTER_PORT"] = str(
+            master_port or os.environ.get("MASTER_PORT", "29530"))
         # worker processes must resolve apex_tpu regardless of how THIS
         # process found it (editable install vs repo-root cwd)
         import apex_tpu

@@ -553,6 +553,70 @@ class ParallelTransformer(nn.Module):
 # GPT
 # ---------------------------------------------------------------------------
 
+def _word_embeddings_param(module, cfg, axis_name):
+    """The vocab-sharded tied word table every LM head reuses (one
+    definition: GPTModel, BertModel and TransformerLanguageModel all
+    carry it at model top level so pipeline stages without pre_process
+    still reach it)."""
+    tp_world = lax.axis_size(axis_name)
+    return module.param(
+        "word_embeddings",
+        _sharded_init(init_normal(cfg.init_method_std),
+                      (cfg.vocab_size, cfg.hidden_size), 0, axis_name),
+        (divide(cfg.vocab_size, tp_world), cfg.hidden_size),
+        cfg.params_dtype)
+
+
+class Embedding(nn.Module):
+    """Word + position (+ optional tokentype) embeddings with the
+    [s, b, h] transpose, compute-dtype cast, the sequence-parallel
+    scatter when ``cfg.sequence_parallel``, and embedding dropout
+    (reference:
+    standalone_transformer_lm.py Embedding :150-280). The word table is
+    passed IN (and owned by the caller) because pipeline stages without
+    ``pre_process`` still need it for tied logits — weight tying as
+    explicit dataflow, per the module docstring."""
+
+    cfg: TransformerConfig
+    num_tokentypes: int = 0
+    axis_name: str = TENSOR_AXIS
+
+    @nn.compact
+    def __call__(self, word_embeddings, input_ids, position_ids,
+                 tokentype_ids=None, deterministic=True):
+        cfg = self.cfg
+        position_embeddings = self.param(
+            "position_embeddings", init_normal(cfg.init_method_std),
+            (cfg.max_position_embeddings, cfg.hidden_size),
+            cfg.params_dtype)
+        emb = (vocab_parallel_embed(word_embeddings, input_ids,
+                                    self.axis_name)
+               + jnp.take(position_embeddings, position_ids, axis=0))
+        if self.num_tokentypes > 0:
+            # table exists whenever the module declares tokentypes (the
+            # reference's rule) — init without tokentype_ids must still
+            # create it, or a later apply WITH them can't find the param
+            tokentype_embeddings = self.param(
+                "tokentype_embeddings", init_normal(cfg.init_method_std),
+                (self.num_tokentypes, cfg.hidden_size), cfg.params_dtype)
+            if tokentype_ids is not None:
+                emb = emb + jnp.take(tokentype_embeddings, tokentype_ids,
+                                     axis=0)
+        else:
+            assert tokentype_ids is None, (
+                "tokentype_ids passed to an Embedding built with "
+                "num_tokentypes=0")
+        # [b, s, h] → [s, b, h]
+        emb = emb.transpose(1, 0, 2)
+        if cfg.compute_in_float16:
+            emb = emb.astype(jnp.bfloat16 if cfg.bf16 else jnp.float16)
+        if cfg.sequence_parallel:
+            emb = mappings.scatter_to_sequence_parallel_region(
+                emb, self.axis_name)
+        return nn.Dropout(rate=cfg.hidden_dropout)(
+            emb, deterministic=deterministic)
+
+
 class GPTModel(nn.Module):
     """GPT language model (reference: standalone_gpt.py:111 +
     standalone_transformer_lm.py TransformerLanguageModel/Embedding).
@@ -568,6 +632,14 @@ class GPTModel(nn.Module):
     post_process: bool = True
     axis_name: str = TENSOR_AXIS
 
+    # NB: GPTModel composes Embedding + ParallelTransformer itself
+    # rather than delegating to TransformerLanguageModel: its param tree
+    # ("transformer", flat word table) is the layout every checkpoint,
+    # sharding rule, and test in this repo addresses — delegating would
+    # rename the trunk to "language_model/encoder". Keep shared fixes in
+    # the pieces (Embedding, ParallelTransformer, Pooler), which both
+    # composites build on.
+
     @nn.compact
     def __call__(self, input_ids, position_ids, attention_mask, labels=None,
                  deterministic=True, hidden_state=None):
@@ -575,33 +647,15 @@ class GPTModel(nn.Module):
         ``pre_process=False`` — the functional form of the reference's
         ``set_input_tensor`` plumbing (schedules/common.py:30-80)."""
         cfg = self.cfg
-        tp_world = lax.axis_size(self.axis_name)
-        word_embeddings = self.param(
-            "word_embeddings",
-            _sharded_init(init_normal(cfg.init_method_std),
-                          (cfg.vocab_size, cfg.hidden_size), 0,
-                          self.axis_name),
-            (divide(cfg.vocab_size, tp_world), cfg.hidden_size),
-            cfg.params_dtype)
+        word_embeddings = _word_embeddings_param(self, cfg,
+                                                 self.axis_name)
 
         hidden = hidden_state
         if self.pre_process:
-            position_embeddings = self.param(
-                "position_embeddings", init_normal(cfg.init_method_std),
-                (cfg.max_position_embeddings, cfg.hidden_size),
-                cfg.params_dtype)
-            emb = (vocab_parallel_embed(word_embeddings, input_ids,
-                                        self.axis_name)
-                   + jnp.take(position_embeddings, position_ids, axis=0))
-            # [b, s, h] → [s, b, h]
-            emb = emb.transpose(1, 0, 2)
-            if cfg.compute_in_float16:
-                emb = emb.astype(jnp.bfloat16 if cfg.bf16 else jnp.float16)
-            if cfg.sequence_parallel:
-                emb = mappings.scatter_to_sequence_parallel_region(
-                    emb, self.axis_name)
-            hidden = nn.Dropout(rate=cfg.hidden_dropout)(
-                emb, deterministic=deterministic)
+            hidden = Embedding(
+                cfg, axis_name=self.axis_name, name="embedding")(
+                word_embeddings, input_ids, position_ids,
+                deterministic=deterministic)
         assert hidden is not None, (
             "pre_process=False requires hidden_state (the upstream "
             "pipeline stage's activation)")
@@ -628,6 +682,75 @@ class GPTModel(nn.Module):
         # post_language_model_processing: vocab-parallel CE in fp32
         return vocab_parallel_cross_entropy(
             logits, labels, axis_name=self.axis_name)
+
+
+class TransformerLanguageModel(nn.Module):
+    """Embedding + transformer trunk (+ optional pooler): the composite
+    the reference's heads build on (reference:
+    standalone_transformer_lm.py TransformerLanguageModel :1260-1420,
+    get_language_model :1240-1257). Returns ``(encoder_output,
+    word_embeddings)`` — or ``(encoder_output, pooled_output,
+    word_embeddings)`` with ``add_pooler`` — so heads can tie logits to
+    the word table explicitly."""
+
+    cfg: TransformerConfig
+    num_tokentypes: int = 0
+    add_pooler: bool = False
+    encoder_attn_mask_type: Any = AttnMaskType.padding
+    pre_process: bool = True
+    post_process: bool = True
+    axis_name: str = TENSOR_AXIS
+
+    @nn.compact
+    def __call__(self, enc_input_ids, enc_position_ids, enc_attn_mask,
+                 tokentype_ids=None, pooling_sequence_index=0,
+                 deterministic=True, hidden_state=None):
+        cfg = self.cfg
+        word_embeddings = _word_embeddings_param(self, cfg,
+                                                 self.axis_name)
+
+        hidden = hidden_state
+        if self.pre_process:
+            hidden = Embedding(
+                cfg, num_tokentypes=self.num_tokentypes,
+                axis_name=self.axis_name, name="embedding")(
+                word_embeddings, enc_input_ids, enc_position_ids,
+                tokentype_ids=tokentype_ids, deterministic=deterministic)
+        assert hidden is not None, (
+            "pre_process=False requires hidden_state")
+
+        encoder_output = ParallelTransformer(
+            cfg, self_attn_mask_type=self.encoder_attn_mask_type,
+            pre_process=self.pre_process, post_process=self.post_process,
+            recompute_activations=(cfg.recompute_granularity == "full"),
+            axis_name=self.axis_name, name="encoder")(
+            hidden, enc_attn_mask, deterministic=deterministic)
+
+        if self.post_process and self.add_pooler:
+            pooled = Pooler(cfg.hidden_size,
+                            init_normal(cfg.init_method_std),
+                            params_dtype=cfg.params_dtype,
+                            sequence_parallel=cfg.sequence_parallel,
+                            axis_name=self.axis_name, name="pooler")(
+                encoder_output, pooling_sequence_index)
+            return encoder_output, pooled, word_embeddings
+        return encoder_output, word_embeddings
+
+
+def get_language_model(cfg, num_tokentypes=0, add_pooler=False,
+                       encoder_attn_mask_type=AttnMaskType.padding,
+                       pre_process=True, post_process=True,
+                       axis_name=TENSOR_AXIS, **unused):
+    """Reference: standalone_transformer_lm.py:1240-1257 — returns
+    ``(language_model, language_model_key)``. The init-method arguments
+    the reference threads through are fixed by ``cfg.init_method_std``
+    here (the same defaulting its callers use)."""
+    model = TransformerLanguageModel(
+        cfg, num_tokentypes=num_tokentypes, add_pooler=add_pooler,
+        encoder_attn_mask_type=encoder_attn_mask_type,
+        pre_process=pre_process, post_process=post_process,
+        axis_name=axis_name)
+    return model, "language_model"
 
 
 def gpt_model_provider(cfg, pre_process=True, post_process=True, **kwargs):
@@ -668,14 +791,25 @@ class NoopTransformerLayer(nn.Module):
 
 class Pooler(nn.Module):
     """First-token (or ``sequence_index``) tanh pooler (reference:
-    standalone_transformer_lm.py:1208-1236). Input [s, b, h]."""
+    standalone_transformer_lm.py:1208-1236). Input [s, b, h]; with
+    ``sequence_parallel`` the input is the trunk's sequence-sharded
+    [s/tp, b, h] and is gathered first — ``sequence_index`` is a GLOBAL
+    position (the reference Pooler does the same gather). The gather's
+    backward uses the replicated-output-grad convention (plain split,
+    not reduce-scatter): the pooled path is replicated across tp."""
 
     hidden_size: int
     init_method: Any = None
     params_dtype: Any = jnp.float32
+    sequence_parallel: bool = False
+    axis_name: str = TENSOR_AXIS
 
     @nn.compact
     def __call__(self, hidden_states, sequence_index=0):
+        if self.sequence_parallel:
+            hidden_states = mappings.gather_from_sequence_parallel_region(
+                hidden_states, self.axis_name,
+                tensor_parallel_output_grad=False)
         dense = nn.Dense(
             self.hidden_size,
             kernel_init=self.init_method or init_normal(0.02),
@@ -727,7 +861,8 @@ class BertLMHead(nn.Module):
                           (word_embeddings.shape[0],), cfg.params_dtype)
         return parallel_lm_logits(
             h, word_embeddings, parallel_output=self.parallel_output,
-            bias=bias, axis_name=self.axis_name)
+            bias=bias, sequence_parallel=cfg.sequence_parallel,
+            axis_name=self.axis_name)
 
 
 class BertModel(nn.Module):
@@ -748,37 +883,18 @@ class BertModel(nn.Module):
     def __call__(self, input_ids, attention_mask, tokentype_ids=None,
                  lm_labels=None, deterministic=True, hidden_state=None):
         cfg = self.cfg
-        tp_world = lax.axis_size(self.axis_name)
         position_ids = bert_position_ids(input_ids)
         ext_mask = bert_extended_attention_mask(attention_mask)
 
-        word_embeddings = self.param(
-            "word_embeddings",
-            _sharded_init(init_normal(cfg.init_method_std),
-                          (cfg.vocab_size, cfg.hidden_size), 0,
-                          self.axis_name),
-            (divide(cfg.vocab_size, tp_world), cfg.hidden_size),
-            cfg.params_dtype)
-        position_embeddings = self.param(
-            "position_embeddings", init_normal(cfg.init_method_std),
-            (cfg.max_position_embeddings, cfg.hidden_size), cfg.params_dtype)
-
+        word_embeddings = _word_embeddings_param(self, cfg,
+                                                 self.axis_name)
         hidden = hidden_state
         if self.pre_process:
-            emb = (vocab_parallel_embed(word_embeddings, input_ids,
-                                        self.axis_name)
-                   + jnp.take(position_embeddings, position_ids, axis=0))
-            if tokentype_ids is not None:
-                tokentype_embeddings = self.param(
-                    "tokentype_embeddings", init_normal(cfg.init_method_std),
-                    (2, cfg.hidden_size), cfg.params_dtype)
-                emb = emb + jnp.take(tokentype_embeddings, tokentype_ids,
-                                     axis=0)
-            emb = emb.transpose(1, 0, 2)
-            if cfg.compute_in_float16:
-                emb = emb.astype(jnp.bfloat16 if cfg.bf16 else jnp.float16)
-            hidden = nn.Dropout(rate=cfg.hidden_dropout)(
-                emb, deterministic=deterministic)
+            hidden = Embedding(
+                cfg, num_tokentypes=2,
+                axis_name=self.axis_name, name="embedding")(
+                word_embeddings, input_ids, position_ids,
+                tokentype_ids=tokentype_ids, deterministic=deterministic)
         assert hidden is not None, (
             "pre_process=False requires hidden_state")
 
@@ -802,6 +918,8 @@ class BertModel(nn.Module):
             pooled = Pooler(cfg.hidden_size,
                             init_normal(cfg.init_method_std),
                             params_dtype=cfg.params_dtype,
+                            sequence_parallel=cfg.sequence_parallel,
+                            axis_name=self.axis_name,
                             name="pooler")(hidden)
             binary_logits = nn.Dense(2, name="binary_head",
                                      param_dtype=cfg.params_dtype)(pooled)

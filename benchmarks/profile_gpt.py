@@ -151,7 +151,7 @@ def make_sc(eps, g0):
         ns = scaler.update(ss, found)
         # keep the unscaled grads live so XLA can't elide the pass
         ns = ns.replace(loss_scale=ns.loss_scale + eps * jnp.sum(
-            g2["position_embeddings"][0]))
+            g2["embedding"]["position_embeddings"][0]))
         return ns, ns.loss_scale
     return body
 

@@ -123,7 +123,7 @@ def test_gpt_context_parallel_matches_single():
             params = model.init(jax.random.PRNGKey(0), ids, pos,
                                 None)["params"]
             loss, grads = jax.value_and_grad(loss_fn)(params)
-            pe = grads["position_embeddings"]
+            pe = grads["embedding"]["position_embeddings"]
             if shard_seq:
                 # replicated param under a pmean'd loss: each rank's local
                 # grad is cp x its disjoint share, so the cross-rank

@@ -137,7 +137,7 @@ def _gpt_loss_and_grads(tp):
         loss, grads = jax.value_and_grad(loss_fn)(params)
         # grad of the pp-replicated position embedding is a good
         # tp-invariance probe (word-embedding grads are sharded)
-        return loss, grads["position_embeddings"]
+        return loss, grads["embedding"]["position_embeddings"]
 
     loss, pe_grad = smap(run, mesh, (P(), P(), P()), (P(), P()))(
         ids, pos, labels)
@@ -191,7 +191,7 @@ def test_gpt_dropout_training_mode():
             {"params": params}, ids, pos, None, labels))
         nodrop_loss = jnp.mean(model_nodrop.apply(
             {"params": params}, ids, pos, None, labels))
-        gleaf = grads["position_embeddings"]
+        gleaf = grads["embedding"]["position_embeddings"]
         return loss, eval_loss, nodrop_loss, gleaf
 
     f = smap(run, mesh, (P(), P(), P(), P()), (P(), P(), P(), P()))
@@ -275,7 +275,7 @@ def test_recompute_granularity_grads_match(granularity):
                 return jnp.mean(model.apply({"params": p}, ids, pos, None,
                                             labels))
             loss, g = jax.value_and_grad(loss_fn)(params)
-            return loss, g["position_embeddings"]
+            return loss, g["embedding"]["position_embeddings"]
 
         return smap(run, mesh, (P(), P(), P()), (P(), P()))(ids, pos, labels)
 
