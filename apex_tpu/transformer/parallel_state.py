@@ -24,9 +24,17 @@ Rank getters come in two forms:
     value in single-controller JAX.
 """
 
+import warnings
+
 import jax
 import numpy as np
 from jax.sharding import Mesh
+
+class ExperimentalWarning(Warning):
+    """Reference: parallel_state.py:673 — the category the experimental
+    surfaces emit (here: selecting the interleaved pipeline schedule,
+    below)."""
+
 
 # Canonical axis names (the reference's group names).
 TENSOR_AXIS = "tp"
@@ -77,6 +85,9 @@ def initialize_model_parallel(tensor_model_parallel_size_=1,
         # reference: parallel_state.py:167 — interleaving needs > 2 stages
         assert pp > 2 or virtual_pipeline_model_parallel_size_ == 1, \
             "interleaved schedule needs pipeline_model_parallel_size > 2"
+        warnings.warn(
+            "the interleaved (virtual pipeline) schedule is experimental",
+            ExperimentalWarning, stacklevel=2)
 
     dev_array = np.asarray(devices).reshape(pp, dp, tp)
     _STATE.mesh = Mesh(dev_array, (PIPELINE_AXIS, DATA_AXIS, TENSOR_AXIS))

@@ -36,6 +36,7 @@ owning stage's contribution.
 """
 
 import functools
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -275,6 +276,14 @@ def get_forward_backward_func(virtual_pipeline_model_parallel_size,
     """Dispatcher (reference: schedules/__init__.py:19-35)."""
     if pipeline_model_parallel_size > 1:
         if virtual_pipeline_model_parallel_size is not None:
+            # reference emits its ExperimentalWarning when the
+            # interleaved schedule is selected (either here or via
+            # initialize_model_parallel's virtual size)
+            from apex_tpu.transformer.parallel_state import (
+                ExperimentalWarning)
+            warnings.warn(
+                "the interleaved (virtual pipeline) schedule is "
+                "experimental", ExperimentalWarning, stacklevel=2)
             return functools.partial(
                 forward_backward_pipelining_with_interleaving,
                 num_model_chunks=virtual_pipeline_model_parallel_size)
