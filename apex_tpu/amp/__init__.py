@@ -28,6 +28,7 @@ from apex_tpu.amp.frontend import (
     Properties,
     build_policy,
 )
+from apex_tpu.amp._amp_state import master_params
 from apex_tpu.amp.scaler import LossScaler, LossScalerState
 from apex_tpu.amp.amp_optimizer import AmpOptimizer, AmpOptState
 from apex_tpu.amp.handle import (scale_loss, value_and_scaled_grad,
@@ -58,7 +59,7 @@ __all__ = [
     "initialize", "state_dict", "load_state_dict", "opt_levels", "Properties",
     "build_policy", "LossScaler", "LossScalerState", "AmpOptimizer",
     "AmpOptState", "scale_loss", "value_and_scaled_grad", "disable_casts",
-    "AmpHandle", "NoOpHandle", "init",
+    "AmpHandle", "NoOpHandle", "init", "master_params",
     "Policy", "autocast", "current_policy", "compute_dtype", "half_function",
     "float_function", "promote_function", "register_half_function",
     "register_float_function", "register_promote_function", "cast_for_op",

@@ -47,3 +47,30 @@ def maybe_print(msg, verbosity=None, rank0=True):
     except Exception:
         pass
     print(msg)
+
+
+def master_params(state, params=None):
+    """The list of param leaves an optimizer steps (reference:
+    _amp_state.py:60-69 iterates the optimizer's param groups — fp32
+    masters under O2, the model params themselves under O1). Here the
+    masters live in the ``AmpOptState`` pytree; when the opt level keeps
+    no masters, pass the model ``params`` (the O1 caller owns them).
+    Returns a list (a real pytree container — an iterator would be one
+    opaque leaf to jax.tree_util). NB the functional clipping pattern
+    clips GRADIENTS, not params: ``clip_grad_norm_(grads, max_norm)``
+    (contrib/clip_grad); use master_params for norms/inspection of what
+    the optimizer will step."""
+    import jax
+
+    masters = getattr(state, "master_params", None)
+    if masters is None:
+        masters = params
+    if masters is None:
+        # validate EAGERLY (a plain function returning a generator):
+        # deferring this to first iteration would surface the misuse
+        # deep inside the consumer, or never
+        raise ValueError(
+            "master_params: this opt level keeps no fp32 masters — pass "
+            "the model params (master_params(state, params)); yielding "
+            "nothing would silently no-op gradient clipping")
+    return jax.tree_util.tree_leaves(masters)

@@ -127,6 +127,19 @@ class DistributedFusedAdam:
             num_shards=num_shards, axis_name=axis_name)
         self.state = None
 
+    def init_params(self, params=None):
+        """Reference pre-registration hook (distributed_fused_adam.py:
+        509-534: builds state buckets for ``params`` — a subset is
+        accepted, unknown params silently skipped). The functional port
+        has nothing to pre-register: state covers the constructor's
+        params and is created lazily by ``step()`` INSIDE the traced
+        region (creating it here, outside, would either fail on the
+        unbound dp axis or cache leaked tracers). Accepts and ignores
+        ``params`` like the reference's default path and returns the
+        current state (None before the first step)."""
+        del params
+        return self.state
+
     def init(self):
         self.state = self.tx.init(self.params)
         return self.state

@@ -21,6 +21,12 @@ import jax
 import jax.numpy as jnp
 
 
+def fill(x):
+    """Fraction of nonzero entries (reference: sparse_masklib.py:9-10 —
+    the density diagnostic ASP logs)."""
+    return float(jnp.count_nonzero(x)) / x.size
+
+
 def _unstructured_mask(w, density):
     """Keep exactly round(size*density) entries. Selection is by index
     (argsort of |w|), not a >=threshold compare — a threshold keeps every

@@ -137,6 +137,12 @@ class MultiTensorApply:
     def __init__(self, chunk_size=2048 * 32):
         self.chunk_size = chunk_size
 
+    @staticmethod
+    def check_avail():
+        """Reference: multi_tensor_apply.py:18-24 probes the amp_C
+        import; the jnp substrate is always available."""
+        return None  # the reference returns None when available
+
     def __call__(self, op, noop_flag_buffer, tensor_lists, *args):
         del noop_flag_buffer  # functional: ops return the flag
         return op(tensor_lists, *args)

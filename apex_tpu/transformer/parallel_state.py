@@ -31,9 +31,10 @@ import numpy as np
 from jax.sharding import Mesh
 
 class ExperimentalWarning(Warning):
-    """Reference: parallel_state.py:673 — the category the experimental
-    surfaces emit (here: selecting the interleaved pipeline schedule,
-    below)."""
+    """Reference: parallel_state.py:673 — the category of its
+    experimental-surface warnings (the reference emits it on the ucc
+    backend path; apex_tpu additionally emits it when the interleaved
+    pipeline schedule is selected)."""
 
 
 # Canonical axis names (the reference's group names).
@@ -85,6 +86,7 @@ def initialize_model_parallel(tensor_model_parallel_size_=1,
         # reference: parallel_state.py:167 — interleaving needs > 2 stages
         assert pp > 2 or virtual_pipeline_model_parallel_size_ == 1, \
             "interleaved schedule needs pipeline_model_parallel_size > 2"
+        # apex_tpu addition (see ExperimentalWarning docstring)
         warnings.warn(
             "the interleaved (virtual pipeline) schedule is experimental",
             ExperimentalWarning, stacklevel=2)

@@ -38,11 +38,13 @@ owning stage's contribution.
 import functools
 import warnings
 
+
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-from apex_tpu.transformer.parallel_state import PIPELINE_AXIS
+from apex_tpu.transformer.parallel_state import (ExperimentalWarning,
+                                                  PIPELINE_AXIS)
 
 
 def _tree_add(a, b):
@@ -276,11 +278,10 @@ def get_forward_backward_func(virtual_pipeline_model_parallel_size,
     """Dispatcher (reference: schedules/__init__.py:19-35)."""
     if pipeline_model_parallel_size > 1:
         if virtual_pipeline_model_parallel_size is not None:
-            # reference emits its ExperimentalWarning when the
-            # interleaved schedule is selected (either here or via
-            # initialize_model_parallel's virtual size)
-            from apex_tpu.transformer.parallel_state import (
-                ExperimentalWarning)
+            # apex_tpu addition: flag the experimental schedule with the
+            # reference's warning CATEGORY (which the reference defines
+            # for its experimental surfaces but only emits on the ucc
+            # backend path, parallel_state.py:130-132)
             warnings.warn(
                 "the interleaved (virtual pipeline) schedule is "
                 "experimental", ExperimentalWarning, stacklevel=2)

@@ -70,6 +70,9 @@ class RngStateTracker:
         return key
 
 
+# torch-named class alias for drop-in parity (reference random.py:119)
+CudaRNGStatesTracker = RngStateTracker
+
 _RNG_STATE_TRACKER = RngStateTracker()
 
 
