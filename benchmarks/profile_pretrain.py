@@ -1,0 +1,167 @@
+"""BERT-large + GPT-345M pretrain step-time (BASELINE configs 3 and 4).
+
+The two flagship transformer configs the reference's Megatron extension
+exists for (apex/transformer; tests/L0/run_transformer), expressed through
+the same TransformerConfig the config-driven pretrain entry
+(examples/transformer/pretrain.py) builds from the Megatron arg bundle:
+
+  * BERT-large (24L, h=1024, 16 heads, s=512) + FusedLAMB + FusedLayerNorm
+  * GPT-2 345M (24L, h=1024, 16 heads, s=1024) + FusedAdam + fused softmax
+
+Full amp-equivalent train step (bf16 fwd/bwd, dynamic loss scaling,
+skip-step) measured with the calibrated scan methodology
+(benchmarks/_timing.py); single chip, tp=1 (the tp=2 program of config 4
+is compile-proven on the virtual mesh by tests/test_arguments.py and the
+dryrun — one real chip can't measure it). Results go to PERF.md.
+
+Run:  python benchmarks/profile_pretrain.py [bert_batch] [gpt_batch]
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+from benchmarks._smoke import smoke_mode  # noqa: E402
+
+SMOKE = smoke_mode("APEX_BENCH_SMOKE")  # force-CPU tiny sanity mode
+
+from benchmarks._timing import measure_dispatch_overhead, sync  # noqa: E402
+
+from apex_tpu.amp.scaler import LossScaler  # noqa: E402
+from apex_tpu.optimizers.fused_adam import fused_adam  # noqa: E402
+from apex_tpu.optimizers.fused_lamb import fused_lamb  # noqa: E402
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS  # noqa: E402
+from apex_tpu.transformer.testing import (  # noqa: E402
+    BertModel,
+    GPTModel,
+    TransformerConfig,
+)
+
+ON_TPU = not SMOKE and jax.devices()[0].platform == "tpu"
+PEAK = 197e12  # v5e bf16
+K = 8 if ON_TPU else 2
+
+mesh = Mesh(np.asarray(jax.devices()[:1]), (TENSOR_AXIS,))
+
+
+def measure(name, model_kind, cfg, b, s, vocab, tx):
+    model = (GPTModel if model_kind == "gpt" else BertModel)(cfg)
+    scaler = LossScaler()
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, vocab, (b, s)), jnp.int32)
+    labels = jnp.asarray(rs.randint(0, vocab, (b, s)), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None], (b, s))
+
+    def fwd_loss(p, ids, pos, labels, scale):
+        if model_kind == "gpt":
+            per_tok = model.apply({"params": p}, ids, pos, None, labels)
+        else:
+            per_tok = model.apply({"params": p}, ids, jnp.ones_like(ids),
+                                  lm_labels=labels)[0]
+        return jnp.mean(per_tok) * scale
+
+    # data is passed as jit arguments throughout (never closure-captured:
+    # captured arrays inline into the HLO as literals and overflow the
+    # remote-compile tunnel — see profile_gpt.py's scan_time note)
+    def init_fn(ids, pos):
+        if model_kind == "gpt":
+            return model.init(jax.random.PRNGKey(0), ids, pos,
+                              None)["params"]
+        return model.init(jax.random.PRNGKey(0), ids,
+                          jnp.ones_like(ids))["params"]
+
+    def shmap(f, n):
+        return jax.shard_map(f, mesh=mesh, in_specs=(P(),) * n,
+                             out_specs=P(), check_vma=False)
+
+    params = jax.jit(shmap(init_fn, 2))(ids, pos)
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    opt_state = jax.jit(lambda p: tx.init(p))(params)
+    scaler_state = scaler.init()
+
+    def run(params, opt_state, scaler_state, eps, ids, pos, labels):
+        def local(params, opt_state, scaler_state, eps, ids, pos, labels):
+            def body(carry, _):
+                p, o, ss = carry
+                scale = scaler.scale(jnp.float32(1.0), ss)
+                loss, grads = jax.value_and_grad(fwd_loss)(
+                    p, ids, pos, labels, scale)
+                grads, found_inf = scaler.unscale(grads, ss)
+                nss = scaler.update(ss, found_inf)
+                updates, no = tx.update(grads, o, p)
+                np_ = jax.tree_util.tree_map(
+                    lambda a, u: jnp.where(found_inf, a,
+                                           a + u.astype(a.dtype)),
+                    p, updates)
+                no = jax.tree_util.tree_map(
+                    lambda new, old: jnp.where(found_inf, old, new), no, o)
+                return (np_, no, nss), loss / scale
+
+            carry, losses = lax.scan(
+                body, (params, opt_state, scaler_state), jnp.arange(K))
+            return carry + (losses + eps,)
+
+        return shmap(local, 7)(params, opt_state, scaler_state, eps,
+                               ids, pos, labels)
+
+    step = jax.jit(run, donate_argnums=(0, 1, 2))
+    overhead = measure_dispatch_overhead(K)
+    t0 = time.perf_counter()
+    out = step(params, opt_state, scaler_state, jnp.float32(0.0),
+               ids, pos, labels)
+    sync(out[3])
+    print(f"{name}: params={n_params/1e6:.1f}M b={b} s={s} "
+          f"compile+first {time.perf_counter()-t0:.1f}s "
+          f"loss={float(np.asarray(out[3][-1])):.3f} "
+          f"(K={K}, overhead {overhead*1e3:.1f} ms)")
+    t0 = time.perf_counter()
+    out = step(out[0], out[1], out[2], jnp.float32(1e-30), ids, pos, labels)
+    sync(out[3])
+    dt = (time.perf_counter() - t0 - overhead) / K
+    if dt <= 0:
+        print(f"{name}: non-positive step time after overhead subtraction "
+              "(relay flap straddled the calibration); unusable")
+        return
+    mfu = 6.0 * n_params * b * s / dt / PEAK if ON_TPU else float("nan")
+    print(f"{name}: step {dt*1e3:.1f} ms  ->  {b*s/dt:,.0f} tokens/s  "
+          f"MFU {mfu*100:.1f}%")
+
+
+def main():
+    if ON_TPU:
+        b_bert = int(sys.argv[1]) if len(sys.argv) > 1 else 16
+        b_gpt = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+        bert_cfg = TransformerConfig(
+            hidden_size=1024, num_layers=24, num_attention_heads=16,
+            vocab_size=30592, max_position_embeddings=512,
+            hidden_dropout=0.0, attention_dropout=0.0, bf16=True)
+        gpt_cfg = TransformerConfig(
+            hidden_size=1024, num_layers=24, num_attention_heads=16,
+            vocab_size=50304, max_position_embeddings=1024,
+            hidden_dropout=0.0, attention_dropout=0.0, bf16=True)
+        s_bert, s_gpt = 512, 1024
+    else:
+        b_bert = b_gpt = 2
+        bert_cfg = TransformerConfig(
+            hidden_size=128, num_layers=2, num_attention_heads=4,
+            vocab_size=512, max_position_embeddings=128,
+            hidden_dropout=0.0, attention_dropout=0.0, bf16=True)
+        gpt_cfg = bert_cfg
+        s_bert = s_gpt = 128
+
+    measure("bert-large+lamb", "bert", bert_cfg, b_bert, s_bert,
+            bert_cfg.vocab_size, fused_lamb(learning_rate=1e-4))
+    measure("gpt-345m+adam", "gpt", gpt_cfg, b_gpt, s_gpt,
+            gpt_cfg.vocab_size, fused_adam(learning_rate=1e-4))
+
+
+if __name__ == "__main__":
+    main()

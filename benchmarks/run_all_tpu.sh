@@ -31,5 +31,6 @@ run optimizers        900 python benchmarks/profile_optimizers.py
 run resnet           1200 python benchmarks/profile_resnet.py
 run multihead_attn    900 python benchmarks/profile_multihead_attn.py
 run dcgan             900 python benchmarks/profile_dcgan.py
+run pretrain         1800 python benchmarks/profile_pretrain.py
 
 echo "=== done; feed the logs into PERF.md"
