@@ -76,8 +76,26 @@ def flash_supported(sq, sk):
     return _tpu_available() and sq % 128 == 0 and sk % 128 == 0
 
 
+# Which TPU kernel backs fused_attention when both can: "flash" (the
+# bundled multi-pass kernel, tuned blocks) or "rows" (the self-authored
+# VMEM-row kernel, ops/attention_pallas.py). The default is whichever won
+# benchmarks/profile_attention.py's fwd+d(q,k,v) decision row on the
+# round's hardware (PERF.md); set_default_impl flips it process-wide.
+_DEFAULT_IMPL = "flash"
+
+
+def set_default_impl(impl):
+    """Select the TPU kernel behind ``fused_attention``: "flash" or
+    "rows" (shapes the chosen kernel can't handle still fall through
+    flash → dense)."""
+    global _DEFAULT_IMPL
+    if impl not in ("flash", "rows"):
+        raise ValueError(f"unknown attention impl {impl!r}")
+    _DEFAULT_IMPL = impl
+
+
 def fused_attention(q, k, v, *, causal=False, sm_scale=None,
-                    segment_ids=None, force_dense=None):
+                    segment_ids=None, force_dense=None, impl=None):
     """Flash attention.
 
     Args:
@@ -88,13 +106,21 @@ def fused_attention(q, k, v, *, causal=False, sm_scale=None,
         tokens attend only within equal ids (varlen/packed batches; the
         fmha cu_seqlens capability).
       force_dense: force the XLA-fused dense path (tests / tiny shapes).
+      impl: override the kernel choice for this call ("flash" | "rows");
+        default is the measured process-wide default (set_default_impl).
 
-    The Pallas path requires seq divisible by 128 and runs everything in
-    one kernel; other shapes (and non-TPU backends) use the dense path.
+    The Pallas paths require seq divisible by 128; other shapes (and
+    non-TPU backends) use the XLA dense path.
     """
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     sq, sk = q.shape[2], k.shape[2]
+    if (impl or _DEFAULT_IMPL) == "rows" and not force_dense:
+        from apex_tpu.ops import attention_pallas as ap
+
+        if _tpu_available() and ap.supported(sq, sk, q.shape[-1]):
+            return ap.fused_attention_rows(q, k, v, causal,
+                                           float(sm_scale), segment_ids)
     use_flash = flash_supported(sq, sk) and not force_dense
     if not use_flash:
         return _dense_attention(q, k, v, causal, sm_scale, segment_ids)

@@ -1,0 +1,228 @@
+"""Pallas TPU fused attention, VMEM-resident rows (fwd + bwd).
+
+Self-authored alternative to the bundled multi-pass flash kernel for the
+sequence lengths the reference's fused attention actually targets
+(contrib/csrc/fmha supports seq <= 512; fast_multihead_attn seq ~64-1024):
+at those lengths a whole [block_q, sk] score row fits in VMEM, so each
+(batch, head, q-block) grid step computes scores, the exact fp32 softmax
+over the FULL key row, and the output matmul in one kernel — no online
+max/sum rescaling passes, no [s, s] tensor in HBM.
+
+Backward is one kernel over the same grid, fully self-contained: it
+recomputes S and P from (q, k, v) (no saved LSE — the softmax residual is
+reconstructed row-exactly), forms dP = dO V^T, uses the identity
+D = rowsum(dO * O) = rowsum(P * dP) to avoid needing O, then
+dS = P * (dP - D) * scale, dQ = dS K, and accumulates dK += dS^T Q,
+dV += P^T dO across q-blocks. The accumulation is safe because the TPU
+grid executes sequentially and the dk/dv output blocks stay VMEM-resident
+while the innermost (q) grid index varies; they are written back once per
+(b, h). dk/dv accumulate in fp32 regardless of the input dtype.
+
+Masking matches ops.attention._dense_attention exactly: causal triangle
+(generated from iota, no mask operand), optional segment ids (packed
+varlen batches), masked positions excluded from the softmax, fully-masked
+rows → 0.
+
+Trade-off vs flash: with causal masking the kernel still computes the
+full [block_q, sk] score block (the masked half is wasted MXU work), so
+it targets moderate sequence lengths where the single-pass structure wins
+more than the causal skip would save. benchmarks/profile_attention.py
+measures the crossover; ops.attention routes to this kernel via its
+``impl="rows"`` knob / ``set_default_impl`` (the measured winner is the
+default there).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+
+_VMEM_BUDGET = 10 * 1024 * 1024  # fp32 [bq, sk] working-set bytes
+_BWD_ARRAYS = 4  # S/P, dP, dS live + headroom (bwd is the tight pass)
+
+
+def _q_block(sq, sk):
+    """Largest power-of-two q block dividing sq whose bwd working set
+    ([bq, sk] fp32 x _BWD_ARRAYS) fits the budget (0 → unsupported)."""
+    cap = max(1, _VMEM_BUDGET // (4 * sk * _BWD_ARRAYS))
+    b = 1
+    while b * 2 <= cap and sq % (b * 2) == 0:
+        b *= 2
+    return b if b >= 8 else 0
+
+
+def supported(sq, sk, d):
+    """Whether the VMEM-row kernel handles [.., sq, d] x [.., sk, d].
+    sk must be lane-aligned; d bounded so the [sk, d] K/V operands and
+    fp32 dk/dv accumulators stay small next to the score rows."""
+    return sk % 128 == 0 and d <= 256 and _q_block(sq, sk) != 0
+
+
+def _masks(iq, bq, rows, sk, causal, seg_q, seg_kv):
+    """(additive_mask, zero_mask) for one [rows, sk] score block; None
+    when unmasked. seg_* are refs or None."""
+    masked = None
+    if causal:
+        row = iq * bq + lax.broadcasted_iota(jnp.int32, (rows, sk), 0)
+        col = lax.broadcasted_iota(jnp.int32, (rows, sk), 1)
+        masked = col > row
+    if seg_q is not None:
+        sq_row = seg_q[0, :]
+        skv_row = seg_kv[0, :]
+        diff = sq_row[:, None] != skv_row[None, :]
+        masked = diff if masked is None else masked | diff
+    return masked
+
+
+def _softmax(s, masked):
+    """Exact fp32 softmax over the full key row with dense-reference
+    semantics (masked excluded, fully-masked rows -> 0)."""
+    if masked is not None:
+        s = jnp.where(masked, jnp.finfo(jnp.float32).min, s)
+    e = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
+    if masked is not None:
+        e = jnp.where(masked, 0.0, e)
+    tot = jnp.sum(e, axis=-1, keepdims=True)
+    return jnp.where(tot > 0, e / jnp.where(tot > 0, tot, 1.0), 0.0)
+
+
+def _fwd_kernel(*refs, scale, causal, has_seg, bq):
+    if has_seg:
+        q_ref, k_ref, v_ref, sq_ref, skv_ref, o_ref = refs
+    else:
+        (q_ref, k_ref, v_ref, o_ref), sq_ref, skv_ref = refs, None, None
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+    s = s * jnp.float32(scale)
+    masked = _masks(pl.program_id(2), bq, q.shape[0], k.shape[0],
+                    causal, sq_ref, skv_ref)
+    p = _softmax(s, masked).astype(v.dtype)
+    o = lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+    o_ref[0, 0] = o.astype(o_ref.dtype)
+
+
+def _bwd_kernel(*refs, scale, causal, has_seg, bq):
+    if has_seg:
+        (q_ref, k_ref, v_ref, sq_ref, skv_ref, do_ref,
+         dq_ref, dk_ref, dv_ref) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, dq_ref, dk_ref, dv_ref) = refs
+        sq_ref = skv_ref = None
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    do = do_ref[0, 0]
+
+    s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+    s = s * jnp.float32(scale)
+    masked = _masks(pl.program_id(2), bq, q.shape[0], k.shape[0],
+                    causal, sq_ref, skv_ref)
+    p = _softmax(s, masked)
+    p_lo = p.astype(q.dtype)
+
+    # dP in fp32; D = rowsum(P * dP) == rowsum(dO * O) so O is not needed
+    dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                         preferred_element_type=jnp.float32)
+    dcol = jnp.sum(p * dp, axis=-1, keepdims=True)
+    ds = (p * (dp - dcol) * jnp.float32(scale)).astype(q.dtype)
+
+    dq = lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                         preferred_element_type=jnp.float32)
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+    # dk/dv accumulate across the (innermost, sequential) q grid axis;
+    # their block index is constant in iq so the block stays resident
+    @pl.when(pl.program_id(2) == 0)
+    def _init():
+        dk_ref[0, 0] = jnp.zeros_like(dk_ref[0, 0])
+        dv_ref[0, 0] = jnp.zeros_like(dv_ref[0, 0])
+
+    dk_ref[0, 0] += lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dv_ref[0, 0] += lax.dot_general(
+        p_lo, do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def _specs(b, h, bq, sq, sk, d, has_seg):
+    """(in_specs for q,k,v[,seg_q,seg_kv], qblk-spec, kvblk-spec)."""
+    qspec = pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq: (ib, ih, iq, 0))
+    kvspec = pl.BlockSpec((1, 1, sk, d), lambda ib, ih, iq: (ib, ih, 0, 0))
+    ins = [qspec, kvspec, kvspec]
+    if has_seg:
+        ins.append(pl.BlockSpec((1, bq), lambda ib, ih, iq: (ib, iq)))
+        ins.append(pl.BlockSpec((1, sk), lambda ib, ih, iq: (ib, 0)))
+    return ins, qspec, kvspec
+
+
+def _seg_ops(segment_ids):
+    if segment_ids is None:
+        return []
+    seg_q, seg_kv = segment_ids
+    return [seg_q.astype(jnp.int32), seg_kv.astype(jnp.int32)]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 6))
+def fused_attention_rows(q, k, v, causal, sm_scale, segment_ids=None,
+                         interpret=False):
+    """VMEM-row fused attention. q: [b, h, sq, d]; k, v: [b, h, sk, d];
+    segment_ids: None or (seg_q [b, sq], seg_kv [b, sk]). Check
+    ``supported(sq, sk, d)`` first. ``interpret=True`` for CPU tests."""
+    return _fwd(q, k, v, causal, sm_scale, segment_ids, interpret)[0]
+
+
+def _fwd(q, k, v, causal, sm_scale, segment_ids, interpret):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    if not supported(sq, sk, d):
+        raise ValueError(f"attention_pallas: unsupported {q.shape}x{k.shape}")
+    bq = _q_block(sq, sk)
+    has_seg = segment_ids is not None
+    ins, qspec, _ = _specs(b, h, bq, sq, sk, d, has_seg)
+    o = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=float(sm_scale), causal=causal,
+                          has_seg=has_seg, bq=bq),
+        grid=(b, h, sq // bq),
+        in_specs=ins,
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v, *_seg_ops(segment_ids))
+    return o, (q, k, v, segment_ids)
+
+
+def _fwd_rule(q, k, v, causal, sm_scale, segment_ids, interpret):
+    return _fwd(q, k, v, causal, sm_scale, segment_ids, interpret)
+
+
+def _bwd_rule(causal, sm_scale, interpret, res, g):
+    q, k, v, segment_ids = res
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq = _q_block(sq, sk)
+    has_seg = segment_ids is not None
+    ins, qspec, kvspec = _specs(b, h, bq, sq, sk, d, has_seg)
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_kernel, scale=float(sm_scale), causal=causal,
+                          has_seg=has_seg, bq=bq),
+        grid=(b, h, sq // bq),
+        in_specs=ins + [qspec],
+        out_specs=(qspec, kvspec, kvspec),
+        out_shape=(jax.ShapeDtypeStruct(q.shape, q.dtype),
+                   jax.ShapeDtypeStruct(k.shape, jnp.float32),
+                   jax.ShapeDtypeStruct(v.shape, jnp.float32)),
+        interpret=interpret,
+    )(q, k, v, *_seg_ops(segment_ids), g)
+    return (dq, dk.astype(k.dtype), dv.astype(v.dtype), None)
+
+
+fused_attention_rows.defvjp(_fwd_rule, _bwd_rule)

@@ -29,7 +29,10 @@ FLOPS = 4 * B * H * S * S * D * 3 // 2
 PEAK = 197e12
 
 
-def measure(name, attn_fn):
+def measure(name, attn_fn, wrt_qkv=False):
+    """wrt_qkv=False: fwd + dq only (the original protocol, kept for
+    comparability with the recorded r3 numbers). wrt_qkv=True: fwd + the
+    full (dq, dk, dv) backward — what a training step actually pays."""
     rs = np.random.RandomState(0)
     q0 = jnp.asarray(rs.randn(B, H, S, D), jnp.bfloat16)
     k0 = jnp.asarray(rs.randn(B, H, S, D), jnp.bfloat16)
@@ -37,9 +40,16 @@ def measure(name, attn_fn):
 
     def run(q, eps, k0, v0):
         def body(qc, _):
-            def f(qq):
-                return jnp.sum(attn_fn(qq, k0, v0).astype(jnp.float32))
-            l, g = jax.value_and_grad(f)(qc)
+            if wrt_qkv:
+                def f(qq, kk, vv):
+                    return jnp.sum(attn_fn(qq, kk, vv).astype(jnp.float32))
+                l, (gq, gk, gv) = jax.value_and_grad(
+                    f, argnums=(0, 1, 2))(qc, k0, v0)
+                g = gq + gk + gv
+            else:
+                def f(qq):
+                    return jnp.sum(attn_fn(qq, k0, v0).astype(jnp.float32))
+                l, g = jax.value_and_grad(f)(qc)
             return qc - eps.astype(qc.dtype) * g.astype(qc.dtype), l
         qc, ls = lax.scan(body, q, jnp.arange(K))
         return qc, ls
@@ -87,10 +97,13 @@ if SMOKE:
     print("SMOKE: skipping TPU-only flash/splash kernel configs")
 
 # current repo config (512/512) and alternatives
+SWEEP = []
 for bq, bk in ([] if SMOKE else
                [(512, 512), (512, 256), (256, 512), (256, 256), (128, 256),
                 (256, 128), (128, 128), (1024, 512), (512, 1024)]):
-    measure(f"flash blocks q={bq} k={bk}", fa_with_blocks(bq, bk))
+    dt = measure(f"flash blocks q={bq} k={bk}", fa_with_blocks(bq, bk))
+    if dt is not None:
+        SWEEP.append((dt, bq, bk))
 
 if not SMOKE:
     measure("flash default blocks",
@@ -123,6 +136,25 @@ from apex_tpu.ops.attention import _dense_attention
 
 measure("XLA dense (materialized scores)",
         lambda q, k, v: _dense_attention(q, k, v, True, float(sm), None))
+
+# self-authored VMEM-row kernel (ops/attention_pallas.py) vs the best
+# flash config, under BOTH protocols — the row kernel computes dk/dv
+# unconditionally, so the dq-only protocol understates it and the
+# qkv protocol is the decision row for the training-step dispatch
+from apex_tpu.ops import attention_pallas as ap
+
+if not SMOKE and ap.supported(S, S, D):
+    vmem_rows = lambda q, k, v: ap.fused_attention_rows(
+        q, k, v, True, float(sm), None)
+    measure("vmem-rows kernel (dq-only protocol)", vmem_rows)
+    measure("vmem-rows kernel fwd+d(q,k,v)", vmem_rows, wrt_qkv=True)
+    # compare against whatever flash config actually won today's sweep
+    _, best_bq, best_bk = min(SWEEP) if SWEEP else (None, 1024, 512)
+    measure(f"flash q={best_bq} k={best_bk} fwd+d(q,k,v)",
+            fa_with_blocks(best_bq, best_bk), wrt_qkv=True)
+    measure("XLA dense fwd+d(q,k,v)",
+            lambda q, k, v: _dense_attention(q, k, v, True, float(sm), None),
+            wrt_qkv=True)
 
 if not MEASURED:
     print("ERROR: no configuration produced a measurement")

@@ -1,0 +1,137 @@
+"""VMEM-row fused attention kernel vs the dense reference (interpret mode
+on CPU; the real-TPU timing comparison lives in
+benchmarks/profile_attention.py). Reference envelope: the fmha /
+fast_multihead_attn fwd+bwd parity tests (contrib/test/fmha,
+contrib/test/multihead_attn)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops import attention_pallas as ap
+from apex_tpu.ops.attention import _dense_attention
+
+
+def _qkv(rs, b, h, sq, sk, d, dtype):
+    q = jnp.asarray(rs.randn(b, h, sq, d), dtype)
+    k = jnp.asarray(rs.randn(b, h, sk, d), dtype)
+    v = jnp.asarray(rs.randn(b, h, sk, d), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_fwd_matches_dense(causal, dtype):
+    b, h, s, d = 2, 3, 256, 64
+    rs = np.random.RandomState(0)
+    q, k, v = _qkv(rs, b, h, s, s, d, dtype)
+    assert ap.supported(s, s, d)
+    scale = 1.0 / np.sqrt(d)
+    got = ap.fused_attention_rows(q, k, v, causal, scale, None, True)
+    want = _dense_attention(q, k, v, causal, scale, None)
+    assert got.dtype == q.dtype
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               atol=3e-2 if dtype == jnp.bfloat16 else 2e-5)
+
+
+def test_fwd_cross_lengths():
+    b, h, sq, sk, d = 2, 2, 128, 384, 32
+    rs = np.random.RandomState(1)
+    q, k, v = _qkv(rs, b, h, sq, sk, d, jnp.float32)
+    scale = 0.17
+    got = ap.fused_attention_rows(q, k, v, False, scale, None, True)
+    want = _dense_attention(q, k, v, False, scale, None)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_fwd_segment_ids_and_masked_rows():
+    """Packed varlen batch; one query segment has no keys at all in the
+    cross-length case -> those rows must be exactly 0 (dense semantics)."""
+    b, h, s, d = 2, 2, 128, 32
+    rs = np.random.RandomState(2)
+    q, k, v = _qkv(rs, b, h, s, s, d, jnp.float32)
+    seg_q = jnp.asarray(rs.randint(0, 3, (b, s)), jnp.int32)
+    # kv only carries segments {0, 1}: queries in segment 2 see no keys
+    seg_kv = jnp.asarray(rs.randint(0, 2, (b, s)), jnp.int32)
+    scale = 1.0 / np.sqrt(d)
+    got = ap.fused_attention_rows(q, k, v, False, scale, (seg_q, seg_kv),
+                                  True)
+    want = _dense_attention(q, k, v, False, scale, (seg_q, seg_kv))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+    empty = np.asarray(seg_q) == 2
+    assert empty.any()
+    np.testing.assert_array_equal(
+        np.asarray(got)[empty.nonzero()[0][0], :,
+                        empty.nonzero()[1][0]], 0.0)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_grads_match_dense(causal, dtype):
+    b, h, s, d = 2, 2, 128, 64
+    rs = np.random.RandomState(3)
+    q, k, v = _qkv(rs, b, h, s, s, d, dtype)
+    tgt = jnp.asarray(rs.randn(b, h, s, d), jnp.float32)
+    scale = 1.0 / np.sqrt(d)
+
+    def loss(fn):
+        def go(q, k, v):
+            y = fn(q, k, v)
+            return jnp.mean((y.astype(jnp.float32) - tgt) ** 2)
+        return go
+
+    gq, gk, gv = jax.grad(loss(
+        lambda q, k, v: ap.fused_attention_rows(q, k, v, causal, scale,
+                                                None, True)),
+        argnums=(0, 1, 2))(q, k, v)
+    rq, rk, rv = jax.grad(loss(
+        lambda q, k, v: _dense_attention(q, k, v, causal, scale, None)),
+        argnums=(0, 1, 2))(q, k, v)
+    tol = 5e-3 if dtype == jnp.bfloat16 else 1e-5
+    for g, r in ((gq, rq), (gk, rk), (gv, rv)):
+        assert g.dtype == dtype
+        np.testing.assert_allclose(np.asarray(g, np.float32),
+                                   np.asarray(r, np.float32), atol=tol)
+
+
+def test_grads_segment_ids_multiblock():
+    """Grid with several q blocks (exercises the dk/dv accumulation) +
+    segment masking in backward."""
+    b, h, s, d = 1, 2, 512, 32
+    rs = np.random.RandomState(4)
+    q, k, v = _qkv(rs, b, h, s, s, d, jnp.float32)
+    seg = jnp.asarray(np.sort(rs.randint(0, 4, (b, s)), axis=1), jnp.int32)
+    scale = 1.0 / np.sqrt(d)
+    # force a multi-block q grid by shrinking the budget
+    orig = ap._VMEM_BUDGET
+    ap._VMEM_BUDGET = 128 * 1024
+    try:
+        assert ap._q_block(s, s) < s
+        def f(q, k, v):
+            y = ap.fused_attention_rows(q, k, v, True, scale, (seg, seg),
+                                        True)
+            return jnp.sum(y * jnp.cos(jnp.arange(d, dtype=jnp.float32)))
+        gq, gk, gv = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    finally:
+        ap._VMEM_BUDGET = orig
+
+    def r(q, k, v):
+        y = _dense_attention(q, k, v, True, scale, (seg, seg))
+        return jnp.sum(y * jnp.cos(jnp.arange(d, dtype=jnp.float32)))
+
+    rq, rk, rv = jax.grad(r, argnums=(0, 1, 2))(q, k, v)
+    for g, ref in ((gq, rq), (gk, rk), (gv, rv)):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(ref),
+                                   atol=2e-4)
+
+
+def test_supported_predicate():
+    assert ap.supported(1024, 1024, 64)
+    assert ap.supported(2048, 2048, 64)
+    assert not ap.supported(1024, 1000, 64)   # sk not lane-aligned
+    assert not ap.supported(1024, 1024, 512)  # d too large
+    # giant sk: q block would fall below the minimum
+    assert not ap.supported(8, 512 * 1024, 64)
