@@ -114,6 +114,8 @@ def fused_attention(q, k, v, *, causal=False, sm_scale=None,
     """
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if impl is not None and impl not in ("flash", "rows"):
+        raise ValueError(f"unknown attention impl {impl!r}")
     sq, sk = q.shape[2], k.shape[2]
     if (impl or _DEFAULT_IMPL) == "rows" and not force_dense:
         from apex_tpu.ops import attention_pallas as ap

@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 
 _VMEM_BUDGET = 10 * 1024 * 1024  # fp32 [bq, sk] working-set bytes
@@ -47,10 +48,10 @@ _BWD_ARRAYS = 4  # S/P, dP, dS live + headroom (bwd is the tight pass)
 def _q_block(sq, sk):
     """Largest power-of-two q block dividing sq whose bwd working set
     ([bq, sk] fp32 x _BWD_ARRAYS) fits the budget (0 → unsupported)."""
+    from apex_tpu.ops.attention import _block
+
     cap = max(1, _VMEM_BUDGET // (4 * sk * _BWD_ARRAYS))
-    b = 1
-    while b * 2 <= cap and sq % (b * 2) == 0:
-        b *= 2
+    b = _block(sq, cap)
     return b if b >= 8 else 0
 
 
@@ -62,8 +63,8 @@ def supported(sq, sk, d):
 
 
 def _masks(iq, bq, rows, sk, causal, seg_q, seg_kv):
-    """(additive_mask, zero_mask) for one [rows, sk] score block; None
-    when unmasked. seg_* are refs or None."""
+    """Boolean masked-out matrix for one [rows, sk] score block (True =
+    excluded), or None when unmasked. seg_* are refs or None."""
     masked = None
     if causal:
         row = iq * bq + lax.broadcasted_iota(jnp.int32, (rows, sk), 0)
@@ -106,6 +107,47 @@ def _fwd_kernel(*refs, scale, causal, has_seg, bq):
     o = lax.dot_general(p, v, (((1,), (0,)), ((), ())),
                         preferred_element_type=jnp.float32)
     o_ref[0, 0] = o.astype(o_ref.dtype)
+
+
+def _fwd_kernel_chunked(*refs, scale, causal, has_seg, bq):
+    """Causal-skip fwd: keys are processed in bq-sized chunks and a chunk
+    whose columns are all beyond this q-block's causal reach is never
+    computed (the guarded branch genuinely skips — the TPU grid is
+    sequential scalar control flow). Skipped chunks leave garbage in the
+    score scratch; the softmax's causal `where` overwrites exactly those
+    positions, so the garbage is never observed."""
+    if has_seg:
+        q_ref, k_ref, v_ref, sq_ref, skv_ref, o_ref, s_scr, o_scr = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, s_scr, o_scr = refs
+        sq_ref = skv_ref = None
+    q = q_ref[0, 0]
+    rows = q.shape[0]
+    sk = k_ref.shape[2]
+    nk = sk // bq
+    iq = pl.program_id(2)
+    reach = iq * bq + rows - 1  # last (absolute) row of this q block
+
+    for c in range(nk):
+        @pl.when(c * bq <= reach)
+        def _(c=c):
+            kc = k_ref[0, 0, c * bq:(c + 1) * bq, :]
+            s_scr[:, c * bq:(c + 1) * bq] = lax.dot_general(
+                q, kc, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * jnp.float32(scale)
+
+    masked = _masks(iq, bq, rows, sk, causal, sq_ref, skv_ref)
+    p = _softmax(s_scr[...], masked).astype(v_ref.dtype)
+
+    o_scr[...] = jnp.zeros_like(o_scr)
+    for c in range(nk):
+        @pl.when(c * bq <= reach)
+        def _(c=c):
+            vc = v_ref[0, 0, c * bq:(c + 1) * bq, :]
+            o_scr[...] += lax.dot_general(
+                p[:, c * bq:(c + 1) * bq], vc, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+    o_ref[0, 0] = o_scr[...].astype(o_ref.dtype)
 
 
 def _bwd_kernel(*refs, scale, causal, has_seg, bq):
@@ -153,6 +195,74 @@ def _bwd_kernel(*refs, scale, causal, has_seg, bq):
         preferred_element_type=jnp.float32)
 
 
+def _bwd_kernel_chunked(*refs, scale, causal, has_seg, bq):
+    """Causal-skip bwd (see _fwd_kernel_chunked). The score scratch is
+    reused for dP once P is materialized; skipped chunks hold garbage in
+    dP, so P*dP is masked to 0 there before the D reduction (P alone is
+    exactly 0 at masked positions, but 0 * garbage could be NaN)."""
+    if has_seg:
+        (q_ref, k_ref, v_ref, sq_ref, skv_ref, do_ref,
+         dq_ref, dk_ref, dv_ref, s_scr, acc_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref,
+         dq_ref, dk_ref, dv_ref, s_scr, acc_scr) = refs
+        sq_ref = skv_ref = None
+    q = q_ref[0, 0]
+    do = do_ref[0, 0]
+    rows = q.shape[0]
+    sk = k_ref.shape[2]
+    nk = sk // bq
+    iq = pl.program_id(2)
+    reach = iq * bq + rows - 1
+
+    for c in range(nk):
+        @pl.when(c * bq <= reach)
+        def _(c=c):
+            kc = k_ref[0, 0, c * bq:(c + 1) * bq, :]
+            s_scr[:, c * bq:(c + 1) * bq] = lax.dot_general(
+                q, kc, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * jnp.float32(scale)
+
+    masked = _masks(iq, bq, rows, sk, causal, sq_ref, skv_ref)
+    p = _softmax(s_scr[...], masked)
+    p_lo = p.astype(q.dtype)
+
+    for c in range(nk):
+        @pl.when(c * bq <= reach)
+        def _(c=c):
+            vc = v_ref[0, 0, c * bq:(c + 1) * bq, :]
+            s_scr[:, c * bq:(c + 1) * bq] = lax.dot_general(
+                do, vc, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+    dp = s_scr[...]
+    pdp = jnp.where(masked, 0.0, p * dp) if masked is not None else p * dp
+    dcol = jnp.sum(pdp, axis=-1, keepdims=True)
+    ds = (pdp - p * dcol) * jnp.float32(scale)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_ref[0, 0] = jnp.zeros_like(dk_ref[0, 0])
+        dv_ref[0, 0] = jnp.zeros_like(dv_ref[0, 0])
+
+    acc_scr[...] = jnp.zeros_like(acc_scr)
+    for c in range(nk):
+        @pl.when(c * bq <= reach)
+        def _(c=c):
+            sl = slice(c * bq, (c + 1) * bq)
+            dsc = ds[:, sl].astype(q.dtype)
+            kc = k_ref[0, 0, sl, :]
+            acc_scr[...] += lax.dot_general(
+                dsc, kc, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dk_ref[0, 0, sl, :] += lax.dot_general(
+                dsc, q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dv_ref[0, 0, sl, :] += lax.dot_general(
+                p_lo[:, sl], do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+    dq_ref[0, 0] = acc_scr[...].astype(dq_ref.dtype)
+
+
 def _specs(b, h, bq, sq, sk, d, has_seg):
     """(in_specs for q,k,v[,seg_q,seg_kv], qblk-spec, kvblk-spec)."""
     qspec = pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq: (ib, ih, iq, 0))
@@ -171,48 +281,79 @@ def _seg_ops(segment_ids):
     return [seg_q.astype(jnp.int32), seg_kv.astype(jnp.int32)]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 6))
+def _chunked(causal, bq, sq, sk):
+    """Causal-skip applies when chunk boundaries are lane-aligned and
+    there are >= 2 q blocks (a single block has nothing to skip)."""
+    return causal and bq % 128 == 0 and sk % bq == 0 and sq >= 2 * bq
+
+
+def _pick_bq(sq, sk, block_q):
+    bq = _q_block(sq, sk)
+    if block_q is not None:
+        if sq % block_q or block_q > bq:
+            raise ValueError(
+                f"block_q={block_q} must divide sq={sq} and fit the VMEM "
+                f"budget (max {bq})")
+        bq = block_q
+    return bq
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 6, 7))
 def fused_attention_rows(q, k, v, causal, sm_scale, segment_ids=None,
-                         interpret=False):
+                         interpret=False, block_q=None):
     """VMEM-row fused attention. q: [b, h, sq, d]; k, v: [b, h, sk, d];
     segment_ids: None or (seg_q [b, sq], seg_kv [b, sk]). Check
-    ``supported(sq, sk, d)`` first. ``interpret=True`` for CPU tests."""
-    return _fwd(q, k, v, causal, sm_scale, segment_ids, interpret)[0]
+    ``supported(sq, sk, d)`` first. ``interpret=True`` for CPU tests.
+    ``block_q`` overrides the auto q-block (benchmark sweeps)."""
+    return _fwd(q, k, v, causal, sm_scale, segment_ids, interpret,
+                block_q)[0]
 
 
-def _fwd(q, k, v, causal, sm_scale, segment_ids, interpret):
+def _fwd(q, k, v, causal, sm_scale, segment_ids, interpret, block_q=None):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     if not supported(sq, sk, d):
         raise ValueError(f"attention_pallas: unsupported {q.shape}x{k.shape}")
-    bq = _q_block(sq, sk)
+    bq = _pick_bq(sq, sk, block_q)
     has_seg = segment_ids is not None
     ins, qspec, _ = _specs(b, h, bq, sq, sk, d, has_seg)
+    kern, scratch = _fwd_kernel, []
+    if _chunked(causal, bq, sq, sk):
+        kern = _fwd_kernel_chunked
+        scratch = [pltpu.VMEM((bq, sk), jnp.float32),
+                   pltpu.VMEM((bq, d), jnp.float32)]
     o = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=float(sm_scale), causal=causal,
+        functools.partial(kern, scale=float(sm_scale), causal=causal,
                           has_seg=has_seg, bq=bq),
         grid=(b, h, sq // bq),
         in_specs=ins,
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=scratch,
         interpret=interpret,
     )(q, k, v, *_seg_ops(segment_ids))
     return o, (q, k, v, segment_ids)
 
 
-def _fwd_rule(q, k, v, causal, sm_scale, segment_ids, interpret):
-    return _fwd(q, k, v, causal, sm_scale, segment_ids, interpret)
+def _fwd_rule(q, k, v, causal, sm_scale, segment_ids, interpret,
+              block_q=None):
+    return _fwd(q, k, v, causal, sm_scale, segment_ids, interpret, block_q)
 
 
-def _bwd_rule(causal, sm_scale, interpret, res, g):
+def _bwd_rule(causal, sm_scale, interpret, block_q, res, g):
     q, k, v, segment_ids = res
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    bq = _q_block(sq, sk)
+    bq = _pick_bq(sq, sk, block_q)
     has_seg = segment_ids is not None
     ins, qspec, kvspec = _specs(b, h, bq, sq, sk, d, has_seg)
+    kern, scratch = _bwd_kernel, []
+    if _chunked(causal, bq, sq, sk):
+        kern = _bwd_kernel_chunked
+        scratch = [pltpu.VMEM((bq, sk), jnp.float32),
+                   pltpu.VMEM((bq, d), jnp.float32)]
     dq, dk, dv = pl.pallas_call(
-        functools.partial(_bwd_kernel, scale=float(sm_scale), causal=causal,
+        functools.partial(kern, scale=float(sm_scale), causal=causal,
                           has_seg=has_seg, bq=bq),
         grid=(b, h, sq // bq),
         in_specs=ins + [qspec],
@@ -220,6 +361,7 @@ def _bwd_rule(causal, sm_scale, interpret, res, g):
         out_shape=(jax.ShapeDtypeStruct(q.shape, q.dtype),
                    jax.ShapeDtypeStruct(k.shape, jnp.float32),
                    jax.ShapeDtypeStruct(v.shape, jnp.float32)),
+        scratch_shapes=scratch,
         interpret=interpret,
     )(q, k, v, *_seg_ops(segment_ids), g)
     return (dq, dk.astype(k.dtype), dv.astype(v.dtype), None)

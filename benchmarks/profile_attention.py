@@ -148,6 +148,16 @@ if not SMOKE and ap.supported(S, S, D):
         q, k, v, True, float(sm), None)
     measure("vmem-rows kernel (dq-only protocol)", vmem_rows)
     measure("vmem-rows kernel fwd+d(q,k,v)", vmem_rows, wrt_qkv=True)
+    # block_q sweep: q-blocks below the VMEM-auto size trade smaller
+    # matmuls for more causal-skip (the chunked kernels engage when
+    # sq >= 2*block_q)
+    for rbq in (512, 256, 128):
+        # skip the auto size — the un-overridden row above already is it
+        if S % rbq == 0 and rbq < ap._q_block(S, S):
+            measure(f"vmem-rows block_q={rbq} fwd+d(q,k,v)",
+                    lambda q, k, v, rbq=rbq: ap.fused_attention_rows(
+                        q, k, v, True, float(sm), None, False, rbq),
+                    wrt_qkv=True)
     # compare against whatever flash config actually won today's sweep
     _, best_bq, best_bk = min(SWEEP) if SWEEP else (None, 1024, 512)
     measure(f"flash q={best_bq} k={best_bk} fwd+d(q,k,v)",

@@ -39,6 +39,14 @@ from apex_tpu.optimizers.fused_adam import fused_adam
 from apex_tpu.transformer.parallel_state import TENSOR_AXIS
 from apex_tpu.transformer.testing import GPTModel, TransformerConfig
 
+# APEX_ATTN_IMPL={flash|rows} selects the attention kernel behind the
+# whole step (ops.attention.set_default_impl) — the step-level half of
+# the profile_attention.py kernel head-to-head
+if os.environ.get("APEX_ATTN_IMPL"):
+    from apex_tpu.ops.attention import set_default_impl
+
+    set_default_impl(os.environ["APEX_ATTN_IMPL"])
+
 B, S = (2, 128) if SMOKE else (8, 1024)
 K = 2 if SMOKE else 32  # scan length
 PEAK = 197e12  # v5e bf16 peak FLOP/s
