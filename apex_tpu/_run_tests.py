@@ -34,7 +34,10 @@ SUITES = {
     "contrib": ["test_contrib_basic.py", "test_contrib_attn.py",
                 "test_contrib_spatial.py",
                 "test_contrib_sparsity_permutation.py"],
-    "ops": ["test_ops_attention.py", "test_softmax_pallas.py"],
+    "ops": ["test_ops_attention.py", "test_softmax_pallas.py",
+            "test_attention_pallas.py", "test_xent_pallas.py"],
+    "api_parity": ["test_api_parity_round3.py"],
+    "harness": ["test_run_tests.py"],
     "checkpoint": ["test_checkpoint.py"],
     "data": ["test_data.py"],
     "examples": ["test_examples.py"],

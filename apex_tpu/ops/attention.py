@@ -120,7 +120,14 @@ def fused_attention(q, k, v, *, causal=False, sm_scale=None,
     if (impl or _DEFAULT_IMPL) == "rows" and not force_dense:
         from apex_tpu.ops import attention_pallas as ap
 
-        if _tpu_available() and ap.supported(sq, sk, q.shape[-1]):
+        # the *default* dispatch caps the rows kernel at the fmha-style
+        # moderate-seq envelope (beyond ~2k keys the multi-pass flash
+        # kernel's causal skip + bounded unroll win back what the
+        # single-pass structure saves); an explicit per-call impl="rows"
+        # is honored for every supported shape so A/B rows stay truthful
+        seq_ok = impl == "rows" or sk <= 2048
+        if (_tpu_available() and seq_ok
+                and ap.supported(sq, sk, q.shape[-1])):
             return ap.fused_attention_rows(q, k, v, causal,
                                            float(sm_scale), segment_ids)
     use_flash = flash_supported(sq, sk) and not force_dense

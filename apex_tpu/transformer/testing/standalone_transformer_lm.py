@@ -74,6 +74,13 @@ class TransformerConfig:
     # route the fused scale-mask-softmax (non-flash scores path) through
     # the Pallas kernel (ops/softmax_pallas.py) instead of the jnp path
     softmax_use_pallas: bool = False
+    # fuse the GPT LM head (logits matmul + vocab-parallel CE) into the
+    # Pallas linear-cross-entropy kernel (ops/xent_pallas.py): the [n, V]
+    # logits never reach HBM. Engages only where the kernel applies
+    # (tp == 1, no label smoothing, supported shapes); falls back to the
+    # materialized path otherwise. _interpret is for CPU tests.
+    fused_lm_head: bool = False
+    fused_lm_head_interpret: bool = False
     sequence_parallel: bool = False
     # context parallelism: mesh axis the SEQUENCE dim is sharded over for
     # the whole model (hidden states are [s/cp, b, h]); attention runs the
@@ -640,6 +647,26 @@ class GPTModel(nn.Module):
     # the pieces (Embedding, ParallelTransformer, Pooler), which both
     # composites build on.
 
+    def _fused_head_applies(self, hidden):
+        """Whether the Pallas fused LM head replaces logits+CE for this
+        call: opt-in, single vocab shard (the kernel is not
+        vocab-parallel — and at tp == 1 the sequence-parallel gather is
+        the identity, so no collective is needed either), a real TPU (or
+        interpret for tests), supported shapes. All static — the choice
+        is baked at trace time."""
+        cfg = self.cfg
+        if not cfg.fused_lm_head:
+            return False
+        if lax.axis_size(self.axis_name) != 1:
+            return False
+        from apex_tpu.ops import xent_pallas
+        from apex_tpu.ops.attention import _tpu_available
+
+        if not (cfg.fused_lm_head_interpret or _tpu_available()):
+            return False
+        s, b, h = hidden.shape
+        return xent_pallas.supported(b * s, cfg.vocab_size, h)
+
     @nn.compact
     def __call__(self, input_ids, position_ids, attention_mask, labels=None,
                  deterministic=True, hidden_state=None):
@@ -669,6 +696,20 @@ class GPTModel(nn.Module):
 
         if not self.post_process:
             return hidden
+
+        if labels is not None and self._fused_head_applies(hidden):
+            from apex_tpu.ops import xent_pallas
+
+            # the fused kernel instead of materializing [n, V] logits
+            # (tp == 1 here, so parallel_lm_logits' pre-matmul
+            # collectives — sp gather / copy — are identities)
+            s, b, h = hidden.shape
+            x2d = hidden.transpose(1, 0, 2).reshape(b * s, h)
+            loss = xent_pallas.linear_cross_entropy(
+                x2d, word_embeddings.astype(x2d.dtype),
+                labels.reshape(-1),
+                cfg.fused_lm_head_interpret)
+            return loss.reshape(b, s)
 
         logits = parallel_lm_logits(
             hidden, word_embeddings, parallel_output=self.parallel_output,

@@ -47,6 +47,11 @@ if os.environ.get("APEX_ATTN_IMPL"):
 
     set_default_impl(os.environ["APEX_ATTN_IMPL"])
 
+# APEX_FUSED_LM_HEAD=1 swaps the loss head for the Pallas fused
+# linear-CE kernel (TransformerConfig.fused_lm_head) — the step-level
+# half of the profile_xent.py head-to-head
+FUSED_HEAD = os.environ.get("APEX_FUSED_LM_HEAD") == "1"
+
 B, S = (2, 128) if SMOKE else (8, 1024)
 K = 2 if SMOKE else 32  # scan length
 PEAK = 197e12  # v5e bf16 peak FLOP/s
@@ -57,7 +62,8 @@ cfg = TransformerConfig(
     num_attention_heads=4 if SMOKE else 12,
     vocab_size=512 if SMOKE else 50304,
     max_position_embeddings=S,
-    hidden_dropout=0.0, attention_dropout=0.0, bf16=True)
+    hidden_dropout=0.0, attention_dropout=0.0, bf16=True,
+    fused_lm_head=FUSED_HEAD, fused_lm_head_interpret=FUSED_HEAD and SMOKE)
 model = GPTModel(cfg)
 mesh = Mesh(np.asarray(jax.devices()[:1]), (TENSOR_AXIS,))
 rs = np.random.RandomState(0)

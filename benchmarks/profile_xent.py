@@ -1,0 +1,105 @@
+"""Fused linear+CE LM head vs the materialized logits path on TPU.
+
+Measures the GPT-2 head shape (n = b*s rows, V=50304, h=768) fwd+bwd
+wrt (hidden, embedding) for ops/xent_pallas.py against the
+jnp/XLA-materialized path (matmul -> fp32 CE, the shape the model's
+vocab_parallel_cross_entropy lowers to at tp=1), at b=8 and b=16 —
+plus peak-HBM deltas from the compiled memory stats. The kernel's win
+condition is memory first (no [n, V] logits in HBM), time second;
+TransformerConfig.fused_lm_head dispatches on the outcome (PERF.md).
+
+Run:  python benchmarks/profile_xent.py
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+from benchmarks._smoke import smoke_mode  # noqa: E402
+
+SMOKE = smoke_mode("APEX_BENCH_SMOKE")  # force-CPU tiny sanity mode
+
+from benchmarks._timing import measure_dispatch_overhead, sync  # noqa: E402
+
+from apex_tpu.ops import xent_pallas as xp  # noqa: E402
+
+ON_TPU = not SMOKE and jax.devices()[0].platform == "tpu"
+H, V = (768, 50304) if ON_TPU else (128, 384)
+K = 16 if ON_TPU else 2
+PEAK = 197e12
+# logits + dlogits matmuls dominate: 3 * 2*n*V*h (fwd + dX + dE)
+FLOPS_PER_ROW = 3 * 2 * V * H
+INTERPRET = not ON_TPU
+
+
+def materialized(x, e, labels):
+    logits = lax.dot_general(x, e, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=1)
+    tgt = jnp.take_along_axis(logits, labels[:, None], axis=1)[:, 0]
+    return lse - tgt
+
+
+def fused(x, e, labels):
+    return xp.linear_cross_entropy(x, e, labels, INTERPRET)
+
+
+def measure(name, fn, n):
+    rs = np.random.RandomState(0)
+    x0 = jnp.asarray(rs.randn(n, H) * 0.3, jnp.bfloat16)
+    e0 = jnp.asarray(rs.randn(V, H) * 0.3, jnp.bfloat16)
+    labels = jnp.asarray(rs.randint(0, V, (n,)), jnp.int32)
+
+    def run(x, e, eps, labels):
+        def body(carry, _):
+            xc, ec = carry
+
+            def f(xx, ee):
+                return jnp.sum(fn(xx, ee, labels))
+
+            l, (gx, ge) = jax.value_and_grad(f, argnums=(0, 1))(xc, ec)
+            xc = xc - eps.astype(xc.dtype) * gx.astype(xc.dtype)
+            ec = ec - eps.astype(ec.dtype) * ge.astype(ec.dtype)
+            return (xc, ec), l
+
+        carry, ls = lax.scan(body, (x, e), jnp.arange(K))
+        return carry, ls
+
+    f = jax.jit(run)
+    try:
+        lowered = f.lower(x0, e0, jnp.float32(0.0), labels)
+        compiled = lowered.compile()
+        stats = compiled.memory_analysis()
+        peak = getattr(stats, "temp_size_in_bytes", None)
+    except Exception:
+        compiled, peak = f, None
+    try:
+        out = compiled(x0, e0, jnp.float32(0.0), labels)
+        sync(out[1])
+    except Exception as e:
+        print(f"{name:34s} FAILED: {type(e).__name__}: {str(e)[:100]}")
+        return
+    t0 = time.perf_counter()
+    out = compiled(x0, e0, jnp.float32(1e-30), labels)
+    sync(out[1])
+    dt = (time.perf_counter() - t0 - OVERHEAD) / K
+    flops = FLOPS_PER_ROW * n
+    mem = f"  peak-temp {peak/1e9:5.2f} GB" if peak is not None else ""
+    print(f"{name:34s} {dt*1e3:8.2f} ms  {flops/dt/1e12:6.1f} TF/s"
+          f"  MFU={flops/dt/PEAK*100:5.1f}%{mem}")
+
+
+OVERHEAD = measure_dispatch_overhead(K)
+print(f"LM head h={H} V={V} (K={K}, overhead {OVERHEAD*1e3:.1f} ms)")
+
+for b in ((8, 16) if ON_TPU else (2,)):
+    n = b * 1024 if ON_TPU else b * 64
+    measure(f"materialized logits+CE b={b}", materialized, n)
+    measure(f"fused linear-CE kernel b={b}", fused, n)

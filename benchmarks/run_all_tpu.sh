@@ -31,6 +31,7 @@ run softmax           900 python benchmarks/profile_softmax.py
 run optimizers        900 python benchmarks/profile_optimizers.py
 run multihead_attn    900 python benchmarks/profile_multihead_attn.py
 run dcgan             900 python benchmarks/profile_dcgan.py
+run xent             1200 python benchmarks/profile_xent.py
 run gpt              1200 python benchmarks/profile_gpt.py
 run resnet           1200 python benchmarks/profile_resnet.py
 run pretrain         1800 python benchmarks/profile_pretrain.py

@@ -60,3 +60,19 @@ def test_fused_attention_grads_match_dense_autodiff():
     for g in (gq, gk, gv):
         assert np.isfinite(np.asarray(g)).all()
         assert np.abs(np.asarray(g)).sum() > 0
+
+
+def test_impl_knob_validation_and_fallthrough():
+    import pytest as _pytest
+
+    from apex_tpu.ops.attention import set_default_impl
+
+    q = jnp.ones((1, 1, 8, 4), jnp.float32)
+    with _pytest.raises(ValueError):
+        fused_attention(q, q, q, impl="row")  # typo must not silently flash
+    with _pytest.raises(ValueError):
+        set_default_impl("dense")
+    # on the CPU backend both impls fall through to the dense path and agree
+    a = fused_attention(q, q, q, causal=True, impl="rows")
+    b = fused_attention(q, q, q, causal=True, impl="flash")
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b))

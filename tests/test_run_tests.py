@@ -1,0 +1,21 @@
+"""The console suite runner (apex-tpu-test -> apex_tpu/_run_tests.py,
+the port of the reference's tests/L0/run_test.py suite selection) must
+know about every test file in this directory — a new test file that is
+not in any suite would silently never run under the entry point."""
+
+import os
+
+from apex_tpu import _run_tests
+
+
+def test_every_test_file_belongs_to_a_suite():
+    here = os.path.dirname(os.path.abspath(__file__))
+    files = {f for f in os.listdir(here)
+             if f.startswith("test_") and f.endswith(".py")}
+    covered = {f for suite in _run_tests.SUITES.values() for f in suite}
+    missing = files - covered
+    assert not missing, (
+        f"test files not in any apex-tpu-test suite: {sorted(missing)}")
+    # and nothing stale: every listed file must exist
+    stale = covered - files
+    assert not stale, f"suite entries without files: {sorted(stale)}"

@@ -1,0 +1,141 @@
+"""Fused linear+cross-entropy kernel vs the jnp reference (interpret
+mode on CPU; TPU timing in benchmarks/profile_xent.py). Reference
+envelope: contrib/csrc/xentropy parity tests (apex_tpu's
+contrib/xentropy covers the materialized-logits form; this kernel fuses
+the LM-head matmul in as well)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops import xent_pallas as xp
+
+
+def _ref(x, e, labels):
+    logits = (x.astype(jnp.float32) @ e.astype(jnp.float32).T)
+    lse = jax.scipy.special.logsumexp(logits, axis=1)
+    tgt = jnp.take_along_axis(logits, labels[:, None], axis=1)[:, 0]
+    return lse - tgt
+
+
+def _data(rs, n, V, h, dtype):
+    x = jnp.asarray(rs.randn(n, h) * 0.3, dtype)
+    e = jnp.asarray(rs.randn(V, h) * 0.3, dtype)
+    labels = jnp.asarray(rs.randint(0, V, (n,)), jnp.int32)
+    return x, e, labels
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_fwd_matches_reference(dtype):
+    n, V, h = 64, 768, 128  # two vocab chunks
+    rs = np.random.RandomState(0)
+    x, e, labels = _data(rs, n, V, h, dtype)
+    assert xp.supported(n, V, h)
+    got = xp.linear_cross_entropy(x, e, labels, True)
+    want = _ref(x, e, labels)
+    assert got.shape == (n,) and got.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=5e-2 if dtype == jnp.bfloat16 else 1e-5)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_grads_match_reference(dtype):
+    """Multi row-block + multi vocab-chunk grid; non-uniform upstream
+    cotangent exercises the dl plumbing in both bwd kernels."""
+    n, V, h = 512, 1280, 128  # nb=2 (row-block 256), nv=5 (chunk 256)
+    rs = np.random.RandomState(1)
+    x, e, labels = _data(rs, n, V, h, dtype)
+    w = jnp.asarray(rs.rand(n) + 0.5, jnp.float32)
+
+    def f(x, e):
+        return jnp.mean(w * xp.linear_cross_entropy(x, e, labels, True))
+
+    def r(x, e):
+        return jnp.mean(w * _ref(x, e, labels))
+
+    gx, ge = jax.grad(f, argnums=(0, 1))(x, e)
+    rx, re = jax.grad(r, argnums=(0, 1))(x, e)
+    assert gx.dtype == dtype and ge.dtype == dtype
+    tol = 6e-3 if dtype == jnp.bfloat16 else 1e-6
+    np.testing.assert_allclose(np.asarray(gx, np.float32),
+                               np.asarray(rx, np.float32), atol=tol)
+    np.testing.assert_allclose(np.asarray(ge, np.float32),
+                               np.asarray(re, np.float32), atol=tol)
+
+
+def test_value_and_grad_through_mean_loss():
+    """The way a training step consumes it: scalar mean loss, finite and
+    equal to the reference, and the loss decreases under a GD step."""
+    n, V, h = 128, 384, 128
+    rs = np.random.RandomState(2)
+    x, e, labels = _data(rs, n, V, h, jnp.float32)
+
+    def f(e):
+        return jnp.mean(xp.linear_cross_entropy(x, e, labels, True))
+
+    l0, g = jax.value_and_grad(f)(e)
+    np.testing.assert_allclose(float(l0),
+                               float(jnp.mean(_ref(x, e, labels))),
+                               rtol=1e-6)
+    l1 = f(e - 0.5 * g)
+    assert float(l1) < float(l0)
+
+
+@pytest.mark.slow
+def test_gpt_model_fused_head_matches_materialized():
+    """cfg.fused_lm_head swaps the GPT loss head for the fused kernel;
+    loss and grads must match the materialized logits+CE path."""
+    import dataclasses
+
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+    from apex_tpu.transformer.testing import GPTModel, TransformerConfig
+
+    base = TransformerConfig(
+        hidden_size=128, num_layers=2, num_attention_heads=4,
+        vocab_size=384, max_position_embeddings=64,
+        hidden_dropout=0.0, attention_dropout=0.0)
+    fused = dataclasses.replace(base, fused_lm_head=True,
+                                fused_lm_head_interpret=True)
+    rs = np.random.RandomState(0)
+    b, s = 2, 64
+    ids = jnp.asarray(rs.randint(0, 384, (b, s)), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None], (b, s))
+    labels = jnp.asarray(rs.randint(0, 384, (b, s)), jnp.int32)
+    mesh = Mesh(np.asarray(jax.devices()[:1]), (TENSOR_AXIS,))
+
+    def run(cfg):
+        model = GPTModel(cfg)
+
+        def local(ids, pos, labels):
+            params = model.init(jax.random.PRNGKey(0), ids, pos, None)[
+                "params"]
+
+            def loss_fn(p):
+                return jnp.mean(model.apply({"params": p}, ids, pos, None,
+                                            labels))
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            gnorm = sum(jnp.sum(g.astype(jnp.float32) ** 2)
+                        for g in jax.tree_util.tree_leaves(grads))
+            return loss, gnorm
+
+        return jax.shard_map(local, mesh=mesh, in_specs=(P(),) * 3,
+                             out_specs=P(), check_vma=False)(
+            ids, pos, labels)
+
+    l_ref, g_ref = run(base)
+    l_fused, g_fused = run(fused)
+    np.testing.assert_allclose(float(l_fused), float(l_ref), rtol=1e-6)
+    np.testing.assert_allclose(float(g_fused), float(g_ref), rtol=1e-5)
+
+
+def test_supported_predicate():
+    assert xp.supported(8192, 50304, 768)      # GPT-2 bench shape
+    assert xp.supported(8192, 30592, 1024)     # BERT-large padded vocab
+    assert not xp.supported(8192, 50000, 768)  # no 128-multiple divisor
+    assert not xp.supported(7, 50304, 768)     # rows not 8-divisible
+    assert not xp.supported(8192, 50304, 760)  # lane-unaligned hidden
