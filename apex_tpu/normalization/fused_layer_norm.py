@@ -40,6 +40,26 @@ def _normalized_axes(x, normalized_shape):
     return tuple(range(x.ndim - n, x.ndim)), tuple(normalized_shape)
 
 
+def would_use_pallas(x_shape, n_norm_axes=1, use_pallas=None):
+    """The exact predicate ``fused_layer_norm`` uses to dispatch to the
+    Pallas row kernel — exposed so callers (benchmark harnesses, tests)
+    can't drift from the real gate. ``use_pallas=None`` resolves to the
+    module-level ``USE_PALLAS`` default, same as ``fused_layer_norm``."""
+    if use_pallas is None:
+        use_pallas = USE_PALLAS
+    if not (use_pallas and n_norm_axes == 1):
+        return False
+    # imports below the early return: the pure-jnp default path must not
+    # require jax.experimental.pallas to be importable
+    from apex_tpu.ops.attention import _tpu_available
+    from apex_tpu.ops import layer_norm_pallas as lnp
+    hidden = x_shape[-1]
+    rows = 1
+    for d in x_shape[:-1]:
+        rows *= d
+    return _tpu_available() and lnp.supported(rows, hidden)
+
+
 def fused_layer_norm(x, normalized_shape, weight=None, bias=None, eps=1e-5,
                      memory_efficient=False, use_pallas=None):
     """Functional layer norm, fp32 statistics (reference autograd fns:
@@ -49,20 +69,16 @@ def fused_layer_norm(x, normalized_shape, weight=None, bias=None, eps=1e-5,
     axes, _ = _normalized_axes(x, normalized_shape)
     orig_dtype = x.dtype
 
-    if use_pallas is None:
-        use_pallas = USE_PALLAS
-    if use_pallas and len(axes) == 1:
-        from apex_tpu.ops.attention import _tpu_available
+    if would_use_pallas(x.shape, len(axes), use_pallas):
         from apex_tpu.ops import layer_norm_pallas as lnp
 
         hidden = x.shape[-1]
         rows = x.size // hidden
-        if _tpu_available() and lnp.supported(rows, hidden):
-            y2d = lnp.layer_norm(
-                x.reshape(rows, hidden),
-                None if weight is None else weight.astype(jnp.float32),
-                None if bias is None else bias.astype(jnp.float32), eps)
-            return y2d.reshape(x.shape)
+        y2d = lnp.layer_norm(
+            x.reshape(rows, hidden),
+            None if weight is None else weight.astype(jnp.float32),
+            None if bias is None else bias.astype(jnp.float32), eps)
+        return y2d.reshape(x.shape)
 
     xf = x.astype(jnp.float32)
     mean = jnp.mean(xf, axis=axes, keepdims=True)

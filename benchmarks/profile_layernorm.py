@@ -42,7 +42,7 @@ print(f"dispatch overhead {OVERHEAD*1e3:.1f} ms; HBM roofline {HBM/1e9:.0f} GB/s
 ROWS = 256 if SMOKE else 8 * 1024  # GPT-2-small b*s
 
 
-def run_case(hidden):
+def run_case(hidden, use_pallas=False):
     rs = np.random.RandomState(0)
     x0 = jnp.asarray(rs.randn(ROWS, hidden), jnp.bfloat16)
     w0 = jnp.ones((hidden,), jnp.float32)
@@ -53,7 +53,8 @@ def run_case(hidden):
             w, b = carry
 
             def f(w, b):
-                y = fused_layer_norm(x0, (hidden,), w, b)
+                y = fused_layer_norm(x0, (hidden,), w, b,
+                                     use_pallas=use_pallas)
                 return jnp.sum(y.astype(jnp.float32) ** 2)
 
             l, (gw, gb) = jax.value_and_grad(f, argnums=(0, 1))(w, b)
@@ -75,11 +76,19 @@ def run_case(hidden):
     # (fused away here — dy comes from y), write dx. Conservative floor:
     # 4 bf16 passes over the tensor.
     bytes_min = 4 * 2 * n
-    print(f"h={hidden:5d}: {dt*1e3:7.3f} ms  "
+    tag = "pallas" if use_pallas else "jnp"
+    print(f"h={hidden:5d} {tag:6s}: {dt*1e3:7.3f} ms  "
           f"{bytes_min/dt/1e9:6.0f} GB/s effective  "
           f"({bytes_min/dt/HBM*100:5.1f}% of HBM roofline)")
     return dt
 
 
+from apex_tpu.normalization.fused_layer_norm import would_use_pallas  # noqa: E402
+
 for h in ((256,) if SMOKE else (768, 1024, 4096, 8192, 12288)):
-    run_case(h)
+    base = run_case(h)
+    # off-TPU (or unsupported shapes) the "pallas" row would silently
+    # re-measure the jnp path — gate on the dispatcher's own predicate
+    if would_use_pallas((ROWS, h), use_pallas=True):
+        pal = run_case(h, use_pallas=True)
+        print(f"{'':13s} pallas/jnp = {pal/base:.2f}x")

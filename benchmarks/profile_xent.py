@@ -99,7 +99,13 @@ def measure(name, fn, n):
 OVERHEAD = measure_dispatch_overhead(K)
 print(f"LM head h={H} V={V} (K={K}, overhead {OVERHEAD*1e3:.1f} ms)")
 
-for b in ((8, 16) if ON_TPU else (2,)):
-    n = b * 1024 if ON_TPU else b * 64
-    measure(f"materialized logits+CE b={b}", materialized, n)
-    measure(f"fused linear-CE kernel b={b}", fused, n)
+# Fused (small-HBM) cases first: the relay's degraded mode selectively
+# starves programs with large HBM working sets (PERF.md §6), and the
+# materialized baseline's [n, V] fp32 logits are exactly such an object —
+# running it last means a partially-healthy window still yields the
+# kernel numbers.
+for label, fn in (("fused linear-CE kernel", fused),
+                  ("materialized logits+CE", materialized)):
+    for b in ((8, 16) if ON_TPU else (2,)):
+        n = b * 1024 if ON_TPU else b * 64
+        measure(f"{label} b={b}", fn, n)
