@@ -8,15 +8,23 @@ at those lengths a whole [block_q, sk] score row fits in VMEM, so each
 over the FULL key row, and the output matmul in one kernel — no online
 max/sum rescaling passes, no [s, s] tensor in HBM.
 
-Backward is one kernel over the same grid, fully self-contained: it
-recomputes S and P from (q, k, v) (no saved LSE — the softmax residual is
-reconstructed row-exactly), forms dP = dO V^T, uses the identity
-D = rowsum(dO * O) = rowsum(P * dP) to avoid needing O, then
-dS = P * (dP - D) * scale, dQ = dS K, and accumulates dK += dS^T Q,
-dV += P^T dO across q-blocks. The accumulation is safe because the TPU
-grid executes sequentially and the dk/dv output blocks stay VMEM-resident
-while the innermost (q) grid index varies; they are written back once per
-(b, h). dk/dv accumulate in fp32 regardless of the input dtype.
+Backward comes in two structures behind the measured ``BWD_IMPL`` knob:
+
+* ``"split"`` (default): a q-major dq pass that recomputes S and P from
+  (q, k, v), forms dP = dO V^T, uses D = rowsum(dO * O) = rowsum(P * dP)
+  to avoid needing O, writes dQ = dS K — and emits the per-row softmax
+  stats (m, l, D) as [b, h, sq] fp32 byproducts; then a k-major dk/dv
+  pass where each (b, h, k-block) grid step reconstructs P row-exactly
+  from those stats and owns its [bk, d] dk/dv outputs outright (no
+  accumulation across grid steps). Eligibility is VMEM-gated
+  (``_split_ok``): the k-major pass keeps the full [sq, d] q and dO
+  resident, so very long sq falls back to monolithic.
+* ``"monolithic"``: one self-contained q-major kernel (no saved stats)
+  that additionally accumulates dK += dS^T Q, dV += P^T dO across
+  q-blocks — safe because the TPU grid executes sequentially and the
+  dk/dv blocks stay VMEM-resident while the innermost (q) index varies.
+
+dk/dv accumulate in fp32 regardless of the input dtype in both.
 
 Masking matches ops.attention._dense_attention exactly: causal triangle
 (generated from iota, no mask operand), optional segment ids (packed
@@ -62,31 +70,53 @@ def supported(sq, sk, d):
     return sk % 128 == 0 and d <= 256 and _q_block(sq, sk) != 0
 
 
-def _masks(iq, bq, rows, sk, causal, seg_q, seg_kv):
+def _masks(iq, bq, rows, sk, causal, seg_q, seg_kv, col0=0,
+           seg_rows=None):
     """Boolean masked-out matrix for one [rows, sk] score block (True =
-    excluded), or None when unmasked. seg_* are refs or None."""
+    excluded), or None when unmasked. seg_* are refs or None. ``col0``
+    offsets the absolute column index (k-major blocks); ``seg_rows``
+    overrides the row-id slice taken from seg_q (q chunks)."""
     masked = None
     if causal:
         row = iq * bq + lax.broadcasted_iota(jnp.int32, (rows, sk), 0)
-        col = lax.broadcasted_iota(jnp.int32, (rows, sk), 1)
+        col = col0 + lax.broadcasted_iota(jnp.int32, (rows, sk), 1)
         masked = col > row
     if seg_q is not None:
-        sq_row = seg_q[0, :]
+        sq_row = seg_q[0, :] if seg_rows is None else seg_rows
         skv_row = seg_kv[0, :]
         diff = sq_row[:, None] != skv_row[None, :]
         masked = diff if masked is None else masked | diff
     return masked
 
 
-def _softmax(s, masked):
+def _softmax_stats(s, masked):
     """Exact fp32 softmax over the full key row with dense-reference
-    semantics (masked excluded, fully-masked rows -> 0)."""
+    semantics (masked excluded, fully-masked rows -> 0). Returns
+    (p, rowmax m, rowsum l) — m/l let a k-major pass reconstruct p
+    row-exactly without the full row."""
     if masked is not None:
         s = jnp.where(masked, jnp.finfo(jnp.float32).min, s)
-    e = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - m)
     if masked is not None:
         e = jnp.where(masked, 0.0, e)
     tot = jnp.sum(e, axis=-1, keepdims=True)
+    p = jnp.where(tot > 0, e / jnp.where(tot > 0, tot, 1.0), 0.0)
+    return p, m, tot
+
+
+def _softmax(s, masked):
+    return _softmax_stats(s, masked)[0]
+
+
+def _p_from_stats(s, m, tot, masked):
+    """Row-exact P reconstruction from saved (rowmax m, rowsum tot)
+    [rows, 1] stats — same exclusion and zero-row semantics as
+    ``_softmax_stats`` (whose outputs m/tot must come from the same
+    mask)."""
+    e = jnp.exp(s - m)
+    if masked is not None:
+        e = jnp.where(masked, 0.0, e)
     return jnp.where(tot > 0, e / jnp.where(tot > 0, tot, 1.0), 0.0)
 
 
@@ -263,6 +293,106 @@ def _bwd_kernel_chunked(*refs, scale, causal, has_seg, bq):
     dq_ref[0, 0] = acc_scr[...].astype(dq_ref.dtype)
 
 
+def _bwd_dq_kernel(*refs, scale, causal, has_seg, bq):
+    """Split backward, pass 1 (q-major): dq plus the per-row softmax
+    stats (rowmax m, rowsum l) and D = rowsum(P*dP) the k-major pass
+    needs to reconstruct P and dS row-exactly."""
+    if has_seg:
+        (q_ref, k_ref, v_ref, sq_ref, skv_ref, do_ref,
+         dq_ref, m_ref, l_ref, dcol_ref) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref,
+         dq_ref, m_ref, l_ref, dcol_ref) = refs
+        sq_ref = skv_ref = None
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    do = do_ref[0, 0]
+
+    s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+    s = s * jnp.float32(scale)
+    masked = _masks(pl.program_id(2), bq, q.shape[0], k.shape[0],
+                    causal, sq_ref, skv_ref)
+    p, m, tot = _softmax_stats(s, masked)
+
+    dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                         preferred_element_type=jnp.float32)
+    dcol = jnp.sum(p * dp, axis=-1, keepdims=True)
+    ds = (p * (dp - dcol) * jnp.float32(scale)).astype(q.dtype)
+
+    dq = lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                         preferred_element_type=jnp.float32)
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+    m_ref[0, 0] = m[:, 0]
+    l_ref[0, 0] = tot[:, 0]
+    dcol_ref[0, 0] = dcol[:, 0]
+
+
+def _bwd_dkv_kernel(*refs, scale, causal, has_seg, bq, sq):
+    """Split backward, pass 2 (k-major): each (b, h, k-block) grid step
+    owns its [bk, d] dk/dv blocks outright — no accumulation across grid
+    steps, no block revisiting. P and dS are reconstructed from the saved
+    (m, l, D) row stats; q is processed in bq-sized chunks so causal
+    blocks skip the strictly-below-diagonal chunks entirely."""
+    if has_seg:
+        (q_ref, k_ref, v_ref, sq_ref, skv_ref, do_ref, m_ref, l_ref,
+         dcol_ref, dk_ref, dv_ref, dk_scr, dv_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, dcol_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+        sq_ref = skv_ref = None
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    bk = k.shape[0]
+    ik = pl.program_id(2)
+    nq = sq // bq
+
+    dk_scr[...] = jnp.zeros_like(dk_scr)
+    dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    for c in range(nq):
+        def _chunk(c=c):
+            qc = q_ref[0, 0, c * bq:(c + 1) * bq, :]
+            doc = do_ref[0, 0, c * bq:(c + 1) * bq, :]
+            m = m_ref[0, 0, c * bq:(c + 1) * bq]
+            tot = l_ref[0, 0, c * bq:(c + 1) * bq]
+            dcol = dcol_ref[0, 0, c * bq:(c + 1) * bq]
+
+            s = lax.dot_general(qc, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+            s = s * jnp.float32(scale)
+
+            seg_rows = (None if sq_ref is None
+                        else sq_ref[0, c * bq:(c + 1) * bq])
+            masked = _masks(c, bq, bq, bk, causal, sq_ref, skv_ref,
+                            col0=ik * bk, seg_rows=seg_rows)
+            p = _p_from_stats(s, m[:, None], tot[:, None], masked)
+
+            dp = lax.dot_general(doc, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+            ds = (p * (dp - dcol[:, None]) * jnp.float32(scale)).astype(
+                qc.dtype)
+            p_lo = p.astype(qc.dtype)
+
+            dk_scr[...] += lax.dot_general(
+                ds, qc, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dv_scr[...] += lax.dot_general(
+                p_lo, doc, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        if causal:
+            # q rows < this k-block's first column contribute nothing —
+            # skip the chunk (the grid is sequential scalar control flow)
+            pl.when((c + 1) * bq - 1 >= ik * bk)(_chunk)
+        else:
+            _chunk()
+
+    dk_ref[0, 0] = dk_scr[...]
+    dv_ref[0, 0] = dv_scr[...]
+
+
 def _specs(b, h, bq, sq, sk, d, has_seg):
     """(in_specs for q,k,v[,seg_q,seg_kv], qblk-spec, kvblk-spec)."""
     qspec = pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq: (ib, ih, iq, 0))
@@ -298,13 +428,31 @@ def _pick_bq(sq, sk, block_q):
     return bq
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 6, 7))
+# Backward structure: "monolithic" = one q-major kernel accumulating
+# dk/dv across the sequential grid; "split" = a q-major dq pass (emitting
+# the (m, l, D) row stats) + a k-major dk/dv pass where each k-block is
+# computed exactly once. Measured knob (PERF.md §3/§7): the winner on the
+# fwd+d(q,k,v) protocol becomes the default.
+BWD_IMPL = "split"
+
+
+def set_bwd_impl(impl):
+    global BWD_IMPL
+    if impl not in ("monolithic", "split"):
+        raise ValueError(f"unknown rows bwd impl {impl!r}")
+    BWD_IMPL = impl
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 6, 7, 8))
 def fused_attention_rows(q, k, v, causal, sm_scale, segment_ids=None,
-                         interpret=False, block_q=None):
+                         interpret=False, block_q=None, bwd_impl=None):
     """VMEM-row fused attention. q: [b, h, sq, d]; k, v: [b, h, sk, d];
     segment_ids: None or (seg_q [b, sq], seg_kv [b, sk]). Check
     ``supported(sq, sk, d)`` first. ``interpret=True`` for CPU tests.
-    ``block_q`` overrides the auto q-block (benchmark sweeps)."""
+    ``block_q`` overrides the auto q-block (benchmark sweeps);
+    ``bwd_impl`` overrides the module-level ``BWD_IMPL``."""
+    if bwd_impl is not None and bwd_impl not in ("monolithic", "split"):
+        raise ValueError(f"unknown rows bwd impl {bwd_impl!r}")
     return _fwd(q, k, v, causal, sm_scale, segment_ids, interpret,
                 block_q)[0]
 
@@ -336,11 +484,11 @@ def _fwd(q, k, v, causal, sm_scale, segment_ids, interpret, block_q=None):
 
 
 def _fwd_rule(q, k, v, causal, sm_scale, segment_ids, interpret,
-              block_q=None):
+              block_q=None, bwd_impl=None):
     return _fwd(q, k, v, causal, sm_scale, segment_ids, interpret, block_q)
 
 
-def _bwd_rule(causal, sm_scale, interpret, block_q, res, g):
+def _bwd_monolithic(causal, sm_scale, interpret, block_q, res, g):
     q, k, v, segment_ids = res
     b, h, sq, d = q.shape
     sk = k.shape[2]
@@ -365,6 +513,86 @@ def _bwd_rule(causal, sm_scale, interpret, block_q, res, g):
         interpret=interpret,
     )(q, k, v, *_seg_ops(segment_ids), g)
     return (dq, dk.astype(k.dtype), dv.astype(v.dtype), None)
+
+
+def _bwd_split(causal, sm_scale, interpret, block_q, res, g):
+    q, k, v, segment_ids = res
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq = _pick_bq(sq, sk, block_q)
+    has_seg = segment_ids is not None
+    ins, qspec, kvspec = _specs(b, h, bq, sq, sk, d, has_seg)
+    vecspec = pl.BlockSpec((1, 1, bq), lambda ib, ih, iq: (ib, ih, iq))
+    vecshape = jax.ShapeDtypeStruct((b, h, sq), jnp.float32)
+
+    dq, m, l, dcol = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=float(sm_scale),
+                          causal=causal, has_seg=has_seg, bq=bq),
+        grid=(b, h, sq // bq),
+        in_specs=ins + [qspec],
+        out_specs=(qspec, vecspec, vecspec, vecspec),
+        out_shape=(jax.ShapeDtypeStruct(q.shape, q.dtype),
+                   vecshape, vecshape, vecshape),
+        interpret=interpret,
+    )(q, k, v, *_seg_ops(segment_ids), g)
+
+    bk = bq  # k-blocks reuse the VMEM-validated row block size
+    fullq = pl.BlockSpec((1, 1, sq, d), lambda ib, ih, ik: (ib, ih, 0, 0))
+    kvblk = pl.BlockSpec((1, 1, bk, d), lambda ib, ih, ik: (ib, ih, ik, 0))
+    fullvec = pl.BlockSpec((1, 1, sq), lambda ib, ih, ik: (ib, ih, 0))
+    dkv_ins = [fullq, kvblk, kvblk]
+    if has_seg:
+        dkv_ins.append(pl.BlockSpec((1, sq), lambda ib, ih, ik: (ib, 0)))
+        dkv_ins.append(pl.BlockSpec((1, bk), lambda ib, ih, ik: (ib, ik)))
+    dkv_ins += [fullq, fullvec, fullvec, fullvec]
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=float(sm_scale),
+                          causal=causal, has_seg=has_seg, bq=bq, sq=sq),
+        grid=(b, h, sk // bk),
+        in_specs=dkv_ins,
+        out_specs=(kvblk, kvblk),
+        out_shape=(jax.ShapeDtypeStruct(k.shape, jnp.float32),
+                   jax.ShapeDtypeStruct(v.shape, jnp.float32)),
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, *_seg_ops(segment_ids), g, m, l, dcol)
+    return (dq, dk.astype(k.dtype), dv.astype(v.dtype), None)
+
+
+def _split_ok(sq, sk, d, bq, itemsize):
+    """VMEM eligibility of the split k-major pass: it keeps the full
+    [sq, d] q and dO resident per grid step (the monolithic backward
+    streams q instead), holds 3 [bq, bq] fp32 chunk arrays + 2 [bq, d]
+    accumulators + 3 [sq] stat vectors, and unrolls sq/bq chunks."""
+    # bq % 128: the stat vectors are emitted as [1, 1, bq] minor-dim
+    # blocks, which Mosaic requires lane-aligned
+    if sk % bq or bq % 128 or sq // bq > 32:
+        return False
+    resident = (2 * sq * d * itemsize      # q, dO
+                + 3 * bq * bq * 4          # s/p, dp, ds
+                + 2 * bq * d * 4           # dk/dv accumulators
+                + 3 * sq * 4)              # m, l, D
+    return resident <= _VMEM_BUDGET
+
+
+def _bwd_rule(causal, sm_scale, interpret, block_q, bwd_impl, res, g):
+    if bwd_impl is not None and bwd_impl not in ("monolithic", "split"):
+        raise ValueError(f"unknown rows bwd impl {bwd_impl!r}")
+    impl = bwd_impl or BWD_IMPL
+    q, k, v, _ = res
+    sq, sk = q.shape[2], k.shape[2]
+    bq = _pick_bq(sq, sk, block_q)
+    ok = _split_ok(sq, sk, q.shape[3], bq, q.dtype.itemsize)
+    if bwd_impl == "split" and not ok:
+        # an explicit request must be honored or error — silently running
+        # monolithic would mislabel A/B benchmark rows
+        raise ValueError(
+            f"split bwd ineligible for {q.shape}x{k.shape} (bq={bq})")
+    if impl == "split" and ok:
+        return _bwd_split(causal, sm_scale, interpret, block_q, res, g)
+    return _bwd_monolithic(causal, sm_scale, interpret, block_q, res, g)
 
 
 fused_attention_rows.defvjp(_fwd_rule, _bwd_rule)

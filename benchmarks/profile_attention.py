@@ -147,17 +147,27 @@ if not SMOKE and ap.supported(S, S, D):
     vmem_rows = lambda q, k, v: ap.fused_attention_rows(
         q, k, v, True, float(sm), None)
     measure("vmem-rows kernel (dq-only protocol)", vmem_rows)
-    measure("vmem-rows kernel fwd+d(q,k,v)", vmem_rows, wrt_qkv=True)
+    # backward-structure A/B (the PERF.md §3 decision row): monolithic
+    # q-major accumulation vs split dq + k-major dkv passes
+    for impl in ("monolithic", "split"):
+        measure(f"vmem-rows {impl}-bwd fwd+d(q,k,v)",
+                lambda q, k, v, impl=impl: ap.fused_attention_rows(
+                    q, k, v, True, float(sm), None, False, None, impl),
+                wrt_qkv=True)
     # block_q sweep: q-blocks below the VMEM-auto size trade smaller
-    # matmuls for more causal-skip (the chunked kernels engage when
-    # sq >= 2*block_q)
+    # matmuls for more causal-skip in the fwd and monolithic-bwd chunked
+    # kernels; bwd_impl is pinned per row so the labels stay truthful
+    # (and comparable with the pre-split rounds, which were monolithic)
     for rbq in (512, 256, 128):
         # skip the auto size — the un-overridden row above already is it
         if S % rbq == 0 and rbq < ap._q_block(S, S):
-            measure(f"vmem-rows block_q={rbq} fwd+d(q,k,v)",
-                    lambda q, k, v, rbq=rbq: ap.fused_attention_rows(
-                        q, k, v, True, float(sm), None, False, rbq),
-                    wrt_qkv=True)
+            for impl in ("monolithic", "split"):
+                measure(f"vmem-rows block_q={rbq} {impl}-bwd fwd+d(q,k,v)",
+                        lambda q, k, v, rbq=rbq, impl=impl:
+                        ap.fused_attention_rows(
+                            q, k, v, True, float(sm), None, False, rbq,
+                            impl),
+                        wrt_qkv=True)
     # compare against whatever flash config actually won today's sweep
     _, best_bq, best_bk = min(SWEEP) if SWEEP else (None, 1024, 512)
     measure(f"flash q={best_bq} k={best_bk} fwd+d(q,k,v)",

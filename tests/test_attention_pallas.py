@@ -128,11 +128,13 @@ def test_grads_segment_ids_multiblock():
                                    atol=2e-4)
 
 
+@pytest.mark.parametrize("bwd_impl", ["monolithic", "split"])
 @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
-def test_chunked_causal_matches_dense(dtype):
+def test_chunked_causal_matches_dense(dtype, bwd_impl):
     """block_q=128 at s=512 engages the causal-skip (chunked) kernels;
     parity incl. grads against dense proves the guarded-skip logic and
-    the dP-garbage masking."""
+    the dP-garbage masking. bwd_impl is pinned per case so the chunked
+    monolithic backward keeps gradient coverage alongside split."""
     b, h, s, d = 1, 2, 512, 64
     rs = np.random.RandomState(5)
     q, k, v = _qkv(rs, b, h, s, s, d, dtype)
@@ -154,7 +156,7 @@ def test_chunked_causal_matches_dense(dtype):
                                atol=3e-2 if dtype == jnp.bfloat16 else 2e-5)
     gq, gk, gv = jax.grad(loss(
         lambda q, k, v: ap.fused_attention_rows(q, k, v, True, scale, None,
-                                                True, 128)),
+                                                True, 128, bwd_impl)),
         argnums=(0, 1, 2))(q, k, v)
     rq, rk, rv = jax.grad(loss(
         lambda q, k, v: _dense_attention(q, k, v, True, scale, None)),
@@ -165,7 +167,8 @@ def test_chunked_causal_matches_dense(dtype):
                                    np.asarray(r, np.float32), atol=tol)
 
 
-def test_chunked_causal_with_segments():
+@pytest.mark.parametrize("bwd_impl", ["monolithic", "split"])
+def test_chunked_causal_with_segments(bwd_impl):
     b, h, s, d = 1, 1, 384, 32
     rs = np.random.RandomState(6)
     q, k, v = _qkv(rs, b, h, s, s, d, jnp.float32)
@@ -174,7 +177,7 @@ def test_chunked_causal_with_segments():
 
     def f(q, k, v):
         y = ap.fused_attention_rows(q, k, v, True, scale, (seg, seg),
-                                    True, 128)
+                                    True, 128, bwd_impl)
         return jnp.sum(jnp.sin(y))
 
     def r(q, k, v):
@@ -203,3 +206,57 @@ def test_supported_predicate():
     assert not ap.supported(1024, 1024, 512)  # d too large
     # giant sk: q block would fall below the minimum
     assert not ap.supported(8, 512 * 1024, 64)
+
+
+@pytest.mark.parametrize("impl", ["monolithic", "split"])
+@pytest.mark.parametrize("causal", [False, True])
+def test_bwd_impls_match_dense(impl, causal):
+    """Both backward structures (q-major accumulating kernel; split
+    dq + k-major dkv passes) produce dense-reference gradients."""
+    b, h, s, d = 1, 2, 256, 32
+    rs = np.random.RandomState(7)
+    q, k, v = _qkv(rs, b, h, s, s, d, jnp.float32)
+    scale = 1.0 / np.sqrt(d)
+
+    def f(q, k, v):
+        y = ap.fused_attention_rows(q, k, v, causal, scale, None, True,
+                                    None, impl)
+        return jnp.sum(jnp.sin(y))
+
+    def r(q, k, v):
+        y = _dense_attention(q, k, v, causal, scale, None)
+        return jnp.sum(jnp.sin(y))
+
+    gq, gk, gv = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    rq, rk, rv = jax.grad(r, argnums=(0, 1, 2))(q, k, v)
+    for g, ref in ((gq, rq), (gk, rk), (gv, rv)):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(ref),
+                                   atol=2e-4)
+
+
+def test_bwd_split_segments_rectangular():
+    """Split backward with segment ids and sq != sk (multi-q-block and
+    multi-k-block grids with the k-major pass)."""
+    b, h, sq, sk, d = 1, 1, 256, 512, 32
+    rs = np.random.RandomState(8)
+    q, k, v = _qkv(rs, b, h, sq, sk, d, jnp.float32)
+    seg_q = jnp.asarray(np.sort(rs.randint(0, 3, (b, sq)), axis=1),
+                        jnp.int32)
+    seg_kv = jnp.asarray(np.sort(rs.randint(0, 3, (b, sk)), axis=1),
+                         jnp.int32)
+    scale = 1.0 / np.sqrt(d)
+
+    def f(q, k, v):
+        y = ap.fused_attention_rows(q, k, v, False, scale, (seg_q, seg_kv),
+                                    True, 128, "split")
+        return jnp.sum(jnp.sin(y))
+
+    def r(q, k, v):
+        y = _dense_attention(q, k, v, False, scale, (seg_q, seg_kv))
+        return jnp.sum(jnp.sin(y))
+
+    gq, gk, gv = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    rq, rk, rv = jax.grad(r, argnums=(0, 1, 2))(q, k, v)
+    for g, ref in ((gq, rq), (gk, rk), (gv, rv)):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(ref),
+                                   atol=2e-4)
