@@ -329,6 +329,63 @@ def _bwd_dq_kernel(*refs, scale, causal, has_seg, bq):
     dcol_ref[0, 0] = dcol[:, 0]
 
 
+def _bwd_dq_kernel_chunked(*refs, scale, causal, has_seg, bq):
+    """Causal-skip variant of the split dq pass (see _bwd_kernel_chunked
+    for the skip/garbage rules) — without it the split default would pay
+    the full-score causal tax the monolithic chunked kernel avoids."""
+    if has_seg:
+        (q_ref, k_ref, v_ref, sq_ref, skv_ref, do_ref,
+         dq_ref, m_ref, l_ref, dcol_ref, s_scr, acc_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref,
+         dq_ref, m_ref, l_ref, dcol_ref, s_scr, acc_scr) = refs
+        sq_ref = skv_ref = None
+    q = q_ref[0, 0]
+    do = do_ref[0, 0]
+    rows = q.shape[0]
+    sk = k_ref.shape[2]
+    nk = sk // bq
+    iq = pl.program_id(2)
+    reach = iq * bq + rows - 1
+
+    for c in range(nk):
+        @pl.when(c * bq <= reach)
+        def _(c=c):
+            kc = k_ref[0, 0, c * bq:(c + 1) * bq, :]
+            s_scr[:, c * bq:(c + 1) * bq] = lax.dot_general(
+                q, kc, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * jnp.float32(scale)
+
+    masked = _masks(iq, bq, rows, sk, causal, sq_ref, skv_ref)
+    p, m, tot = _softmax_stats(s_scr[...], masked)
+
+    for c in range(nk):
+        @pl.when(c * bq <= reach)
+        def _(c=c):
+            vc = v_ref[0, 0, c * bq:(c + 1) * bq, :]
+            s_scr[:, c * bq:(c + 1) * bq] = lax.dot_general(
+                do, vc, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+    dp = s_scr[...]
+    pdp = jnp.where(masked, 0.0, p * dp) if masked is not None else p * dp
+    dcol = jnp.sum(pdp, axis=-1, keepdims=True)
+    ds = (pdp - p * dcol) * jnp.float32(scale)
+
+    acc_scr[...] = jnp.zeros_like(acc_scr)
+    for c in range(nk):
+        @pl.when(c * bq <= reach)
+        def _(c=c):
+            sl = slice(c * bq, (c + 1) * bq)
+            kc = k_ref[0, 0, sl, :]
+            acc_scr[...] += lax.dot_general(
+                ds[:, sl].astype(q.dtype), kc, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+    dq_ref[0, 0] = acc_scr[...].astype(dq_ref.dtype)
+    m_ref[0, 0] = m[:, 0]
+    l_ref[0, 0] = tot[:, 0]
+    dcol_ref[0, 0] = dcol[:, 0]
+
+
 def _bwd_dkv_kernel(*refs, scale, causal, has_seg, bq, sq):
     """Split backward, pass 2 (k-major): each (b, h, k-block) grid step
     owns its [bk, d] dk/dv blocks outright — no accumulation across grid
@@ -437,6 +494,11 @@ BWD_IMPL = "split"
 
 
 def set_bwd_impl(impl):
+    """Set the process-wide backward-structure *preference*. Shapes that
+    fail ``_split_ok`` fall back to monolithic silently (a model may mix
+    eligible and ineligible layers); a per-call ``bwd_impl=`` is a strict
+    demand and raises instead — benchmark rows use the per-call form so
+    their labels stay truthful."""
     global BWD_IMPL
     if impl not in ("monolithic", "split"):
         raise ValueError(f"unknown rows bwd impl {impl!r}")
@@ -525,14 +587,20 @@ def _bwd_split(causal, sm_scale, interpret, block_q, res, g):
     vecspec = pl.BlockSpec((1, 1, bq), lambda ib, ih, iq: (ib, ih, iq))
     vecshape = jax.ShapeDtypeStruct((b, h, sq), jnp.float32)
 
+    dq_kern, dq_scratch = _bwd_dq_kernel, []
+    if _chunked(causal, bq, sq, sk):
+        dq_kern = _bwd_dq_kernel_chunked
+        dq_scratch = [pltpu.VMEM((bq, sk), jnp.float32),
+                      pltpu.VMEM((bq, d), jnp.float32)]
     dq, m, l, dcol = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=float(sm_scale),
+        functools.partial(dq_kern, scale=float(sm_scale),
                           causal=causal, has_seg=has_seg, bq=bq),
         grid=(b, h, sq // bq),
         in_specs=ins + [qspec],
         out_specs=(qspec, vecspec, vecspec, vecspec),
         out_shape=(jax.ShapeDtypeStruct(q.shape, q.dtype),
                    vecshape, vecshape, vecshape),
+        scratch_shapes=dq_scratch,
         interpret=interpret,
     )(q, k, v, *_seg_ops(segment_ids), g)
 

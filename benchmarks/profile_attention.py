@@ -146,7 +146,14 @@ from apex_tpu.ops import attention_pallas as ap
 if not SMOKE and ap.supported(S, S, D):
     vmem_rows = lambda q, k, v: ap.fused_attention_rows(
         q, k, v, True, float(sm), None)
-    measure("vmem-rows kernel (dq-only protocol)", vmem_rows)
+    # dq-only protocol rows pin bwd_impl: custom_vjp runs the full
+    # backward even under grad-wrt-q, so an unpinned row would silently
+    # re-measure whatever BWD_IMPL defaults to (the committed r3 0.346 ms
+    # number was monolithic)
+    for impl in ("monolithic", "split"):
+        measure(f"vmem-rows {impl}-bwd (dq-only protocol)",
+                lambda q, k, v, impl=impl: ap.fused_attention_rows(
+                    q, k, v, True, float(sm), None, False, None, impl))
     # backward-structure A/B (the PERF.md §3 decision row): monolithic
     # q-major accumulation vs split dq + k-major dkv passes
     for impl in ("monolithic", "split"):

@@ -52,6 +52,14 @@ if os.environ.get("APEX_ATTN_IMPL"):
 # half of the profile_xent.py head-to-head
 FUSED_HEAD = os.environ.get("APEX_FUSED_LM_HEAD") == "1"
 
+# APEX_LN_PALLAS=1 routes every FusedLayerNorm in the step through the
+# Pallas row kernel — the step-level half of the profile_layernorm.py
+# head-to-head (h=768 is the GPT-2-small trunk's LN width)
+if os.environ.get("APEX_LN_PALLAS") == "1":
+    from apex_tpu.normalization import fused_layer_norm as _fln
+
+    _fln.USE_PALLAS = True
+
 B, S = (2, 128) if SMOKE else (8, 1024)
 K = 2 if SMOKE else 32  # scan length
 PEAK = 197e12  # v5e bf16 peak FLOP/s

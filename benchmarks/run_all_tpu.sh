@@ -36,6 +36,7 @@ run gpt              1200 python benchmarks/profile_gpt.py
 # step-level A/B halves of the late-kernel decision procedures (PERF.md §7)
 run gpt_rows          900 env APEX_ATTN_IMPL=rows python benchmarks/profile_gpt.py
 run gpt_fused_head    900 env APEX_FUSED_LM_HEAD=1 python benchmarks/profile_gpt.py
+run gpt_ln_pallas     900 env APEX_LN_PALLAS=1 python benchmarks/profile_gpt.py
 run resnet           1200 python benchmarks/profile_resnet.py
 run pretrain         1800 python benchmarks/profile_pretrain.py
 run bench            5900 python bench.py
