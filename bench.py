@@ -52,12 +52,21 @@ def main():
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
 
+    # Kernel-dispatch knobs shared with benchmarks/profile_gpt.py
+    # (benchmarks/_knobs.py): the measured winners (PERF.md §3/§4/§7)
+    # can be adopted or A/B'd without editing the bench.
+    from benchmarks._knobs import apply_dispatch_knobs, fused_head_requested
+
+    apply_dispatch_knobs()
+    fused_head = fused_head_requested()
+
     # GPT-2 small shapes on TPU; tiny on CPU (local smoke)
     if on_tpu:
         cfg = TransformerConfig(
             hidden_size=768, num_layers=12, num_attention_heads=12,
             vocab_size=50304, max_position_embeddings=1024,
-            hidden_dropout=0.0, attention_dropout=0.0, bf16=True)
+            hidden_dropout=0.0, attention_dropout=0.0, bf16=True,
+            fused_lm_head=fused_head)
         # b=16 doubles the round-2 batch while staying in the
         # known-to-compile envelope of the tunneled remote-compile helper
         # (b=32 compiles stalled it — see PERF.md); override to taste
@@ -68,7 +77,8 @@ def main():
         cfg = TransformerConfig(
             hidden_size=128, num_layers=2, num_attention_heads=4,
             vocab_size=512, max_position_embeddings=128,
-            hidden_dropout=0.0, attention_dropout=0.0, bf16=True)
+            hidden_dropout=0.0, attention_dropout=0.0, bf16=True,
+            fused_lm_head=fused_head, fused_lm_head_interpret=fused_head)
         b, s, iters = 2, 128, 3
         peak_flops = None
 

@@ -1,0 +1,33 @@
+"""Shared kernel-dispatch env knobs for the step-level A/B harnesses.
+
+One implementation consumed by both ``benchmarks/profile_gpt.py`` and
+``bench.py`` so the knob semantics cannot drift between them:
+
+* ``APEX_ATTN_IMPL={flash|rows}`` — process-wide attention kernel
+  (``ops.attention.set_default_impl``).
+* ``APEX_LN_PALLAS=1`` — route every FusedLayerNorm through the Pallas
+  row kernel (module-level ``USE_PALLAS``).
+* ``APEX_FUSED_LM_HEAD=1`` — swap the loss head for the Pallas fused
+  linear-CE kernel (``TransformerConfig.fused_lm_head``); pass
+  ``fused_head_requested()`` into the config, with
+  ``fused_lm_head_interpret`` True off-TPU so CPU smokes exercise it.
+"""
+
+import os
+
+
+def apply_dispatch_knobs():
+    """Apply the process-wide knobs (attention impl, layernorm kernel).
+    Call before building the model."""
+    if os.environ.get("APEX_ATTN_IMPL"):
+        from apex_tpu.ops.attention import set_default_impl
+
+        set_default_impl(os.environ["APEX_ATTN_IMPL"])
+    if os.environ.get("APEX_LN_PALLAS") == "1":
+        from apex_tpu.normalization import fused_layer_norm as _fln
+
+        _fln.USE_PALLAS = True
+
+
+def fused_head_requested():
+    return os.environ.get("APEX_FUSED_LM_HEAD") == "1"

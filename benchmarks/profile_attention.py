@@ -23,16 +23,29 @@ SMOKE = smoke_mode("APEX_BENCH_SMOKE")  # force-CPU tiny sanity mode
 from benchmarks._timing import measure_dispatch_overhead, sync  # noqa: E402
 
 B, H, S, D = (2, 2, 128, 32) if SMOKE else (8, 12, 1024, 64)
+# APEX_ATTN_SEQ overrides s (batch rescaled toward constant b*s tokens)
+# — measures the long-sequence crossover behind the ops.attention
+# dispatch rule (rows kernel capped at sk<=2048 by default). The full
+# 9-config flash block sweep is trimmed to the two known-good configs so
+# the crossover decision rows (which run last) fit the window budget.
+LONG_SEQ = not SMOKE and bool(os.environ.get("APEX_ATTN_SEQ"))
+if LONG_SEQ:
+    S = int(os.environ["APEX_ATTN_SEQ"])
+    B = max(1, 8 * 1024 // S)
+    if B * S != 8 * 1024:
+        print(f"note: b*s = {B * S} tokens (baseline rows used 8192) — "
+              f"compare MFU, not tokens/s, across seq lengths")
 K = 2 if SMOKE else 32
 # fwd = 4*b*h*s^2*d/2 (causal); bwd = 2x fwd
 FLOPS = 4 * B * H * S * S * D * 3 // 2
 PEAK = 197e12
 
 
-def measure(name, attn_fn, wrt_qkv=False):
+def measure(name, attn_fn, wrt_qkv=False, fwd_only=False):
     """wrt_qkv=False: fwd + dq only (the original protocol, kept for
     comparability with the recorded r3 numbers). wrt_qkv=True: fwd + the
-    full (dq, dk, dv) backward — what a training step actually pays."""
+    full (dq, dk, dv) backward — what a training step actually pays.
+    fwd_only=True: no grad at all — the inference protocol."""
     rs = np.random.RandomState(0)
     q0 = jnp.asarray(rs.randn(B, H, S, D), jnp.bfloat16)
     k0 = jnp.asarray(rs.randn(B, H, S, D), jnp.bfloat16)
@@ -40,7 +53,11 @@ def measure(name, attn_fn, wrt_qkv=False):
 
     def run(q, eps, k0, v0):
         def body(qc, _):
-            if wrt_qkv:
+            if fwd_only:
+                y = attn_fn(qc, k0, v0)
+                l = jnp.sum(y.astype(jnp.float32))
+                g = y[..., :1].astype(qc.dtype)  # feedback, no backward
+            elif wrt_qkv:
                 def f(qq, kk, vv):
                     return jnp.sum(attn_fn(qq, kk, vv).astype(jnp.float32))
                 l, (gq, gk, gv) = jax.value_and_grad(
@@ -63,8 +80,9 @@ def measure(name, attn_fn, wrt_qkv=False):
     t0 = time.perf_counter()
     sync(f(q0, jnp.float32(1e-30), k0, v0))
     dt = (time.perf_counter() - t0 - OVERHEAD) / K
-    print(f"{name:40s} {dt*1e3:8.3f} ms  {FLOPS/dt/1e12:6.1f} TF/s"
-          f"  MFU={FLOPS/dt/PEAK*100:5.1f}%")
+    flops = FLOPS // 3 if fwd_only else FLOPS  # fwd is 1/3 of fwd+bwd
+    print(f"{name:40s} {dt*1e3:8.3f} ms  {flops/dt/1e12:6.1f} TF/s"
+          f"  MFU={flops/dt/PEAK*100:5.1f}%")
     MEASURED.append(name)
     return dt
 
@@ -98,14 +116,16 @@ if SMOKE:
 
 # current repo config (512/512) and alternatives
 SWEEP = []
-for bq, bk in ([] if SMOKE else
-               [(512, 512), (512, 256), (256, 512), (256, 256), (128, 256),
-                (256, 128), (128, 128), (1024, 512), (512, 1024)]):
+_SWEEP_CFGS = [(512, 512), (512, 256), (256, 512), (256, 256), (128, 256),
+               (256, 128), (128, 128), (1024, 512), (512, 1024)]
+if LONG_SEQ:
+    _SWEEP_CFGS = [(512, 512), (512, 256)]
+for bq, bk in ([] if SMOKE else _SWEEP_CFGS):
     dt = measure(f"flash blocks q={bq} k={bk}", fa_with_blocks(bq, bk))
     if dt is not None:
         SWEEP.append((dt, bq, bk))
 
-if not SMOKE:
+if not SMOKE and not LONG_SEQ:
     measure("flash default blocks",
             lambda q, k, v: fa.flash_attention(q, k, v, causal=True,
                                                sm_scale=float(sm)))
@@ -126,16 +146,18 @@ try:
         return jax.vmap(lambda qq, kk, vv: kernel(qq * sm, kk, vv))(
             q.astype(jnp.float32).astype(jnp.bfloat16), k, v)
 
-    if not SMOKE:
+    if not SMOKE and not LONG_SEQ:
         measure("splash attention (default)", splash)
 except Exception as e:
     print(f"splash attention unavailable: {type(e).__name__}: {str(e)[:120]}")
 
-# XLA dense reference
+# XLA dense reference (skipped at long seq: the [b, h, s, s] fp32 scores
+# are a GB-scale HBM object — the class the degraded relay starves on)
 from apex_tpu.ops.attention import _dense_attention
 
-measure("XLA dense (materialized scores)",
-        lambda q, k, v: _dense_attention(q, k, v, True, float(sm), None))
+if not LONG_SEQ:
+    measure("XLA dense (materialized scores)",
+            lambda q, k, v: _dense_attention(q, k, v, True, float(sm), None))
 
 # self-authored VMEM-row kernel (ops/attention_pallas.py) vs the best
 # flash config, under BOTH protocols — the row kernel computes dk/dv
@@ -146,6 +168,12 @@ from apex_tpu.ops import attention_pallas as ap
 if not SMOKE and ap.supported(S, S, D):
     vmem_rows = lambda q, k, v: ap.fused_attention_rows(
         q, k, v, True, float(sm), None)
+    # inference protocol: fwd kernels alone — the rows kernel's
+    # single-pass structure vs flash's multi-pass fwd loop
+    measure("vmem-rows kernel fwd-only", vmem_rows, fwd_only=True)
+    measure("flash best blocks fwd-only",
+            fa_with_blocks(*((min(SWEEP)[1:]) if SWEEP else (1024, 512))),
+            fwd_only=True)
     # dq-only protocol rows pin bwd_impl: custom_vjp runs the full
     # backward even under grad-wrt-q, so an unpinned row would silently
     # re-measure whatever BWD_IMPL defaults to (the committed r3 0.346 ms
@@ -179,9 +207,11 @@ if not SMOKE and ap.supported(S, S, D):
     _, best_bq, best_bk = min(SWEEP) if SWEEP else (None, 1024, 512)
     measure(f"flash q={best_bq} k={best_bk} fwd+d(q,k,v)",
             fa_with_blocks(best_bq, best_bk), wrt_qkv=True)
-    measure("XLA dense fwd+d(q,k,v)",
-            lambda q, k, v: _dense_attention(q, k, v, True, float(sm), None),
-            wrt_qkv=True)
+    if not LONG_SEQ:
+        measure("XLA dense fwd+d(q,k,v)",
+                lambda q, k, v: _dense_attention(q, k, v, True, float(sm),
+                                                 None),
+                wrt_qkv=True)
 
 if not MEASURED:
     print("ERROR: no configuration produced a measurement")

@@ -39,26 +39,13 @@ from apex_tpu.optimizers.fused_adam import fused_adam
 from apex_tpu.transformer.parallel_state import TENSOR_AXIS
 from apex_tpu.transformer.testing import GPTModel, TransformerConfig
 
-# APEX_ATTN_IMPL={flash|rows} selects the attention kernel behind the
-# whole step (ops.attention.set_default_impl) — the step-level half of
-# the profile_attention.py kernel head-to-head
-if os.environ.get("APEX_ATTN_IMPL"):
-    from apex_tpu.ops.attention import set_default_impl
+# Step-level halves of the kernel head-to-heads (profile_attention /
+# profile_xent / profile_layernorm): APEX_ATTN_IMPL, APEX_FUSED_LM_HEAD,
+# APEX_LN_PALLAS — shared semantics with bench.py via benchmarks/_knobs
+from benchmarks._knobs import apply_dispatch_knobs, fused_head_requested
 
-    set_default_impl(os.environ["APEX_ATTN_IMPL"])
-
-# APEX_FUSED_LM_HEAD=1 swaps the loss head for the Pallas fused
-# linear-CE kernel (TransformerConfig.fused_lm_head) — the step-level
-# half of the profile_xent.py head-to-head
-FUSED_HEAD = os.environ.get("APEX_FUSED_LM_HEAD") == "1"
-
-# APEX_LN_PALLAS=1 routes every FusedLayerNorm in the step through the
-# Pallas row kernel — the step-level half of the profile_layernorm.py
-# head-to-head (h=768 is the GPT-2-small trunk's LN width)
-if os.environ.get("APEX_LN_PALLAS") == "1":
-    from apex_tpu.normalization import fused_layer_norm as _fln
-
-    _fln.USE_PALLAS = True
+apply_dispatch_knobs()
+FUSED_HEAD = fused_head_requested()
 
 B, S = (2, 128) if SMOKE else (8, 1024)
 K = 2 if SMOKE else 32  # scan length

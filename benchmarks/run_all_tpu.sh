@@ -37,6 +37,8 @@ run gpt              1200 python benchmarks/profile_gpt.py
 run gpt_rows          900 env APEX_ATTN_IMPL=rows python benchmarks/profile_gpt.py
 run gpt_fused_head    900 env APEX_FUSED_LM_HEAD=1 python benchmarks/profile_gpt.py
 run gpt_ln_pallas     900 env APEX_LN_PALLAS=1 python benchmarks/profile_gpt.py
+# long-sequence crossover behind the rows-vs-flash dispatch rule
+run attn_seq4096      900 env APEX_ATTN_SEQ=4096 python benchmarks/profile_attention.py
 run resnet           1200 python benchmarks/profile_resnet.py
 run pretrain         1800 python benchmarks/profile_pretrain.py
 run bench            5900 python bench.py
