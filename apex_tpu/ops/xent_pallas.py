@@ -32,8 +32,16 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-_ROW_BLOCK = 256
+# Row block sizes the number of full passes over E (n/br passes of
+# V*h*2 bytes each in fwd and again in dx): bigger blocks cut that
+# traffic linearly, so the cap is VMEM-derived per (h, bv) rather than a
+# constant — at GPT-2 shapes (h=768, bv=384) it resolves to 512, ~5 MB
+# in the worst kernel (dx: x + dx out + fp32 acc + logits + p tiles).
+# The vocab chunk is the largest lane-aligned divisor of V <= 512
+# (GPT-2's 50304 = 2^7*3*131 gives 384).
+_ROW_BLOCK = 512
 _MAX_VCHUNK = 512
+_VMEM_BUDGET = 8 * 1024 * 1024
 
 
 def _v_chunk(V):
@@ -45,10 +53,14 @@ def _v_chunk(V):
     return 0
 
 
-def _row_block(n):
+def _row_block(n, h, bv):
+    """Largest power-of-two row block dividing ``n``, capped at
+    _ROW_BLOCK and by the dx kernel's per-row VMEM bytes: x + dx out
+    (bf16) + fp32 acc = 8h, logits + p tiles = 8bv, per block row."""
+    cap = min(_ROW_BLOCK, _VMEM_BUDGET // (8 * h + 8 * bv))
     b = 8
     best = 0
-    while b <= _ROW_BLOCK:
+    while b <= cap:
         if n % b == 0:
             best = b
         b *= 2
@@ -57,7 +69,8 @@ def _row_block(n):
 
 def supported(n, V, h):
     """Whether the fused head handles X [n, h] x E [V, h]."""
-    return _v_chunk(V) != 0 and _row_block(n) != 0 and h % 128 == 0
+    bv = _v_chunk(V)
+    return bv != 0 and h % 128 == 0 and _row_block(n, h, bv) != 0
 
 
 def _hit(labels, iv, bv, rows):
@@ -169,7 +182,8 @@ def _fwd(x, embedding, labels, interpret):
     V = embedding.shape[0]
     if not supported(n, V, h):
         raise ValueError(f"xent_pallas: unsupported [{n},{h}]x[{V},{h}]")
-    br, bv = _row_block(n), _v_chunk(V)
+    bv = _v_chunk(V)
+    br = _row_block(n, h, bv)
     nb, nv = n // br, V // bv
     labs = labels.astype(jnp.int32).reshape(n, 1)
     xspec, espec, lspec = _common_specs(br, bv, h)
@@ -194,7 +208,8 @@ def _bwd_rule(interpret, res, g):
     x, embedding, labs, lse = res
     n, h = x.shape
     V = embedding.shape[0]
-    br, bv = _row_block(n), _v_chunk(V)
+    bv = _v_chunk(V)
+    br = _row_block(n, h, bv)
     nb, nv = n // br, V // bv
     xspec, espec, lspec = _common_specs(br, bv, h)
     dl = g.astype(jnp.float32).reshape(n, 1)

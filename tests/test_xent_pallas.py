@@ -44,7 +44,8 @@ def test_fwd_matches_reference(dtype):
 def test_grads_match_reference(dtype):
     """Multi row-block + multi vocab-chunk grid; non-uniform upstream
     cotangent exercises the dl plumbing in both bwd kernels."""
-    n, V, h = 512, 1280, 128  # nb=2 (row-block 256), nv=5 (chunk 256)
+    n, V, h = 1024, 1280, 128  # nb=2 (row-block 512), nv=5 (chunk 256)
+    assert xp._row_block(n, h, xp._v_chunk(V)) == 512  # keep nb > 1
     rs = np.random.RandomState(1)
     x, e, labels = _data(rs, n, V, h, dtype)
     w = jnp.asarray(rs.rand(n) + 0.5, jnp.float32)
