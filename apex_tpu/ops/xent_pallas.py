@@ -55,9 +55,16 @@ def _v_chunk(V):
 
 def _row_block(n, h, bv):
     """Largest power-of-two row block dividing ``n``, capped at
-    _ROW_BLOCK and by the dx kernel's per-row VMEM bytes: x + dx out
-    (bf16) + fp32 acc = 8h, logits + p tiles = 8bv, per block row."""
-    cap = min(_ROW_BLOCK, _VMEM_BUDGET // (8 * h + 8 * bv))
+    _ROW_BLOCK and by the backward kernels' VMEM model: the dE kernel
+    carries br-independent (bv, h) tiles (e bf16 + fp32 dE output block
+    = 6*bv*h bytes), and the worst per-block-row cost is
+    max(dx: x + dx out + fp32 acc + logits/p = 8h + 8bv,
+        dE: x + fp32 wx + logits/p/coeff = 6h + 10bv)."""
+    fixed = 6 * bv * h
+    if fixed >= _VMEM_BUDGET:
+        return 0
+    per_row = max(8 * h + 8 * bv, 6 * h + 10 * bv)
+    cap = min(_ROW_BLOCK, (_VMEM_BUDGET - fixed) // per_row)
     b = 8
     best = 0
     while b <= cap:
