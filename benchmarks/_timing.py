@@ -42,3 +42,17 @@ def measure_dispatch_overhead(k):
         sync(f(jnp.float32(0.0), jnp.float32(1e-30 * (i + 1))))
         best = min(best, time.perf_counter() - t0)
     return best
+
+
+def bench_k(smoke, default=128):
+    """Scan length for kernel-level microbenches (env ``APEX_BENCH_K``).
+
+    The relay's ±30 ms dispatch-overhead variance divides by K, so sub-ms
+    kernel rows need K >> 32 to resolve (~±0.25 ms at the 128 default);
+    scan length does not grow the compiled program. Step-level harnesses
+    (profile_gpt etc.) keep their own smaller fixed K — their rows are
+    10–100 ms, where K=16–32 noise is already <5%.
+    """
+    import os
+
+    return 2 if smoke else int(os.environ.get("APEX_BENCH_K", str(default)))

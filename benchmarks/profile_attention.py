@@ -20,7 +20,8 @@ from benchmarks._smoke import smoke_mode  # noqa: E402
 
 SMOKE = smoke_mode("APEX_BENCH_SMOKE")  # force-CPU tiny sanity mode
 
-from benchmarks._timing import measure_dispatch_overhead, sync  # noqa: E402
+from benchmarks._timing import (bench_k, measure_dispatch_overhead,
+                               sync)  # noqa: E402
 
 B, H, S, D = (2, 2, 128, 32) if SMOKE else (8, 12, 1024, 64)
 # APEX_ATTN_SEQ overrides s (batch rescaled toward constant b*s tokens)
@@ -35,7 +36,7 @@ if LONG_SEQ:
     if B * S != 8 * 1024:
         print(f"note: b*s = {B * S} tokens (baseline rows used 8192) — "
               f"compare MFU, not tokens/s, across seq lengths")
-K = 2 if SMOKE else 32
+K = bench_k(SMOKE)  # see benchmarks/_timing.bench_k
 # fwd = 4*b*h*s^2*d/2 (causal); bwd = 2x fwd
 FLOPS = 4 * B * H * S * S * D * 3 // 2
 PEAK = 197e12

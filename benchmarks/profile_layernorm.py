@@ -29,11 +29,12 @@ from benchmarks._smoke import smoke_mode  # noqa: E402
 
 SMOKE = smoke_mode("APEX_BENCH_SMOKE")  # force-CPU tiny sanity mode
 
-from benchmarks._timing import measure_dispatch_overhead, sync  # noqa: E402
+from benchmarks._timing import (bench_k, measure_dispatch_overhead,
+                               sync)  # noqa: E402
 
 from apex_tpu.normalization.fused_layer_norm import fused_layer_norm
 
-K = 2 if SMOKE else 32
+K = bench_k(SMOKE)  # see benchmarks/_timing.bench_k
 HBM = 819e9  # v5e
 
 OVERHEAD = measure_dispatch_overhead(K)

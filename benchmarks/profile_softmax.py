@@ -27,7 +27,8 @@ from benchmarks._smoke import smoke_mode  # noqa: E402
 
 SMOKE = smoke_mode("APEX_BENCH_SMOKE")  # force-CPU tiny sanity mode
 
-from benchmarks._timing import measure_dispatch_overhead, sync  # noqa: E402
+from benchmarks._timing import (bench_k, measure_dispatch_overhead,
+                               sync)  # noqa: E402
 
 from apex_tpu.ops import softmax_pallas
 from apex_tpu.transformer.functional.fused_softmax import (
@@ -35,7 +36,7 @@ from apex_tpu.transformer.functional.fused_softmax import (
     scaled_upper_triang_masked_softmax as jnp_causal,
 )
 
-K = 2 if SMOKE else 32
+K = bench_k(SMOKE)  # see benchmarks/_timing.bench_k
 HBM = 819e9  # v5e
 
 OVERHEAD = measure_dispatch_overhead(K)
