@@ -8,9 +8,11 @@ at those lengths a whole [block_q, sk] score row fits in VMEM, so each
 over the FULL key row, and the output matmul in one kernel — no online
 max/sum rescaling passes, no [s, s] tensor in HBM.
 
-Backward comes in two structures behind the measured ``BWD_IMPL`` knob:
+Backward comes in two structures behind the measured ``BWD_IMPL`` knob
+(monolithic is the default until the queued TPU A/B decides — see the
+knob's comment):
 
-* ``"split"`` (default): a q-major dq pass that recomputes S and P from
+* ``"split"``: a q-major dq pass that recomputes S and P from
   (q, k, v), forms dP = dO V^T, uses D = rowsum(dO * O) = rowsum(P * dP)
   to avoid needing O, writes dQ = dS K — and emits the per-row softmax
   stats (m, l, D) as [b, h, sq] fp32 byproducts; then a k-major dk/dv
@@ -489,8 +491,11 @@ def _pick_bq(sq, sk, block_q):
 # dk/dv across the sequential grid; "split" = a q-major dq pass (emitting
 # the (m, l, D) row stats) + a k-major dk/dv pass where each k-block is
 # computed exactly once. Measured knob (PERF.md §3/§7): the winner on the
-# fwd+d(q,k,v) protocol becomes the default.
-BWD_IMPL = "split"
+# fwd+d(q,k,v) protocol becomes the default — monolithic holds the seat
+# until the split A/B lands (split is interpret-parity-proven but its
+# TPU timing is queued on the relay; profile_attention.py carries the
+# decision rows).
+BWD_IMPL = "monolithic"
 
 
 def set_bwd_impl(impl):

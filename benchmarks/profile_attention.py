@@ -21,7 +21,7 @@ from benchmarks._smoke import smoke_mode  # noqa: E402
 SMOKE = smoke_mode("APEX_BENCH_SMOKE")  # force-CPU tiny sanity mode
 
 from benchmarks._timing import (bench_k, measure_dispatch_overhead,
-                               sync)  # noqa: E402
+                                sync)  # noqa: E402
 
 B, H, S, D = (2, 2, 128, 32) if SMOKE else (8, 12, 1024, 64)
 # APEX_ATTN_SEQ overrides s (batch rescaled toward constant b*s tokens)

@@ -30,7 +30,7 @@ from benchmarks._smoke import smoke_mode  # noqa: E402
 SMOKE = smoke_mode("APEX_BENCH_SMOKE")  # force-CPU tiny sanity mode
 
 from benchmarks._timing import (bench_k, measure_dispatch_overhead,
-                               sync)  # noqa: E402
+                                sync)  # noqa: E402
 
 from apex_tpu.normalization.fused_layer_norm import fused_layer_norm
 

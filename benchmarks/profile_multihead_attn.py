@@ -30,12 +30,13 @@ SMOKE = smoke_mode("APEX_MHA_SMOKE")  # tiny CPU sanity mode
 import jax.numpy as jnp  # noqa: E402
 from jax import lax  # noqa: E402
 
-from benchmarks._timing import measure_dispatch_overhead, sync  # noqa: E402
+from benchmarks._timing import (bench_k, measure_dispatch_overhead,  # noqa: E402
+                                sync)
 
 from apex_tpu.contrib.multihead_attn import SelfMultiheadAttn
 from apex_tpu.ops.attention import flash_supported  # noqa: E402
 
-K = 2 if SMOKE else 16
+K = bench_k(SMOKE)  # see benchmarks/_timing.bench_k
 PEAK = 197e12  # v5e bf16
 
 OVERHEAD = measure_dispatch_overhead(K)

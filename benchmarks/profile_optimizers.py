@@ -28,7 +28,8 @@ from benchmarks._smoke import smoke_mode  # noqa: E402
 
 SMOKE = smoke_mode("APEX_BENCH_SMOKE")  # force-CPU tiny sanity mode
 
-from benchmarks._timing import measure_dispatch_overhead, sync  # noqa: E402
+from benchmarks._timing import (bench_k, measure_dispatch_overhead,  # noqa: E402
+                                sync)
 
 from apex_tpu.optimizers.fused_adam import fused_adam  # noqa: E402
 from apex_tpu.optimizers.fused_lamb import fused_lamb  # noqa: E402
@@ -36,7 +37,7 @@ from apex_tpu.optimizers.fused_sgd import fused_sgd  # noqa: E402
 
 # SMOKE forces the CPU backend, so it implies the tiny branches
 ON_TPU = not SMOKE and jax.devices()[0].platform == "tpu"
-K = 32 if ON_TPU else 2
+K = bench_k(not ON_TPU)  # see benchmarks/_timing.bench_k
 HBM = 819e9  # v5e
 
 # GPT-2-small-like parameter set: a few big 2D tensors + many small ones

@@ -28,7 +28,7 @@ from benchmarks._smoke import smoke_mode  # noqa: E402
 SMOKE = smoke_mode("APEX_BENCH_SMOKE")  # force-CPU tiny sanity mode
 
 from benchmarks._timing import (bench_k, measure_dispatch_overhead,
-                               sync)  # noqa: E402
+                                sync)  # noqa: E402
 
 from apex_tpu.ops import softmax_pallas
 from apex_tpu.transformer.functional.fused_softmax import (
