@@ -1,0 +1,36 @@
+"""The driver-facing bench.py contract: one parseable JSON line with the
+required fields, produced end-to-end in CPU smoke mode. A broken bench at
+driver time means no headline measurement for the round, so this is
+regression-tested like any other interface."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_bench_smoke_json_contract():
+    # one attempt with a sub-test-timeout budget: bench's own timeout
+    # path then fires first on a slow box, yielding a deterministic
+    # error-JSON line instead of subprocess.run SIGKILLing the watchdog
+    # (which would bypass its SIGTERM flush and orphan the inner child)
+    env = dict(os.environ, APEX_BENCH_SMOKE="1", APEX_BENCH_ATTEMPTS="1",
+               APEX_BENCH_TIMEOUT="420")
+    env.pop("JAX_PLATFORMS", None)  # smoke_mode forces CPU itself
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
+    assert lines, out.stdout[-2000:]
+    rec = json.loads(lines[-1])
+    for field in ("metric", "value", "unit", "vs_baseline", "mfu"):
+        assert field in rec, rec
+    assert rec["unit"] == "tokens/s"
+    assert rec["value"] > 0, rec
+    assert "error" not in rec, rec
