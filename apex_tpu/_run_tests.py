@@ -37,7 +37,7 @@ SUITES = {
     "ops": ["test_ops_attention.py", "test_softmax_pallas.py",
             "test_attention_pallas.py", "test_xent_pallas.py"],
     "api_parity": ["test_api_parity_round3.py"],
-    "harness": ["test_run_tests.py"],
+    "harness": ["test_run_tests.py", "test_bench_contract.py"],
     "checkpoint": ["test_checkpoint.py"],
     "data": ["test_data.py"],
     "examples": ["test_examples.py"],

@@ -27,7 +27,9 @@ def test_bench_smoke_json_contract():
         capture_output=True, text=True, timeout=600, env=env)
     assert out.returncode == 0, out.stderr[-2000:]
     lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
-    assert lines, out.stdout[-2000:]
+    # the driver reads ONE JSON line — a second (e.g. per-attempt debug
+    # record) is a contract break even if the last line is well-formed
+    assert len(lines) == 1, out.stdout[-2000:]
     rec = json.loads(lines[-1])
     for field in ("metric", "value", "unit", "vs_baseline", "mfu"):
         assert field in rec, rec
