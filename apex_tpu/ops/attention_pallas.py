@@ -116,7 +116,12 @@ def _p_from_stats(s, m, tot, masked):
     [rows, 1] stats — same exclusion and zero-row semantics as
     ``_softmax_stats`` (whose outputs m/tot must come from the same
     mask)."""
-    e = jnp.exp(s - m)
+    # Fully-masked rows save m = finfo.min, so an unclamped s - m
+    # overflows to +inf in the k-major pass before the where() discards
+    # it. s - m <= 0 holds for every live row (m is that row's max), so
+    # clamping at 0 is exact — and keeps e finite for any future
+    # arithmetic inserted before the mask (e.g. a fused scale).
+    e = jnp.exp(jnp.minimum(s - m, 0.0))
     if masked is not None:
         e = jnp.where(masked, 0.0, e)
     return jnp.where(tot > 0, e / jnp.where(tot > 0, tot, 1.0), 0.0)

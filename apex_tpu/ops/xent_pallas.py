@@ -24,6 +24,7 @@ materialized path). Tested against the jnp reference in interpret mode
 """
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -37,9 +38,11 @@ from jax.experimental.pallas import tpu as pltpu
 # traffic linearly, so the cap is VMEM-derived per (h, bv) rather than a
 # constant — at GPT-2 shapes (h=768, bv=384) it resolves to 512, ~5 MB
 # in the worst kernel (dx: x + dx out + fp32 acc + logits + p tiles).
+# APEX_XENT_ROW_BLOCK overrides the cap (escape hatch if Mosaic's
+# double-buffering pushes the modeled 6.5 MB over real VMEM on device).
 # The vocab chunk is the largest lane-aligned divisor of V <= 512
 # (GPT-2's 50304 = 2^7*3*131 gives 384).
-_ROW_BLOCK = 512
+_ROW_BLOCK = int(os.environ.get("APEX_XENT_ROW_BLOCK", "512"))
 _MAX_VCHUNK = 512
 _VMEM_BUDGET = 8 * 1024 * 1024
 

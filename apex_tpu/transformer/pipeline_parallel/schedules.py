@@ -8,24 +8,32 @@ The reference drives NCCL p2p send/recv from Python, hand-ordering a warmup /
 steady-1F1B / cooldown sequence per rank. On TPU the whole schedule is ONE
 jitted SPMD program inside ``shard_map`` over the "pp" mesh axis:
 
-  * a ``lax.scan`` over T = num_microbatches + pp − 1 ticks carries each
-    stage's live activation; ``lax.ppermute`` shifts activations one stage
-    ahead per tick (the p2p boundary, reference p2p_communication.py:117);
-  * every device runs the same stage trunk; bubbles are masked ticks;
-  * **the backward schedule is not hand-written at all** — differentiating
-    through the scan+ppermute reverses the permutation and replays the
-    ticks in reverse order, which IS the mirrored pipeline (cooldown ↔
-    warmup swap).
+  * a ``lax.scan`` over ticks carries each stage's live activation;
+    ``lax.ppermute`` shifts activations one stage ahead per tick (the
+    p2p boundary, reference p2p_communication.py:117);
+  * every device runs the same stage trunk; bubbles are masked ticks.
 
-Memory (measured — benchmarks/profile_pipeline_memory.py, PERF.md §5):
-AD-of-scan saves residuals for every tick, so activation memory grows
-O(T = M + pp − 1) in the microbatch count — a GPipe-shaped profile, not
-true 1F1B's O(pp) in-flight bound. The ``checkpoint_stages`` knob
-(``jax.checkpoint`` around the trunk) shrinks the per-tick residual to
-the stage-boundary activation — measured 9.9x smaller than the
-uncheckpointed trunk internals (~0.6 MB vs ~6.2 MB per extra microbatch
-at the test shape) — which is what makes long microbatch trains viable;
-the trunk internals are recomputed one tick at a time in backward.
+Two backward cores (measured — benchmarks/profile_pipeline_memory.py,
+PERF.md §5), selected by ``impl`` / ``APEX_PP_IMPL``:
+
+  * ``"1f1b"`` (default) — ``pipeline_fwd_bwd_1f1b``: every tick runs
+    one forward AND one manually-vjp'd backward per stage; the scan
+    carries a (2·pp − 1)-slot ring of stage inputs and is never
+    differentiated, so live activation memory is **O(pp), flat in M** —
+    the true 1F1B in-flight bound the reference's hand schedule exists
+    for (measured: 1.58 MB carry at every M, zero AD residuals).
+  * ``"adscan"`` — the fwd-only scan differentiated with reverse-mode
+    AD: the backward schedule falls out of reversing the ppermute, but
+    AD saves one residual per tick — O(M + pp) GPipe-shaped memory
+    (measured: ~0.6 MB per extra microbatch checkpointed, ~6.2 MB
+    uncheckpointed). Kept for A/B and as the interleaved
+    (virtual-pipeline) core, where AD's reversal handles the
+    chunk-wrapped ring for free.
+
+``checkpoint_stages`` (``jax.checkpoint`` around the trunk): under
+adscan it shrinks the per-tick residual to the stage-boundary
+activation (9.9x, PERF.md §5); under 1f1b it bounds the *within-tick*
+vjp peak the same way (the cross-tick state is the ring either way).
 
 Stage heterogeneity (embedding on the first stage, loss head on the last —
 the reference's ``pre_process``/``post_process``, common.py:30-80) is
@@ -36,6 +44,7 @@ owning stage's contribution.
 """
 
 import functools
+import os
 import warnings
 
 
@@ -116,6 +125,128 @@ def forward_backward_no_pipelining(forward_step_func, batch, params, *,
     if grad_mean:
         grads = jax.tree_util.tree_map(lambda g: g / num_mb, grads)
     return losses, grads
+
+
+# ---------------------------------------------------------------------------
+# the true-1F1B core: O(pp) in-flight residuals, backprop inside the scan
+# ---------------------------------------------------------------------------
+
+def pipeline_fwd_bwd_1f1b(stage_fn, stage_params, embed_fn, embed_params,
+                          loss_fn, head_params, microbatches,
+                          num_microbatches, *, axis_name=PIPELINE_AXIS,
+                          checkpoint_stages=True):
+    """One-forward-one-backward schedule with the true 1F1B memory bound.
+
+    The reference's 1F1B loop
+    (fwd_bwd_pipelining_without_interleaving.py:228, warmup = pp-rank-1
+    at :292) exists to cap in-flight activations at O(pp). The AD-of-scan
+    schedule (``pipeline_forward``) cannot reach that bound: reverse-mode
+    AD saves one residual per scan tick, O(M + pp). This schedule gets
+    the bound the TPU-native way — **backprop is part of the forward
+    program**. Every scan tick runs, on every stage,
+
+      * one forward: advance microbatch ``t - p`` one stage (as in
+        ``pipeline_forward``), saving only the stage INPUT into a ring
+        buffer of ``R = 2·pp - 1`` slots;
+      * one backward: for microbatch ``t - 2(pp-1) + p`` — whose output
+        cotangent just arrived over the reverse ``ppermute`` ring — pop
+        its saved input, rebuild the stage vjp by recompute
+        (``jax.vjp``; the same recompute real 1F1B pays under Megatron's
+        activation checkpointing), accumulate param grads, and send the
+        input cotangent downstream.
+
+    The scan itself is never differentiated, so it holds NO AD residuals:
+    live activation state is exactly the ring buffer — ``2·pp - 1`` stage
+    inputs per device, **independent of M** (the uniform fwd+bwd tick
+    issues microbatches at 1F1B's steady-state rate but pays the full
+    2(pp-1)-tick turnaround as in-flight depth, hence 2·pp - 1 rather
+    than the reference's pp; both are O(pp)). Ticks: T = M + 2(pp-1),
+    one pipeline-fill longer than GPipe's M + pp - 1.
+
+    Stage heterogeneity stays masked-SPMD: the head's vjp runs every tick
+    on every stage and is where-masked to the last stage (its dy seeds
+    that stage's trunk backward in the SAME tick — the fwd→bwd
+    turnaround), the embed vjp likewise masked to stage 0.
+
+    Returns ``(local mean loss, (stage, embed, head) grad trees)`` with
+    the same conventions as ``pipeline_forward`` + AD: loss and
+    embed/head grads are nonzero only on their owning stage (callers
+    psum), stage grads are per-device.
+    """
+    pp = lax.axis_size(axis_name)
+    p = lax.axis_index(axis_name)
+    M = num_microbatches
+    R = 2 * pp - 1              # max residual lifetime: 2(pp-1) ticks
+    T = M + 2 * (pp - 1)
+
+    mb0 = _index_microbatch(microbatches, 0)
+    act = jax.eval_shape(embed_fn, embed_params, mb0)
+    trunk = jax.checkpoint(stage_fn) if checkpoint_stages else stage_fn
+
+    fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
+    bwd_perm = [(i, (i - 1) % pp) for i in range(pp)]
+
+    def masked_add(acc, new, live):
+        return jax.tree_util.tree_map(
+            lambda a, n: a + jnp.where(live, n, 0).astype(a.dtype),
+            acc, new)
+
+    def tick(carry, t):
+        acts, cot_up, ring, gs, ge, gh, loss_acc = carry
+
+        # ---- forward: stage p advances microbatch t - p one stage
+        mb_f = _index_microbatch(microbatches, jnp.clip(t, 0, M - 1))
+        x0 = embed_fn(embed_params, mb_f)
+        x_in = jnp.where((p == 0) & (t < M), x0, acts)
+        ring = lax.dynamic_update_index_in_dim(ring, x_in, t % R, 0)
+        y = trunk(stage_params, x_in, 0)
+
+        # ---- head fwd+vjp (live on the last stage): microbatch t-(pp-1)
+        m_h = t - (pp - 1)
+        mb_h = _index_microbatch(microbatches, jnp.clip(m_h, 0, M - 1))
+        loss, head_vjp = jax.vjp(
+            lambda hp, h: loss_fn(hp, h, mb_h), head_params, y)
+        dhp, dy = head_vjp(jnp.ones_like(loss))
+        head_live = (p == pp - 1) & (m_h >= 0) & (m_h < M)
+        loss_acc = loss_acc + jnp.where(head_live, loss, 0.0)
+        gh = masked_add(gh, dhp, head_live)
+
+        # ---- backward: stage p backprops microbatch t - 2(pp-1) + p.
+        # Its input was saved 2(pp-1-p) ticks ago; on the last stage that
+        # is THIS tick's slot (the fwd→bwd turnaround), and its incoming
+        # cotangent is the head's dy rather than the ppermute'd one.
+        m_b = t - 2 * (pp - 1) + p
+        x_b = lax.dynamic_index_in_dim(
+            ring, (t - 2 * (pp - 1 - p)) % R, 0, keepdims=False)
+        cot_in = jnp.where(p == pp - 1, dy, cot_up)
+        _, trunk_vjp = jax.vjp(
+            lambda sp, x: trunk(sp, x, 0), stage_params, x_b)
+        dsp, dx = trunk_vjp(cot_in)
+        b_live = (m_b >= 0) & (m_b < M)
+        gs = masked_add(gs, dsp, b_live)
+
+        # ---- embed vjp (live on stage 0): close out microbatch m_b
+        mb_b = _index_microbatch(microbatches, jnp.clip(m_b, 0, M - 1))
+        _, embed_vjp = jax.vjp(lambda ep: embed_fn(ep, mb_b), embed_params)
+        (dep,) = embed_vjp(dx)
+        ge = masked_add(ge, dep, b_live & (p == 0))
+
+        acts_next = lax.ppermute(y, axis_name, fwd_perm)
+        cot_next = lax.ppermute(dx, axis_name, bwd_perm)
+        return (acts_next, cot_next, ring, gs, ge, gh, loss_acc), None
+
+    zero_act = jnp.zeros(act.shape, act.dtype)
+    carry0 = (zero_act, zero_act,
+              jnp.zeros((R,) + act.shape, act.dtype),
+              _tree_zeros_like(stage_params),
+              _tree_zeros_like(embed_params),
+              _tree_zeros_like(head_params),
+              jnp.zeros((), jnp.float32))
+    (_, _, _, gs, ge, gh, loss_sum), _ = lax.scan(
+        tick, carry0, jnp.arange(T))
+
+    mean = lambda tree: jax.tree_util.tree_map(lambda g: g / M, tree)
+    return loss_sum / M, (mean(gs), mean(ge), mean(gh))
 
 
 # ---------------------------------------------------------------------------
@@ -213,8 +344,8 @@ def pipeline_forward(stage_fn, stage_params, embed_fn, embed_params,
 def forward_backward_pipelining_without_interleaving(
         forward_step_func, batch, params, *, num_microbatches,
         axis_name=PIPELINE_AXIS, forward_only=False,
-        checkpoint_stages=True, **_compat):
-    """1F1B-equivalent schedule (reference:
+        checkpoint_stages=True, impl=None, **_compat):
+    """1F1B schedule (reference:
     fwd_bwd_pipelining_without_interleaving.py:228).
 
     ``params = (stage_params, embed_params, head_params)`` and
@@ -222,12 +353,19 @@ def forward_backward_pipelining_without_interleaving(
     split of the reference's pre_process/post_process model wrapping.
     Returns (mean loss, grads pytree or None). Call inside shard_map over
     the pp axis.
+
+    ``impl``: ``"1f1b"`` (default; ``pipeline_fwd_bwd_1f1b`` — true O(pp)
+    in-flight memory, matching the reference's capability) or
+    ``"adscan"`` (the AD-of-scan schedule — O(M + pp) residual memory,
+    kept for A/B and as the only interleaved-capable core). ``None``
+    reads ``APEX_PP_IMPL`` then falls back to "1f1b"; an explicit
+    unknown value raises.
     """
     return _pipelined_fwd_bwd(forward_step_func, batch, params,
                               num_microbatches=num_microbatches,
                               axis_name=axis_name, forward_only=forward_only,
                               checkpoint_stages=checkpoint_stages,
-                              num_chunks=1)
+                              num_chunks=1, impl=impl)
 
 
 def forward_backward_pipelining_with_interleaving(
@@ -246,7 +384,7 @@ def forward_backward_pipelining_with_interleaving(
 
 def _pipelined_fwd_bwd(forward_step_func, batch, params, *, num_microbatches,
                        axis_name, forward_only, checkpoint_stages,
-                       num_chunks):
+                       num_chunks, impl=None):
     stage_fn, embed_fn, loss_fn = forward_step_func
     stage_params, embed_params, head_params = params
 
@@ -257,12 +395,34 @@ def _pipelined_fwd_bwd(forward_step_func, batch, params, *, num_microbatches,
             num_microbatches, axis_name=axis_name,
             checkpoint_stages=checkpoint_stages, num_chunks=num_chunks)
 
+    explicit = impl is not None
+    if impl is None:
+        impl = os.environ.get("APEX_PP_IMPL", "1f1b")
+    if impl not in ("1f1b", "adscan"):
+        raise ValueError(f"unknown pipeline impl {impl!r} "
+                         "(expected '1f1b' or 'adscan')")
+    if impl == "1f1b" and num_chunks > 1:
+        # the interleaved (virtual pipeline) core only exists AD-scan
+        # shaped; an explicit 1f1b request there is un-honorable
+        if explicit:
+            raise ValueError("impl='1f1b' does not support num_chunks > 1; "
+                             "the interleaved schedule is AD-scan only")
+        impl = "adscan"
+
     if forward_only:
+        # forward-only has one core (the fwd scan) regardless of impl;
+        # validation still applies so a typo'd knob cannot pass silently
         return lax.psum(loss_of(params), axis_name), None
 
-    loss_local, grads = jax.value_and_grad(loss_of)(
-        (stage_params, embed_params, head_params))
-    gs, ge, gh = grads
+    if impl == "1f1b":
+        loss_local, (gs, ge, gh) = pipeline_fwd_bwd_1f1b(
+            stage_fn, stage_params, embed_fn, embed_params, loss_fn,
+            head_params, batch, num_microbatches, axis_name=axis_name,
+            checkpoint_stages=checkpoint_stages)
+    else:
+        loss_local, grads = jax.value_and_grad(loss_of)(
+            (stage_params, embed_params, head_params))
+        gs, ge, gh = grads
     # stage grads are per-device (varying); embed/head params are
     # pp-replicated, so their logical grad is the sum of each stage copy's
     # contribution (only the owning stage's is nonzero — the masked selects

@@ -222,6 +222,67 @@ def factorize_mesh(n_devices):
     return pp, dp, tp
 
 
+def toy_batch(vocab_size, num_microbatches, global_mb, seq_len):
+    """The deterministic [M, global_mb, s] ids/labels batch every minimal
+    run (and its parity reference) shares."""
+    rs = np.random.RandomState(0)
+    return {
+        "ids": jnp.asarray(rs.randint(
+            0, vocab_size,
+            (num_microbatches, global_mb, seq_len)), jnp.int32),
+        "labels": jnp.asarray(rs.randint(
+            0, vocab_size,
+            (num_microbatches, global_mb, seq_len)), jnp.int32),
+    }
+
+
+def reference_first_step_loss(cfg, pp, batch, device=None):
+    """Single-device recomputation of the first-step loss of
+    ``run_minimal_gpt_training(cfg, topology=(pp, dp, tp))``.
+
+    Same modules, same per-stage init keys (``fold_in(k_s, stage)``
+    mirrors init_params' pipeline-rank fork), but the microbatches run
+    sequentially through the stage chunks on ONE device — no pipeline
+    ring, no dp slicing, no tp sharding. Agreement with the n-device run
+    certifies the 3D-parallel step computes the same function, not merely
+    a finite one (the reference's L0 run_transformer tests make the same
+    1-rank-vs-n-rank comparison).
+    """
+    if device is None:
+        device = jax.devices("cpu")[0]
+    mesh = Mesh(np.asarray([device]).reshape(1, 1, 1),
+                (PIPELINE_AXIS, DATA_AXIS, TENSOR_AXIS))
+    embed_mod = GPTEmbed(cfg)
+    stage_mod = GPTStage(cfg, layers_per_stage=cfg.num_layers // pp)
+    head_mod = GPTHead(cfg)
+    M = batch["ids"].shape[0]
+
+    def f(batch):
+        mb0 = {k: v[0] for k, v in batch.items()}
+        k_e, k_s, k_h = jax.random.split(jax.random.PRNGKey(0), 3)
+        ep = embed_mod.init(k_e, mb0["ids"])["params"]
+        hidden0 = embed_mod.apply({"params": ep}, mb0["ids"])
+        stage_params = [
+            stage_mod.init(jax.random.fold_in(k_s, s), hidden0)["params"]
+            for s in range(pp)]
+        hp = head_mod.init(k_h, hidden0, mb0["labels"])["params"]
+
+        def mb_loss(i):
+            mb = {k: v[i] for k, v in batch.items()}
+            h = embed_mod.apply({"params": ep}, mb["ids"])
+            for sp in stage_params:
+                h = stage_mod.apply({"params": sp}, h)
+            return head_mod.apply({"params": hp}, h, mb["labels"])
+
+        return jnp.mean(jnp.stack([mb_loss(i) for i in range(M)]))
+
+    g = jax.jit(jax.shard_map(
+        f, mesh=mesh,
+        in_specs=({"ids": P(), "labels": P()},), out_specs=P(),
+        check_vma=False))
+    return float(np.asarray(jax.block_until_ready(g(batch))))
+
+
 def run_minimal_gpt_training(n_devices=None, cfg=None, num_microbatches=4,
                              micro_batch_size=2, seq_len=16, num_steps=1,
                              devices=None, topology=None):
@@ -256,16 +317,8 @@ def run_minimal_gpt_training(n_devices=None, cfg=None, num_microbatches=4,
     _, init_params = make_gpt_fns(cfg, pp)
     step, tx, scaler = gpt_train_step_fn(cfg, pp, num_microbatches)
 
-    rs = np.random.RandomState(0)
     global_mb = micro_batch_size * dp
-    batch = {
-        "ids": jnp.asarray(rs.randint(
-            0, cfg.vocab_size,
-            (num_microbatches, global_mb, seq_len)), jnp.int32),
-        "labels": jnp.asarray(rs.randint(
-            0, cfg.vocab_size,
-            (num_microbatches, global_mb, seq_len)), jnp.int32),
-    }
+    batch = toy_batch(cfg.vocab_size, num_microbatches, global_mb, seq_len)
 
     def whole_run(batch):
         params = init_params(jax.random.PRNGKey(0),

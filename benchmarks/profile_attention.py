@@ -172,8 +172,12 @@ if not SMOKE and ap.supported(S, S, D):
     # inference protocol: fwd kernels alone — the rows kernel's
     # single-pass structure vs flash's multi-pass fwd loop
     measure("vmem-rows kernel fwd-only", vmem_rows, fwd_only=True)
-    measure("flash best blocks fwd-only",
-            fa_with_blocks(*((min(SWEEP)[1:]) if SWEEP else (1024, 512))),
+    # pin the actual (bq, bk) into the label: with an empty SWEEP this
+    # row is the hardcoded fallback, and in LONG_SEQ mode "best" is only
+    # best-of-the-trimmed-sweep — the label must say which config ran
+    _fo_bq, _fo_bk = (min(SWEEP)[1:]) if SWEEP else (1024, 512)
+    measure(f"flash q={_fo_bq} k={_fo_bk} fwd-only",
+            fa_with_blocks(_fo_bq, _fo_bk),
             fwd_only=True)
     # dq-only protocol rows pin bwd_impl: custom_vjp runs the full
     # backward even under grad-wrt-q, so an unpinned row would silently

@@ -83,7 +83,8 @@ def batch():
     return rng.randn(M, 2, HID).astype(np.float32)
 
 
-def run_pipeline(batch, chunks=1, forward_only=False):
+def run_pipeline(batch, chunks=1, forward_only=False, impl=None,
+                 num_microbatches=M):
     mesh = pp_mesh()
     stacked = np.stack([stage_weight(p, chunks) for p in range(PP)])
     e = jnp.asarray(1.5)
@@ -94,10 +95,12 @@ def run_pipeline(batch, chunks=1, forward_only=False):
 
     def run(mbs, sp):
         sp = sp[0]  # drop the sharded singleton: local stage params
-        kwargs = dict(num_microbatches=M, axis_name="pp",
+        kwargs = dict(num_microbatches=num_microbatches, axis_name="pp",
                       forward_only=forward_only)
         if chunks > 1:
             kwargs["num_model_chunks"] = chunks
+        if impl is not None:
+            kwargs["impl"] = impl
         loss, grads = fwd_bwd(
             (stage_fn, embed_fn, loss_fn), mbs, (sp, e, c), **kwargs)
         if grads is None:
@@ -111,7 +114,7 @@ def run_pipeline(batch, chunks=1, forward_only=False):
     return np.asarray(loss), np.asarray(gs), np.asarray(ge), np.asarray(gc)
 
 
-def sequential_reference_grads(batch, chunks=1):
+def sequential_reference_grads(batch, chunks=1, num_microbatches=M):
     """jax.grad of the closed-form sequential composition."""
     stacked = jnp.asarray(
         np.stack([stage_weight(p, chunks) for p in range(PP)]))
@@ -120,14 +123,14 @@ def sequential_reference_grads(batch, chunks=1):
         sp, e, c = args
         # virtual stage order: chunk-major — v0p0..v0p3, v1p0..v1p3
         total = 0.0
-        for m in range(M):
+        for m in range(num_microbatches):
             h = embed_fn(e, jnp.asarray(batch[m]))
             for v in range(chunks):
                 for p in range(PP):
                     w = sp[p, v] if chunks > 1 else sp[p]
                     h = stage_fn(w, h, v)
             total = total + loss_fn(c, h, jnp.asarray(batch[m]))
-        return total / M
+        return total / num_microbatches
 
     args = (stacked, jnp.asarray(1.5), jnp.asarray(2.0))
     loss, grads = jax.value_and_grad(loss_of)(args)
@@ -157,6 +160,57 @@ def test_pipeline_interleaved_matches_sequential(batch):
     np.testing.assert_allclose(gs, rgs, rtol=1e-4, atol=1e-6)
     np.testing.assert_allclose(ge, rge, rtol=1e-4, atol=1e-6)
     np.testing.assert_allclose(gc, rgc, rtol=1e-4, atol=1e-6)
+
+
+def test_pipeline_1f1b_matches_adscan(batch):
+    """The O(pp)-memory 1f1b core and the AD-of-scan core are the same
+    function: identical loss and all three grad trees."""
+    a = run_pipeline(batch, impl="1f1b")
+    b = run_pipeline(batch, impl="adscan")
+    for got, want in zip(a, b):
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-7)
+
+
+@pytest.mark.parametrize("m", [1, 2])
+def test_pipeline_1f1b_fewer_microbatches_than_stages(m):
+    """M < pp exercises a pipeline that never reaches steady state —
+    every tick is warmup/cooldown masking."""
+    rng = np.random.RandomState(1)
+    small = rng.randn(m, 2, HID).astype(np.float32)
+    loss, gs, ge, gc = run_pipeline(small, impl="1f1b", num_microbatches=m)
+    ref_loss, (rgs, rge, rgc) = sequential_reference_grads(
+        small, num_microbatches=m)
+    np.testing.assert_allclose(loss.item(), ref_loss.item(), rtol=1e-5)
+    np.testing.assert_allclose(gs, rgs, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(ge, rge, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(gc, rgc, rtol=1e-4, atol=1e-6)
+
+
+def test_pipeline_impl_knob_validation(batch):
+    with pytest.raises(ValueError, match="unknown pipeline impl"):
+        run_pipeline(batch, impl="bogus")
+    # validation applies on the forward-only path too
+    with pytest.raises(ValueError, match="unknown pipeline impl"):
+        run_pipeline(batch, impl="bogus", forward_only=True)
+    # explicit 1f1b + interleaving is un-honorable (per-call knobs raise)
+    with pytest.raises(ValueError, match="num_chunks > 1"):
+        from apex_tpu.transformer.pipeline_parallel.schedules import (
+            _pipelined_fwd_bwd,
+        )
+        mesh = pp_mesh()
+
+        def run(mbs, sp):
+            loss, _ = _pipelined_fwd_bwd(
+                (stage_fn, embed_fn, loss_fn), mbs,
+                (sp[0], jnp.asarray(1.5), jnp.asarray(2.0)),
+                num_microbatches=M, axis_name="pp", forward_only=False,
+                checkpoint_stages=True, num_chunks=2, impl="1f1b")
+            return loss
+
+        shard_map(run, mesh=mesh, in_specs=(P(), P("pp")), out_specs=P(),
+                  check_vma=False)(
+            jnp.asarray(batch),
+            jnp.asarray(np.stack([stage_weight(p, 2) for p in range(PP)])))
 
 
 def test_pipeline_forward_only(batch):
@@ -211,6 +265,16 @@ def test_constant_microbatches():
     assert calc.get_current_global_batch_size() == 32
     with pytest.raises(AssertionError):
         ConstantNumMicroBatches(33, 2, 4)
+
+
+def test_rampup_zero_ramp_samples():
+    """ramp_samples=0 with start < final is an instant ramp, not a
+    division by zero (the constructor itself admits ramp_samples >= 0)."""
+    calc = RampupBatchsizeNumMicroBatches(
+        start_batch_size=4, batch_size_increment=4, ramup_samples=0,
+        global_batch_size=8, micro_batch_size=1, data_parallel_size=1)
+    assert calc.get_current_global_batch_size() == 8
+    assert calc.get() == 8
 
 
 def test_rampup_microbatches():
@@ -295,3 +359,28 @@ def test_minimal_gpt_training_deep_topologies(topology):
         micro_batch_size=1, seq_len=16, num_steps=2)
     assert len(losses) == 2
     assert all(np.isfinite(l) for l in losses)
+
+
+def test_minimal_gpt_loss_parity_vs_single_device():
+    """The 8-device (pp, dp, tp) first-step loss must equal a sequential
+    1-device replay of the same model/init/batch — the same check
+    __graft_entry__.dryrun_multichip asserts for the driver."""
+    from apex_tpu.transformer.testing import TransformerConfig
+    from apex_tpu.transformer.testing.minimal import (
+        reference_first_step_loss,
+        run_minimal_gpt_training,
+        toy_batch,
+    )
+
+    pp, dp, tp = 2, 2, 2
+    cfg = TransformerConfig(
+        hidden_size=64, num_layers=2 * pp, num_attention_heads=4,
+        vocab_size=128, max_position_embeddings=16,
+        hidden_dropout=0.0, attention_dropout=0.0, bf16=True,
+        apply_query_key_layer_scaling=False)
+    losses = run_minimal_gpt_training(
+        n_devices=8, cfg=cfg, topology=(pp, dp, tp), num_microbatches=4,
+        micro_batch_size=2, seq_len=16, num_steps=1)
+    ref = reference_first_step_loss(
+        cfg, pp, toy_batch(cfg.vocab_size, 4, 2 * dp, 16))
+    assert abs(losses[0] - ref) <= 0.05, (losses[0], ref)
