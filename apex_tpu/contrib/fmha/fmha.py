@@ -7,12 +7,16 @@ as [total_tokens, 3, h, d] + cu_seqlens prefix offsets).
 TPU design: varlen packing exists to avoid padding waste on GPUs; on TPU
 the same effect comes from segment-id masking — the packed token stream
 stays packed, and attention is computed blockwise with a segment mask so
-tokens only attend within their own sequence. This implementation keeps
-the packed layout end-to-end (no unpack/pad round trip) and computes
-one [total, total] masked attention in the amp compute dtype; the
-dedicated Pallas flash-attention kernel (apex_tpu.ops) takes over for
-long totals, identical semantics.
+tokens only attend within their own sequence. Both training and eval
+route through fused kernels at lane-aligned totals: eval through
+``apex_tpu.ops.fused_attention``, dropout training through the VMEM-row
+kernel's in-kernel counter-hash dropout (replayed exactly in backward,
+mirroring fmhalib's Philox-offset replay — reference fmha.py:33-61), so
+the [total, total] probability matrix never reaches HBM in either mode.
+The dense computation below survives only as the odd-shape fallback.
 """
+
+import os
 
 import jax
 import jax.numpy as jnp
@@ -21,6 +25,15 @@ from apex_tpu.utils import train_dropout
 import numpy as np
 from flax import linen as nn
 from jax import lax
+
+# Dropout-training kernel preference: "fused" (in-kernel hash dropout —
+# the default, on the memory-capability argument documented at the call
+# site) or "dense" (materialized probs + jax.random dropout — the
+# escape hatch while the device speed A/B is queued).
+DROPOUT_IMPL = os.environ.get("APEX_FMHA_DROPOUT", "fused")
+if DROPOUT_IMPL not in ("fused", "dense"):
+    raise ValueError(f"APEX_FMHA_DROPOUT={DROPOUT_IMPL!r} "
+                     "(expected 'fused' or 'dense')")
 
 
 def _segment_ids_from_cu_seqlens(cu_seqlens, total):
@@ -58,6 +71,31 @@ def fmha_varlen(qkv, cu_seqlens, p_dropout=0.0, max_s=512,
             v.transpose(1, 0, 2)[None],
             sm_scale=1.0 / np.sqrt(d),
             segment_ids=(seg[None], seg[None]))
+        return ctx[0].transpose(1, 0, 2).astype(qkv.dtype)
+
+    from apex_tpu.ops import attention_pallas
+
+    if rng is None:
+        raise ValueError("dropout requires an rng key")
+    if (DROPOUT_IMPL == "fused"
+            and attention_pallas.supported(total, total, d, dropout=True)):
+        # fused dropout-training path: probability dropout happens INSIDE
+        # the VMEM-row kernel (counter-hash mask, replayed in backward),
+        # so the [total, total] attention matrix never reaches HBM — the
+        # capability fmhalib's Philox-offset replay provides on GPU
+        # (reference apex/contrib/fmha/fmha.py:33-61). The default is the
+        # memory-capability argument (at MLPerf packing the dense probs
+        # are the HBM blow-up fmhalib exists to avoid); the device speed
+        # A/B (profile_attention.py dropout rows) is queued — PERF.md §7.
+        # The dense path below remains as the odd-shape fallback and the
+        # APEX_FMHA_DROPOUT=dense escape hatch.
+        seed = jax.random.randint(rng, (1, 1), -2**31, 2**31 - 1, jnp.int32)
+        interpret = jax.devices()[0].platform == "cpu"
+        ctx = attention_pallas.fused_attention_rows(
+            q.transpose(1, 0, 2)[None], k.transpose(1, 0, 2)[None],
+            v.transpose(1, 0, 2)[None], False, 1.0 / np.sqrt(d),
+            (seg[None], seg[None]), interpret, None, None,
+            float(p_dropout), seed)
         return ctx[0].transpose(1, 0, 2).astype(qkv.dtype)
 
     same_seg = (seg[:, None] == seg[None, :]) & valid[:, None] \

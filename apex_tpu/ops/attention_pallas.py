@@ -53,23 +53,31 @@ from jax.experimental.pallas import tpu as pltpu
 
 _VMEM_BUDGET = 10 * 1024 * 1024  # fp32 [bq, sk] working-set bytes
 _BWD_ARRAYS = 4  # S/P, dP, dS live + headroom (bwd is the tight pass)
+# dropout keeps two extra [bq, sk] fp32 arrays live in the backward (the
+# keep-scale tile and the dropped probs), so its q block is sized for a
+# 6-array working set
+_DROP_BWD_ARRAYS = 6
 
 
-def _q_block(sq, sk):
+def _q_block(sq, sk, n_arrays=_BWD_ARRAYS):
     """Largest power-of-two q block dividing sq whose bwd working set
-    ([bq, sk] fp32 x _BWD_ARRAYS) fits the budget (0 → unsupported)."""
+    ([bq, sk] fp32 x n_arrays) fits the budget (0 → unsupported)."""
     from apex_tpu.ops.attention import _block
 
-    cap = max(1, _VMEM_BUDGET // (4 * sk * _BWD_ARRAYS))
+    cap = max(1, _VMEM_BUDGET // (4 * sk * n_arrays))
     b = _block(sq, cap)
     return b if b >= 8 else 0
 
 
-def supported(sq, sk, d):
+def supported(sq, sk, d, dropout=False):
     """Whether the VMEM-row kernel handles [.., sq, d] x [.., sk, d].
     sk must be lane-aligned; d bounded so the [sk, d] K/V operands and
-    fp32 dk/dv accumulators stay small next to the score rows."""
-    return sk % 128 == 0 and d <= 256 and _q_block(sq, sk) != 0
+    fp32 dk/dv accumulators stay small next to the score rows. Pass
+    ``dropout=True`` when a dropout_p > 0 call is intended — the dropout
+    backward's larger working set shrinks the viable q block and can
+    push a shape that fits the plain kernel out of budget."""
+    n_arrays = _DROP_BWD_ARRAYS if dropout else _BWD_ARRAYS
+    return sk % 128 == 0 and d <= 256 and _q_block(sq, sk, n_arrays) != 0
 
 
 def _masks(iq, bq, rows, sk, causal, seg_q, seg_kv, col0=0,
@@ -127,11 +135,69 @@ def _p_from_stats(s, m, tot, masked):
     return jnp.where(tot > 0, e / jnp.where(tot > 0, tot, 1.0), 0.0)
 
 
-def _fwd_kernel(*refs, scale, causal, has_seg, bq):
+# ---------------------------------------------------------------------------
+# attention dropout: counter-based PRNG, replayed exactly in backward
+# ---------------------------------------------------------------------------
+#
+# The mask is a pure hash of the GLOBAL element coordinate (b, h, row,
+# col) and the step seed — murmur3's fmix32 finalizer on a flat counter.
+# Tile-layout independent by construction: the backward pass (any block
+# size, any q-major/k-major order) regenerates bit-identical keep
+# decisions without storing the [sq, sk] mask in HBM — the same
+# replay-from-counter design as fmhalib's Philox offsets
+# (reference apex/contrib/fmha/fmha.py:33-61 saves rng_state instead).
+# Plain jnp uint32 ops so it lowers on Mosaic AND in interpret mode
+# (pltpu.prng_* has no CPU interpret rule), and tests can rebuild the
+# dense mask with the very same function.
+
+def _fmix32(x):
+    """murmur3 32-bit finalizer: full avalanche on distinct inputs."""
+    x = x ^ (x >> jnp.uint32(16))
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> jnp.uint32(13))
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> jnp.uint32(16))
+    return x
+
+
+def _dropout_mscale(seed, ib, ih, row0, rows, sk, p, n_heads, sq_total):
+    """fp32 [rows, sk] inverted-dropout scale (keep/(1-p), drop→0) for
+    the score block whose global rows start at ``row0``. ``seed`` is a
+    traced uint32/int32 scalar; ``ib``/``ih`` the batch/head indices.
+
+    Every index input is coerced to uint32 BEFORE any arithmetic: a
+    traced int32 (``pl.program_id``) in the chain silently demotes the
+    whole hash to int32, and the ``bits >= thresh`` compare then wraps
+    thresh negative — an always-keep mask that drops nothing.
+    """
+    u32 = lambda x: jnp.asarray(x).astype(jnp.uint32)
+    row = u32(row0) + lax.broadcasted_iota(jnp.uint32, (rows, sk), 0)
+    col = lax.broadcasted_iota(jnp.uint32, (rows, sk), 1)
+    flat = ((u32(ib) * jnp.uint32(n_heads) + u32(ih))
+            * jnp.uint32(sq_total) + row) * jnp.uint32(sk) + col
+    # hash the seed once so consecutive seeds give decorrelated masks
+    # (a raw counter+seed would just shift the pattern by one element)
+    s = _fmix32(jnp.uint32(0x9E3779B9) ^ u32(seed))
+    bits = _fmix32(flat ^ s)
+    assert bits.dtype == jnp.uint32, bits.dtype
+    thresh = jnp.uint32(min(max(p, 0.0), 1.0) * 4294967296.0)
+    keep = bits >= thresh
+    return jnp.where(keep, jnp.float32(1.0 / (1.0 - p)), jnp.float32(0.0))
+
+
+def _fwd_kernel(*refs, scale, causal, has_seg, bq, dropout_p=0.0,
+                n_heads=1, sq_total=0):
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    i = 3
+    sq_ref = skv_ref = seed_ref = None
     if has_seg:
-        q_ref, k_ref, v_ref, sq_ref, skv_ref, o_ref = refs
-    else:
-        (q_ref, k_ref, v_ref, o_ref), sq_ref, skv_ref = refs, None, None
+        sq_ref, skv_ref = refs[i:i + 2]
+        i += 2
+    if dropout_p > 0.0:
+        seed_ref = refs[i]
+        i += 1
+    o_ref = refs[i]
     q = q_ref[0, 0]
     k = k_ref[0, 0]
     v = v_ref[0, 0]
@@ -140,8 +206,13 @@ def _fwd_kernel(*refs, scale, causal, has_seg, bq):
     s = s * jnp.float32(scale)
     masked = _masks(pl.program_id(2), bq, q.shape[0], k.shape[0],
                     causal, sq_ref, skv_ref)
-    p = _softmax(s, masked).astype(v.dtype)
-    o = lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+    p = _softmax(s, masked)
+    if dropout_p > 0.0:
+        p = p * _dropout_mscale(
+            seed_ref[0, 0], pl.program_id(0), pl.program_id(1),
+            pl.program_id(2) * bq, q.shape[0], k.shape[0], dropout_p,
+            n_heads, sq_total)
+    o = lax.dot_general(p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
                         preferred_element_type=jnp.float32)
     o_ref[0, 0] = o.astype(o_ref.dtype)
 
@@ -187,13 +258,19 @@ def _fwd_kernel_chunked(*refs, scale, causal, has_seg, bq):
     o_ref[0, 0] = o_scr[...].astype(o_ref.dtype)
 
 
-def _bwd_kernel(*refs, scale, causal, has_seg, bq):
+def _bwd_kernel(*refs, scale, causal, has_seg, bq, dropout_p=0.0,
+                n_heads=1, sq_total=0):
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    i = 3
+    sq_ref = skv_ref = seed_ref = None
     if has_seg:
-        (q_ref, k_ref, v_ref, sq_ref, skv_ref, do_ref,
-         dq_ref, dk_ref, dv_ref) = refs
-    else:
-        (q_ref, k_ref, v_ref, do_ref, dq_ref, dk_ref, dv_ref) = refs
-        sq_ref = skv_ref = None
+        sq_ref, skv_ref = refs[i:i + 2]
+        i += 2
+    if dropout_p > 0.0:
+        seed_ref = refs[i]
+        i += 1
+    do_ref, dq_ref, dk_ref, dv_ref = refs[i:i + 4]
     q = q_ref[0, 0]
     k = k_ref[0, 0]
     v = v_ref[0, 0]
@@ -205,12 +282,25 @@ def _bwd_kernel(*refs, scale, causal, has_seg, bq):
     masked = _masks(pl.program_id(2), bq, q.shape[0], k.shape[0],
                     causal, sq_ref, skv_ref)
     p = _softmax(s, masked)
-    p_lo = p.astype(q.dtype)
 
     # dP in fp32; D = rowsum(P * dP) == rowsum(dO * O) so O is not needed
     dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                          preferred_element_type=jnp.float32)
-    dcol = jnp.sum(p * dp, axis=-1, keepdims=True)
+    if dropout_p > 0.0:
+        # replay the fwd keep mask from the counter hash: out = (P∘m)V,
+        # so dV uses the dropped probs, dL/dP = m∘(dO V^T), and the
+        # softmax-bwd row term rowsum(P ∘ dL/dP) == rowsum(Pd ∘ dP_raw)
+        mscale = _dropout_mscale(
+            seed_ref[0, 0], pl.program_id(0), pl.program_id(1),
+            pl.program_id(2) * bq, q.shape[0], k.shape[0], dropout_p,
+            n_heads, sq_total)
+        pd = p * mscale
+        p_lo = pd.astype(q.dtype)          # feeds dV
+        dcol = jnp.sum(pd * dp, axis=-1, keepdims=True)
+        dp = dp * mscale
+    else:
+        p_lo = p.astype(q.dtype)
+        dcol = jnp.sum(p * dp, axis=-1, keepdims=True)
     ds = (p * (dp - dcol) * jnp.float32(scale)).astype(q.dtype)
 
     dq = lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
@@ -481,8 +571,8 @@ def _chunked(causal, bq, sq, sk):
     return causal and bq % 128 == 0 and sk % bq == 0 and sq >= 2 * bq
 
 
-def _pick_bq(sq, sk, block_q):
-    bq = _q_block(sq, sk)
+def _pick_bq(sq, sk, block_q, n_arrays=_BWD_ARRAYS):
+    bq = _q_block(sq, sk, n_arrays)
     if block_q is not None:
         if sq % block_q or block_q > bq:
             raise ValueError(
@@ -515,30 +605,61 @@ def set_bwd_impl(impl):
     BWD_IMPL = impl
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 6, 7, 8, 9))
 def fused_attention_rows(q, k, v, causal, sm_scale, segment_ids=None,
-                         interpret=False, block_q=None, bwd_impl=None):
+                         interpret=False, block_q=None, bwd_impl=None,
+                         dropout_p=0.0, dropout_seed=None):
     """VMEM-row fused attention. q: [b, h, sq, d]; k, v: [b, h, sk, d];
     segment_ids: None or (seg_q [b, sq], seg_kv [b, sk]). Check
     ``supported(sq, sk, d)`` first. ``interpret=True`` for CPU tests.
     ``block_q`` overrides the auto q-block (benchmark sweeps);
-    ``bwd_impl`` overrides the module-level ``BWD_IMPL``."""
+    ``bwd_impl`` overrides the module-level ``BWD_IMPL``.
+
+    ``dropout_p`` > 0 applies inverted attention-probability dropout
+    INSIDE the kernel (counter-hash mask, replayed in backward — no
+    [sq, sk] mask in HBM); requires a traced int32 ``dropout_seed``
+    of shape (1, 1). Dropout forces the monolithic backward (an
+    explicit ``bwd_impl="split"`` request raises)."""
     if bwd_impl is not None and bwd_impl not in ("monolithic", "split"):
         raise ValueError(f"unknown rows bwd impl {bwd_impl!r}")
+    if not 0.0 <= dropout_p < 1.0:
+        raise ValueError(f"dropout_p={dropout_p} outside [0, 1)")
+    if dropout_p > 0.0 and bwd_impl == "split":
+        raise ValueError("dropout requires the monolithic backward")
     return _fwd(q, k, v, causal, sm_scale, segment_ids, interpret,
-                block_q)[0]
+                block_q, dropout_p, dropout_seed)[0]
 
 
-def _fwd(q, k, v, causal, sm_scale, segment_ids, interpret, block_q=None):
+def _drop_ops(dropout_p, dropout_seed):
+    if dropout_p <= 0.0:
+        return []
+    if dropout_seed is None:
+        raise ValueError("dropout_p > 0 requires dropout_seed")
+    seed = jnp.asarray(dropout_seed).reshape(1, 1)
+    return [seed.astype(jnp.int32)]
+
+
+def _drop_spec(dropout_p):
+    if dropout_p <= 0.0:
+        return []
+    return [pl.BlockSpec((1, 1), lambda ib, ih, iq: (0, 0))]
+
+
+def _fwd(q, k, v, causal, sm_scale, segment_ids, interpret, block_q=None,
+         dropout_p=0.0, dropout_seed=None):
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    if not supported(sq, sk, d):
-        raise ValueError(f"attention_pallas: unsupported {q.shape}x{k.shape}")
-    bq = _pick_bq(sq, sk, block_q)
+    if not supported(sq, sk, d, dropout=dropout_p > 0.0):
+        raise ValueError(f"attention_pallas: unsupported {q.shape}x{k.shape}"
+                         + (" with dropout" if dropout_p > 0.0 else ""))
+    n_arrays = _DROP_BWD_ARRAYS if dropout_p > 0.0 else _BWD_ARRAYS
+    bq = _pick_bq(sq, sk, block_q, n_arrays)
     has_seg = segment_ids is not None
     ins, qspec, _ = _specs(b, h, bq, sq, sk, d, has_seg)
-    kern, scratch = _fwd_kernel, []
-    if _chunked(causal, bq, sq, sk):
+    kern = functools.partial(_fwd_kernel, dropout_p=dropout_p, n_heads=h,
+                             sq_total=sq)
+    scratch = []
+    if dropout_p <= 0.0 and _chunked(causal, bq, sq, sk):
         kern = _fwd_kernel_chunked
         scratch = [pltpu.VMEM((bq, sk), jnp.float32),
                    pltpu.VMEM((bq, d), jnp.float32)]
@@ -546,29 +667,35 @@ def _fwd(q, k, v, causal, sm_scale, segment_ids, interpret, block_q=None):
         functools.partial(kern, scale=float(sm_scale), causal=causal,
                           has_seg=has_seg, bq=bq),
         grid=(b, h, sq // bq),
-        in_specs=ins,
+        in_specs=ins + _drop_spec(dropout_p),
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=scratch,
         interpret=interpret,
-    )(q, k, v, *_seg_ops(segment_ids))
-    return o, (q, k, v, segment_ids)
+    )(q, k, v, *_seg_ops(segment_ids), *_drop_ops(dropout_p, dropout_seed))
+    return o, (q, k, v, segment_ids, dropout_seed)
 
 
 def _fwd_rule(q, k, v, causal, sm_scale, segment_ids, interpret,
-              block_q=None, bwd_impl=None):
-    return _fwd(q, k, v, causal, sm_scale, segment_ids, interpret, block_q)
+              block_q=None, bwd_impl=None, dropout_p=0.0,
+              dropout_seed=None):
+    return _fwd(q, k, v, causal, sm_scale, segment_ids, interpret, block_q,
+                dropout_p, dropout_seed)
 
 
-def _bwd_monolithic(causal, sm_scale, interpret, block_q, res, g):
-    q, k, v, segment_ids = res
+def _bwd_monolithic(causal, sm_scale, interpret, block_q, res, g,
+                    dropout_p=0.0):
+    q, k, v, segment_ids, dropout_seed = res
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    bq = _pick_bq(sq, sk, block_q)
+    n_arrays = _DROP_BWD_ARRAYS if dropout_p > 0.0 else _BWD_ARRAYS
+    bq = _pick_bq(sq, sk, block_q, n_arrays)
     has_seg = segment_ids is not None
     ins, qspec, kvspec = _specs(b, h, bq, sq, sk, d, has_seg)
-    kern, scratch = _bwd_kernel, []
-    if _chunked(causal, bq, sq, sk):
+    kern = functools.partial(_bwd_kernel, dropout_p=dropout_p, n_heads=h,
+                             sq_total=sq)
+    scratch = []
+    if dropout_p <= 0.0 and _chunked(causal, bq, sq, sk):
         kern = _bwd_kernel_chunked
         scratch = [pltpu.VMEM((bq, sk), jnp.float32),
                    pltpu.VMEM((bq, d), jnp.float32)]
@@ -576,19 +703,20 @@ def _bwd_monolithic(causal, sm_scale, interpret, block_q, res, g):
         functools.partial(kern, scale=float(sm_scale), causal=causal,
                           has_seg=has_seg, bq=bq),
         grid=(b, h, sq // bq),
-        in_specs=ins + [qspec],
+        in_specs=ins + _drop_spec(dropout_p) + [qspec],
         out_specs=(qspec, kvspec, kvspec),
         out_shape=(jax.ShapeDtypeStruct(q.shape, q.dtype),
                    jax.ShapeDtypeStruct(k.shape, jnp.float32),
                    jax.ShapeDtypeStruct(v.shape, jnp.float32)),
         scratch_shapes=scratch,
         interpret=interpret,
-    )(q, k, v, *_seg_ops(segment_ids), g)
-    return (dq, dk.astype(k.dtype), dv.astype(v.dtype), None)
+    )(q, k, v, *_seg_ops(segment_ids),
+      *_drop_ops(dropout_p, dropout_seed), g)
+    return (dq, dk.astype(k.dtype), dv.astype(v.dtype), None, None)
 
 
 def _bwd_split(causal, sm_scale, interpret, block_q, res, g):
-    q, k, v, segment_ids = res
+    q, k, v, segment_ids, _ = res  # no dropout on the split path
     b, h, sq, d = q.shape
     sk = k.shape[2]
     bq = _pick_bq(sq, sk, block_q)
@@ -636,7 +764,7 @@ def _bwd_split(causal, sm_scale, interpret, block_q, res, g):
                         pltpu.VMEM((bk, d), jnp.float32)],
         interpret=interpret,
     )(q, k, v, *_seg_ops(segment_ids), g, m, l, dcol)
-    return (dq, dk.astype(k.dtype), dv.astype(v.dtype), None)
+    return (dq, dk.astype(k.dtype), dv.astype(v.dtype), None, None)
 
 
 def _split_ok(sq, sk, d, bq, itemsize):
@@ -655,11 +783,20 @@ def _split_ok(sq, sk, d, bq, itemsize):
     return resident <= _VMEM_BUDGET
 
 
-def _bwd_rule(causal, sm_scale, interpret, block_q, bwd_impl, res, g):
+def _bwd_rule(causal, sm_scale, interpret, block_q, bwd_impl, dropout_p,
+              res, g):
     if bwd_impl is not None and bwd_impl not in ("monolithic", "split"):
         raise ValueError(f"unknown rows bwd impl {bwd_impl!r}")
+    if dropout_p > 0.0:
+        # the split structure has no dropout replay wired through its two
+        # passes; the per-call demand raises (fused_attention_rows already
+        # pre-checks this), the process-wide preference falls back
+        if bwd_impl == "split":
+            raise ValueError("dropout requires the monolithic backward")
+        return _bwd_monolithic(causal, sm_scale, interpret, block_q, res,
+                               g, dropout_p)
     impl = bwd_impl or BWD_IMPL
-    q, k, v, _ = res
+    q, k, v, _, _ = res
     sq, sk = q.shape[2], k.shape[2]
     bq = _pick_bq(sq, sk, block_q)
     ok = _split_ok(sq, sk, q.shape[3], bq, q.dtype.itemsize)

@@ -208,6 +208,22 @@ if not SMOKE and ap.supported(S, S, D):
                             q, k, v, True, float(sm), None, False, rbq,
                             impl),
                         wrt_qkv=True)
+    # in-kernel dropout (the fmha training path): hash-mask cost
+    # isolated by pinning everything else — non-causal (so neither row
+    # can take the chunked causal-skip kernels) at the DROPOUT path's
+    # auto block size for both rows
+    _dbq = ap._pick_bq(S, S, None, ap._DROP_BWD_ARRAYS)
+    _dseed = jnp.asarray([[123]], jnp.int32)
+    measure(f"vmem-rows noncausal block_q={_dbq} no-dropout fwd+d(q,k,v)",
+            lambda q, k, v: ap.fused_attention_rows(
+                q, k, v, False, float(sm), None, False, _dbq,
+                "monolithic"),
+            wrt_qkv=True)
+    measure(f"vmem-rows noncausal block_q={_dbq} dropout=0.1 fwd+d(q,k,v)",
+            lambda q, k, v: ap.fused_attention_rows(
+                q, k, v, False, float(sm), None, False, _dbq, None,
+                0.1, _dseed),
+            wrt_qkv=True)
     # compare against whatever flash config actually won today's sweep
     _, best_bq, best_bk = min(SWEEP) if SWEEP else (None, 1024, 512)
     measure(f"flash q={best_bq} k={best_bk} fwd+d(q,k,v)",

@@ -778,6 +778,16 @@ def test_api_parity_audit_tool():
     assert out.returncode == 0, out.stdout + out.stderr
     assert ", 0 MISSING" in out.stdout, out.stdout
 
+    # scoped mode: name collisions across modules can't mask a gap
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "check_api_parity.py"),
+         "--reference", ref, "--per-module"],
+        capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "MISSING" not in out.stdout, out.stdout
+    # every mapped group actually audited (none silently skipped)
+    assert out.stdout.count("— ok") >= 20, out.stdout
+
 
 def test_round3_small_surface_behaviors(state_guard):
     """Behavioral coverage for the last parity batch: amp.master_params

@@ -129,7 +129,8 @@ def test_grads_segment_ids_multiblock():
 
 
 @pytest.mark.parametrize("bwd_impl", ["monolithic", "split"])
-@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("dtype", [
+    jnp.float32, pytest.param(jnp.bfloat16, marks=pytest.mark.slow)])
 def test_chunked_causal_matches_dense(dtype, bwd_impl):
     """block_q=128 at s=512 engages the causal-skip (chunked) kernels;
     parity incl. grads against dense proves the guarded-skip logic and
@@ -167,7 +168,8 @@ def test_chunked_causal_matches_dense(dtype, bwd_impl):
                                    np.asarray(r, np.float32), atol=tol)
 
 
-@pytest.mark.parametrize("bwd_impl", ["monolithic", "split"])
+@pytest.mark.parametrize("bwd_impl", [
+    "monolithic", pytest.param("split", marks=pytest.mark.slow)])
 def test_chunked_causal_with_segments(bwd_impl):
     b, h, s, d = 1, 1, 384, 32
     rs = np.random.RandomState(6)
@@ -286,6 +288,8 @@ def test_bwd_split_bf16_matches_dense():
                                    np.asarray(ref, np.float32), atol=4e-2)
 
 
+@pytest.mark.slow  # split-bwd causal+rectangular corner; the impl matrix
+# and bf16/segment split tests keep split-bwd covered fast
 def test_bwd_split_causal_rectangular():
     """Causal with sq != sk: the k-major pass's absolute row/column
     bookkeeping (col0 offsets, chunk-skip reach) must match dense's
@@ -311,3 +315,130 @@ def test_bwd_split_causal_rectangular():
     for g, ref in ((gq, rq), (gk, rk), (gv, rv)):
         np.testing.assert_allclose(np.asarray(g), np.asarray(ref),
                                    atol=2e-4)
+
+
+# ----------------------------- dropout ------------------------------------
+
+def _dense_mscale(seed, b, h, sq, sk, p):
+    """Dense [b, h, sq, sk] keep-scale built from the kernel's own hash
+    (tile-layout independent, so the full-array build is exact)."""
+    out = np.zeros((b, h, sq, sk), np.float32)
+    seed = jnp.asarray(seed, jnp.int32)
+    for ib in range(b):
+        for ih in range(h):
+            out[ib, ih] = np.asarray(ap._dropout_mscale(
+                seed, jnp.int32(ib), jnp.int32(ih), 0, sq, sk, p, h, sq))
+    return jnp.asarray(out)
+
+
+def test_dropout_fwd_bwd_matches_dense_with_same_mask():
+    """Exact parity: dense attention with the hash mask applied to the
+    probabilities == the kernel, for the output AND all three grads."""
+    b, h, s, d, p = 2, 3, 256, 32, 0.3
+    rs = np.random.RandomState(3)
+    q, k, v = _qkv(rs, b, h, s, s, d, jnp.float32)
+    scale = 1.0 / np.sqrt(d)
+    seed = jnp.asarray([[42]], jnp.int32)
+    mscale = _dense_mscale(seed, b, h, s, s, p)
+
+    def f(q, k, v):
+        y = ap.fused_attention_rows(q, k, v, False, scale, None, True,
+                                    None, None, p, seed)
+        return jnp.sum(jnp.sin(y))
+
+    def r(q, k, v):
+        sc = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        probs = jax.nn.softmax(sc, axis=-1) * mscale
+        y = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        return jnp.sum(jnp.sin(y))
+
+    np.testing.assert_allclose(float(f(q, k, v)), float(r(q, k, v)),
+                               rtol=1e-5)
+    gq, gk, gv = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    rq, rk, rv = jax.grad(r, argnums=(0, 1, 2))(q, k, v)
+    for g, ref in ((gq, rq), (gk, rk), (gv, rv)):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(ref),
+                                   atol=2e-4)
+
+
+def test_dropout_segments_and_statistics():
+    """Dropout composes with segment masking; drop rate ~ p and the
+    surviving probs are scaled by 1/(1-p) (inverted dropout)."""
+    b, h, s, d, p = 2, 2, 256, 32, 0.25
+    rs = np.random.RandomState(4)
+    q, k, v = _qkv(rs, b, h, s, s, d, jnp.float32)
+    seg = jnp.asarray(rs.randint(0, 3, (b, s)), jnp.int32)
+    scale = 1.0 / np.sqrt(d)
+    seed = jnp.asarray([[7]], jnp.int32)
+    got = ap.fused_attention_rows(q, k, v, False, scale, (seg, seg), True,
+                                  None, None, p, seed)
+    assert np.isfinite(np.asarray(got)).all()
+    # statistics of the mask itself
+    ms = np.asarray(_dense_mscale(seed, b, h, s, s, p))
+    drop_rate = (ms == 0).mean()
+    assert abs(drop_rate - p) < 0.01, drop_rate
+    np.testing.assert_allclose(ms[ms > 0], 1.0 / (1.0 - p), rtol=1e-6)
+    # expectation: averaging many independent masks recovers the
+    # no-dropout output (checked on the mask mean, which is what enters
+    # linearly)
+    assert abs(ms.mean() - 1.0) < 0.01
+
+
+def test_dropout_determinism_and_seed_sensitivity():
+    b, h, s, d, p = 1, 2, 128, 32, 0.5
+    rs = np.random.RandomState(5)
+    q, k, v = _qkv(rs, b, h, s, s, d, jnp.float32)
+    scale = 1.0 / np.sqrt(d)
+    a1 = ap.fused_attention_rows(q, k, v, False, scale, None, True,
+                                 None, None, p, jnp.asarray([[3]], jnp.int32))
+    a2 = ap.fused_attention_rows(q, k, v, False, scale, None, True,
+                                 None, None, p, jnp.asarray([[3]], jnp.int32))
+    b2 = ap.fused_attention_rows(q, k, v, False, scale, None, True,
+                                 None, None, p, jnp.asarray([[4]], jnp.int32))
+    np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+    assert np.abs(np.asarray(a1) - np.asarray(b2)).max() > 1e-3
+
+
+def test_dropout_zero_p_equals_base_kernel():
+    b, h, s, d = 1, 2, 128, 32
+    rs = np.random.RandomState(6)
+    q, k, v = _qkv(rs, b, h, s, s, d, jnp.float32)
+    scale = 1.0 / np.sqrt(d)
+    base = ap.fused_attention_rows(q, k, v, True, scale, None, True)
+    zero = ap.fused_attention_rows(q, k, v, True, scale, None, True,
+                                   None, None, 0.0,
+                                   jnp.asarray([[9]], jnp.int32))
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(zero))
+
+
+def test_dropout_knob_validation():
+    b, h, s, d = 1, 1, 128, 32
+    rs = np.random.RandomState(7)
+    q, k, v = _qkv(rs, b, h, s, s, d, jnp.float32)
+    with pytest.raises(ValueError, match="monolithic"):
+        ap.fused_attention_rows(q, k, v, False, 0.1, None, True, None,
+                                "split", 0.3, jnp.asarray([[1]], jnp.int32))
+    with pytest.raises(ValueError, match="dropout_seed"):
+        ap.fused_attention_rows(q, k, v, False, 0.1, None, True, None,
+                                None, 0.3, None)
+    for bad_p in (1.0, 1.5, -0.1):
+        with pytest.raises(ValueError, match="outside"):
+            ap.fused_attention_rows(q, k, v, False, 0.1, None, True, None,
+                                    None, bad_p,
+                                    jnp.asarray([[1]], jnp.int32))
+
+
+def test_supported_dropout_gate_tighter():
+    """The dropout backward's 6-array working set shrinks the viable q
+    block: a shape can fit the plain kernel but not the dropout one —
+    supported(dropout=True) must say so (callers gate dispatch on it;
+    an un-gated call would hit a zero q block)."""
+    sq = sk = 65536  # bq cap: 4-array 9 -> block 8; 6-array 6 -> 0
+    assert ap.supported(sq, sk, 64)
+    assert not ap.supported(sq, sk, 64, dropout=True)
+    # and an un-gated dropout call at that shape refuses loudly rather
+    # than dividing by zero in the grid computation
+    q = jnp.zeros((1, 1, sq, 64), jnp.bfloat16)
+    with pytest.raises(ValueError, match="unsupported"):
+        ap.fused_attention_rows(q, q, q, False, 0.1, None, True, None,
+                                None, 0.3, jnp.asarray([[1]], jnp.int32))

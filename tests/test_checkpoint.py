@@ -122,6 +122,8 @@ def test_manager_partial_restore(tmp_path):
                                   np.asarray(state["params"]["w"]))
 
 
+@pytest.mark.slow  # compile-heavy (4 shard_map programs + 2 orbax IOs);
+# the 3D no-gather roundtrip below keeps checkpoint/resume in the fast tier
 def test_zero_sharded_optimizer_state_roundtrip(tmp_path):
     """ZeRO-2 (DistributedFusedAdam) state — per-rank flat shards living
     on a dp axis — checkpoints and resumes WITHOUT a gather: saved as a

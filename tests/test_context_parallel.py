@@ -48,6 +48,9 @@ def test_matches_dense(fn, causal):
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow  # compiling grad-of-ring (scan+ppermute reversal) over
+# 4 devices is ~10-20s/impl; fwd parity stays fast and the driver's
+# dryrun runs value_and_grad through ring-cp every round
 @pytest.mark.parametrize("fn", [ring_attention, ulysses_attention],
                          ids=["ring", "ulysses"])
 def test_grads_match_dense(fn):

@@ -227,6 +227,8 @@ def test_groupbn_fuse_relu_and_residual():
     assert (np.asarray(y) >= 0).all()
 
 
+@pytest.mark.slow  # multi-subgroup shard_map compile; the plain
+# group-BN parity test stays fast
 def test_group_bn_stats_shared_across_subgroups():
     """bn_group=2 over an 8-wide dp axis: stats equal within pairs,
     differ across pairs (reference: bn_group semantics)."""

@@ -59,6 +59,8 @@ def test_halo_exchange_sendrecv_and_allgather_agree():
     np.testing.assert_array_equal(np.asarray(ri_n), 0)
 
 
+@pytest.mark.slow  # spatial-split conv compile; the halo-exchange
+# agreement test keeps the mechanism fast
 def test_spatial_bottleneck_matches_unsplit():
     """H-split over 4 ranks == single-device bottleneck (the substance of
     the reference's spatial bottleneck test)."""

@@ -67,6 +67,8 @@ def _run_reference(tx, params, grads, steps=3):
     return params
 
 
+@pytest.mark.slow  # compile-heavy exact parity; the distinct-rank-grads
+# reduction test keeps the ZeRO mechanism in the fast tier
 def test_distributed_adam_matches_fused_adam():
     params, grads = _params(), _grads()
     dist = distributed_fused_adam(learning_rate=0.1, weight_decay=0.01,

@@ -98,6 +98,8 @@ def _moe_ref_and_ep(seed=0):
     return params, x, ref_fwd, run_ep
 
 
+@pytest.mark.slow  # compile-heavy exact parity; routing/dropped-token
+# tests stay fast and dryrun_multichip exercises EP fwd+bwd every round
 def test_expert_parallel_matches_reference():
     params, x, ref_fwd, run_ep = _moe_ref_and_ep()
     want = ref_fwd(params, x)
@@ -186,24 +188,32 @@ def test_gpt_with_moe_layers_trains():
     assert losses[-1] < losses[0]
 
 
-def test_collect_moe_aux_and_tp_sharding():
-    """collect_moe_aux picks up every layer's sown aux loss, and tp=2
-    expert-ffn sharding reproduces the tp=1 MoE exactly."""
-    from apex_tpu.transformer.moe import collect_moe_aux
-
-    T, H, F, E = 16, 8, 16, 4
+def _moe_tp1(T=16, H=8, F=16, E=4):
     x = jnp.asarray(np.random.RandomState(0).randn(T, H), jnp.float32)
-
     cfg1 = MoEConfig(hidden_size=H, ffn_hidden_size=F, num_experts=E,
                      capacity_factor=float(E))
     m1 = ExpertParallelMLP(cfg1)
     params = m1.init(jax.random.PRNGKey(0), x)["params"]
     out1, vars1 = m1.apply({"params": params}, x,
                            mutable=["intermediates"])
+    return x, params, out1, vars1
+
+
+def test_collect_moe_aux():
+    """collect_moe_aux picks up every layer's sown aux loss."""
+    from apex_tpu.transformer.moe import collect_moe_aux
+
+    _, _, _, vars1 = _moe_tp1()
     aux = collect_moe_aux(vars1["intermediates"])
     assert float(aux) > 0.0
 
-    # tp=2: shard the same params' ffn dim; output must match exactly
+
+@pytest.mark.slow  # second ExpertParallelMLP compile under shard_map;
+# the ep dryrun + fast routing tests keep MoE in the fast tier
+def test_moe_tp_sharding_matches_tp1():
+    """tp=2 expert-ffn sharding reproduces the tp=1 MoE exactly."""
+    T, H, F, E = 16, 8, 16, 4
+    x, params, out1, _ = _moe_tp1(T, H, F, E)
     cfg2 = MoEConfig(hidden_size=H, ffn_hidden_size=F, num_experts=E,
                      capacity_factor=float(E), tensor_parallel_axis="tp")
     m2 = ExpertParallelMLP(cfg2)

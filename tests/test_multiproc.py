@@ -13,6 +13,8 @@ import pytest
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
 
+@pytest.mark.slow  # two fresh jax processes (~15s); pure jax.distributed
+# smoke orthogonal to repo code changes — slow tier keeps it exercised
 def test_multiproc_two_process_psum():
     env = dict(os.environ)
     env["MASTER_PORT"] = "29531"

@@ -170,6 +170,8 @@ def test_syncbn_matches_full_batch_bn():
     np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow  # grad-of-syncbn compile is the cost; the forward
+# full-batch parity test keeps SyncBN in the fast tier
 def test_syncbn_backward_matches_full_batch():
     rng = np.random.RandomState(1)
     x = rng.randn(NDEV * 2, 8).astype(np.float32)

@@ -50,9 +50,10 @@ def _torch_parity(cell_type, torch_cls, T=5, B=3, I=4, H=6, layers=2,
                                theirs_out.numpy(), atol=1e-5)
 
 
-@pytest.mark.parametrize("cell,cls", [("LSTM", torch.nn.LSTM),
-                                      ("GRU", torch.nn.GRU),
-                                      ("ReLU", None), ("Tanh", None)])
+@pytest.mark.parametrize("cell,cls", [
+    pytest.param("LSTM", torch.nn.LSTM, marks=pytest.mark.slow),
+    ("GRU", torch.nn.GRU),
+    ("ReLU", None), ("Tanh", None)])
 def test_rnn_matches_torch(cell, cls):
     _torch_parity(cell, cls)
 

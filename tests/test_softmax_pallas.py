@@ -63,7 +63,8 @@ def test_fully_masked_rows_are_zero_with_zero_grads():
     assert np.all(np.isfinite(np.asarray(g)))
 
 
-@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("causal", [
+    pytest.param(False, marks=pytest.mark.slow), True])
 def test_grads_match_reference(causal):
     x = _x(jnp.float32, seed=4)
     rs = np.random.RandomState(5)

@@ -300,7 +300,8 @@ def _ref_ce(logits, target, smoothing=0.0):
     return nll
 
 
-@pytest.mark.parametrize("smoothing", [0.0, 0.1])
+@pytest.mark.parametrize("smoothing", [
+    0.0, pytest.param(0.1, marks=pytest.mark.slow)])
 def test_vocab_parallel_cross_entropy(smoothing):
     """Port of test_cross_entropy.py: sharded CE == full-vocab CE."""
     mesh = tp_mesh()
@@ -316,7 +317,8 @@ def test_vocab_parallel_cross_entropy(smoothing):
     np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
 
 
-@pytest.mark.parametrize("smoothing", [0.0, 0.1])
+@pytest.mark.parametrize("smoothing", [
+    0.0, pytest.param(0.1, marks=pytest.mark.slow)])
 def test_vocab_parallel_cross_entropy_grad(smoothing):
     mesh = tp_mesh()
     B, V = 4, NDEV * 2
