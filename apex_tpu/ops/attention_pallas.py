@@ -139,12 +139,13 @@ def _p_from_stats(s, m, tot, masked):
 # attention dropout: counter-based PRNG, replayed exactly in backward
 # ---------------------------------------------------------------------------
 #
-# The mask is a pure hash of the GLOBAL element coordinate (b, h, row,
-# col) and the step seed — murmur3's fmix32 finalizer on a flat counter.
-# Tile-layout independent by construction: the backward pass (any block
-# size, any q-major/k-major order) regenerates bit-identical keep
-# decisions without storing the [sq, sk] mask in HBM — the same
-# replay-from-counter design as fmhalib's Philox offsets
+# The mask is a pure chained hash of the GLOBAL element coordinate
+# (b, h, row, col) and the step seed — one murmur3 fmix32 avalanche per
+# level, never a flat multiplied counter (which would wrap uint32 at
+# large b·h·sq·sk). Tile-layout independent by construction: the
+# backward pass (any block size, any q-major/k-major order) regenerates
+# bit-identical keep decisions without storing the [sq, sk] mask in HBM
+# — the same replay-from-offsets design as fmhalib's Philox states
 # (reference apex/contrib/fmha/fmha.py:33-61 saves rng_state instead).
 # Plain jnp uint32 ops so it lowers on Mosaic AND in interpret mode
 # (pltpu.prng_* has no CPU interpret rule), and tests can rebuild the
@@ -160,10 +161,17 @@ def _fmix32(x):
     return x
 
 
-def _dropout_mscale(seed, ib, ih, row0, rows, sk, p, n_heads, sq_total):
+def _dropout_mscale(seed, ib, ih, row0, rows, sk, p, n_heads):
     """fp32 [rows, sk] inverted-dropout scale (keep/(1-p), drop→0) for
     the score block whose global rows start at ``row0``. ``seed`` is a
     traced uint32/int32 scalar; ``ib``/``ih`` the batch/head indices.
+
+    The hash is CHAINED, not a flat element counter: seed → per-(b, h)
+    key → per-row key → per-element bits, one fmix32 avalanche per
+    level. A flat ``((b·H + h)·sq + row)·sk + col`` counter silently
+    wraps uint32 once b·h·sq·sk > 2^32 (shapes the supported() gate
+    admits), correlating far-apart elements; the chain never multiplies
+    coordinates, so no level can overflow.
 
     Every index input is coerced to uint32 BEFORE any arithmetic: a
     traced int32 (``pl.program_id``) in the chain silently demotes the
@@ -171,14 +179,12 @@ def _dropout_mscale(seed, ib, ih, row0, rows, sk, p, n_heads, sq_total):
     thresh negative — an always-keep mask that drops nothing.
     """
     u32 = lambda x: jnp.asarray(x).astype(jnp.uint32)
-    row = u32(row0) + lax.broadcasted_iota(jnp.uint32, (rows, sk), 0)
+    row = u32(row0) + lax.broadcasted_iota(jnp.uint32, (rows, 1), 0)
     col = lax.broadcasted_iota(jnp.uint32, (rows, sk), 1)
-    flat = ((u32(ib) * jnp.uint32(n_heads) + u32(ih))
-            * jnp.uint32(sq_total) + row) * jnp.uint32(sk) + col
-    # hash the seed once so consecutive seeds give decorrelated masks
-    # (a raw counter+seed would just shift the pattern by one element)
     s = _fmix32(jnp.uint32(0x9E3779B9) ^ u32(seed))
-    bits = _fmix32(flat ^ s)
+    s_bh = _fmix32(s ^ (u32(ib) * jnp.uint32(n_heads) + u32(ih)))
+    rowkey = _fmix32(s_bh ^ row)            # [rows, 1]
+    bits = _fmix32(rowkey ^ col)            # [rows, sk]
     assert bits.dtype == jnp.uint32, bits.dtype
     thresh = jnp.uint32(min(max(p, 0.0), 1.0) * 4294967296.0)
     keep = bits >= thresh
@@ -186,7 +192,7 @@ def _dropout_mscale(seed, ib, ih, row0, rows, sk, p, n_heads, sq_total):
 
 
 def _fwd_kernel(*refs, scale, causal, has_seg, bq, dropout_p=0.0,
-                n_heads=1, sq_total=0):
+                n_heads=1):
     refs = list(refs)
     q_ref, k_ref, v_ref = refs[:3]
     i = 3
@@ -211,7 +217,7 @@ def _fwd_kernel(*refs, scale, causal, has_seg, bq, dropout_p=0.0,
         p = p * _dropout_mscale(
             seed_ref[0, 0], pl.program_id(0), pl.program_id(1),
             pl.program_id(2) * bq, q.shape[0], k.shape[0], dropout_p,
-            n_heads, sq_total)
+            n_heads)
     o = lax.dot_general(p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
                         preferred_element_type=jnp.float32)
     o_ref[0, 0] = o.astype(o_ref.dtype)
@@ -259,7 +265,7 @@ def _fwd_kernel_chunked(*refs, scale, causal, has_seg, bq):
 
 
 def _bwd_kernel(*refs, scale, causal, has_seg, bq, dropout_p=0.0,
-                n_heads=1, sq_total=0):
+                n_heads=1):
     refs = list(refs)
     q_ref, k_ref, v_ref = refs[:3]
     i = 3
@@ -293,7 +299,7 @@ def _bwd_kernel(*refs, scale, causal, has_seg, bq, dropout_p=0.0,
         mscale = _dropout_mscale(
             seed_ref[0, 0], pl.program_id(0), pl.program_id(1),
             pl.program_id(2) * bq, q.shape[0], k.shape[0], dropout_p,
-            n_heads, sq_total)
+            n_heads)
         pd = p * mscale
         p_lo = pd.astype(q.dtype)          # feeds dV
         dcol = jnp.sum(pd * dp, axis=-1, keepdims=True)
@@ -656,8 +662,7 @@ def _fwd(q, k, v, causal, sm_scale, segment_ids, interpret, block_q=None,
     bq = _pick_bq(sq, sk, block_q, n_arrays)
     has_seg = segment_ids is not None
     ins, qspec, _ = _specs(b, h, bq, sq, sk, d, has_seg)
-    kern = functools.partial(_fwd_kernel, dropout_p=dropout_p, n_heads=h,
-                             sq_total=sq)
+    kern = functools.partial(_fwd_kernel, dropout_p=dropout_p, n_heads=h)
     scratch = []
     if dropout_p <= 0.0 and _chunked(causal, bq, sq, sk):
         kern = _fwd_kernel_chunked
@@ -692,8 +697,7 @@ def _bwd_monolithic(causal, sm_scale, interpret, block_q, res, g,
     bq = _pick_bq(sq, sk, block_q, n_arrays)
     has_seg = segment_ids is not None
     ins, qspec, kvspec = _specs(b, h, bq, sq, sk, d, has_seg)
-    kern = functools.partial(_bwd_kernel, dropout_p=dropout_p, n_heads=h,
-                             sq_total=sq)
+    kern = functools.partial(_bwd_kernel, dropout_p=dropout_p, n_heads=h)
     scratch = []
     if dropout_p <= 0.0 and _chunked(causal, bq, sq, sk):
         kern = _bwd_kernel_chunked

@@ -26,9 +26,9 @@ PERF.md §5), selected by ``impl`` / ``APEX_PP_IMPL``:
     AD: the backward schedule falls out of reversing the ppermute, but
     AD saves one residual per tick — O(M + pp) GPipe-shaped memory
     (measured: ~0.6 MB per extra microbatch checkpointed, ~6.2 MB
-    uncheckpointed). Kept for A/B and as the interleaved
-    (virtual-pipeline) core, where AD's reversal handles the
-    chunk-wrapped ring for free.
+    uncheckpointed). Kept for A/B. Both cores handle the interleaved
+    (virtual-pipeline) schedule; 1f1b uses per-chunk rings there
+    (O(V·L) live state, still flat in M).
 
 ``checkpoint_stages`` (``jax.checkpoint`` around the trunk): under
 adscan it shrinks the per-tick residual to the stage-boundary
@@ -134,7 +134,7 @@ def forward_backward_no_pipelining(forward_step_func, batch, params, *,
 def pipeline_fwd_bwd_1f1b(stage_fn, stage_params, embed_fn, embed_params,
                           loss_fn, head_params, microbatches,
                           num_microbatches, *, axis_name=PIPELINE_AXIS,
-                          checkpoint_stages=True):
+                          checkpoint_stages=True, num_chunks=1):
     """One-forward-one-backward schedule with the true 1F1B memory bound.
 
     The reference's 1F1B loop
@@ -143,41 +143,50 @@ def pipeline_fwd_bwd_1f1b(stage_fn, stage_params, embed_fn, embed_params,
     schedule (``pipeline_forward``) cannot reach that bound: reverse-mode
     AD saves one residual per scan tick, O(M + pp). This schedule gets
     the bound the TPU-native way — **backprop is part of the forward
-    program**. Every scan tick runs, on every stage,
+    program**. With V = num_chunks virtual chunks per device (the
+    interleaved schedule, fwd_bwd_pipelining_with_interleaving.py:26;
+    virtual pipeline length L = pp·V), every scan tick runs, on every
+    stage and every chunk,
 
-      * one forward: advance microbatch ``t - p`` one stage (as in
-        ``pipeline_forward``), saving only the stage INPUT into a ring
-        buffer of ``R = 2·pp - 1`` slots;
-      * one backward: for microbatch ``t - 2(pp-1) + p`` — whose output
-        cotangent just arrived over the reverse ``ppermute`` ring — pop
-        its saved input, rebuild the stage vjp by recompute
-        (``jax.vjp``; the same recompute real 1F1B pays under Megatron's
-        activation checkpointing), accumulate param grads, and send the
-        input cotangent downstream.
+      * one forward: advance the chunk's live microbatch one virtual
+        stage (exactly ``pipeline_forward``'s tick, including the
+        chunk-wrap ring on device 0), saving only each chunk's INPUT
+        into that chunk's ring buffer of ``R = 2·L - 1`` slots;
+      * one backward: virtual stage ℓ = v·pp + p backprops microbatch
+        ``t - 2(L-1) + ℓ`` — whose output cotangent just arrived over
+        the reverse ``ppermute`` ring (with the mirrored chunk-wrap on
+        device pp-1) — popping its saved input, rebuilding the stage
+        vjp by recompute (``jax.vjp``; the same recompute real 1F1B pays
+        under Megatron's activation checkpointing), accumulating param
+        grads, and sending the input cotangent downstream.
 
     The scan itself is never differentiated, so it holds NO AD residuals:
-    live activation state is exactly the ring buffer — ``2·pp - 1`` stage
+    live activation state is exactly the rings — ``V·(2·L − 1)`` stage
     inputs per device, **independent of M** (the uniform fwd+bwd tick
     issues microbatches at 1F1B's steady-state rate but pays the full
-    2(pp-1)-tick turnaround as in-flight depth, hence 2·pp - 1 rather
-    than the reference's pp; both are O(pp)). Ticks: T = M + 2(pp-1),
-    one pipeline-fill longer than GPipe's M + pp - 1.
+    2(L−1)-tick turnaround as in-flight depth; the reference's
+    interleaved schedule likewise pays more in-flight memory per chunk —
+    both are O(L), never O(M)). Ticks: T = M + 2(L−1).
 
     Stage heterogeneity stays masked-SPMD: the head's vjp runs every tick
-    on every stage and is where-masked to the last stage (its dy seeds
-    that stage's trunk backward in the SAME tick — the fwd→bwd
-    turnaround), the embed vjp likewise masked to stage 0.
+    on every stage and is where-masked to (device pp-1, chunk V-1) —
+    its dy seeds that virtual stage's trunk backward in the SAME tick
+    (the fwd→bwd turnaround) — and the embed vjp is masked to
+    (device 0, chunk 0).
 
     Returns ``(local mean loss, (stage, embed, head) grad trees)`` with
     the same conventions as ``pipeline_forward`` + AD: loss and
     embed/head grads are nonzero only on their owning stage (callers
-    psum), stage grads are per-device.
+    psum), stage grads are per-device (leading [V] dim when V > 1,
+    matching ``stage_params``).
     """
     pp = lax.axis_size(axis_name)
     p = lax.axis_index(axis_name)
     M = num_microbatches
-    R = 2 * pp - 1              # max residual lifetime: 2(pp-1) ticks
-    T = M + 2 * (pp - 1)
+    V = num_chunks
+    L = pp * V
+    R = 2 * L - 1               # max residual lifetime: 2(L-1) ticks
+    T = M + 2 * (L - 1)
 
     mb0 = _index_microbatch(microbatches, 0)
     act = jax.eval_shape(embed_fn, embed_params, mb0)
@@ -187,57 +196,94 @@ def pipeline_fwd_bwd_1f1b(stage_fn, stage_params, embed_fn, embed_params,
     bwd_perm = [(i, (i - 1) % pp) for i in range(pp)]
 
     def masked_add(acc, new, live):
-        return jax.tree_util.tree_map(
-            lambda a, n: a + jnp.where(live, n, 0).astype(a.dtype),
-            acc, new)
+        """live: scalar bool, or [V] when ``new`` carries a leading
+        chunk dim."""
+        def upd(a, n):
+            mask = live
+            if getattr(live, "ndim", 0) == 1:
+                mask = live.reshape((V,) + (1,) * (n.ndim - 1))
+            return a + jnp.where(mask, n, 0).astype(a.dtype)
+
+        return jax.tree_util.tree_map(upd, acc, new)
+
+    def chunk_bwd(sp_v, ring_v, slot_v, cot_v, v_idx):
+        x_v = lax.dynamic_index_in_dim(ring_v, slot_v, 0, keepdims=False)
+        _, f_vjp = jax.vjp(lambda sp, x: trunk(sp, x, v_idx), sp_v, x_v)
+        return f_vjp(cot_v)
 
     def tick(carry, t):
         acts, cot_up, ring, gs, ge, gh, loss_acc = carry
 
-        # ---- forward: stage p advances microbatch t - p one stage
+        # ---- forward: every chunk advances one virtual stage; chunk 0
+        # on device 0 injects microbatch t
         mb_f = _index_microbatch(microbatches, jnp.clip(t, 0, M - 1))
         x0 = embed_fn(embed_params, mb_f)
-        x_in = jnp.where((p == 0) & (t < M), x0, acts)
-        ring = lax.dynamic_update_index_in_dim(ring, x_in, t % R, 0)
-        y = trunk(stage_params, x_in, 0)
+        inject = jnp.where((p == 0) & (t < M), x0, acts[0])
+        x_in = acts.at[0].set(inject)
+        ring = lax.dynamic_update_slice_in_dim(
+            ring, x_in[:, None], t % R, axis=1)
+        if V == 1:
+            ys = trunk(stage_params, x_in[0], 0)[None]
+        else:
+            ys = jax.vmap(lambda sp, x, v: trunk(sp, x, v),
+                          in_axes=(0, 0, 0))(stage_params, x_in,
+                                             jnp.arange(V))
 
-        # ---- head fwd+vjp (live on the last stage): microbatch t-(pp-1)
-        m_h = t - (pp - 1)
+        # ---- head fwd+vjp (live on device pp-1, chunk V-1):
+        # microbatch t - (L-1)
+        m_h = t - (L - 1)
         mb_h = _index_microbatch(microbatches, jnp.clip(m_h, 0, M - 1))
         loss, head_vjp = jax.vjp(
-            lambda hp, h: loss_fn(hp, h, mb_h), head_params, y)
+            lambda hp, h: loss_fn(hp, h, mb_h), head_params, ys[V - 1])
         dhp, dy = head_vjp(jnp.ones_like(loss))
         head_live = (p == pp - 1) & (m_h >= 0) & (m_h < M)
         loss_acc = loss_acc + jnp.where(head_live, loss, 0.0)
         gh = masked_add(gh, dhp, head_live)
 
-        # ---- backward: stage p backprops microbatch t - 2(pp-1) + p.
-        # Its input was saved 2(pp-1-p) ticks ago; on the last stage that
-        # is THIS tick's slot (the fwd→bwd turnaround), and its incoming
-        # cotangent is the head's dy rather than the ppermute'd one.
-        m_b = t - 2 * (pp - 1) + p
-        x_b = lax.dynamic_index_in_dim(
-            ring, (t - 2 * (pp - 1 - p)) % R, 0, keepdims=False)
-        cot_in = jnp.where(p == pp - 1, dy, cot_up)
-        _, trunk_vjp = jax.vjp(
-            lambda sp, x: trunk(sp, x, 0), stage_params, x_b)
-        dsp, dx = trunk_vjp(cot_in)
-        b_live = (m_b >= 0) & (m_b < M)
-        gs = masked_add(gs, dsp, b_live)
+        # ---- backward: virtual stage ℓ = v·pp + p backprops microbatch
+        # t - 2(L-1) + ℓ. Its input was saved 2(L-1-ℓ) ticks ago; for
+        # the LAST virtual stage that is THIS tick's slot (the fwd→bwd
+        # turnaround) and its incoming cotangent is the head's dy.
+        ells = jnp.arange(V) * pp + p                      # [V]
+        m_b = t - 2 * (L - 1) + ells                       # [V]
+        slots = (t - 2 * (L - 1 - ells)) % R               # [V]
+        cot_in = cot_up.at[V - 1].set(
+            jnp.where(p == pp - 1, dy, cot_up[V - 1]))
+        if V == 1:
+            dsp, dx0 = chunk_bwd(stage_params, ring[0], slots[0],
+                                 cot_in[0], 0)
+            dx_all = dx0[None]
+            gs = masked_add(gs, dsp, (m_b[0] >= 0) & (m_b[0] < M))
+        else:
+            dsp, dx_all = jax.vmap(chunk_bwd)(
+                stage_params, ring, slots, cot_in, jnp.arange(V))
+            gs = masked_add(gs, dsp, (m_b >= 0) & (m_b < M))
 
-        # ---- embed vjp (live on stage 0): close out microbatch m_b
-        mb_b = _index_microbatch(microbatches, jnp.clip(m_b, 0, M - 1))
+        # ---- embed vjp (live on device 0, chunk 0)
+        mb_b = _index_microbatch(microbatches,
+                                 jnp.clip(m_b[0], 0, M - 1))
         _, embed_vjp = jax.vjp(lambda ep: embed_fn(ep, mb_b), embed_params)
-        (dep,) = embed_vjp(dx)
-        ge = masked_add(ge, dep, b_live & (p == 0))
+        (dep,) = embed_vjp(dx_all[0])
+        ge = masked_add(ge, dep,
+                        (m_b[0] >= 0) & (m_b[0] < M) & (p == 0))
 
-        acts_next = lax.ppermute(y, axis_name, fwd_perm)
-        cot_next = lax.ppermute(dx, axis_name, bwd_perm)
+        # ---- ring shifts: fwd chunk-wrap on device 0 (as in
+        # pipeline_forward), its mirror for cotangents on device pp-1
+        shifted_y = lax.ppermute(ys, axis_name, fwd_perm)
+        acts_next = shifted_y
+        shifted_cot = lax.ppermute(dx_all, axis_name, bwd_perm)
+        cot_next = shifted_cot
+        if V > 1:
+            acts_next = jnp.where(p == 0, jnp.roll(shifted_y, 1, axis=0),
+                                  shifted_y)
+            cot_next = jnp.where(p == pp - 1,
+                                 jnp.roll(shifted_cot, -1, axis=0),
+                                 shifted_cot)
         return (acts_next, cot_next, ring, gs, ge, gh, loss_acc), None
 
-    zero_act = jnp.zeros(act.shape, act.dtype)
-    carry0 = (zero_act, zero_act,
-              jnp.zeros((R,) + act.shape, act.dtype),
+    zero_acts = jnp.zeros((V,) + act.shape, act.dtype)
+    carry0 = (zero_acts, zero_acts,
+              jnp.zeros((V, R) + act.shape, act.dtype),
               _tree_zeros_like(stage_params),
               _tree_zeros_like(embed_params),
               _tree_zeros_like(head_params),
@@ -357,9 +403,8 @@ def forward_backward_pipelining_without_interleaving(
     ``impl``: ``"1f1b"`` (default; ``pipeline_fwd_bwd_1f1b`` — true O(pp)
     in-flight memory, matching the reference's capability) or
     ``"adscan"`` (the AD-of-scan schedule — O(M + pp) residual memory,
-    kept for A/B and as the only interleaved-capable core). ``None``
-    reads ``APEX_PP_IMPL`` then falls back to "1f1b"; an explicit
-    unknown value raises.
+    kept for A/B). ``None`` reads ``APEX_PP_IMPL`` then falls back to
+    "1f1b"; an explicit unknown value raises.
     """
     return _pipelined_fwd_bwd(forward_step_func, batch, params,
                               num_microbatches=num_microbatches,
@@ -371,15 +416,17 @@ def forward_backward_pipelining_without_interleaving(
 def forward_backward_pipelining_with_interleaving(
         forward_step_func, batch, params, *, num_microbatches,
         num_model_chunks, axis_name=PIPELINE_AXIS, forward_only=False,
-        checkpoint_stages=True, **_compat):
+        checkpoint_stages=True, impl=None, **_compat):
     """Interleaved (virtual pipeline) schedule (reference:
     fwd_bwd_pipelining_with_interleaving.py:26). ``stage_params`` carries a
-    leading [num_model_chunks] dim per device."""
+    leading [num_model_chunks] dim per device. Same ``impl`` knob as the
+    non-interleaved schedule — the 1f1b core handles virtual chunks with
+    per-chunk rings (memory O(V·L), flat in M)."""
     return _pipelined_fwd_bwd(forward_step_func, batch, params,
                               num_microbatches=num_microbatches,
                               axis_name=axis_name, forward_only=forward_only,
                               checkpoint_stages=checkpoint_stages,
-                              num_chunks=num_model_chunks)
+                              num_chunks=num_model_chunks, impl=impl)
 
 
 def _pipelined_fwd_bwd(forward_step_func, batch, params, *, num_microbatches,
@@ -395,19 +442,11 @@ def _pipelined_fwd_bwd(forward_step_func, batch, params, *, num_microbatches,
             num_microbatches, axis_name=axis_name,
             checkpoint_stages=checkpoint_stages, num_chunks=num_chunks)
 
-    explicit = impl is not None
     if impl is None:
         impl = os.environ.get("APEX_PP_IMPL", "1f1b")
     if impl not in ("1f1b", "adscan"):
         raise ValueError(f"unknown pipeline impl {impl!r} "
                          "(expected '1f1b' or 'adscan')")
-    if impl == "1f1b" and num_chunks > 1:
-        # the interleaved (virtual pipeline) core only exists AD-scan
-        # shaped; an explicit 1f1b request there is un-honorable
-        if explicit:
-            raise ValueError("impl='1f1b' does not support num_chunks > 1; "
-                             "the interleaved schedule is AD-scan only")
-        impl = "adscan"
 
     if forward_only:
         # forward-only has one core (the fwd scan) regardless of impl;
@@ -418,7 +457,7 @@ def _pipelined_fwd_bwd(forward_step_func, batch, params, *, num_microbatches,
         loss_local, (gs, ge, gh) = pipeline_fwd_bwd_1f1b(
             stage_fn, stage_params, embed_fn, embed_params, loss_fn,
             head_params, batch, num_microbatches, axis_name=axis_name,
-            checkpoint_stages=checkpoint_stages)
+            checkpoint_stages=checkpoint_stages, num_chunks=num_chunks)
     else:
         loss_local, grads = jax.value_and_grad(loss_of)(
             (stage_params, embed_params, head_params))

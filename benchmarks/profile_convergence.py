@@ -135,6 +135,8 @@ def gate(name, l0, l2, extra=None):
     print(f"  {name}: mean_rel_dev={mean_dev:.4f} (tol {tol_mean}), "
           f"final_dev={final_dev:.4f} (tol {tol_final}), "
           f"both_decreased={decreased} -> {'PASS' if ok else 'FAIL'}")
+    if extra:
+        ok = ok and extra.get("impl_parity_pass", True)
     rec = {"model": name, "steps": STEPS,
            "mean_rel_dev": float(mean_dev),
            "final_dev": float(final_dev),
@@ -142,7 +144,6 @@ def gate(name, l0, l2, extra=None):
            "o0": l0.tolist(), "o2": l2.tolist()}
     if extra:
         rec.update(extra)
-        ok = ok and extra.get("impl_parity_pass", True)
     return ok, rec
 
 
@@ -231,6 +232,15 @@ def resnet_curves():
     model_bf16 = resnet50(num_classes=n_cls, norm_axis_name="data",
                           dtype=jnp.bfloat16)
 
+    # structured learnable batches: each class has a fixed random
+    # template, images are template + noise — real signal, so the O0/O2
+    # trajectories are gradient-aligned rather than the chaotic BN
+    # feedback pure-noise images produce. Built ONCE here (602 MB fp32
+    # at the TPU shape) so the scan body closes over a constant instead
+    # of re-deriving it per step.
+    templates = jax.random.normal(
+        jax.random.PRNGKey(99), (n_cls, img, img, 3), jnp.float32)
+
     def make(mod):
         def init_fn():
             x0 = jnp.zeros((2, img, img, 3), jnp.float32)
@@ -240,14 +250,8 @@ def resnet_curves():
             return variables["params"], variables["batch_stats"]
 
         def loss_fn_of(key, bstats):
-            # structured learnable batches: each class has a fixed random
-            # template, images are template + noise — real signal, so the
-            # O0/O2 trajectories are gradient-aligned rather than the
-            # chaotic BN feedback pure-noise images produce
             kx, ky = jax.random.split(key)
             y = jax.random.randint(ky, (b,), 0, n_cls, jnp.int32)
-            templates = jax.random.normal(
-                jax.random.PRNGKey(99), (n_cls, img, img, 3), jnp.float32)
             x = (templates[y]
                  + 0.3 * jax.random.normal(kx, (b, img, img, 3),
                                            jnp.float32))

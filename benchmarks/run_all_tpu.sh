@@ -41,6 +41,9 @@ run gpt_ln_pallas     900 env APEX_LN_PALLAS=1 python benchmarks/profile_gpt.py
 run attn_seq4096      900 env APEX_ATTN_SEQ=4096 python benchmarks/profile_attention.py
 run resnet           1200 python benchmarks/profile_resnet.py
 run pretrain         1800 python benchmarks/profile_pretrain.py
+# L1-analog convergence curves (GPT + RN50, O0 vs O2 + impl-parity leg):
+# 6 short training runs; the traces land in benchmarks/curves/
+run convergence      2400 python benchmarks/profile_convergence.py
 run bench            5900 python bench.py
 
 echo "=== done; feed the logs into PERF.md"

@@ -327,7 +327,7 @@ def _dense_mscale(seed, b, h, sq, sk, p):
     for ib in range(b):
         for ih in range(h):
             out[ib, ih] = np.asarray(ap._dropout_mscale(
-                seed, jnp.int32(ib), jnp.int32(ih), 0, sq, sk, p, h, sq))
+                seed, jnp.int32(ib), jnp.int32(ih), 0, sq, sk, p, h))
     return jnp.asarray(out)
 
 

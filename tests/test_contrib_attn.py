@@ -242,7 +242,7 @@ def test_fmha_dropout_grads_finite_and_match_masked_dense():
     for head in range(h):
         ms = np.asarray(ap._dropout_mscale(
             seed[0, 0], jnp.int32(0), jnp.int32(head), 0, total, total,
-            p, h, total))
+            p, h))
         s = (np.asarray(q[:, head]) / np.sqrt(d)) @ np.asarray(k[:, head]).T
         s = np.where(same, s, -1e30)
         pr = np.exp(s - s.max(-1, keepdims=True))

@@ -192,25 +192,38 @@ def test_pipeline_impl_knob_validation(batch):
     # validation applies on the forward-only path too
     with pytest.raises(ValueError, match="unknown pipeline impl"):
         run_pipeline(batch, impl="bogus", forward_only=True)
-    # explicit 1f1b + interleaving is un-honorable (per-call knobs raise)
-    with pytest.raises(ValueError, match="num_chunks > 1"):
-        from apex_tpu.transformer.pipeline_parallel.schedules import (
-            _pipelined_fwd_bwd,
-        )
-        mesh = pp_mesh()
 
-        def run(mbs, sp):
-            loss, _ = _pipelined_fwd_bwd(
+
+def test_pipeline_interleaved_1f1b_matches_sequential(batch):
+    """The 1f1b core's virtual-chunk rings (per-chunk save/replay with
+    the mirrored cotangent chunk-wrap) against the closed-form
+    sequential composition — and against the AD-scan interleaved core."""
+    mesh = pp_mesh()
+    stacked = np.stack([stage_weight(p, 2) for p in range(PP)])
+
+    def run(impl):
+        def body(mbs, sp):
+            loss, grads = forward_backward_pipelining_with_interleaving(
                 (stage_fn, embed_fn, loss_fn), mbs,
                 (sp[0], jnp.asarray(1.5), jnp.asarray(2.0)),
-                num_microbatches=M, axis_name="pp", forward_only=False,
-                checkpoint_stages=True, num_chunks=2, impl="1f1b")
-            return loss
+                num_microbatches=M, num_model_chunks=2, axis_name="pp",
+                impl=impl)
+            return loss, grads[0][None], grads[1], grads[2]
 
-        shard_map(run, mesh=mesh, in_specs=(P(), P("pp")), out_specs=P(),
-                  check_vma=False)(
-            jnp.asarray(batch),
-            jnp.asarray(np.stack([stage_weight(p, 2) for p in range(PP)])))
+        f = shard_map(body, mesh=mesh, in_specs=(P(), P("pp")),
+                      out_specs=(P(), P("pp"), P(), P()), check_vma=False)
+        out = jax.jit(f)(jnp.asarray(batch), jnp.asarray(stacked))
+        return tuple(np.asarray(o) for o in out)
+
+    got = run("1f1b")
+    ref_loss, (rgs, rge, rgc) = sequential_reference_grads(batch, chunks=2)
+    np.testing.assert_allclose(got[0].item(), ref_loss.item(), rtol=1e-5)
+    np.testing.assert_allclose(got[1], rgs, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(got[2], rge, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(got[3], rgc, rtol=1e-4, atol=1e-6)
+    ad = run("adscan")
+    for g, w in zip(got, ad):
+        np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-7)
 
 
 def test_pipeline_forward_only(batch):
