@@ -36,3 +36,151 @@ def test_bench_smoke_json_contract():
     assert rec["unit"] == "tokens/s"
     assert rec["value"] > 0, rec
     assert "error" not in rec, rec
+
+
+def _fake_rec(value, fused):
+    return {"metric": "gpt2s_train_tokens_per_sec (tpu)", "value": value,
+            "unit": "tokens/s", "vs_baseline": 1.0, "mfu": 0.4,
+            "config": {"fused_lm_head": fused}}
+
+
+def test_watchdog_config_ladder(monkeypatch, capsys):
+    """The retry ladder A/Bs the fused-LM-head config: both configs get a
+    healthy attempt, the higher-throughput line wins, exactly one JSON
+    line is printed."""
+    sys.path.insert(0, REPO)
+    import bench
+
+    calls = []
+
+    def fake_attempt(state, extra_env=None):
+        fused = bool((extra_env or {}).get("APEX_FUSED_LM_HEAD"))
+        calls.append(fused)
+        rec = _fake_rec(120.0 if fused else 100.0, fused)
+        return json.dumps(rec), rec, 0
+
+    monkeypatch.setattr(bench, "_attempt_once", fake_attempt)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.setenv("APEX_BENCH_ATTEMPTS", "3")
+    monkeypatch.delenv("APEX_BENCH_SMOKE", raising=False)
+    for k in ("APEX_FUSED_LM_HEAD", "APEX_ATTN_IMPL", "APEX_LN_PALLAS"):
+        monkeypatch.delenv(k, raising=False)
+    rc = bench._watchdog()
+    out = [l for l in capsys.readouterr().out.splitlines()
+           if l.startswith("{")]
+    assert rc == 0
+    assert calls == [False, True]  # both configs, then early stop
+    assert len(out) == 1
+    rec = json.loads(out[0])
+    assert rec["value"] == 120.0 and rec["config"]["fused_lm_head"]
+
+
+def test_watchdog_ladder_retries_unhealthy_config(monkeypatch, capsys):
+    """A degraded base attempt gets retried on the flap-retry slot after
+    the fused attempt lands healthy; an explicit knob pin disables the
+    ladder entirely."""
+    sys.path.insert(0, REPO)
+    import bench
+
+    calls = []
+
+    def fake_attempt(state, extra_env=None):
+        fused = bool((extra_env or {}).get("APEX_FUSED_LM_HEAD"))
+        calls.append(fused)
+        if len(calls) == 1:
+            rec = dict(_fake_rec(5.0, fused), note="relay degraded",
+                       degraded_kind="relay")
+        else:
+            rec = _fake_rec(120.0 if fused else 100.0, fused)
+        return json.dumps(rec), rec, 0
+
+    monkeypatch.setattr(bench, "_attempt_once", fake_attempt)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.setenv("APEX_BENCH_ATTEMPTS", "3")
+    monkeypatch.delenv("APEX_BENCH_SMOKE", raising=False)
+    for k in ("APEX_FUSED_LM_HEAD", "APEX_ATTN_IMPL", "APEX_LN_PALLAS"):
+        monkeypatch.delenv(k, raising=False)
+    rc = bench._watchdog()
+    out = [l for l in capsys.readouterr().out.splitlines()
+           if l.startswith("{")]
+    assert rc == 0
+    assert calls == [False, True, False]  # degraded base retried last
+    assert json.loads(out[0])["value"] == 120.0
+
+    # explicit pin: the ladder collapses to the caller's env verbatim
+    calls.clear()
+    monkeypatch.setenv("APEX_FUSED_LM_HEAD", "1")
+
+    def fake_pinned(state, extra_env=None):
+        merged = dict(os.environ, **(extra_env or {}))
+        fused = merged.get("APEX_FUSED_LM_HEAD") == "1"
+        calls.append(fused)
+        rec = _fake_rec(120.0, fused)
+        return json.dumps(rec), rec, 0
+
+    monkeypatch.setattr(bench, "_attempt_once", fake_pinned)
+    rc = bench._watchdog()
+    capsys.readouterr()
+    assert rc == 0
+    assert calls == [True]  # pinned config, healthy first attempt, done
+
+
+def test_watchdog_ladder_retries_degraded_fused_config(monkeypatch, capsys):
+    """The spare attempt goes to whichever config lacks a healthy line —
+    including one whose original slot already ran (fused degraded on
+    attempt 2 gets attempt 3)."""
+    sys.path.insert(0, REPO)
+    import bench
+
+    calls = []
+
+    def fake_attempt(state, extra_env=None):
+        fused = bool((extra_env or {}).get("APEX_FUSED_LM_HEAD"))
+        calls.append(fused)
+        if len(calls) == 2:  # the fused slot flaps
+            rec = dict(_fake_rec(5.0, fused), note="relay degraded",
+                       degraded_kind="relay")
+        else:
+            rec = _fake_rec(130.0 if fused else 100.0, fused)
+        return json.dumps(rec), rec, 0
+
+    monkeypatch.setattr(bench, "_attempt_once", fake_attempt)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.setenv("APEX_BENCH_ATTEMPTS", "3")
+    monkeypatch.delenv("APEX_BENCH_SMOKE", raising=False)
+    for k in ("APEX_FUSED_LM_HEAD", "APEX_ATTN_IMPL", "APEX_LN_PALLAS"):
+        monkeypatch.delenv(k, raising=False)
+    rc = bench._watchdog()
+    out = [l for l in capsys.readouterr().out.splitlines()
+           if l.startswith("{")]
+    assert rc == 0
+    assert calls == [False, True, True]  # fused retried on the spare slot
+    assert json.loads(out[0])["value"] == 130.0
+
+
+def test_watchdog_cpu_only_box_runs_once(monkeypatch, capsys):
+    """A clean first-attempt CPU line (no TPU hardware) collapses the
+    ladder: no second full bench for a CPU 'A/B'."""
+    sys.path.insert(0, REPO)
+    import bench
+
+    calls = []
+
+    def fake_attempt(state, extra_env=None):
+        calls.append(bool((extra_env or {}).get("APEX_FUSED_LM_HEAD")))
+        rec = dict(_fake_rec(90.0, False),
+                   metric="gpt2s_train_tokens_per_sec (cpu)")
+        return json.dumps(rec), rec, 0
+
+    monkeypatch.setattr(bench, "_attempt_once", fake_attempt)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.setenv("APEX_BENCH_ATTEMPTS", "3")
+    monkeypatch.delenv("APEX_BENCH_SMOKE", raising=False)
+    for k in ("APEX_FUSED_LM_HEAD", "APEX_ATTN_IMPL", "APEX_LN_PALLAS"):
+        monkeypatch.delenv(k, raising=False)
+    rc = bench._watchdog()
+    out = [l for l in capsys.readouterr().out.splitlines()
+           if l.startswith("{")]
+    assert rc == 0
+    assert calls == [False]
+    assert json.loads(out[0])["value"] == 90.0
