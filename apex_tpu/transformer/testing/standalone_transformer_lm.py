@@ -81,6 +81,14 @@ class TransformerConfig:
     # materialized path otherwise. _interpret is for CPU tests.
     fused_lm_head: bool = False
     fused_lm_head_interpret: bool = False
+    # training with attention_dropout > 0 (causal, no explicit mask):
+    # route through the VMEM-rows kernel's in-kernel hash dropout instead
+    # of the materialized-scores path. Default follows the committed
+    # measurement (PERF.md §3: rows fwd+d(q,k,v) 1.82 ms vs XLA dense
+    # 4.34 ms at GPT shape — the scores path additionally writes the
+    # [b·h, s, s] probs to HBM); the in-kernel dropout delta rides the
+    # queued device row (PERF.md §9). False restores the scores path.
+    fused_attention_dropout: bool = True
     sequence_parallel: bool = False
     # context parallelism: mesh axis the SEQUENCE dim is sharded over for
     # the whole model (hidden states are [s/cp, b, h]); attention runs the
@@ -352,6 +360,18 @@ class ParallelAttention(nn.Module):
             kv = kv.reshape(sk, b, np_local, 2 * hd)
             k, v = jnp.split(kv, 2, axis=-1)
 
+        # the output projection is shared by every dispatch branch below —
+        # constructed once so the paths cannot drift apart (the flax
+        # param path stays "dense" whichever branch traces)
+        dense = RowParallelLinear(
+            proj_size, cfg.hidden_size, input_is_parallel=True,
+            skip_bias_add=True,
+            init_method=scaled_init_method_normal(cfg.init_method_std,
+                                                  cfg.num_layers),
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            params_dtype=cfg.params_dtype, axis_name=self.axis_name,
+            name="dense")
+
         # flash path: causal self-attention with no explicit mask and no
         # attention dropout lowers to the Pallas flash kernel on TPU (the
         # fmhalib / fused-softmax replacement); other configs take the
@@ -361,6 +381,33 @@ class ParallelAttention(nn.Module):
             and attention_mask is None
             and (deterministic or cfg.attention_dropout == 0.0)
         )
+        # training WITH attention dropout: the VMEM-rows kernel applies
+        # inverted dropout inside the kernel (counter-hash, replayed in
+        # backward) so the [b·h, s, s] probs never reach HBM — without
+        # this the dropout>0 config silently falls off every fused path
+        # (cfg.fused_attention_dropout documents the measured default)
+        if (not use_flash
+                and self.attn_mask_type == AttnMaskType.causal
+                and attention_mask is None
+                and cfg.fused_attention_dropout
+                and cfg.context_parallel_axis is None):
+            from apex_tpu.ops import attention_pallas
+
+            s_len, kv_len = q.shape[0], k.shape[0]
+            if attention_pallas.supported(s_len, kv_len, hd, dropout=True):
+                seed = jax.random.randint(
+                    self.make_rng("dropout"), (1, 1), -2**31, 2**31 - 1,
+                    jnp.int32)
+                qf = q.transpose(1, 2, 0, 3)
+                kf = k.transpose(1, 2, 0, 3)
+                vf = v.transpose(1, 2, 0, 3)
+                interpret = jax.devices()[0].platform == "cpu"
+                ctx = attention_pallas.fused_attention_rows(
+                    qf, kf, vf, True, 1.0 / math.sqrt(hd), None, interpret,
+                    None, None, float(cfg.attention_dropout), seed)
+                ctx = ctx.transpose(2, 0, 1, 3).reshape(
+                    q.shape[0], q.shape[1], np_local * hd)
+                return dense(ctx)
         if use_flash:
             from apex_tpu.ops import fused_attention, ring_attention
 
@@ -380,14 +427,6 @@ class ParallelAttention(nn.Module):
                                       sm_scale=1.0 / math.sqrt(hd))
             ctx = ctx.transpose(2, 0, 1, 3).reshape(
                 q.shape[0], q.shape[1], np_local * hd)
-            dense = RowParallelLinear(
-                proj_size, cfg.hidden_size, input_is_parallel=True,
-                skip_bias_add=True,
-                init_method=scaled_init_method_normal(cfg.init_method_std,
-                                                      cfg.num_layers),
-                sequence_parallel_enabled=cfg.sequence_parallel,
-                params_dtype=cfg.params_dtype, axis_name=self.axis_name,
-                name="dense")
             return dense(ctx)
 
         if cfg.context_parallel_axis is not None:
@@ -429,14 +468,6 @@ class ParallelAttention(nn.Module):
         ctx = ctx.reshape(-1, np_local, sq, hd).transpose(2, 0, 1, 3)
         ctx = ctx.reshape(sq, ctx.shape[1], np_local * hd)
 
-        dense = RowParallelLinear(
-            proj_size, cfg.hidden_size, input_is_parallel=True,
-            skip_bias_add=True,
-            init_method=scaled_init_method_normal(cfg.init_method_std,
-                                                  cfg.num_layers),
-            sequence_parallel_enabled=cfg.sequence_parallel,
-            params_dtype=cfg.params_dtype, axis_name=self.axis_name,
-            name="dense")
         out, bias = dense(ctx)
         return out, bias
 
