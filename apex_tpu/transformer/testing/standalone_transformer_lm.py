@@ -312,7 +312,7 @@ class ParallelAttention(nn.Module):
 
     @nn.compact
     def __call__(self, hidden, attention_mask, encoder_output=None,
-                 deterministic=True):
+                 deterministic=True, padding_validity=None):
         cfg = self.cfg
         tp = lax.axis_size(self.axis_name)
         np_local = divide(cfg.num_attention_heads, tp)
@@ -385,26 +385,50 @@ class ParallelAttention(nn.Module):
         # inverted dropout inside the kernel (counter-hash, replayed in
         # backward) so the [b·h, s, s] probs never reach HBM — without
         # this the dropout>0 config silently falls off every fused path
-        # (cfg.fused_attention_dropout documents the measured default)
+        # (cfg.fused_attention_dropout documents the measured default).
+        # Two eligible mask forms:
+        #   * causal self-attention, no explicit mask (GPT);
+        #   * padding-type self-attention whose [b, s] key validity was
+        #     threaded down (BERT) — expressed as segment ids (valid=0,
+        #     pad=1): valid queries exclude exactly the pad keys (the
+        #     extended mask's semantics for them); pad ROWS attend pad
+        #     keys — finite garbage the caller's loss mask drops, the
+        #     same contract as fmhalib's packed path (reference
+        #     contrib/fmha/fmha.py:33-61, where pad rows don't exist)
+        drop_causal = (self.attn_mask_type == AttnMaskType.causal
+                       and attention_mask is None)
+        drop_padding = (self.attn_mask_type == AttnMaskType.padding
+                        and padding_validity is not None
+                        and self.attention_type == AttnType.self_attn
+                        and q.shape[0] == k.shape[0]
+                        and fused_padding_dropout_eligible(
+                            cfg, deterministic, q.shape[0], hd))
         if (not use_flash
-                and self.attn_mask_type == AttnMaskType.causal
-                and attention_mask is None
+                and (drop_causal or drop_padding)
+                and not deterministic and cfg.attention_dropout > 0.0
                 and cfg.fused_attention_dropout
                 and cfg.context_parallel_axis is None):
             from apex_tpu.ops import attention_pallas
 
             s_len, kv_len = q.shape[0], k.shape[0]
-            if attention_pallas.supported(s_len, kv_len, hd, dropout=True):
+            if attention_pallas.supported(s_len, kv_len, hd,
+                                          dropout=True) or drop_padding:
                 seed = jax.random.randint(
                     self.make_rng("dropout"), (1, 1), -2**31, 2**31 - 1,
                     jnp.int32)
+                segs = None
+                if drop_padding:
+                    pad_ids = (padding_validity.astype(jnp.int32)
+                               == 0).astype(jnp.int32)
+                    segs = (pad_ids, pad_ids)
                 qf = q.transpose(1, 2, 0, 3)
                 kf = k.transpose(1, 2, 0, 3)
                 vf = v.transpose(1, 2, 0, 3)
                 interpret = jax.devices()[0].platform == "cpu"
                 ctx = attention_pallas.fused_attention_rows(
-                    qf, kf, vf, True, 1.0 / math.sqrt(hd), None, interpret,
-                    None, None, float(cfg.attention_dropout), seed)
+                    qf, kf, vf, drop_causal, 1.0 / math.sqrt(hd), segs,
+                    interpret, None, None, float(cfg.attention_dropout),
+                    seed)
                 ctx = ctx.transpose(2, 0, 1, 3).reshape(
                     q.shape[0], q.shape[1], np_local * hd)
                 return dense(ctx)
@@ -491,7 +515,8 @@ class ParallelTransformerLayer(nn.Module):
 
     @nn.compact
     def __call__(self, hidden, attention_mask, encoder_output=None,
-                 enc_dec_attn_mask=None, deterministic=True):
+                 enc_dec_attn_mask=None, deterministic=True,
+                 padding_validity=None):
         cfg = self.cfg
         ln = FusedLayerNorm(normalized_shape=cfg.hidden_size,
                             eps=cfg.layernorm_epsilon,
@@ -528,7 +553,7 @@ class ParallelTransformerLayer(nn.Module):
         # positional call: nn.remat's static_argnums counts self at 0, so
         # deterministic must arrive as positional arg 4
         attn_out, attn_bias = attn(ln(hidden), attention_mask, None,
-                                   deterministic)
+                                   deterministic, padding_validity)
         hidden = _layer_bias_dropout_add(attn_out, attn_bias, hidden)
 
         if self.layer_type == LayerType.decoder:
@@ -566,7 +591,8 @@ class ParallelTransformer(nn.Module):
     axis_name: str = TENSOR_AXIS
 
     @nn.compact
-    def __call__(self, hidden, attention_mask, deterministic=True):
+    def __call__(self, hidden, attention_mask, deterministic=True,
+                 padding_validity=None):
         cfg = self.cfg
         layer_cls = ParallelTransformerLayer
         if self.recompute_activations:
@@ -579,7 +605,8 @@ class ParallelTransformer(nn.Module):
                 cfg, layer_number=i + 1,
                 self_attn_mask_type=self.self_attn_mask_type,
                 axis_name=self.axis_name, name=f"layer_{i}")
-            hidden = layer(hidden, attention_mask, None, None, deterministic)
+            hidden = layer(hidden, attention_mask, None, None,
+                           deterministic, padding_validity)
         if self.post_process and self.post_layer_norm:
             hidden = FusedLayerNorm(normalized_shape=cfg.hidden_size,
                                     eps=cfg.layernorm_epsilon,
@@ -894,6 +921,20 @@ class Pooler(nn.Module):
 # ---------------------------------------------------------------------------
 
 
+def fused_padding_dropout_eligible(cfg, deterministic, s_len, hd):
+    """Static predicate shared by BertModel and ParallelAttention: does
+    padding-type training-with-dropout route through the rows kernel?
+    Both sides must agree — BertModel skips building the [b, 1, s, s]
+    extended mask exactly when the attention will not read it."""
+    from apex_tpu.ops import attention_pallas
+
+    return (cfg.fused_attention_dropout
+            and not deterministic
+            and cfg.attention_dropout > 0.0
+            and cfg.context_parallel_axis is None
+            and attention_pallas.supported(s_len, s_len, hd, dropout=True))
+
+
 def bert_extended_attention_mask(attention_mask):
     """[b, s] (1 = attend) → [b, 1, s, s] boolean, True = masked out
     (reference: standalone_bert.py bert_extended_attention_mask — builds
@@ -956,7 +997,15 @@ class BertModel(nn.Module):
                  lm_labels=None, deterministic=True, hidden_state=None):
         cfg = self.cfg
         position_ids = bert_position_ids(input_ids)
-        ext_mask = bert_extended_attention_mask(attention_mask)
+        # when every layer's self-attention will take the fused
+        # segment-id dropout route, the [b, 1, s, s] extended mask is
+        # never read — don't build it (it would be the very [s, s]
+        # materialization the route exists to avoid)
+        if fused_padding_dropout_eligible(
+                cfg, deterministic, input_ids.shape[1], cfg.head_dim):
+            ext_mask = None
+        else:
+            ext_mask = bert_extended_attention_mask(attention_mask)
 
         word_embeddings = _word_embeddings_param(self, cfg,
                                                  self.axis_name)
@@ -975,7 +1024,8 @@ class BertModel(nn.Module):
             pre_process=self.pre_process, post_process=self.post_process,
             recompute_activations=(cfg.recompute_granularity == "full"),
             axis_name=self.axis_name, name="transformer")(
-            hidden, ext_mask, deterministic=deterministic)
+            hidden, ext_mask, deterministic=deterministic,
+            padding_validity=attention_mask)
 
         if not self.post_process:
             return hidden

@@ -288,6 +288,31 @@ def test_recompute_granularity_grads_match(granularity):
                                atol=1e-7)
 
 
+def _sub_jaxprs(val):
+    if hasattr(val, "eqns"):          # raw Jaxpr (e.g. shard_map)
+        yield val
+    elif hasattr(val, "jaxpr"):       # ClosedJaxpr (e.g. pjit)
+        yield val.jaxpr
+    elif isinstance(val, (list, tuple)):
+        for x in val:
+            yield from _sub_jaxprs(x)
+
+
+def _has_ss_aval(jaxpr, size):
+    """Any aval of rank >= 3 whose last two dims are (size, size) — the
+    materialized-attention-scores signature — anywhere in the jaxpr."""
+    for eqn in jaxpr.eqns:
+        for v in list(eqn.invars) + list(eqn.outvars):
+            shp = getattr(getattr(v, "aval", None), "shape", ())
+            if len(shp) >= 3 and shp[-1] == size and shp[-2] == size:
+                return True
+        for val in eqn.params.values():
+            for inner in _sub_jaxprs(val):
+                if _has_ss_aval(inner, size):
+                    return True
+    return False
+
+
 def _attn_dropout_cfgs(s):
     kw = dict(hidden_size=32, num_layers=1, num_attention_heads=2,
               vocab_size=64, max_position_embeddings=s,
@@ -311,28 +336,6 @@ def test_gpt_attention_dropout_routes_fused_no_ss_materialization():
     pos = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
     labels = jnp.asarray(rs.randint(0, 64, (b, s)))
 
-    def sub_jaxprs(val):
-        if hasattr(val, "eqns"):          # raw Jaxpr (e.g. shard_map)
-            yield val
-        elif hasattr(val, "jaxpr"):       # ClosedJaxpr (e.g. pjit)
-            yield val.jaxpr
-        elif isinstance(val, (list, tuple)):
-            for x in val:
-                yield from sub_jaxprs(x)
-
-    def has_ss_aval(jaxpr, size):
-        for eqn in jaxpr.eqns:
-            for v in list(eqn.invars) + list(eqn.outvars):
-                shp = getattr(getattr(v, "aval", None), "shape", ())
-                if (len(shp) >= 3 and shp[-1] == size
-                        and shp[-2] == size):
-                    return True
-            for val in eqn.params.values():
-                for inner in sub_jaxprs(val):
-                    if has_ss_aval(inner, size):
-                        return True
-        return False
-
     ss = {}
     for name, cfg in (("fused", cfg_fused), ("dense", cfg_dense)):
         model = GPTModel(cfg)
@@ -355,7 +358,7 @@ def test_gpt_attention_dropout_routes_fused_no_ss_materialization():
             smap(init_fn, mesh, (P(), P()), P()), ids, pos)
         ft = smap(train_loss, mesh, (P(), P(), P(), P()), P())
         jaxpr = jax.make_jaxpr(ft)(params_shape, ids, pos, labels)
-        ss[name] = has_ss_aval(jaxpr.jaxpr, s)
+        ss[name] = _has_ss_aval(jaxpr.jaxpr, s)
 
     assert not ss["fused"], \
         "fused dropout path still materializes an [.., s, s] tensor"
@@ -394,3 +397,79 @@ def test_gpt_attention_dropout_fused_path_trains():
     assert np.isfinite(float(loss))
     for leaf in jax.tree_util.tree_leaves(grads):
         assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_bert_attention_dropout_routes_fused_no_ss_materialization():
+    """BERT's padding-mask training-with-dropout routes through the rows
+    kernel with the [b, s] validity expressed as segment ids: no
+    [.., s, s] tensor in the training jaxpr (knob off: present)."""
+    b, s = 2, 128
+    kw = dict(hidden_size=32, num_layers=1, num_attention_heads=2,
+              vocab_size=64, max_position_embeddings=s,
+              hidden_dropout=0.0, attention_dropout=0.3,
+              bert_binary_head=False)
+    mesh = tp_mesh(2)
+    rs = np.random.RandomState(7)
+    ids = jnp.asarray(rs.randint(0, 64, (b, s)))
+    mask = jnp.ones((b, s), jnp.int32).at[:, 100:].set(0)  # tail pads
+    labels = jnp.asarray(rs.randint(0, 64, (b, s)))
+
+    ss = {}
+    for name, fused in (("fused", True), ("dense", False)):
+        model = BertModel(TransformerConfig(
+            fused_attention_dropout=fused, **kw))
+
+        def train_loss(params, ids, mask, labels, model=model):
+            per_tok, _ = model.apply(
+                {"params": params}, ids, mask, lm_labels=labels,
+                deterministic=False,
+                rngs={"dropout": jax.random.PRNGKey(3)})
+            return jnp.mean(per_tok)
+
+        def init_fn(ids, mask, model=model):
+            return model.init(jax.random.PRNGKey(0), ids, mask)["params"]
+
+        params_shape = jax.eval_shape(
+            smap(init_fn, mesh, (P(), P()), P()), ids, mask)
+        ft = smap(train_loss, mesh, (P(), P(), P(), P()), P())
+        jaxpr = jax.make_jaxpr(ft)(params_shape, ids, mask, labels)
+        ss[name] = _has_ss_aval(jaxpr.jaxpr, s)
+
+    assert not ss["fused"], \
+        "BERT fused dropout path still materializes an [.., s, s] tensor"
+    assert ss["dense"], "structural check lost its teeth"
+
+
+@pytest.mark.slow  # interpret-mode rows kernel fwd on CPU
+def test_bert_fused_dropout_valid_rows_isolated_from_pads():
+    """Under the segment-id formulation, valid-position losses are exactly
+    invariant to pad-token CONTENT (valid queries never see pad keys);
+    pad-position outputs are loss-masked garbage by contract."""
+    b, s, n_pad = 2, 128, 28
+    model = BertModel(TransformerConfig(
+        hidden_size=32, num_layers=1, num_attention_heads=2, vocab_size=64,
+        max_position_embeddings=s, hidden_dropout=0.0,
+        attention_dropout=0.3, bert_binary_head=False,
+        fused_attention_dropout=True))
+    mesh = tp_mesh(2)
+    rs = np.random.RandomState(8)
+    ids = np.asarray(rs.randint(0, 64, (b, s)), np.int32)
+    mask = jnp.ones((b, s), jnp.int32).at[:, s - n_pad:].set(0)
+    labels = jnp.asarray(rs.randint(0, 64, (b, s)))
+
+    def per_tok_loss(ids, mask, labels):
+        params = model.init(jax.random.PRNGKey(0), ids, mask)["params"]
+        per_tok, _ = model.apply(
+            {"params": params}, ids, mask, lm_labels=labels,
+            deterministic=False, rngs={"dropout": jax.random.PRNGKey(5)})
+        return per_tok
+
+    f = smap(per_tok_loss, mesh, (P(), P(), P()), P())
+    base = np.asarray(f(jnp.asarray(ids), mask, labels))
+    ids2 = ids.copy()
+    ids2[:, s - n_pad:] = rs.randint(0, 64, (b, n_pad))  # scramble pads
+    pert = np.asarray(f(jnp.asarray(ids2), mask, labels))
+    # NOTE: init params depend only on shapes, identical across calls
+    np.testing.assert_array_equal(base[:, :s - n_pad],
+                                  pert[:, :s - n_pad])
+    assert np.isfinite(base).all() and np.isfinite(pert).all()
