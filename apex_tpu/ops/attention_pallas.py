@@ -161,10 +161,12 @@ def _fmix32(x):
     return x
 
 
-def _dropout_mscale(seed, ib, ih, row0, rows, sk, p, n_heads):
+def _dropout_mscale(seed, ib, ih, row0, rows, sk, p, n_heads, col0=0):
     """fp32 [rows, sk] inverted-dropout scale (keep/(1-p), drop→0) for
-    the score block whose global rows start at ``row0``. ``seed`` is a
-    traced uint32/int32 scalar; ``ib``/``ih`` the batch/head indices.
+    the score block whose global rows start at ``row0`` and columns at
+    ``col0`` (ring-attention blocks pass a nonzero col0 so every rank
+    regenerates the same global mask). ``seed`` is a traced
+    uint32/int32 scalar; ``ib``/``ih`` the batch/head indices.
 
     The hash is CHAINED, not a flat element counter: seed → per-(b, h)
     key → per-row key → per-element bits, one fmix32 avalanche per
@@ -180,7 +182,7 @@ def _dropout_mscale(seed, ib, ih, row0, rows, sk, p, n_heads):
     """
     u32 = lambda x: jnp.asarray(x).astype(jnp.uint32)
     row = u32(row0) + lax.broadcasted_iota(jnp.uint32, (rows, 1), 0)
-    col = lax.broadcasted_iota(jnp.uint32, (rows, sk), 1)
+    col = u32(col0) + lax.broadcasted_iota(jnp.uint32, (rows, sk), 1)
     s = _fmix32(jnp.uint32(0x9E3779B9) ^ u32(seed))
     s_bh = _fmix32(s ^ (u32(ib) * jnp.uint32(n_heads) + u32(ih)))
     rowkey = _fmix32(s_bh ^ row)            # [rows, 1]

@@ -36,7 +36,8 @@ from apex_tpu.ops.attention import fused_attention
 NEG_INF = -1e30
 
 
-def ring_attention(q, k, v, axis_name, *, causal=True, sm_scale=None):
+def ring_attention(q, k, v, axis_name, *, causal=True, sm_scale=None,
+                   dropout_p=0.0, dropout_seed=None):
     """Ring attention over sequence shards.
 
     Args:
@@ -45,11 +46,23 @@ def ring_attention(q, k, v, axis_name, *, causal=True, sm_scale=None):
       axis_name: mesh axis the sequence is sharded over (inside shard_map).
       causal: apply the global lower-triangular mask.
       sm_scale: softmax scale; default 1/sqrt(d).
+      dropout_p / dropout_seed: inverted attention-probability dropout,
+        applied INSIDE the ring with the same coordinate-chained hash as
+        the rows kernel (attention_pallas._dropout_mscale, keyed on
+        GLOBAL (b, h, row, col)) — every rank regenerates its slice of
+        one consistent global mask, and dropping the unnormalized block
+        probs while accumulating the UNdropped row sums is exactly
+        dropout on the normalized probabilities. ``dropout_seed`` must be
+        the same traced int32 scalar on every rank.
 
     Returns [b, h, s_local, d] in q.dtype.
     """
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if not 0.0 <= dropout_p < 1.0:
+        raise ValueError(f"dropout_p={dropout_p} outside [0, 1)")
+    if dropout_p > 0.0 and dropout_seed is None:
+        raise ValueError("dropout_p > 0 requires dropout_seed")
     cp = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, h, s, d = q.shape
@@ -82,9 +95,18 @@ def ring_attention(q, k, v, axis_name, *, causal=True, sm_scale=None):
         p = jnp.exp(scores - m_new[..., None])
         if causal:
             p = jnp.where(block_mask[None, None], 0.0, p)
-        l_new = l * alpha + jnp.sum(p, axis=-1)
+        l_new = l * alpha + jnp.sum(p, axis=-1)   # UNdropped row sums
+        pd = p
+        if dropout_p > 0.0:
+            from apex_tpu.ops.attention_pallas import _dropout_mscale
+
+            mscale = jax.vmap(lambda ib: jax.vmap(
+                lambda ih: _dropout_mscale(
+                    dropout_seed, ib, ih, idx * s, s, s, dropout_p, h,
+                    col0=src * s))(jnp.arange(h)))(jnp.arange(b))
+            pd = p * mscale
         o_new = o * alpha[..., None] + lax.dot_general(
-            p, v_cur.astype(jnp.float32),
+            pd, v_cur.astype(jnp.float32),
             (((3,), (2,)), ((0, 1), (0, 1))))
 
         k_nxt = lax.ppermute(k_cur, axis_name, perm)

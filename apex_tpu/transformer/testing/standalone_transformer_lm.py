@@ -406,16 +406,40 @@ class ParallelAttention(nn.Module):
         if (not use_flash
                 and (drop_causal or drop_padding)
                 and not deterministic and cfg.attention_dropout > 0.0
-                and cfg.fused_attention_dropout
-                and cfg.context_parallel_axis is None):
+                and cfg.fused_attention_dropout):
             from apex_tpu.ops import attention_pallas
 
+            def _drop_seed():
+                # derived lazily so a fall-through (unsupported shape)
+                # doesn't advance the flax rng stream for nn.Dropout
+                return derive_attention_dropout_seed(
+                    self.make_rng("dropout"), self.axis_name)
+
+            if drop_causal and cfg.context_parallel_axis is not None:
+                # context-parallel training with dropout: the ring
+                # regenerates its slice of the global hash mask per
+                # block (previously this combination raised)
+                from apex_tpu.ops import ring_attention
+
+                seed = _drop_seed()
+                qf = q.transpose(1, 2, 0, 3)
+                kf = k.transpose(1, 2, 0, 3)
+                vf = v.transpose(1, 2, 0, 3)
+                ctx = ring_attention(
+                    qf, kf, vf, cfg.context_parallel_axis, causal=True,
+                    sm_scale=1.0 / math.sqrt(hd),
+                    dropout_p=float(cfg.attention_dropout),
+                    dropout_seed=seed[0, 0])
+                ctx = ctx.transpose(2, 0, 1, 3).reshape(
+                    q.shape[0], q.shape[1], np_local * hd)
+                return dense(ctx)
             s_len, kv_len = q.shape[0], k.shape[0]
-            if attention_pallas.supported(s_len, kv_len, hd,
-                                          dropout=True) or drop_padding:
-                seed = jax.random.randint(
-                    self.make_rng("dropout"), (1, 1), -2**31, 2**31 - 1,
-                    jnp.int32)
+            # (drop_padding already implies supported() via the shared
+            # eligibility predicate — the check is the single gate)
+            if (cfg.context_parallel_axis is None
+                    and attention_pallas.supported(s_len, kv_len, hd,
+                                                   dropout=True)):
+                seed = _drop_seed()
                 segs = None
                 if drop_padding:
                     pad_ids = (padding_validity.astype(jnp.int32)
@@ -919,6 +943,20 @@ class Pooler(nn.Module):
 # ---------------------------------------------------------------------------
 # BERT
 # ---------------------------------------------------------------------------
+
+
+def derive_attention_dropout_seed(key, axis_name):
+    """Per-rank int32 seed for the in-kernel/in-ring dropout hash.
+
+    The flax "dropout" rng is replicated across the mesh, and the hash
+    keys on LOCAL (head, row, col) coordinates — without folding the
+    tensor-parallel rank in, TP head shards would regenerate
+    bit-identical masks for corresponding local heads (silently
+    correlated dropout noise). fold_in(tp_rank) decorrelates the shards
+    while staying uniform along any OTHER axis (the cp ring requires
+    the same seed on every cp rank)."""
+    key = jax.random.fold_in(key, lax.axis_index(axis_name))
+    return jax.random.randint(key, (1, 1), -2**31, 2**31 - 1, jnp.int32)
 
 
 def fused_padding_dropout_eligible(cfg, deterministic, s_len, hd):

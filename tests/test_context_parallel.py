@@ -148,3 +148,117 @@ def test_gpt_context_parallel_matches_single():
                                rtol=2e-4)
     np.testing.assert_allclose(np.asarray(pe_cp), np.asarray(pe_1),
                                rtol=5e-3, atol=1e-5)
+
+
+# ------------------------------ dropout ------------------------------------
+
+def _global_mscale(seed, b, h, s_glob, p):
+    """Dense [b, h, s, s] keep-scale from the kernel's own chained hash
+    (global coordinates), for the dense reference."""
+    from apex_tpu.ops import attention_pallas as ap
+
+    out = np.zeros((b, h, s_glob, s_glob), np.float32)
+    for ib in range(b):
+        for ih in range(h):
+            out[ib, ih] = np.asarray(ap._dropout_mscale(
+                jnp.asarray(seed, jnp.int32), jnp.int32(ib), jnp.int32(ih),
+                0, s_glob, s_glob, p, h))
+    return out
+
+
+def test_ring_dropout_matches_dense_with_same_mask():
+    """Ring attention with in-ring dropout == dense attention with the
+    SAME global hash mask applied to the normalized probs — exact, fwd."""
+    p, seed = 0.3, 77
+    q, k, v = _data(3)
+    got = _run_cp(lambda q_, k_, v_, axis_name, causal: ring_attention(
+        q_, k_, v_, axis_name, causal=causal, dropout_p=p,
+        dropout_seed=jnp.int32(seed)), q, k, v, True)
+
+    # dense reference: softmax then mask the normalized probs
+    ms = _global_mscale(seed, B, H, S, p)
+    scale = 1.0 / np.sqrt(D)
+    sc = np.einsum("bhqd,bhkd->bhqk", np.asarray(q), np.asarray(k)) * scale
+    tri = np.triu(np.ones((S, S), bool), 1)
+    sc = np.where(tri, -1e30, sc)
+    e = np.exp(sc - sc.max(-1, keepdims=True))
+    probs = e / e.sum(-1, keepdims=True)
+    want = np.einsum("bhqk,bhkd->bhqd", probs * ms, np.asarray(v))
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-5)
+
+
+@pytest.mark.slow  # second ring compile; grads through the AD-reversed ring
+def test_ring_dropout_grads_finite_and_deterministic():
+    q, k, v = _data(4)
+
+    def run(seed):
+        def loss(q, k, v):
+            y = _run_cp(lambda q_, k_, v_, axis_name, causal:
+                        ring_attention(q_, k_, v_, axis_name, causal=causal,
+                                       dropout_p=0.4,
+                                       dropout_seed=jnp.int32(seed)),
+                        q, k, v, True)
+            return jnp.sum(y.astype(jnp.float32) ** 2)
+
+        l, g = jax.value_and_grad(loss, argnums=(0,))(q, k, v)
+        return float(l), np.asarray(g[0])
+
+    l1, g1 = run(5)
+    l2, g2 = run(5)
+    l3, _ = run(6)
+    assert np.isfinite(g1).all()
+    assert l1 == l2 and l1 != l3
+    np.testing.assert_array_equal(g1, g2)
+
+
+def test_ring_dropout_validation():
+    q, k, v = _data(5)
+    with pytest.raises(ValueError, match="dropout_seed"):
+        _run_cp(lambda q_, k_, v_, a, causal: ring_attention(
+            q_, k_, v_, a, causal=causal, dropout_p=0.3), q, k, v, True)
+    with pytest.raises(ValueError, match="outside"):
+        _run_cp(lambda q_, k_, v_, a, causal: ring_attention(
+            q_, k_, v_, a, causal=causal, dropout_p=1.0,
+            dropout_seed=jnp.int32(1)), q, k, v, True)
+
+
+@pytest.mark.slow
+def test_gpt_context_parallel_with_dropout_trains():
+    """context_parallel_axis + attention_dropout > 0 previously raised
+    NotImplementedError; now it routes through the in-ring hash dropout."""
+    from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+    from apex_tpu.transformer.testing import GPTModel, TransformerConfig
+
+    cfg = TransformerConfig(
+        hidden_size=64, num_layers=1, num_attention_heads=4,
+        vocab_size=128, max_position_embeddings=S,
+        hidden_dropout=0.0, attention_dropout=0.3,
+        context_parallel_axis="cp")
+    model = GPTModel(cfg)
+    rs = np.random.RandomState(9)
+    b = 2
+    ids = jnp.asarray(rs.randint(0, 128, (b, S)), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(S)[None, :], (b, S))
+    labels = jnp.asarray(rs.randint(0, 128, (b, S)), jnp.int32)
+    mesh = Mesh(np.array(jax.devices()[:CP]).reshape(1, CP),
+                (TENSOR_AXIS, "cp"))
+
+    def f(ids, pos, labels):
+        params = model.init(jax.random.PRNGKey(0), ids, pos,
+                            None)["params"]
+
+        def loss_fn(p):
+            per_tok = model.apply({"params": p}, ids, pos, None, labels,
+                                  deterministic=False,
+                                  rngs={"dropout": jax.random.PRNGKey(2)})
+            return jax.lax.pmean(jnp.mean(per_tok), "cp")
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        return loss, grads["embedding"]["position_embeddings"]
+
+    seq = P(None, "cp")
+    loss, pe = shard_map(f, mesh=mesh, in_specs=(seq, seq, seq),
+                         out_specs=(P(), P()), check_vma=False)(
+        ids, pos, labels)
+    assert np.isfinite(float(loss))
+    assert np.isfinite(np.asarray(pe)).all()

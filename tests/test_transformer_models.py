@@ -473,3 +473,20 @@ def test_bert_fused_dropout_valid_rows_isolated_from_pads():
     np.testing.assert_array_equal(base[:, :s - n_pad],
                                   pert[:, :s - n_pad])
     assert np.isfinite(base).all() and np.isfinite(pert).all()
+
+
+def test_attention_dropout_seed_differs_across_tp_ranks():
+    """The dropout-hash seed folds in the TP rank: without it, TP head
+    shards would regenerate bit-identical masks for corresponding local
+    heads (the flax dropout rng is replicated across the mesh)."""
+    from apex_tpu.transformer.testing.standalone_transformer_lm import (
+        derive_attention_dropout_seed,
+    )
+
+    mesh = tp_mesh(4)
+    key = jax.random.PRNGKey(11)
+    seeds = smap(
+        lambda: derive_attention_dropout_seed(key, "tp").reshape(1),
+        mesh, (), P("tp"))()
+    seeds = np.asarray(seeds)
+    assert len(set(seeds.tolist())) == 4, seeds
