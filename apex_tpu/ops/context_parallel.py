@@ -122,19 +122,30 @@ def ring_attention(q, k, v, axis_name, *, causal=True, sm_scale=None,
 
 
 def ulysses_attention(q, k, v, axis_name, *, causal=True, sm_scale=None,
-                      **attn_kwargs):
+                      dropout_p=0.0, dropout_seed=None, **attn_kwargs):
     """All-to-all (Ulysses) context-parallel attention.
 
     Args/returns as ``ring_attention``. Requires ``h % cp == 0``: the
     all-to-all trades the sequence sharding for a head sharding, each rank
     then runs the ordinary fused attention kernel over FULL sequences for
     its h/cp heads, and the reverse all-to-all restores sequence sharding.
+
+    ``dropout_p``/``dropout_seed``: inverted attention dropout via the
+    VMEM-rows kernel's in-kernel hash (each rank owns DISJOINT global
+    heads, so the per-rank mask streams are decorrelated by folding the
+    rank into the seed). Requires rows-kernel-supported shapes — the
+    materialized fallback at Ulysses-scale sequences is the HBM blow-up
+    this scheme exists to avoid, so unsupported shapes raise.
     """
     cp = lax.axis_size(axis_name)
     b, h, s, d = q.shape
     if h % cp != 0:
         raise ValueError(f"ulysses_attention: heads ({h}) not divisible by "
                          f"axis size ({cp})")
+    if not 0.0 <= dropout_p < 1.0:
+        raise ValueError(f"dropout_p={dropout_p} outside [0, 1)")
+    if dropout_p > 0.0 and dropout_seed is None:
+        raise ValueError("dropout_p > 0 requires dropout_seed")
     if "segment_ids" in attn_kwargs and attn_kwargs["segment_ids"] is not None:
         raise NotImplementedError(
             "ulysses_attention: segment_ids are shard-local and would need "
@@ -152,6 +163,31 @@ def ulysses_attention(q, k, v, axis_name, *, causal=True, sm_scale=None,
                               tiled=True)
 
     qh, kh, vh = scatter_heads(q), scatter_heads(k), scatter_heads(v)
-    ctx = fused_attention(qh, kh, vh, causal=causal, sm_scale=sm_scale,
-                          **attn_kwargs)
+    if dropout_p > 0.0:
+        from apex_tpu.ops import attention_pallas
+
+        if attn_kwargs:
+            # per-call knobs are demands, not preferences (CLAUDE.md):
+            # the dropout branch runs the rows kernel unconditionally,
+            # so an explicit impl=/force_dense= cannot be honored
+            raise ValueError(
+                f"ulysses_attention: kwargs {sorted(attn_kwargs)} cannot "
+                "be honored with dropout_p > 0 (the dropout branch runs "
+                "the rows kernel)")
+        s_glob = qh.shape[2]
+        if not attention_pallas.supported(s_glob, s_glob, d, dropout=True):
+            raise NotImplementedError(
+                f"ulysses_attention dropout needs rows-kernel-supported "
+                f"shapes (s={s_glob}, d={d}); the materialized fallback "
+                "would defeat the scheme's memory purpose")
+        seed = (jnp.asarray(dropout_seed, jnp.int32)
+                + lax.axis_index(axis_name)).reshape(1, 1)
+        ctx = attention_pallas.fused_attention_rows(
+            qh, kh, vh, causal,
+            sm_scale if sm_scale is not None else 1.0 / math.sqrt(d),
+            None, jax.devices()[0].platform == "cpu", None, None,
+            float(dropout_p), seed)
+    else:
+        ctx = fused_attention(qh, kh, vh, causal=causal, sm_scale=sm_scale,
+                              **attn_kwargs)
     return gather_heads(ctx)

@@ -372,6 +372,17 @@ class ParallelAttention(nn.Module):
             params_dtype=cfg.params_dtype, axis_name=self.axis_name,
             name="dense")
 
+        def _via_bhsd(attn_fn):
+            # [s, b, np, hd] -> [b, np, s, hd], run the kernel, restore
+            # [s, b, np*hd] and project — the one layout adapter every
+            # fused branch shares
+            ctx = attn_fn(q.transpose(1, 2, 0, 3),
+                          k.transpose(1, 2, 0, 3),
+                          v.transpose(1, 2, 0, 3))
+            ctx = ctx.transpose(2, 0, 1, 3).reshape(
+                q.shape[0], q.shape[1], np_local * hd)
+            return dense(ctx)
+
         # flash path: causal self-attention with no explicit mask and no
         # attention dropout lowers to the Pallas flash kernel on TPU (the
         # fmhalib / fused-softmax replacement); other configs take the
@@ -422,17 +433,11 @@ class ParallelAttention(nn.Module):
                 from apex_tpu.ops import ring_attention
 
                 seed = _drop_seed()
-                qf = q.transpose(1, 2, 0, 3)
-                kf = k.transpose(1, 2, 0, 3)
-                vf = v.transpose(1, 2, 0, 3)
-                ctx = ring_attention(
+                return _via_bhsd(lambda qf, kf, vf: ring_attention(
                     qf, kf, vf, cfg.context_parallel_axis, causal=True,
                     sm_scale=1.0 / math.sqrt(hd),
                     dropout_p=float(cfg.attention_dropout),
-                    dropout_seed=seed[0, 0])
-                ctx = ctx.transpose(2, 0, 1, 3).reshape(
-                    q.shape[0], q.shape[1], np_local * hd)
-                return dense(ctx)
+                    dropout_seed=seed[0, 0]))
             s_len, kv_len = q.shape[0], k.shape[0]
             # (drop_padding already implies supported() via the shared
             # eligibility predicate — the check is the single gate)
@@ -445,37 +450,24 @@ class ParallelAttention(nn.Module):
                     pad_ids = (padding_validity.astype(jnp.int32)
                                == 0).astype(jnp.int32)
                     segs = (pad_ids, pad_ids)
-                qf = q.transpose(1, 2, 0, 3)
-                kf = k.transpose(1, 2, 0, 3)
-                vf = v.transpose(1, 2, 0, 3)
                 interpret = jax.devices()[0].platform == "cpu"
-                ctx = attention_pallas.fused_attention_rows(
-                    qf, kf, vf, drop_causal, 1.0 / math.sqrt(hd), segs,
-                    interpret, None, None, float(cfg.attention_dropout),
-                    seed)
-                ctx = ctx.transpose(2, 0, 1, 3).reshape(
-                    q.shape[0], q.shape[1], np_local * hd)
-                return dense(ctx)
+                return _via_bhsd(
+                    lambda qf, kf, vf: attention_pallas.fused_attention_rows(
+                        qf, kf, vf, drop_causal, 1.0 / math.sqrt(hd), segs,
+                        interpret, None, None,
+                        float(cfg.attention_dropout), seed))
         if use_flash:
             from apex_tpu.ops import fused_attention, ring_attention
 
-            # [s, b, np, hd] → [b, np, s, hd]
-            qf = q.transpose(1, 2, 0, 3)
-            kf = k.transpose(1, 2, 0, 3)
-            vf = v.transpose(1, 2, 0, 3)
             # q/norm_factor then softmax×coeff == plain 1/sqrt(hd) scaling
             # (qk-layer-scaling is an fp16-range trick; flash accumulates
             # in fp32 so the composed scale is exact)
             if cfg.context_parallel_axis is not None:
-                ctx = ring_attention(qf, kf, vf, cfg.context_parallel_axis,
-                                     causal=True,
-                                     sm_scale=1.0 / math.sqrt(hd))
-            else:
-                ctx = fused_attention(qf, kf, vf, causal=True,
-                                      sm_scale=1.0 / math.sqrt(hd))
-            ctx = ctx.transpose(2, 0, 1, 3).reshape(
-                q.shape[0], q.shape[1], np_local * hd)
-            return dense(ctx)
+                return _via_bhsd(lambda qf, kf, vf: ring_attention(
+                    qf, kf, vf, cfg.context_parallel_axis, causal=True,
+                    sm_scale=1.0 / math.sqrt(hd)))
+            return _via_bhsd(lambda qf, kf, vf: fused_attention(
+                qf, kf, vf, causal=True, sm_scale=1.0 / math.sqrt(hd)))
 
         if cfg.context_parallel_axis is not None:
             raise NotImplementedError(

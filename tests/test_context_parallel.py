@@ -262,3 +262,64 @@ def test_gpt_context_parallel_with_dropout_trains():
         ids, pos, labels)
     assert np.isfinite(float(loss))
     assert np.isfinite(np.asarray(pe)).all()
+
+
+def test_ulysses_dropout_matches_dense_with_same_masks():
+    """Ulysses dropout: each rank applies the rows kernel's hash dropout
+    to its DISJOINT global head group with a rank-offset seed — the dense
+    reference rebuilds each group's mask from (seed + rank, local head)."""
+    from apex_tpu.ops import attention_pallas as ap
+
+    p, seed = 0.25, 31
+    # rows kernel needs lane-aligned global seq: use s=128 (local 32 x 4)
+    s_glob = 128
+    rs = np.random.RandomState(6)
+    mk = lambda: jnp.asarray(rs.randn(B, H, s_glob, D) * 0.5, jnp.float32)
+    q, k, v = mk(), mk(), mk()
+
+    f = shard_map(
+        lambda q_, k_, v_: ulysses_attention(
+            q_, k_, v_, "cp", causal=True, dropout_p=p,
+            dropout_seed=jnp.int32(seed)),
+        mesh=cp_mesh(), in_specs=(P(None, None, "cp"),) * 3,
+        out_specs=P(None, None, "cp"), check_vma=False)
+    got = np.asarray(f(q, k, v))
+
+    # dense reference: per rank r (owning head group r, H/CP heads), the
+    # mask stream is _dropout_mscale(seed + r, ib, local_ih, ...)
+    hg = H // CP
+    scale = 1.0 / np.sqrt(D)
+    sc = np.einsum("bhqd,bhkd->bhqk", np.asarray(q), np.asarray(k)) * scale
+    tri = np.triu(np.ones((s_glob, s_glob), bool), 1)
+    sc = np.where(tri, -1e30, sc)
+    e = np.exp(sc - sc.max(-1, keepdims=True))
+    probs = e / e.sum(-1, keepdims=True)
+    ms = np.zeros_like(probs)
+    for g in range(H):
+        r, lh = g // hg, g % hg
+        for ib in range(B):
+            ms[ib, g] = np.asarray(ap._dropout_mscale(
+                jnp.asarray(seed + r, jnp.int32), jnp.int32(ib),
+                jnp.int32(lh), 0, s_glob, s_glob, p, hg))
+    want = np.einsum("bhqk,bhkd->bhqd", probs * ms, np.asarray(v))
+    np.testing.assert_allclose(got, want, atol=2e-5)
+
+
+def test_ulysses_dropout_validation():
+    q, k, v = _data(7)
+    with pytest.raises(ValueError, match="dropout_seed"):
+        _run_cp(lambda q_, k_, v_, a, causal: ulysses_attention(
+            q_, k_, v_, a, causal=causal, dropout_p=0.3), q, k, v, True)
+    # S=32 global: below the rows kernel's lane alignment -> loud refusal
+    with pytest.raises(NotImplementedError, match="rows-kernel-supported"):
+        _run_cp(lambda q_, k_, v_, a, causal: ulysses_attention(
+            q_, k_, v_, a, causal=causal, dropout_p=0.3,
+            dropout_seed=jnp.int32(1)), q, k, v, True)
+
+
+def test_ulysses_dropout_rejects_unhonorable_kwargs():
+    q, k, v = _data(8)
+    with pytest.raises(ValueError, match="cannot be honored"):
+        _run_cp(lambda q_, k_, v_, a, causal: ulysses_attention(
+            q_, k_, v_, a, causal=causal, dropout_p=0.2,
+            dropout_seed=jnp.int32(1), impl="flash"), q, k, v, True)
