@@ -166,12 +166,15 @@ def ulysses_attention(q, k, v, axis_name, *, causal=True, sm_scale=None,
     if dropout_p > 0.0:
         from apex_tpu.ops import attention_pallas
 
-        if attn_kwargs:
+        # an explicitly-passed default (e.g. segment_ids=None) IS its
+        # default — only non-default demands are un-honorable
+        demands = {k: v for k, v in attn_kwargs.items() if v is not None}
+        if demands:
             # per-call knobs are demands, not preferences (CLAUDE.md):
             # the dropout branch runs the rows kernel unconditionally,
             # so an explicit impl=/force_dense= cannot be honored
             raise ValueError(
-                f"ulysses_attention: kwargs {sorted(attn_kwargs)} cannot "
+                f"ulysses_attention: kwargs {sorted(demands)} cannot "
                 "be honored with dropout_p > 0 (the dropout branch runs "
                 "the rows kernel)")
         s_glob = qh.shape[2]
@@ -180,8 +183,17 @@ def ulysses_attention(q, k, v, axis_name, *, causal=True, sm_scale=None,
                 f"ulysses_attention dropout needs rows-kernel-supported "
                 f"shapes (s={s_glob}, d={d}); the materialized fallback "
                 "would defeat the scheme's memory purpose")
-        seed = (jnp.asarray(dropout_seed, jnp.int32)
-                + lax.axis_index(axis_name)).reshape(1, 1)
+        # rank folded through the avalanche, not added: seed + rank has
+        # additive pre-image collisions (step t, rank r+1 == step t+1,
+        # rank r for consecutive caller seeds), replaying one head
+        # group's mask stream on another
+        from apex_tpu.ops.attention_pallas import _fmix32
+
+        rank_u = lax.axis_index(axis_name).astype(jnp.uint32)
+        seed = lax.bitcast_convert_type(
+            jnp.asarray(dropout_seed, jnp.int32).astype(jnp.uint32)
+            ^ _fmix32(rank_u + jnp.uint32(0x9E3779B9)),
+            jnp.int32).reshape(1, 1)
         ctx = attention_pallas.fused_attention_rows(
             qh, kh, vh, causal,
             sm_scale if sm_scale is not None else 1.0 / math.sqrt(d),

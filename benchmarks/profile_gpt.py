@@ -166,26 +166,38 @@ def make_sc(eps, g0):
 
 t_sc = scan_time("scaler unscale+update", make_sc, scaler.init(), (g0,))
 
-# 5. FULL train step
-def make_step(eps, ids, pos, labels):
-    def body(carry, _):
-        p, o, ss = carry
+# 5. FULL train step. One step body shared by the deterministic row and
+# the dropout A/B rows (row 10) so every row measures the SAME scaler/
+# optimizer/skip-step logic — only the model and its rng kwargs vary.
+def make_train_step(model_, rng_of=None):
+    def make_step(eps, ids, pos, labels):
+        def body(carry, t):
+            p, o, ss = carry
+            kw = {}
+            if rng_of is not None:
+                kw = dict(deterministic=False,
+                          rngs={"dropout": rng_of(t)})
 
-        def loss_fn(pp):
-            per_tok = model.apply({"params": pp}, ids, pos, None, labels)
-            return jnp.mean(per_tok) * ss.loss_scale
+            def loss_fn(pp):
+                per_tok = model_.apply({"params": pp}, ids, pos, None,
+                                       labels, **kw)
+                return jnp.mean(per_tok) * ss.loss_scale
 
-        loss, grads = jax.value_and_grad(loss_fn)(p)
-        grads, found_inf = scaler.unscale(grads, ss)
-        nss = scaler.update(ss, found_inf)
-        updates, no = tx.update(grads, o, p)
-        np_ = jax.tree_util.tree_map(
-            lambda a, u: jnp.where(found_inf, a, a + u.astype(a.dtype)),
-            p, updates)
-        no = jax.tree_util.tree_map(
-            lambda new, old: jnp.where(found_inf, old, new), no, o)
-        return (np_, no, nss), loss / ss.loss_scale
-    return body
+            loss, grads = jax.value_and_grad(loss_fn)(p)
+            grads, found_inf = scaler.unscale(grads, ss)
+            nss = scaler.update(ss, found_inf)
+            updates, no = tx.update(grads, o, p)
+            np_ = jax.tree_util.tree_map(
+                lambda a, u: jnp.where(found_inf, a, a + u.astype(a.dtype)),
+                p, updates)
+            no = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(found_inf, old, new), no, o)
+            return (np_, no, nss), loss / ss.loss_scale
+        return body
+    return make_step
+
+
+make_step = make_train_step(model)
 
 t_step = scan_time("FULL train step", make_step,
                    (params, opt_state, scaler.init()), (ids, pos, labels),
@@ -266,3 +278,31 @@ def make_fa(eps, k0, v0):
 attn_flops = 4 * B * 12 * S * S * 64 * 3 // 2  # fwd+2x bwd, causal halves
 scan_time("flash attn fwd+bwd (1 lyr)", make_fa, q0, (k0, v0),
           flops_per_iter=attn_flops)
+
+# 10. FULL train step WITH dropout (the reference GPT-2 recipe trains
+# with hidden/attention dropout 0.1): the step-level A/B of the
+# in-kernel rows dropout vs the materialized-scores path. Knobs pinned
+# per row (fused_attention_dropout), same shapes/optimizer as row 5.
+# (APEX_BENCH_DROPOUT_SMOKE=1 exercises the rows at smoke shapes too —
+# a CPU validity check; smoke's s=128, h=32 keeps both paths traceable)
+if not SMOKE or os.environ.get("APEX_BENCH_DROPOUT_SMOKE") == "1":
+    import dataclasses as _dc
+
+    for _label, _fused in (("drop0.1 rows-kernel", True),
+                           ("drop0.1 scores path", False)):
+        _dcfg = _dc.replace(cfg, hidden_dropout=0.1, attention_dropout=0.1,
+                            fused_attention_dropout=_fused)
+        _dmodel = GPTModel(_dcfg)
+        _dparams = jax.jit(shmap(
+            lambda i, p: _dmodel.init(
+                jax.random.PRNGKey(0), i, p, None)["params"], 2))(ids, pos)
+        _dopt = tx.init(_dparams)
+
+        make_dstep = make_train_step(
+            _dmodel, rng_of=lambda t: jax.random.fold_in(
+                jax.random.PRNGKey(11), t))
+
+        t_d = scan_time(f"FULL step {_label}", make_dstep,
+                        (_dparams, _dopt, scaler.init()),
+                        (ids, pos, labels), flops_per_iter=model_flops_fb)
+        print(f"{'':28s} -> {B*S/t_d:.0f} tok/s")

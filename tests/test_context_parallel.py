@@ -297,9 +297,11 @@ def test_ulysses_dropout_matches_dense_with_same_masks():
     ms = np.zeros_like(probs)
     for g in range(H):
         r, lh = g // hg, g % hg
+        seed_r = np.uint32(seed) ^ np.asarray(ap._fmix32(
+            jnp.uint32(r) + jnp.uint32(0x9E3779B9)))
         for ib in range(B):
             ms[ib, g] = np.asarray(ap._dropout_mscale(
-                jnp.asarray(seed + r, jnp.int32), jnp.int32(ib),
+                jnp.asarray(seed_r.astype(np.int32)), jnp.int32(ib),
                 jnp.int32(lh), 0, s_glob, s_glob, p, hg))
     want = np.einsum("bhqk,bhkd->bhqd", probs * ms, np.asarray(v))
     np.testing.assert_allclose(got, want, atol=2e-5)
