@@ -166,6 +166,8 @@ def _global_mscale(seed, b, h, s_glob, p):
     return out
 
 
+@pytest.mark.slow  # ring compile + dense reconstruction; the
+# validation tests and the ring fwd parity stay fast
 def test_ring_dropout_matches_dense_with_same_mask():
     """Ring attention with in-ring dropout == dense attention with the
     SAME global hash mask applied to the normalized probs — exact, fwd."""
@@ -264,6 +266,7 @@ def test_gpt_context_parallel_with_dropout_trains():
     assert np.isfinite(np.asarray(pe)).all()
 
 
+@pytest.mark.slow  # interpret rows kernel at s=128 x 4 head groups
 def test_ulysses_dropout_matches_dense_with_same_masks():
     """Ulysses dropout: each rank applies the rows kernel's hash dropout
     to its DISJOINT global head group with a rank-offset seed — the dense
