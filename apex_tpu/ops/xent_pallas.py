@@ -91,8 +91,9 @@ def _hit(labels, iv, bv, rows):
     return (cols == local).astype(jnp.float32)
 
 
-def _fwd_kernel(x_ref, e_ref, lab_ref, loss_ref, lse_ref, m_scr, s_scr,
-                t_scr, *, bv, nv):
+def _accumulate_chunk(x_ref, e_ref, lab_ref, m_scr, s_scr, t_scr, bv):
+    """One vocab chunk's online (max, sumexp) update + target gather —
+    the shared core of the full and partial (vocab-sharded) forwards."""
     iv = pl.program_id(1)
     x = x_ref[...]
     e = e_ref[...]
@@ -116,11 +117,30 @@ def _fwd_kernel(x_ref, e_ref, lab_ref, loss_ref, lse_ref, m_scr, s_scr,
     hit = _hit(lab_ref[...], iv, bv, rows)
     t_scr[...] += jnp.sum(logits * hit, axis=1, keepdims=True)
 
-    @pl.when(iv == nv - 1)
+
+def _fwd_kernel(x_ref, e_ref, lab_ref, loss_ref, lse_ref, m_scr, s_scr,
+                t_scr, *, bv, nv):
+    _accumulate_chunk(x_ref, e_ref, lab_ref, m_scr, s_scr, t_scr, bv)
+
+    @pl.when(pl.program_id(1) == nv - 1)
     def _():
         lse = m_scr[...] + jnp.log(s_scr[...])
         lse_ref[...] = lse
         loss_ref[...] = lse - t_scr[...]
+
+
+def _fwd_partial_kernel(x_ref, e_ref, lab_ref, m_ref, s_ref, t_ref, m_scr,
+                        s_scr, t_scr, *, bv, nv):
+    """Vocab-SHARD forward: emit this shard's (rowmax, sumexp-at-rowmax,
+    target-logit partial) so the caller can combine across tensor-
+    parallel ranks (pmax/psum) into the global LSE and loss."""
+    _accumulate_chunk(x_ref, e_ref, lab_ref, m_scr, s_scr, t_scr, bv)
+
+    @pl.when(pl.program_id(1) == nv - 1)
+    def _():
+        m_ref[...] = m_scr[...]
+        s_ref[...] = s_scr[...]
+        t_ref[...] = t_scr[...]
 
 
 def _dx_kernel(x_ref, e_ref, lab_ref, lse_ref, dl_ref, dx_ref, acc_scr,
@@ -176,6 +196,74 @@ def _common_specs(br, bv, h):
     return xspec, espec, lspec
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def linear_cross_entropy_sharded(x, embedding_shard, labels, axis_name,
+                                 interpret=False):
+    """Vocab-parallel fused linear+CE: the tensor-parallel form of
+    ``linear_cross_entropy`` (reference analog:
+    tensor_parallel/cross_entropy.py over materialized logit shards —
+    here the shard logits never exist in HBM either).
+
+    Call inside ``shard_map`` with ``embedding_shard`` [V/tp, h] sharded
+    over ``axis_name`` and ``x`` [n, h] / ``labels`` [n] (GLOBAL vocab
+    ids) replicated along it. Each rank runs the row-blocked kernel over
+    its shard emitting per-row (rowmax, sumexp, target partial); the
+    cross-rank combine (pmax + two psums over [n] vectors — tiny) forms
+    the global LSE and loss. Backward reuses the single-shard kernels
+    with the GLOBAL lse: dX is the psum of the per-shard dx, dE stays
+    shard-local. Check ``supported(n, V_shard, h)`` on the SHARD dims.
+    """
+    return _fwd_sharded(x, embedding_shard, labels, axis_name,
+                        interpret)[0]
+
+
+def _fwd_sharded(x, embedding_shard, labels, axis_name, interpret):
+    n, h = x.shape
+    Vs = embedding_shard.shape[0]
+    if not supported(n, Vs, h):
+        raise ValueError(
+            f"xent_pallas sharded: unsupported [{n},{h}]x[{Vs},{h}]")
+    bv = _v_chunk(Vs)
+    br = _row_block(n, h, bv)
+    nb, nv = n // br, Vs // bv
+    # shift labels into SHARD-local ids: out-of-shard rows match no
+    # column in any chunk, so their hit (and target partial) is zero
+    rank = lax.axis_index(axis_name)
+    labs = (labels.astype(jnp.int32) - rank * Vs).reshape(n, 1)
+    xspec, espec, lspec = _common_specs(br, bv, h)
+    m, s_, t = pl.pallas_call(
+        functools.partial(_fwd_partial_kernel, bv=bv, nv=nv),
+        grid=(nb, nv),
+        in_specs=[xspec, espec, lspec],
+        out_specs=(lspec, lspec, lspec),
+        out_shape=(jax.ShapeDtypeStruct((n, 1), jnp.float32),) * 3,
+        scratch_shapes=[pltpu.VMEM((br, 1), jnp.float32)] * 3,
+        interpret=interpret,
+    )(x, embedding_shard, labs)
+    # cross-rank online-softmax combine on [n] vectors
+    m_g = lax.pmax(m, axis_name)
+    l_g = lax.psum(s_ * jnp.exp(m - m_g), axis_name)
+    t_g = lax.psum(t, axis_name)
+    lse = m_g + jnp.log(l_g)
+    loss = lse - t_g
+    return loss[:, 0], (x, embedding_shard, labs, lse)
+
+
+def _fwd_sharded_rule(x, embedding_shard, labels, axis_name, interpret):
+    return _fwd_sharded(x, embedding_shard, labels, axis_name, interpret)
+
+
+def _bwd_sharded_rule(axis_name, interpret, res, g):
+    x, embedding_shard, labs, lse = res
+    dx_local, de, _ = _bwd_kernels(x, embedding_shard, labs, lse, g,
+                                   interpret)
+    # dX sums every shard's p_shard @ E_shard contribution; dE is local
+    return lax.psum(dx_local, axis_name), de, None
+
+
+linear_cross_entropy_sharded.defvjp(_fwd_sharded_rule, _bwd_sharded_rule)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def linear_cross_entropy(x, embedding, labels, interpret=False):
     """Fused ``-log_softmax(x @ embedding^T)[i, labels[i]]`` -> [n] fp32.
@@ -214,8 +302,10 @@ def _fwd_rule(x, embedding, labels, interpret):
     return _fwd(x, embedding, labels, interpret)
 
 
-def _bwd_rule(interpret, res, g):
-    x, embedding, labs, lse = res
+def _bwd_kernels(x, embedding, labs, lse, g, interpret):
+    """The two backward pallas calls, shared by the single-slab and the
+    vocab-sharded vjp rules (``embedding`` is the full table or one
+    shard — the kernels only see its leading dim)."""
     n, h = x.shape
     V = embedding.shape[0]
     bv = _v_chunk(V)
@@ -247,6 +337,11 @@ def _bwd_rule(interpret, res, g):
         interpret=interpret,
     )(x, embedding, labs, lse, dl)
     return dx, de.astype(embedding.dtype), None
+
+
+def _bwd_rule(interpret, res, g):
+    x, embedding, labs, lse = res
+    return _bwd_kernels(x, embedding, labs, lse, g, interpret)
 
 
 linear_cross_entropy.defvjp(_fwd_rule, _bwd_rule)

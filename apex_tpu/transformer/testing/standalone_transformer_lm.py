@@ -76,9 +76,12 @@ class TransformerConfig:
     softmax_use_pallas: bool = False
     # fuse the GPT LM head (logits matmul + vocab-parallel CE) into the
     # Pallas linear-cross-entropy kernel (ops/xent_pallas.py): the [n, V]
-    # logits never reach HBM. Engages only where the kernel applies
-    # (tp == 1, no label smoothing, supported shapes); falls back to the
-    # materialized path otherwise. _interpret is for CPU tests.
+    # logits never reach HBM — at tp > 1 via the vocab-parallel variant
+    # (per-shard online stats, pmax/psum combine; shard logits never
+    # materialize either). Engages where the kernel applies (supported
+    # shard shapes, no label smoothing, not tp>1+sequence_parallel);
+    # falls back to the materialized path otherwise. _interpret is for
+    # CPU tests.
     fused_lm_head: bool = False
     fused_lm_head_interpret: bool = False
     # training with attention_dropout > 0 (causal, no explicit mask):
@@ -723,15 +726,17 @@ class GPTModel(nn.Module):
 
     def _fused_head_applies(self, hidden):
         """Whether the Pallas fused LM head replaces logits+CE for this
-        call: opt-in, single vocab shard (the kernel is not
-        vocab-parallel — and at tp == 1 the sequence-parallel gather is
-        the identity, so no collective is needed either), a real TPU (or
-        interpret for tests), supported shapes. All static — the choice
-        is baked at trace time."""
+        call: opt-in, a real TPU (or interpret for tests), supported
+        SHARD shapes. tp > 1 runs the vocab-parallel kernel
+        (``linear_cross_entropy_sharded`` — per-shard online stats +
+        pmax/psum combine); the one exclusion is tp > 1 WITH sequence
+        parallelism, whose pre-matmul seq gather only the materialized
+        path performs. All static — the choice is baked at trace time."""
         cfg = self.cfg
         if not cfg.fused_lm_head:
             return False
-        if lax.axis_size(self.axis_name) != 1:
+        tp = lax.axis_size(self.axis_name)
+        if tp != 1 and cfg.sequence_parallel:
             return False
         from apex_tpu.ops import xent_pallas
         from apex_tpu.ops.attention import _tpu_available
@@ -739,7 +744,7 @@ class GPTModel(nn.Module):
         if not (cfg.fused_lm_head_interpret or _tpu_available()):
             return False
         s, b, h = hidden.shape
-        return xent_pallas.supported(b * s, cfg.vocab_size, h)
+        return xent_pallas.supported(b * s, cfg.vocab_size // tp, h)
 
     @nn.compact
     def __call__(self, input_ids, position_ids, attention_mask, labels=None,
@@ -774,15 +779,21 @@ class GPTModel(nn.Module):
         if labels is not None and self._fused_head_applies(hidden):
             from apex_tpu.ops import xent_pallas
 
-            # the fused kernel instead of materializing [n, V] logits
-            # (tp == 1 here, so parallel_lm_logits' pre-matmul
-            # collectives — sp gather / copy — are identities)
+            # the fused kernel instead of materializing [n, V] logits;
+            # at tp > 1 the vocab-parallel variant combines per-shard
+            # online stats across ranks (no shard logits in HBM either)
             s, b, h = hidden.shape
             x2d = hidden.transpose(1, 0, 2).reshape(b * s, h)
-            loss = xent_pallas.linear_cross_entropy(
-                x2d, word_embeddings.astype(x2d.dtype),
-                labels.reshape(-1),
-                cfg.fused_lm_head_interpret)
+            if lax.axis_size(self.axis_name) == 1:
+                loss = xent_pallas.linear_cross_entropy(
+                    x2d, word_embeddings.astype(x2d.dtype),
+                    labels.reshape(-1),
+                    cfg.fused_lm_head_interpret)
+            else:
+                loss = xent_pallas.linear_cross_entropy_sharded(
+                    x2d, word_embeddings.astype(x2d.dtype),
+                    labels.reshape(-1), self.axis_name,
+                    cfg.fused_lm_head_interpret)
             return loss.reshape(b, s)
 
         logits = parallel_lm_logits(

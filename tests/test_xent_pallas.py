@@ -140,3 +140,128 @@ def test_supported_predicate():
     assert not xp.supported(8192, 50000, 768)  # no 128-multiple divisor
     assert not xp.supported(7, 50304, 768)     # rows not 8-divisible
     assert not xp.supported(8192, 50304, 760)  # lane-unaligned hidden
+
+
+# --------------------- vocab-parallel (sharded) head -----------------------
+
+def test_sharded_matches_full_table_with_grads():
+    """linear_cross_entropy_sharded over tp=4 vocab shards == the
+    single-slab kernel on the full table: loss, dX (psum'd), and the
+    concatenated dE shards."""
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    n, h, V, tp = 64, 128, 512, 4
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(n, h), jnp.float32)
+    e = jnp.asarray(rs.randn(V, h) * 0.1, jnp.float32)
+    labels = jnp.asarray(rs.randint(0, V, (n,)), jnp.int32)
+    g = jnp.asarray(rs.randn(n), jnp.float32)
+    mesh = Mesh(np.array(jax.devices()[:tp]), ("tp",))
+
+    def sharded(x, e, labels, g):
+        def f(args):
+            xx, ee = args
+            loss = xp.linear_cross_entropy_sharded(
+                xx, ee, labels, "tp", True)
+            return jnp.sum(loss * g), loss
+
+        (_, loss), grads = jax.value_and_grad(f, has_aux=True)((x, e))
+        return loss, grads[0], grads[1]
+
+    loss_s, dx_s, de_s = shard_map(
+        sharded, mesh=mesh, in_specs=(P(), P("tp"), P(), P()),
+        out_specs=(P(), P(), P("tp")), check_vma=False)(x, e, labels, g)
+
+    def full(args):
+        xx, ee = args
+        loss = xp.linear_cross_entropy(xx, ee, labels, True)
+        return jnp.sum(loss * g), loss
+
+    (_, loss_f), (dx_f, de_f) = jax.value_and_grad(
+        full, has_aux=True)((x, e))
+
+    np.testing.assert_allclose(np.asarray(loss_s), np.asarray(loss_f),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(dx_s), np.asarray(dx_f),
+                               atol=1e-5, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(de_s), np.asarray(de_f),
+                               atol=1e-5, rtol=1e-4)
+
+
+def test_sharded_matches_vocab_parallel_materialized():
+    """...and the materialized vocab-parallel CE (the tensor_parallel
+    reference surface) on the same shards."""
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+    from apex_tpu.transformer.tensor_parallel.cross_entropy import (
+        vocab_parallel_cross_entropy,
+    )
+
+    n, h, V, tp = 64, 128, 512, 4
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.randn(n, h), jnp.float32)
+    e = jnp.asarray(rs.randn(V, h) * 0.1, jnp.float32)
+    labels = jnp.asarray(rs.randint(0, V, (n,)), jnp.int32)
+    mesh = Mesh(np.array(jax.devices()[:tp]), ("tp",))
+
+    def both(x, e, labels):
+        fused = xp.linear_cross_entropy_sharded(
+            x, e, labels, "tp", True)
+        logits_shard = (x @ e.T)[None]  # [1, n, V/tp]
+        mat = vocab_parallel_cross_entropy(
+            logits_shard, labels[None], axis_name="tp")[0]
+        return fused, mat
+
+    fused, mat = shard_map(
+        both, mesh=mesh, in_specs=(P(), P("tp"), P()),
+        out_specs=(P(), P()), check_vma=False)(x, e, labels)
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(mat),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_gpt_fused_head_tp2_matches_materialized():
+    """GPTModel with fused_lm_head under tp=2: per-token losses and
+    embedding grads match the materialized vocab-parallel path."""
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+    from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+    from apex_tpu.transformer.testing import GPTModel, TransformerConfig
+
+    b, s = 2, 64
+    kw = dict(hidden_size=128, num_layers=1, num_attention_heads=2,
+              vocab_size=512, max_position_embeddings=s,
+              hidden_dropout=0.0, attention_dropout=0.0)
+    m_fused = GPTModel(TransformerConfig(
+        fused_lm_head=True, fused_lm_head_interpret=True, **kw))
+    m_mat = GPTModel(TransformerConfig(**kw))
+    mesh = Mesh(np.array(jax.devices()[:2]), (TENSOR_AXIS,))
+    rs = np.random.RandomState(2)
+    ids = jnp.asarray(rs.randint(0, 512, (b, s)), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+    labels = jnp.asarray(rs.randint(0, 512, (b, s)), jnp.int32)
+
+    def run(model):
+        def f(ids, pos, labels):
+            params = model.init(jax.random.PRNGKey(0), ids, pos,
+                                None)["params"]
+
+            def loss_fn(p):
+                per_tok = model.apply({"params": p}, ids, pos, None,
+                                      labels)
+                return jnp.mean(per_tok), per_tok
+
+            (_, per_tok), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            return per_tok, grads["embedding"]["position_embeddings"]
+
+        return shard_map(f, mesh=mesh, in_specs=(P(), P(), P()),
+                         out_specs=(P(), P()), check_vma=False)(
+            ids, pos, labels)
+
+    lt_f, g_f = run(m_fused)
+    lt_m, g_m = run(m_mat)
+    np.testing.assert_allclose(np.asarray(lt_f), np.asarray(lt_m),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(g_f), np.asarray(g_m),
+                               atol=1e-5, rtol=1e-4)
