@@ -18,9 +18,12 @@ constant in the innermost dim), both recomputing the probability tile
 from the saved per-row LSE, exactly the flash-attention bwd structure.
 
 Semantics match ``-log_softmax(x @ e^T)[i, labels[i]]`` per row (fp32
-softmax; no label smoothing — callers wanting smoothing keep the
-materialized path). Tested against the jnp reference in interpret mode
-(tests/test_xent_pallas.py).
+softmax), with optional label smoothing (contrib-xentropy semantics —
+the uniform term's logits sum rides the same chunk pass) and a
+vocab-parallel variant for tensor parallelism
+(``linear_cross_entropy_sharded``: per-shard online stats + pmax/psum
+combine; shard logits never materialize either). Tested against the jnp
+and contrib references in interpret mode (tests/test_xent_pallas.py).
 """
 
 import functools
@@ -91,9 +94,13 @@ def _hit(labels, iv, bv, rows):
     return (cols == local).astype(jnp.float32)
 
 
-def _accumulate_chunk(x_ref, e_ref, lab_ref, m_scr, s_scr, t_scr, bv):
+def _accumulate_chunk(x_ref, e_ref, lab_ref, m_scr, s_scr, t_scr, u_scr,
+                      bv):
     """One vocab chunk's online (max, sumexp) update + target gather —
-    the shared core of the full and partial (vocab-sharded) forwards."""
+    plus, ONLY when smoothing is active (``u_scr`` not None), the running
+    logits sum for the uniform term. The shared core of the full and
+    partial (vocab-sharded) forwards; the smoothing=0 path is
+    bit-identical to the pre-smoothing kernel."""
     iv = pl.program_id(1)
     x = x_ref[...]
     e = e_ref[...]
@@ -106,6 +113,8 @@ def _accumulate_chunk(x_ref, e_ref, lab_ref, m_scr, s_scr, t_scr, bv):
         m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
         s_scr[...] = jnp.zeros_like(s_scr)
         t_scr[...] = jnp.zeros_like(t_scr)
+        if u_scr is not None:
+            u_scr[...] = jnp.zeros_like(u_scr)
 
     m_old = m_scr[...]
     tile_max = jnp.max(logits, axis=1, keepdims=True)
@@ -116,35 +125,50 @@ def _accumulate_chunk(x_ref, e_ref, lab_ref, m_scr, s_scr, t_scr, bv):
 
     hit = _hit(lab_ref[...], iv, bv, rows)
     t_scr[...] += jnp.sum(logits * hit, axis=1, keepdims=True)
+    if u_scr is not None:
+        u_scr[...] += jnp.sum(logits, axis=1, keepdims=True)
 
 
 def _fwd_kernel(x_ref, e_ref, lab_ref, loss_ref, lse_ref, m_scr, s_scr,
-                t_scr, *, bv, nv):
-    _accumulate_chunk(x_ref, e_ref, lab_ref, m_scr, s_scr, t_scr, bv)
+                t_scr, *maybe_u, bv, nv, eps, v_total):
+    u_scr = maybe_u[0] if maybe_u else None
+    _accumulate_chunk(x_ref, e_ref, lab_ref, m_scr, s_scr, t_scr, u_scr,
+                      bv)
 
     @pl.when(pl.program_id(1) == nv - 1)
     def _():
         lse = m_scr[...] + jnp.log(s_scr[...])
         lse_ref[...] = lse
-        loss_ref[...] = lse - t_scr[...]
+        if eps:
+            # label smoothing (contrib xentropy semantics):
+            # (1-eps)*(lse - x_y) + eps*(lse - mean_j x_j)
+            loss_ref[...] = (lse - (1.0 - eps) * t_scr[...]
+                             - eps * u_scr[...] / v_total)
+        else:
+            loss_ref[...] = lse - t_scr[...]
 
 
-def _fwd_partial_kernel(x_ref, e_ref, lab_ref, m_ref, s_ref, t_ref, m_scr,
-                        s_scr, t_scr, *, bv, nv):
-    """Vocab-SHARD forward: emit this shard's (rowmax, sumexp-at-rowmax,
-    target-logit partial) so the caller can combine across tensor-
-    parallel ranks (pmax/psum) into the global LSE and loss."""
-    _accumulate_chunk(x_ref, e_ref, lab_ref, m_scr, s_scr, t_scr, bv)
+def _fwd_partial_kernel(*refs, bv, nv, eps):
+    """Vocab-SHARD forward: emit this shard's per-row partials — (rowmax,
+    sumexp-at-rowmax, target-logit partial) plus, when smoothing is
+    active, the logits-sum partial — for the caller's cross-rank
+    combine."""
+    n_out = 4 if eps else 3
+    x_ref, e_ref, lab_ref = refs[:3]
+    outs = refs[3:3 + n_out]
+    scrs = refs[3 + n_out:]
+    u_scr = scrs[3] if eps else None
+    _accumulate_chunk(x_ref, e_ref, lab_ref, scrs[0], scrs[1], scrs[2],
+                      u_scr, bv)
 
     @pl.when(pl.program_id(1) == nv - 1)
     def _():
-        m_ref[...] = m_scr[...]
-        s_ref[...] = s_scr[...]
-        t_ref[...] = t_scr[...]
+        for ref, scr in zip(outs, scrs):
+            ref[...] = scr[...]
 
 
 def _dx_kernel(x_ref, e_ref, lab_ref, lse_ref, dl_ref, dx_ref, acc_scr,
-               *, bv, nv):
+               *, bv, nv, eps, v_total):
     iv = pl.program_id(1)
     x = x_ref[...]
     e = e_ref[...]
@@ -152,7 +176,8 @@ def _dx_kernel(x_ref, e_ref, lab_ref, lse_ref, dl_ref, dx_ref, acc_scr,
                              preferred_element_type=jnp.float32)
     rows = logits.shape[0]
     p = jnp.exp(logits - lse_ref[...])
-    coeff = (p - _hit(lab_ref[...], iv, bv, rows)).astype(e.dtype)
+    coeff = (p - (1.0 - eps) * _hit(lab_ref[...], iv, bv, rows)
+             - eps / v_total).astype(e.dtype)
 
     @pl.when(iv == 0)
     def _():
@@ -166,7 +191,8 @@ def _dx_kernel(x_ref, e_ref, lab_ref, lse_ref, dl_ref, dx_ref, acc_scr,
         dx_ref[...] = (dl_ref[...] * acc_scr[...]).astype(dx_ref.dtype)
 
 
-def _de_kernel(x_ref, e_ref, lab_ref, lse_ref, dl_ref, de_ref, *, bv):
+def _de_kernel(x_ref, e_ref, lab_ref, lse_ref, dl_ref, de_ref, *, bv,
+               eps, v_total):
     # grid (nv, nb): row blocks innermost so each dE chunk accumulates
     # while its block index is constant
     iv = pl.program_id(0)
@@ -177,7 +203,8 @@ def _de_kernel(x_ref, e_ref, lab_ref, lse_ref, dl_ref, de_ref, *, bv):
                              preferred_element_type=jnp.float32)
     rows = logits.shape[0]
     p = jnp.exp(logits - lse_ref[...])
-    coeff = (p - _hit(lab_ref[...], iv, bv, rows))
+    coeff = (p - (1.0 - eps) * _hit(lab_ref[...], iv, bv, rows)
+             - eps / v_total)
     wx = (dl_ref[...] * x.astype(jnp.float32))
 
     @pl.when(ib == 0)
@@ -196,9 +223,9 @@ def _common_specs(br, bv, h):
     return xspec, espec, lspec
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def linear_cross_entropy_sharded(x, embedding_shard, labels, axis_name,
-                                 interpret=False):
+                                 interpret=False, smoothing=0.0):
     """Vocab-parallel fused linear+CE: the tensor-parallel form of
     ``linear_cross_entropy`` (reference analog:
     tensor_parallel/cross_entropy.py over materialized logit shards —
@@ -207,17 +234,25 @@ def linear_cross_entropy_sharded(x, embedding_shard, labels, axis_name,
     Call inside ``shard_map`` with ``embedding_shard`` [V/tp, h] sharded
     over ``axis_name`` and ``x`` [n, h] / ``labels`` [n] (GLOBAL vocab
     ids) replicated along it. Each rank runs the row-blocked kernel over
-    its shard emitting per-row (rowmax, sumexp, target partial); the
-    cross-rank combine (pmax + two psums over [n] vectors — tiny) forms
-    the global LSE and loss. Backward reuses the single-shard kernels
-    with the GLOBAL lse: dX is the psum of the per-shard dx, dE stays
-    shard-local. Check ``supported(n, V_shard, h)`` on the SHARD dims.
+    its shard emitting per-row partials — (rowmax, sumexp, target
+    partial), plus the logits-sum partial when ``smoothing`` is active;
+    the cross-rank combine (pmax + two or three psums over [n] vectors —
+    tiny) forms the global LSE and loss. Backward reuses the
+    single-shard kernels with the GLOBAL lse: dX is the psum of the
+    per-shard dx, dE stays shard-local. Check ``supported(n, V_shard,
+    h)`` on the SHARD dims.
+
+    ``smoothing`` uses CONTRIB-xentropy semantics ((1-eps)*nll +
+    eps*(lse - mean logits)) — NOT vocab_parallel_cross_entropy's
+    Megatron semantics (which rescales eps by V/(V-1) against mean
+    log-probs); the two differ numerically for the same eps.
     """
     return _fwd_sharded(x, embedding_shard, labels, axis_name,
-                        interpret)[0]
+                        interpret, smoothing)[0]
 
 
-def _fwd_sharded(x, embedding_shard, labels, axis_name, interpret):
+def _fwd_sharded(x, embedding_shard, labels, axis_name, interpret,
+                 smoothing=0.0):
     n, h = x.shape
     Vs = embedding_shard.shape[0]
     if not supported(n, Vs, h):
@@ -231,32 +266,44 @@ def _fwd_sharded(x, embedding_shard, labels, axis_name, interpret):
     rank = lax.axis_index(axis_name)
     labs = (labels.astype(jnp.int32) - rank * Vs).reshape(n, 1)
     xspec, espec, lspec = _common_specs(br, bv, h)
-    m, s_, t = pl.pallas_call(
-        functools.partial(_fwd_partial_kernel, bv=bv, nv=nv),
+    n_part = 4 if smoothing else 3
+    parts = pl.pallas_call(
+        functools.partial(_fwd_partial_kernel, bv=bv, nv=nv,
+                          eps=float(smoothing)),
         grid=(nb, nv),
         in_specs=[xspec, espec, lspec],
-        out_specs=(lspec, lspec, lspec),
-        out_shape=(jax.ShapeDtypeStruct((n, 1), jnp.float32),) * 3,
-        scratch_shapes=[pltpu.VMEM((br, 1), jnp.float32)] * 3,
+        out_specs=(lspec,) * n_part,
+        out_shape=(jax.ShapeDtypeStruct((n, 1), jnp.float32),) * n_part,
+        scratch_shapes=[pltpu.VMEM((br, 1), jnp.float32)] * n_part,
         interpret=interpret,
     )(x, embedding_shard, labs)
+    m, s_, t = parts[:3]
     # cross-rank online-softmax combine on [n] vectors
     m_g = lax.pmax(m, axis_name)
     l_g = lax.psum(s_ * jnp.exp(m - m_g), axis_name)
     t_g = lax.psum(t, axis_name)
     lse = m_g + jnp.log(l_g)
-    loss = lse - t_g
+    if smoothing:
+        u_g = lax.psum(parts[3], axis_name)
+        v_total = Vs * lax.axis_size(axis_name)
+        loss = (lse - (1.0 - smoothing) * t_g
+                - smoothing * u_g / v_total)
+    else:
+        loss = lse - t_g
     return loss[:, 0], (x, embedding_shard, labs, lse)
 
 
-def _fwd_sharded_rule(x, embedding_shard, labels, axis_name, interpret):
-    return _fwd_sharded(x, embedding_shard, labels, axis_name, interpret)
+def _fwd_sharded_rule(x, embedding_shard, labels, axis_name, interpret,
+                      smoothing):
+    return _fwd_sharded(x, embedding_shard, labels, axis_name, interpret,
+                        smoothing)
 
 
-def _bwd_sharded_rule(axis_name, interpret, res, g):
+def _bwd_sharded_rule(axis_name, interpret, smoothing, res, g):
     x, embedding_shard, labs, lse = res
+    v_total = embedding_shard.shape[0] * lax.axis_size(axis_name)
     dx_local, de, _ = _bwd_kernels(x, embedding_shard, labs, lse, g,
-                                   interpret)
+                                   interpret, smoothing, v_total)
     # dX sums every shard's p_shard @ E_shard contribution; dE is local
     return lax.psum(dx_local, axis_name), de, None
 
@@ -264,18 +311,24 @@ def _bwd_sharded_rule(axis_name, interpret, res, g):
 linear_cross_entropy_sharded.defvjp(_fwd_sharded_rule, _bwd_sharded_rule)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def linear_cross_entropy(x, embedding, labels, interpret=False):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def linear_cross_entropy(x, embedding, labels, interpret=False,
+                         smoothing=0.0):
     """Fused ``-log_softmax(x @ embedding^T)[i, labels[i]]`` -> [n] fp32.
 
     x: [n, h]; embedding: [V, h]; labels: [n] int32. The [n, V] logits
     are never materialized. Check ``supported(n, V, h)`` first.
-    ``interpret=True`` for CPU tests.
+    ``interpret=True`` for CPU tests. ``smoothing``: label smoothing with
+    CONTRIB-xentropy semantics ((1-eps)*nll + eps*(lse - mean logits) —
+    NOT vocab_parallel_cross_entropy's Megatron rescale). When active it
+    costs one extra row-vector accumulator riding the same vocab-chunk
+    pass; at the default 0.0 the kernels are bit-identical to the
+    pre-smoothing ones (the accumulator is not even allocated).
     """
-    return _fwd(x, embedding, labels, interpret)[0]
+    return _fwd(x, embedding, labels, interpret, smoothing)[0]
 
 
-def _fwd(x, embedding, labels, interpret):
+def _fwd(x, embedding, labels, interpret, smoothing=0.0):
     n, h = x.shape
     V = embedding.shape[0]
     if not supported(n, V, h):
@@ -285,29 +338,36 @@ def _fwd(x, embedding, labels, interpret):
     nb, nv = n // br, V // bv
     labs = labels.astype(jnp.int32).reshape(n, 1)
     xspec, espec, lspec = _common_specs(br, bv, h)
+    n_scr = 4 if smoothing else 3
     loss, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, bv=bv, nv=nv),
+        functools.partial(_fwd_kernel, bv=bv, nv=nv,
+                          eps=float(smoothing), v_total=float(V)),
         grid=(nb, nv),
         in_specs=[xspec, espec, lspec],
         out_specs=(lspec, lspec),
         out_shape=(jax.ShapeDtypeStruct((n, 1), jnp.float32),
                    jax.ShapeDtypeStruct((n, 1), jnp.float32)),
-        scratch_shapes=[pltpu.VMEM((br, 1), jnp.float32)] * 3,
+        scratch_shapes=[pltpu.VMEM((br, 1), jnp.float32)] * n_scr,
         interpret=interpret,
     )(x, embedding, labs)
     return loss[:, 0], (x, embedding, labs, lse)
 
 
-def _fwd_rule(x, embedding, labels, interpret):
-    return _fwd(x, embedding, labels, interpret)
+def _fwd_rule(x, embedding, labels, interpret, smoothing):
+    return _fwd(x, embedding, labels, interpret, smoothing)
 
 
-def _bwd_kernels(x, embedding, labs, lse, g, interpret):
+def _bwd_kernels(x, embedding, labs, lse, g, interpret, smoothing=0.0,
+                 v_total=None):
     """The two backward pallas calls, shared by the single-slab and the
     vocab-sharded vjp rules (``embedding`` is the full table or one
-    shard — the kernels only see its leading dim)."""
+    shard — the kernels only see its leading dim; ``v_total`` is the
+    GLOBAL vocab for the smoothed uniform term, defaulting to the local
+    table size)."""
     n, h = x.shape
     V = embedding.shape[0]
+    if v_total is None:
+        v_total = V
     bv = _v_chunk(V)
     br = _row_block(n, h, bv)
     nb, nv = n // br, V // bv
@@ -315,7 +375,8 @@ def _bwd_kernels(x, embedding, labs, lse, g, interpret):
     dl = g.astype(jnp.float32).reshape(n, 1)
 
     dx = pl.pallas_call(
-        functools.partial(_dx_kernel, bv=bv, nv=nv),
+        functools.partial(_dx_kernel, bv=bv, nv=nv,
+                          eps=float(smoothing), v_total=float(v_total)),
         grid=(nb, nv),
         in_specs=[xspec, espec, lspec, lspec, lspec],
         out_specs=xspec,
@@ -329,7 +390,8 @@ def _bwd_kernels(x, embedding, labs, lse, g, interpret):
     espec_t = pl.BlockSpec((bv, h), lambda iv, ib: (iv, 0))
     lspec_t = pl.BlockSpec((br, 1), lambda iv, ib: (ib, 0))
     de = pl.pallas_call(
-        functools.partial(_de_kernel, bv=bv),
+        functools.partial(_de_kernel, bv=bv, eps=float(smoothing),
+                          v_total=float(v_total)),
         grid=(nv, nb),
         in_specs=[xspec_t, espec_t, lspec_t, lspec_t, lspec_t],
         out_specs=espec_t,
@@ -339,9 +401,9 @@ def _bwd_kernels(x, embedding, labs, lse, g, interpret):
     return dx, de.astype(embedding.dtype), None
 
 
-def _bwd_rule(interpret, res, g):
+def _bwd_rule(interpret, smoothing, res, g):
     x, embedding, labs, lse = res
-    return _bwd_kernels(x, embedding, labs, lse, g, interpret)
+    return _bwd_kernels(x, embedding, labs, lse, g, interpret, smoothing)
 
 
 linear_cross_entropy.defvjp(_fwd_rule, _bwd_rule)

@@ -265,3 +265,76 @@ def test_gpt_fused_head_tp2_matches_materialized():
                                atol=1e-5, rtol=1e-5)
     np.testing.assert_allclose(np.asarray(g_f), np.asarray(g_m),
                                atol=1e-5, rtol=1e-4)
+
+
+@pytest.mark.parametrize("smoothing", [0.1, 0.3])
+def test_smoothing_matches_contrib_xentropy(smoothing):
+    """Fused-head label smoothing == contrib xentropy's materialized
+    reference ((1-eps)*nll + eps*(lse - mean logits)): loss and both
+    grads."""
+    from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
+
+    n, h, V = 64, 128, 512
+    rs = np.random.RandomState(3)
+    x = jnp.asarray(rs.randn(n, h), jnp.float32)
+    e = jnp.asarray(rs.randn(V, h) * 0.1, jnp.float32)
+    labels = jnp.asarray(rs.randint(0, V, (n,)), jnp.int32)
+    g = jnp.asarray(rs.randn(n), jnp.float32)
+
+    def fused(args):
+        xx, ee = args
+        return jnp.sum(xp.linear_cross_entropy(
+            xx, ee, labels, True, smoothing) * g)
+
+    def ref(args):
+        xx, ee = args
+        return jnp.sum(softmax_cross_entropy_loss(
+            xx @ ee.T, labels, smoothing=smoothing) * g)
+
+    lf, (dxf, def_) = jax.value_and_grad(fused)((x, e))
+    lr, (dxr, der) = jax.value_and_grad(ref)((x, e))
+    np.testing.assert_allclose(float(lf), float(lr), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(dxf), np.asarray(dxr),
+                               atol=1e-5, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(def_), np.asarray(der),
+                               atol=1e-5, rtol=1e-4)
+
+
+def test_smoothing_sharded_matches_full():
+    """Sharded smoothing: the uniform term's logits-sum partials psum
+    into the same global correction."""
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    n, h, V, tp, eps = 64, 128, 512, 4, 0.2
+    rs = np.random.RandomState(4)
+    x = jnp.asarray(rs.randn(n, h), jnp.float32)
+    e = jnp.asarray(rs.randn(V, h) * 0.1, jnp.float32)
+    labels = jnp.asarray(rs.randint(0, V, (n,)), jnp.int32)
+    g = jnp.asarray(rs.randn(n), jnp.float32)
+    mesh = Mesh(np.array(jax.devices()[:tp]), ("tp",))
+
+    def sharded(x, e, labels, g):
+        def f(args):
+            xx, ee = args
+            return jnp.sum(xp.linear_cross_entropy_sharded(
+                xx, ee, labels, "tp", True, eps) * g)
+
+        l, grads = jax.value_and_grad(f)((x, e))
+        return l, grads[0], grads[1]
+
+    l_s, dx_s, de_s = shard_map(
+        sharded, mesh=mesh, in_specs=(P(), P("tp"), P(), P()),
+        out_specs=(P(), P(), P("tp")), check_vma=False)(x, e, labels, g)
+
+    def full(args):
+        xx, ee = args
+        return jnp.sum(xp.linear_cross_entropy(
+            xx, ee, labels, True, eps) * g)
+
+    l_f, (dx_f, de_f) = jax.value_and_grad(full)((x, e))
+    np.testing.assert_allclose(float(l_s), float(l_f), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(dx_s), np.asarray(dx_f),
+                               atol=1e-5, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(de_s), np.asarray(de_f),
+                               atol=1e-5, rtol=1e-4)
