@@ -53,6 +53,12 @@ def fused(x, e, labels):
     return xp.linear_cross_entropy(x, e, labels, INTERPRET)
 
 
+def fused_smoothed(x, e, labels):
+    # label smoothing active: costs the extra logits-sum accumulator
+    # (eps=0 is bit-identical to `fused` — nothing to measure there)
+    return xp.linear_cross_entropy(x, e, labels, INTERPRET, 0.1)
+
+
 def measure(name, fn, n):
     rs = np.random.RandomState(0)
     x0 = jnp.asarray(rs.randn(n, H) * 0.3, jnp.bfloat16)
@@ -107,6 +113,7 @@ print(f"LM head h={H} V={V} (K={K}, overhead {OVERHEAD*1e3:.1f} ms)")
 # running it last means a partially-healthy window still yields the
 # kernel numbers.
 for label, fn in (("fused linear-CE kernel", fused),
+                  ("fused + smoothing=0.1", fused_smoothed),
                   ("materialized logits+CE", materialized)):
     for b in ((8, 16) if ON_TPU else (2,)):
         n = b * 1024 if ON_TPU else b * 64
