@@ -223,9 +223,10 @@ def _common_specs(br, bv, h):
     return xspec, espec, lspec
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def linear_cross_entropy_sharded(x, embedding_shard, labels, axis_name,
-                                 interpret=False, smoothing=0.0):
+                                 interpret=False, smoothing=0.0,
+                                 reduce_dx=True):
     """Vocab-parallel fused linear+CE: the tensor-parallel form of
     ``linear_cross_entropy`` (reference analog:
     tensor_parallel/cross_entropy.py over materialized logit shards —
@@ -246,7 +247,15 @@ def linear_cross_entropy_sharded(x, embedding_shard, labels, axis_name,
     eps*(lse - mean logits)) — NOT vocab_parallel_cross_entropy's
     Megatron semantics (which rescales eps by V/(V-1) against mean
     log-probs); the two differ numerically for the same eps.
+
+    ``reduce_dx``: True (default) psums dX across ``axis_name`` inside
+    the vjp — for callers whose upstream hidden is tp-replicated. Pass
+    False when a downstream mapping performs the cross-rank reduction
+    itself (e.g. a sequence-parallel gather whose backward
+    reduce-scatters): the vjp then returns this rank's PARTIAL dX,
+    halving collective traffic on the model's hottest bwd tensor.
     """
+    del reduce_dx  # backward-only knob
     return _fwd_sharded(x, embedding_shard, labels, axis_name,
                         interpret, smoothing)[0]
 
@@ -294,18 +303,21 @@ def _fwd_sharded(x, embedding_shard, labels, axis_name, interpret,
 
 
 def _fwd_sharded_rule(x, embedding_shard, labels, axis_name, interpret,
-                      smoothing):
+                      smoothing, reduce_dx=True):
     return _fwd_sharded(x, embedding_shard, labels, axis_name, interpret,
                         smoothing)
 
 
-def _bwd_sharded_rule(axis_name, interpret, smoothing, res, g):
+def _bwd_sharded_rule(axis_name, interpret, smoothing, reduce_dx, res, g):
     x, embedding_shard, labs, lse = res
     v_total = embedding_shard.shape[0] * lax.axis_size(axis_name)
     dx_local, de, _ = _bwd_kernels(x, embedding_shard, labs, lse, g,
                                    interpret, smoothing, v_total)
-    # dX sums every shard's p_shard @ E_shard contribution; dE is local
-    return lax.psum(dx_local, axis_name), de, None
+    # dX sums every shard's p_shard @ E_shard contribution; dE is local.
+    # With reduce_dx=False the caller's downstream mapping (e.g. an sp
+    # gather's reduce-scatter bwd) performs the sum instead.
+    dx = lax.psum(dx_local, axis_name) if reduce_dx else dx_local
+    return dx, de, None
 
 
 linear_cross_entropy_sharded.defvjp(_fwd_sharded_rule, _bwd_sharded_rule)

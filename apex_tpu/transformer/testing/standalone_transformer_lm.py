@@ -729,21 +729,22 @@ class GPTModel(nn.Module):
         call: opt-in, a real TPU (or interpret for tests), supported
         SHARD shapes. tp > 1 runs the vocab-parallel kernel
         (``linear_cross_entropy_sharded`` — per-shard online stats +
-        pmax/psum combine); the one exclusion is tp > 1 WITH sequence
-        parallelism, whose pre-matmul seq gather only the materialized
-        path performs. All static — the choice is baked at trace time."""
+        pmax/psum combine); under sequence parallelism the standard
+        pre-matmul seq gather runs first (with split-bwd, since the
+        sharded head's dX is already cross-rank reduced). All static —
+        the choice is baked at trace time."""
         cfg = self.cfg
         if not cfg.fused_lm_head:
             return False
         tp = lax.axis_size(self.axis_name)
-        if tp != 1 and cfg.sequence_parallel:
-            return False
         from apex_tpu.ops import xent_pallas
         from apex_tpu.ops.attention import _tpu_available
 
         if not (cfg.fused_lm_head_interpret or _tpu_available()):
             return False
         s, b, h = hidden.shape
+        if cfg.sequence_parallel:
+            s = s * tp  # hidden arrives seq-sharded; the head gathers
         return xent_pallas.supported(b * s, cfg.vocab_size // tp, h)
 
     @nn.compact
@@ -782,8 +783,19 @@ class GPTModel(nn.Module):
             # the fused kernel instead of materializing [n, V] logits;
             # at tp > 1 the vocab-parallel variant combines per-shard
             # online stats across ranks (no shard logits in HBM either)
-            s, b, h = hidden.shape
-            x2d = hidden.transpose(1, 0, 2).reshape(b * s, h)
+            head_in = hidden
+            sp_gathered = (cfg.sequence_parallel
+                           and lax.axis_size(self.axis_name) > 1)
+            if sp_gathered:
+                # the same pre-matmul gather parallel_lm_logits
+                # performs; its reduce-scatter backward does the
+                # cross-rank dX sum, so the head runs reduce_dx=False
+                # (partial dX out — half the collective traffic of
+                # psum-then-split on the model's hottest bwd tensor)
+                head_in = mappings.gather_from_sequence_parallel_region(
+                    hidden, self.axis_name, True)
+            s, b, h = head_in.shape
+            x2d = head_in.transpose(1, 0, 2).reshape(b * s, h)
             if lax.axis_size(self.axis_name) == 1:
                 loss = xent_pallas.linear_cross_entropy(
                     x2d, word_embeddings.astype(x2d.dtype),
@@ -793,7 +805,8 @@ class GPTModel(nn.Module):
                 loss = xent_pallas.linear_cross_entropy_sharded(
                     x2d, word_embeddings.astype(x2d.dtype),
                     labels.reshape(-1), self.axis_name,
-                    cfg.fused_lm_head_interpret)
+                    cfg.fused_lm_head_interpret, 0.0,
+                    not sp_gathered)
             return loss.reshape(b, s)
 
         logits = parallel_lm_logits(

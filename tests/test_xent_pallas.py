@@ -220,9 +220,12 @@ def test_sharded_matches_vocab_parallel_materialized():
                                atol=1e-5, rtol=1e-5)
 
 
-def test_gpt_fused_head_tp2_matches_materialized():
-    """GPTModel with fused_lm_head under tp=2: per-token losses and
-    embedding grads match the materialized vocab-parallel path."""
+@pytest.mark.parametrize("sequence_parallel", [False, True])
+def test_gpt_fused_head_tp2_matches_materialized(sequence_parallel):
+    """GPTModel with fused_lm_head under tp=2 (optionally with sequence
+    parallelism — the pre-matmul gather composing with reduce_dx=False):
+    per-token losses and embedding grads match the materialized
+    vocab-parallel path."""
     from jax import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
     from apex_tpu.transformer.parallel_state import TENSOR_AXIS
@@ -231,7 +234,8 @@ def test_gpt_fused_head_tp2_matches_materialized():
     b, s = 2, 64
     kw = dict(hidden_size=128, num_layers=1, num_attention_heads=2,
               vocab_size=512, max_position_embeddings=s,
-              hidden_dropout=0.0, attention_dropout=0.0)
+              hidden_dropout=0.0, attention_dropout=0.0,
+              sequence_parallel=sequence_parallel)
     m_fused = GPTModel(TransformerConfig(
         fused_lm_head=True, fused_lm_head_interpret=True, **kw))
     m_mat = GPTModel(TransformerConfig(**kw))
