@@ -220,7 +220,8 @@ def test_sharded_matches_vocab_parallel_materialized():
                                atol=1e-5, rtol=1e-5)
 
 
-@pytest.mark.parametrize("sequence_parallel", [False, True])
+@pytest.mark.parametrize("sequence_parallel", [
+    False, pytest.param(True, marks=pytest.mark.slow)])
 def test_gpt_fused_head_tp2_matches_materialized(sequence_parallel):
     """GPTModel with fused_lm_head under tp=2 (optionally with sequence
     parallelism — the pre-matmul gather composing with reduce_dx=False):
