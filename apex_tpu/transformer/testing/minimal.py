@@ -150,13 +150,69 @@ def make_gpt_fns(cfg, pp):
     return (stage_fn, embed_fn, loss_fn), init_params
 
 
+_TP_SHARDED_MARKERS = ("query_key_value", "dense_h_to_4h",
+                       "word_embeddings", "lm_head")
+_TP_ROW_WEIGHT_MARKERS = ("dense_4h_to_h", "self_attention")
+
+
+def _is_tp_sharded(path):
+    """Whether the minimal-GPT param at *path* (a tree_util key path) is
+    tensor-parallel-sharded (distinct shard per tp rank) as opposed to
+    replicated. Column-parallel layers shard weight AND bias; row-parallel
+    layers ('self_attention.dense', 'dense_4h_to_h') shard the weight but
+    replicate the bias (added after the psum); layernorms and position
+    embeddings are replicated. Structural, not value-based: zero-init
+    biases defeat any cross-rank equality test."""
+    names = [str(getattr(k, "key", k)) for k in path]
+    if any(m in n for n in names for m in _TP_SHARDED_MARKERS):
+        return True
+    if any(m in n for n in names for m in _TP_ROW_WEIGHT_MARKERS):
+        return names[-1] == "weight"
+    return False
+
+
+def global_grad_norm(grads):
+    """Global L2 norm of the (stage, embed, head) *grads* trees over the
+    (pp, tp) mesh axes, counting every logical parameter exactly once —
+    call INSIDE shard_map, after the dp pmean (grads are dp-replicated
+    there).
+
+    tp-sharded leaves (see `_is_tp_sharded`) contribute the tp-psum of
+    their shard sq-norms; tp-replicated leaves carry the full identical
+    grad on every rank (the copy-region psums their cotangents in
+    backward, mappings.py), so their local sq-norm IS the contribution.
+    Stage grads are distinct per pp rank (psum over pp); embed/head grads
+    come out of the schedule already reduced and replicated across pp
+    (schedules.py `_pipelined_fwd_bwd`), so they count once, locally.
+    Used for the n-device vs 1-device trajectory parity check (the
+    reference's L0 run_transformer tests compare 1-rank-vs-n-rank grads
+    the same way)."""
+    gs, ge, gh = grads
+
+    def leaf(path, g):
+        sq = jnp.sum(jnp.square(g.astype(jnp.float32)))
+        if _is_tp_sharded(path):
+            sq = lax.psum(sq, TENSOR_AXIS)
+        return sq
+
+    def tree_sq(tree):
+        sq_tree = jax.tree_util.tree_map_with_path(leaf, tree)
+        return functools.reduce(
+            jnp.add, jax.tree_util.tree_leaves(sq_tree), jnp.float32(0.0))
+
+    total = lax.psum(tree_sq(gs), PIPELINE_AXIS) + tree_sq(ge) + tree_sq(gh)
+    return jnp.sqrt(total)
+
+
 def gpt_train_step_fn(cfg, pp, num_microbatches, lr=1e-4,
-                      checkpoint_stages=True):
+                      checkpoint_stages=True, with_grad_norm=False):
     """Returns ``(step, tx, scaler)`` where ``step(params, opt_state,
     scaler_state, batch) -> (params, opt_state, scaler_state, loss)`` — to
     be called INSIDE shard_map over the (pp, dp, tp) mesh; ``tx``/``scaler``
     are the exact transform objects ``step`` uses (for state init).
     ``batch``: {"ids","labels"} of [M, mb, s] (already dp-local).
+    ``with_grad_norm``: append the unscaled `global_grad_norm` as a 5th
+    output (trajectory-parity diagnostics).
 
     The full apex training semantics: forward/backward through the 1F1B
     schedule with loss scaling, DP gradient pmean (the DDP allreduce),
@@ -198,6 +254,10 @@ def gpt_train_step_fn(cfg, pp, num_microbatches, lr=1e-4,
             lambda new, old: jnp.where(found_inf, old, new),
             new_opt_state, opt_state)
         loss = loss / scaler.scale(jnp.float32(1.0), scaler_state)
+        if with_grad_norm:
+            gnorm = global_grad_norm(grads)
+            return (new_params, new_opt_state, new_scaler_state, loss,
+                    gnorm)
         return new_params, new_opt_state, new_scaler_state, loss
 
     return step, tx, scaler
@@ -248,6 +308,28 @@ def reference_first_step_loss(cfg, pp, batch, device=None):
     a finite one (the reference's L0 run_transformer tests make the same
     1-rank-vs-n-rank comparison).
     """
+    # one step of the full replay: the loss scale multiplies then divides
+    # out on step 0, so this equals the pre-round-5 direct recomputation
+    return reference_training(cfg, pp, batch, num_steps=1,
+                              device=device)[0][0]
+
+
+def reference_training(cfg, pp, batch, num_steps, lr=1e-4, device=None):
+    """Sequential single-device replay of ``num_steps`` of the EXACT
+    training semantics of ``gpt_train_step_fn`` — same per-stage init keys
+    as ``init_params`` (``fold_in(k_s, stage)``), same dynamic loss
+    scaling / found_inf skip-step / fused-Adam update — with the
+    microbatches run one after another on ONE device: no pipeline ring,
+    no dp slicing, no tp sharding.
+
+    Returns ``(losses, grad_norms)`` as per-step float lists; the grad
+    norms are of the unscaled grads, directly comparable to the
+    ``with_grad_norm=True`` output of the n-device run. Multi-step
+    agreement certifies the whole 3D-parallel TRAJECTORY — optimizer
+    update, scaler bookkeeping, gradient collectives — not just the first
+    forward (the single-step analog of the reference's
+    tests/L0/run_transformer 1-rank-vs-n-rank comparisons).
+    """
     if device is None:
         device = jax.devices("cpu")[0]
     mesh = Mesh(np.asarray([device]).reshape(1, 1, 1),
@@ -256,36 +338,70 @@ def reference_first_step_loss(cfg, pp, batch, device=None):
     stage_mod = GPTStage(cfg, layers_per_stage=cfg.num_layers // pp)
     head_mod = GPTHead(cfg)
     M = batch["ids"].shape[0]
+    scaler = LossScaler()
+    tx = fused_adam(learning_rate=lr)
 
     def f(batch):
         mb0 = {k: v[0] for k, v in batch.items()}
         k_e, k_s, k_h = jax.random.split(jax.random.PRNGKey(0), 3)
         ep = embed_mod.init(k_e, mb0["ids"])["params"]
         hidden0 = embed_mod.apply({"params": ep}, mb0["ids"])
-        stage_params = [
+        sps = tuple(
             stage_mod.init(jax.random.fold_in(k_s, s), hidden0)["params"]
-            for s in range(pp)]
+            for s in range(pp))
         hp = head_mod.init(k_h, hidden0, mb0["labels"])["params"]
+        params = (sps, ep, hp)
+        opt_state = tx.init(params)
+        scaler_state = scaler.init()
 
-        def mb_loss(i):
-            mb = {k: v[i] for k, v in batch.items()}
-            h = embed_mod.apply({"params": ep}, mb["ids"])
-            for sp in stage_params:
-                h = stage_mod.apply({"params": sp}, h)
-            return head_mod.apply({"params": hp}, h, mb["labels"])
+        def scaled_loss(params, scale):
+            sps, ep, hp = params
 
-        return jnp.mean(jnp.stack([mb_loss(i) for i in range(M)]))
+            def mb_loss(i):
+                mb = {k: v[i] for k, v in batch.items()}
+                h = embed_mod.apply({"params": ep}, mb["ids"])
+                for sp in sps:
+                    h = stage_mod.apply({"params": sp}, h)
+                return head_mod.apply({"params": hp}, h, mb["labels"])
+
+            return jnp.mean(jnp.stack(
+                [mb_loss(i) for i in range(M)])) * scale
+
+        losses, gnorms = [], []
+        for _ in range(num_steps):
+            scale = scaler.scale(jnp.float32(1.0), scaler_state)
+            loss, grads = jax.value_and_grad(scaled_loss)(params, scale)
+            grads, found_inf = scaler.unscale(grads, scaler_state)
+            new_scaler_state = scaler.update(scaler_state, found_inf)
+            updates, new_opt_state = tx.update(grads, opt_state, params)
+            params = jax.tree_util.tree_map(
+                lambda p, u: jnp.where(found_inf, p, p + u.astype(p.dtype)),
+                params, updates)
+            opt_state = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(found_inf, old, new),
+                new_opt_state, opt_state)
+            losses.append(loss / scale)
+            scaler_state = new_scaler_state
+            sq = functools.reduce(
+                jnp.add,
+                [jnp.sum(jnp.square(g.astype(jnp.float32)))
+                 for g in jax.tree_util.tree_leaves(grads)],
+                jnp.float32(0.0))
+            gnorms.append(jnp.sqrt(sq))
+        return jnp.stack(losses), jnp.stack(gnorms)
 
     g = jax.jit(jax.shard_map(
-        f, mesh=mesh,
-        in_specs=({"ids": P(), "labels": P()},), out_specs=P(),
-        check_vma=False))
-    return float(np.asarray(jax.block_until_ready(g(batch))))
+        f, mesh=mesh, in_specs=({"ids": P(), "labels": P()},),
+        out_specs=(P(), P()), check_vma=False))
+    losses, gnorms = jax.block_until_ready(g(batch))
+    return ([float(x) for x in np.asarray(losses)],
+            [float(x) for x in np.asarray(gnorms)])
 
 
 def run_minimal_gpt_training(n_devices=None, cfg=None, num_microbatches=4,
                              micro_batch_size=2, seq_len=16, num_steps=1,
-                             devices=None, topology=None):
+                             devices=None, topology=None,
+                             return_grad_norms=False):
     """Build an (pp, dp, tp) mesh over ``n_devices`` and run ``num_steps``
     full GPT training steps. Returns the per-step losses (floats).
 
@@ -315,7 +431,8 @@ def run_minimal_gpt_training(n_devices=None, cfg=None, num_microbatches=4,
                 (PIPELINE_AXIS, DATA_AXIS, TENSOR_AXIS))
 
     _, init_params = make_gpt_fns(cfg, pp)
-    step, tx, scaler = gpt_train_step_fn(cfg, pp, num_microbatches)
+    step, tx, scaler = gpt_train_step_fn(cfg, pp, num_microbatches,
+                                         with_grad_norm=return_grad_norms)
 
     global_mb = micro_batch_size * dp
     batch = toy_batch(cfg.vocab_size, num_microbatches, global_mb, seq_len)
@@ -325,16 +442,24 @@ def run_minimal_gpt_training(n_devices=None, cfg=None, num_microbatches=4,
                              {k: v[0] for k, v in batch.items()})
         opt_state = tx.init(params)
         scaler_state = scaler.init()
-        losses = []
+        losses, gnorms = [], []
         for _ in range(num_steps):
-            params, opt_state, scaler_state, loss = step(
-                params, opt_state, scaler_state, batch)
+            out = step(params, opt_state, scaler_state, batch)
+            params, opt_state, scaler_state, loss = out[:4]
             losses.append(lax.pmean(loss, DATA_AXIS))
+            if return_grad_norms:
+                gnorms.append(out[4])
+        if return_grad_norms:
+            return jnp.stack(losses), jnp.stack(gnorms)
         return jnp.stack(losses)
 
+    out_specs = (P(), P()) if return_grad_norms else P()
     f = jax.jit(jax.shard_map(
         whole_run, mesh=mesh,
         in_specs=({"ids": P(None, DATA_AXIS), "labels": P(None, DATA_AXIS)},),
-        out_specs=P(), check_vma=False))
-    losses = jax.block_until_ready(f(batch))
-    return [float(x) for x in np.asarray(losses)]
+        out_specs=out_specs, check_vma=False))
+    out = jax.block_until_ready(f(batch))
+    if return_grad_norms:
+        return ([float(x) for x in np.asarray(out[0])],
+                [float(x) for x in np.asarray(out[1])])
+    return [float(x) for x in np.asarray(out)]
