@@ -65,9 +65,22 @@ STEPS = (int(sys.argv[1]) if len(sys.argv) > 1
          else (300 if ON_TPU else 20))
 BURN_IN = max(3, STEPS // 10)
 IMPL_TOL = 5e-3    # impl-parity: per-step rel dev, default vs alt kernels
-# cross-precision (O0 vs O2) tolerances per model: (mean after burn-in,
-# final-window). ResNet's are wide by design — see module docstring.
-XPREC_TOL = {"gpt2": (0.02, 0.01), "resnet50": (0.30, 0.20)}
+# cross-precision (O0 vs O2) trace tolerances: (mean after burn-in,
+# final-window). Only GPT gates on the loss trace — short-horizon ResNet
+# bf16-conv + BN-feedback traces genuinely diverge, and a tolerance wide
+# enough to absorb that certifies nothing (VERDICT r4 weak #2). ResNet
+# gates on ACCURACY-AT-N instead (see `resnet_acc_gate`).
+XPREC_TOL = {"gpt2": (0.02, 0.01)}
+# ResNet accuracy-at-N gate: O2's training accuracy on the fixed batch
+# pool must be within ACC_GAP of O0's (a broken cast policy — e.g. bf16
+# master weights, a mis-cast BN update, a dead loss scale — drags O2
+# below O0 by far more), and both must clear ACC_FLOOR (learnability:
+# both runs actually fit the pool, so the gap comparison is not
+# chance-vs-chance). Floors are horizon-dependent: the TPU run does
+# >=300 real steps; the CPU smoke's 20 steps reach ~0.3 on 10 classes.
+ACC_GAP = 0.10
+ACC_FLOOR = 0.60
+ACC_FLOOR_SMOKE = 0.15
 OUT_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "curves")
 # the data stream cycles a FIXED pool of batches (step % N_POOL) so the
 # models can actually fit it — per-step fresh random labels are
@@ -86,9 +99,12 @@ def shmap(f, n):
 
 
 def train_curve(init_fn, loss_fn_of, tx, opt_level, half_dtype=None):
-    """Loss per step over STEPS steps at ``opt_level``. ``init_fn()``
-    returns (params fp32, aux); ``loss_fn_of(batch_key, aux)`` returns a
-    closure params -> (loss, new_aux)."""
+    """``(losses, final_params, final_aux)`` over STEPS steps at
+    ``opt_level``. ``init_fn()`` returns (params fp32, aux);
+    ``loss_fn_of(batch_key, aux)`` returns a closure
+    params -> (loss, new_aux). The returned params are the trained model
+    params at the level's compute dtype (what inference at that level
+    would use) — the accuracy-at-N gate evals with them."""
     params, aux = init_fn()
     kwargs = {} if half_dtype is None else {"half_dtype": half_dtype}
     params, opt = amp.initialize(params, tx, opt_level=opt_level, **kwargs)
@@ -106,19 +122,21 @@ def train_curve(init_fn, loss_fn_of, tx, opt_level, half_dtype=None):
                     found_inf=found_inf)
                 return (p, st, ax), loss
 
-            (_, _, _), losses = lax.scan(
+            (p, st, ax), losses = lax.scan(
                 body, (params, state, aux), jnp.arange(STEPS))
-            return losses
+            return losses, p, ax
 
-        return shmap(local, 4)(params, state, aux, key)
+        return jax.shard_map(local, mesh=mesh, in_specs=(P(),) * 4,
+                             out_specs=(P(), P(), P()),
+                             check_vma=False)(params, state, aux, key)
 
     t0 = time.perf_counter()
-    losses = jax.block_until_ready(
+    losses, final_p, final_aux = jax.block_until_ready(
         jax.jit(run)(params, state, aux, jax.random.PRNGKey(7)))
     dt = time.perf_counter() - t0
     print(f"  {opt_level}: {STEPS} steps in {dt:.1f}s "
           f"(first {float(losses[0]):.4f} -> last {float(losses[-1]):.4f})")
-    return np.asarray(losses, np.float64)
+    return np.asarray(losses, np.float64), final_p, final_aux
 
 
 def gate(name, l0, l2, extra=None):
@@ -144,6 +162,35 @@ def gate(name, l0, l2, extra=None):
            "o0": l0.tolist(), "o2": l2.tolist()}
     if extra:
         rec.update(extra)
+    return ok, rec
+
+
+def resnet_acc_gate(l0, l2, acc0, acc2):
+    """Accuracy-at-N gate (VERDICT r4 weak #2: the old (0.30, 0.20)
+    loss-trace tolerance green-lit curves disagreeing by 22% — wide
+    enough to pass a broken cast policy). O2 must reach O0's training
+    accuracy on the fixed pool within ACC_GAP — a broken cast policy
+    (bf16 masters, mis-cast BN update, dead loss scale) drags O2's
+    accuracy far below O0's — and both must clear the horizon floor so
+    the gap isn't compared at chance level. Loss traces are recorded but
+    not gated (short-horizon bf16-conv/BN trajectories genuinely
+    diverge; the reference's compare.py never gates cross-precision
+    traces either)."""
+    floor = ACC_FLOOR if ON_TPU else ACC_FLOOR_SMOKE
+    w = max(1, STEPS // 10)
+    decreased = (l2[-w:].mean() < l2[:w].mean()
+                 and l0[-w:].mean() < l0[:w].mean())
+    gap = abs(acc0 - acc2)
+    ok = bool(decreased and acc0 >= floor and acc2 >= floor
+              and gap <= ACC_GAP)
+    print(f"  resnet50: acc@N O0={acc0:.3f} O2={acc2:.3f} "
+          f"(floor {floor}, gap {gap:.3f} <= {ACC_GAP}), "
+          f"both_decreased={decreased} -> {'PASS' if ok else 'FAIL'}")
+    rec = {"model": "resnet50", "steps": STEPS,
+           "acc_at_n_o0": float(acc0), "acc_at_n_o2": float(acc2),
+           "acc_floor": float(floor), "acc_gap_tol": ACC_GAP,
+           "decreased": bool(decreased), "pass": ok,
+           "o0": l0.tolist(), "o2": l2.tolist()}
     return ok, rec
 
 
@@ -193,9 +240,9 @@ def gpt_curves():
     tx = fused_adam(learning_rate=1e-4)
     print(f"GPT-2 {'small' if ON_TPU else 'tiny'} b={b} s={s}")
     i0, f0 = make(model_o0)
-    l0 = train_curve(i0, f0, tx, "O0")
+    l0, _, _ = train_curve(i0, f0, tx, "O0")
     i2, f2 = make(model_o2)
-    l2 = train_curve(i2, f2, tx, "O2")
+    l2, _, _ = train_curve(i2, f2, tx, "O2")
 
     # impl-parity leg — compare.py's ACTUAL assertion: the same O2 run
     # under the alternate kernel dispatch (rows attention + Pallas LN +
@@ -209,7 +256,7 @@ def gpt_curves():
     _attn.set_default_impl("rows")
     try:
         ia, fa = make(model_alt)
-        l2_alt = train_curve(ia, fa, tx, "O2")
+        l2_alt, _, _ = train_curve(ia, fa, tx, "O2")
     finally:
         _fln.USE_PALLAS = False
         _attn.set_default_impl("flash")
@@ -271,16 +318,55 @@ def resnet_curves():
 
         return init_fn, loss_fn_of
 
-    # linear-scaling rule on TPU (0.1 @ b=256); the smoke's b=4 needs
-    # the empirically-stable 3e-4 (b=4 at the rule's 1.6e-3 wobbles)
-    lr = 0.1 * b / 256 if ON_TPU else 3e-4
-    tx = fused_sgd(learning_rate=lr, momentum=0.9, weight_decay=1e-4)
+    def pool_accuracy(mod, params, bstats, key):
+        """Mean accuracy over the SAME fixed batch pool the run cycled
+        (fold_in(key, step % N_POOL) in train_curve), argmax vs the pool
+        labels — evaluated in TRAIN mode (batch-local BN statistics,
+        mutation discarded). Eval-mode running stats are still near init
+        at short horizons (BN cold start) and freeze the argmax at one
+        class regardless of how much the params learned; batch-local
+        stats measure what the loss actually optimized, which is the
+        quantity the O0-vs-O2 gap certifies."""
+        def f(params, bstats):
+            accs = []
+            for i in range(N_POOL):
+                kx, ky = jax.random.split(jax.random.fold_in(key, i))
+                y = jax.random.randint(ky, (b,), 0, n_cls, jnp.int32)
+                x = (templates[y]
+                     + 0.3 * jax.random.normal(kx, (b, img, img, 3),
+                                               jnp.float32))
+                logits, _ = mod.apply(
+                    {"params": params, "batch_stats": bstats},
+                    x.astype(mod.dtype), train=True,
+                    mutable=["batch_stats"])
+                accs.append(jnp.mean((jnp.argmax(logits, -1) == y)
+                                     .astype(jnp.float32)))
+            return jnp.mean(jnp.stack(accs))
+
+        return float(np.asarray(jax.block_until_ready(
+            jax.jit(shmap(f, 2))(params, bstats))))
+
+    # TPU: the reference imagenet recipe — SGD+momentum, linear-scaling
+    # rule (0.1 @ b=256). Smoke: SGD cannot clear the accuracy floor at
+    # b=4 in 20 steps at any stable lr (measured: 3e-4 and 1e-3 stay at
+    # chance, 3e-3 wobbles the bf16 leg, 1e-2 diverges), so the smoke
+    # validates the gate MECHANISM with fused_adam(1e-3) (measured: O0
+    # acc 0.56 / O2 0.63, both traces descend); fused_sgd keeps its own
+    # unit tests and the TPU leg.
+    if ON_TPU:
+        tx = fused_sgd(learning_rate=0.1 * b / 256, momentum=0.9,
+                       weight_decay=1e-4)
+    else:
+        tx = fused_adam(learning_rate=1e-3)
     print(f"ResNet-50 b={b} img={img}")
+    key = jax.random.PRNGKey(7)  # train_curve's data key: eval the pool
     i0, l0f = make(model)
-    l0 = train_curve(i0, l0f, tx, "O0")
+    l0, p0, bs0 = train_curve(i0, l0f, tx, "O0")
+    acc0 = pool_accuracy(model, p0, bs0, key)
     i2, l2f = make(model_bf16)
-    l2 = train_curve(i2, l2f, tx, "O2")
-    return gate("resnet50", l0, l2)
+    l2, p2, bs2 = train_curve(i2, l2f, tx, "O2")
+    acc2 = pool_accuracy(model_bf16, p2, bs2, key)
+    return resnet_acc_gate(l0, l2, acc0, acc2)
 
 
 def main():
