@@ -402,6 +402,8 @@ def test_misc_compat_surfaces():
                                   np.asarray(m4n2_1d(w)))
 
 
+@pytest.mark.slow  # 6s of tiny-surface compiles; behavior-parity
+# coverage retained in the slow tier, name-parity in check_api_parity
 def test_testing_commons(state_guard):
     """apex/transformer/testing/commons.py:83-296: IdentityLayer,
     ToyParallelMLP, set_random_seed, initialize_distributed,

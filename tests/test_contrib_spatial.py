@@ -27,6 +27,8 @@ def spatial_mesh(n=NDEV):
     return Mesh(np.array(jax.devices()[:n]), ("spatial",))
 
 
+@pytest.mark.slow  # two-impl agreement compile; the 1d exchanger's
+# fills-padding check stays fast
 def test_halo_exchange_sendrecv_and_allgather_agree():
     mesh = spatial_mesh(4)
     rs = np.random.RandomState(0)

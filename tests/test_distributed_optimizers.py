@@ -103,16 +103,19 @@ def test_distributed_lamb_matches_fused_lamb():
 
 def test_distributed_adam_reduces_distinct_rank_grads():
     """Per-rank distinct grads → behaves like mean of grads (the DDP+ZeRO
-    composition)."""
+    composition). 2 shards, not 8: the psum_scatter/all_gather mechanics
+    are shard-count-independent and the 8-way program costs 3x the
+    compile (fast-tier budget, CLAUDE.md)."""
+    n = 2
     params = {"w": jnp.zeros((16,), jnp.float32)}
-    mesh = Mesh(np.array(jax.devices()), ("dp",))
-    dist = distributed_fused_adam(learning_rate=0.1, num_shards=NDEV,
+    mesh = Mesh(np.array(jax.devices()[:n]), ("dp",))
+    dist = distributed_fused_adam(learning_rate=0.1, num_shards=n,
                                   axis_name="dp")
     ref = fused_adam(learning_rate=0.1)
 
-    # rank r grad = (r+1) * ones → mean = 4.5
+    # rank r grad = (r+1) * ones → mean = 1.5
     per_rank = jnp.stack([jnp.full((16,), float(r + 1))
-                          for r in range(NDEV)])
+                          for r in range(n)])
 
     def run(params, my_grad):
         g = {"w": my_grad[0]}
@@ -123,7 +126,7 @@ def test_distributed_adam_reduces_distinct_rank_grads():
     got = shard_map(run, mesh=mesh, in_specs=(P(), P("dp")),
                     out_specs=P(), check_vma=False)(params, per_rank)
     state = ref.init(params)
-    updates, _ = ref.update({"w": jnp.full((16,), 4.5)}, state, params)
+    updates, _ = ref.update({"w": jnp.full((16,), 1.5)}, state, params)
     want = jax.tree_util.tree_map(jnp.add, params, updates)
     np.testing.assert_allclose(np.asarray(got["w"]),
                                np.asarray(want["w"]), rtol=1e-5)

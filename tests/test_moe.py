@@ -129,6 +129,8 @@ def test_expert_parallel_grads_match_reference():
             atol=1e-5, rtol=1e-4)
 
 
+@pytest.mark.slow  # full ExpertParallelMLP compile; routing-level
+# dropped-token coverage stays fast (test_switch_routing_capacity_and_gates)
 def test_dropped_tokens_produce_zero_output():
     T, H, F, E = 8, 8, 16, 2
     cfg = MoEConfig(hidden_size=H, ffn_hidden_size=F, num_experts=E,

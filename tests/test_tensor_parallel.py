@@ -189,7 +189,7 @@ def test_gather_sequence_parallel_bwd_reduce_scatter():
 def test_column_parallel_linear_parity():
     """Column output (gathered) == dense with the gathered master weight
     (port of test_layers.py:26-130)."""
-    mesh = tp_mesh()
+    mesh = tp_mesh(2)
     x = jnp.asarray(np.random.RandomState(0).randn(5, 16), jnp.float32)
     mod = ColumnParallelLinear(input_size=16, output_size=32,
                                gather_output=True)
@@ -207,7 +207,7 @@ def test_column_parallel_linear_parity():
 
 
 def test_column_parallel_linear_grad_x():
-    mesh = tp_mesh()
+    mesh = tp_mesh(2)
     x = jnp.asarray(np.random.RandomState(2).randn(4, 16), jnp.float32)
     mod = ColumnParallelLinear(input_size=16, output_size=32,
                                gather_output=True, bias=False)
@@ -225,7 +225,7 @@ def test_column_parallel_linear_grad_x():
 
 
 def test_row_parallel_linear_parity():
-    mesh = tp_mesh()
+    mesh = tp_mesh(2)
     x = jnp.asarray(np.random.RandomState(3).randn(5, 32), jnp.float32)
     mod = RowParallelLinear(input_size=32, output_size=16,
                             input_is_parallel=False)
@@ -243,6 +243,8 @@ def test_row_parallel_linear_parity():
     np.testing.assert_allclose(np.asarray(y), want, rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow  # the full sp MLP chain compile; per-layer
+# column/row parity and the sp mapping round-trips stay fast
 def test_column_row_sequence_parallel_mlp():
     """SP end-to-end: seq-sharded input → Column(SP) → Row(SP) → seq-sharded
     output equals the dense computation (test_layers.py sequence_parallel)."""
@@ -272,7 +274,7 @@ def test_column_row_sequence_parallel_mlp():
 
 
 def test_vocab_parallel_embedding_parity():
-    mesh = tp_mesh()
+    mesh = tp_mesh(2)
     vocab, dim = NDEV * 4, 8
     ids = jnp.asarray(np.random.RandomState(8).randint(0, vocab, (3, 5)))
     mod = VocabParallelEmbedding(num_embeddings=vocab, embedding_dim=dim)
@@ -304,7 +306,7 @@ def _ref_ce(logits, target, smoothing=0.0):
     0.0, pytest.param(0.1, marks=pytest.mark.slow)])
 def test_vocab_parallel_cross_entropy(smoothing):
     """Port of test_cross_entropy.py: sharded CE == full-vocab CE."""
-    mesh = tp_mesh()
+    mesh = tp_mesh(2)
     B, V = 6, NDEV * 4
     rng = np.random.RandomState(10)
     logits = rng.randn(B, V).astype(np.float32)
@@ -320,7 +322,7 @@ def test_vocab_parallel_cross_entropy(smoothing):
 @pytest.mark.parametrize("smoothing", [
     0.0, pytest.param(0.1, marks=pytest.mark.slow)])
 def test_vocab_parallel_cross_entropy_grad(smoothing):
-    mesh = tp_mesh()
+    mesh = tp_mesh(2)
     B, V = 4, NDEV * 2
     rng = np.random.RandomState(11)
     logits = rng.randn(B, V).astype(np.float32)

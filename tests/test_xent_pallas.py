@@ -189,6 +189,8 @@ def test_sharded_matches_full_table_with_grads():
                                atol=1e-5, rtol=1e-4)
 
 
+@pytest.mark.slow  # cross-impl consistency; the sharded-vs-full-table
+# parity (with grads) stays fast
 def test_sharded_matches_vocab_parallel_materialized():
     """...and the materialized vocab-parallel CE (the tensor_parallel
     reference surface) on the same shards."""
@@ -306,6 +308,8 @@ def test_smoothing_matches_contrib_xentropy(smoothing):
                                atol=1e-5, rtol=1e-4)
 
 
+@pytest.mark.slow  # sharded smoothing consistency; unsharded smoothing
+# parity and sharded unsmoothed parity stay fast
 def test_smoothing_sharded_matches_full():
     """Sharded smoothing: the uniform term's logits-sum partials psum
     into the same global correction."""
