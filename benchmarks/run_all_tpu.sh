@@ -32,6 +32,10 @@ run optimizers        900 python benchmarks/profile_optimizers.py
 run multihead_attn    900 python benchmarks/profile_multihead_attn.py
 run dcgan             900 python benchmarks/profile_dcgan.py
 run xent             1200 python benchmarks/profile_xent.py
+# row-block escape hatch A/B: if the analytic br=512 VMEM model is wrong
+# on device (Mosaic reject / spill), this rung still lands a working
+# number and the delta quantifies the cap (VERDICT r4 missing #2)
+run xent_rb256        900 env APEX_XENT_ROW_BLOCK=256 python benchmarks/profile_xent.py
 run gpt              1200 python benchmarks/profile_gpt.py
 # step-level A/B halves of the late-kernel decision procedures (PERF.md §7)
 run gpt_rows          900 env APEX_ATTN_IMPL=rows python benchmarks/profile_gpt.py
@@ -45,5 +49,10 @@ run pretrain         1800 python benchmarks/profile_pretrain.py
 # 6 short training runs; the traces land in benchmarks/curves/
 run convergence      2400 python benchmarks/profile_convergence.py
 run bench            5900 python bench.py
+# b=32 amortization probe LAST: its compile stalled the tunneled
+# remote-compile helper once (PERF.md) and a wedged client can poison
+# subsequent backend inits — nothing after it left to lose. Single
+# attempt: the retry ladder would re-wedge.
+run bench_b32        1500 env APEX_BENCH_BATCH=32 APEX_BENCH_ATTEMPTS=1 python bench.py
 
 echo "=== done; feed the logs into PERF.md"
