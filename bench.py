@@ -55,10 +55,12 @@ def main():
     # Kernel-dispatch knobs shared with benchmarks/profile_gpt.py
     # (benchmarks/_knobs.py): the measured winners (PERF.md §3/§4/§7)
     # can be adopted or A/B'd without editing the bench.
-    from benchmarks._knobs import apply_dispatch_knobs, fused_head_requested
+    from benchmarks._knobs import (apply_dispatch_knobs,
+                                   fused_head_requested, remat_granularity)
 
     apply_dispatch_knobs()
     fused_head = fused_head_requested()
+    remat = remat_granularity()
 
     # GPT-2 small shapes on TPU; tiny on CPU (local smoke)
     if on_tpu:
@@ -66,7 +68,7 @@ def main():
             hidden_size=768, num_layers=12, num_attention_heads=12,
             vocab_size=50304, max_position_embeddings=1024,
             hidden_dropout=0.0, attention_dropout=0.0, bf16=True,
-            fused_lm_head=fused_head)
+            fused_lm_head=fused_head, recompute_granularity=remat)
         # b=16 doubles the round-2 batch while staying in the
         # known-to-compile envelope of the tunneled remote-compile helper
         # (b=32 compiles stalled it — see PERF.md); override to taste
@@ -78,7 +80,8 @@ def main():
             hidden_size=128, num_layers=2, num_attention_heads=4,
             vocab_size=512, max_position_embeddings=128,
             hidden_dropout=0.0, attention_dropout=0.0, bf16=True,
-            fused_lm_head=fused_head, fused_lm_head_interpret=fused_head)
+            fused_lm_head=fused_head, fused_lm_head_interpret=fused_head,
+            recompute_granularity=remat)
         b, s, iters = 2, 128, 3
         peak_flops = None
 
@@ -224,6 +227,7 @@ def main():
             "fused_lm_head": bool(fused_head),
             "attn_impl": os.environ.get("APEX_ATTN_IMPL", "flash"),
             "ln_pallas": os.environ.get("APEX_LN_PALLAS") == "1",
+            "remat": remat,
         },
     }
     if degraded:
@@ -292,7 +296,7 @@ def _config_ladder(attempts, smoke):
     dispatch won."""
     pinned = any(os.environ.get(k)
                  for k in ("APEX_FUSED_LM_HEAD", "APEX_ATTN_IMPL",
-                           "APEX_LN_PALLAS"))
+                           "APEX_LN_PALLAS", "APEX_REMAT"))
     if smoke or pinned or attempts < 2:
         return [{}] * attempts
     return [{}, {"APEX_FUSED_LM_HEAD": "1"}] + [{}] * (attempts - 2)

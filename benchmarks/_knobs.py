@@ -11,9 +11,20 @@ One implementation consumed by both ``benchmarks/profile_gpt.py`` and
   linear-CE kernel (``TransformerConfig.fused_lm_head``); pass
   ``fused_head_requested()`` into the config, with
   ``fused_lm_head_interpret`` True off-TPU so CPU smokes exercise it.
+* ``APEX_REMAT={selective|full}`` — activation recompute on the trunk
+  (``TransformerConfig.recompute_granularity``): the queued MFU lever
+  for batch sizes the no-remat backward can't fit/compile.
 """
 
 import os
+
+
+def remat_granularity():
+    """Validated APEX_REMAT value (None when unset)."""
+    v = os.environ.get("APEX_REMAT") or None
+    if v not in (None, "selective", "full"):
+        raise ValueError(f"APEX_REMAT={v!r}: want 'selective' or 'full'")
+    return v
 
 
 def apply_dispatch_knobs():

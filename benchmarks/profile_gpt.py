@@ -42,10 +42,12 @@ from apex_tpu.transformer.testing import GPTModel, TransformerConfig
 # Step-level halves of the kernel head-to-heads (profile_attention /
 # profile_xent / profile_layernorm): APEX_ATTN_IMPL, APEX_FUSED_LM_HEAD,
 # APEX_LN_PALLAS — shared semantics with bench.py via benchmarks/_knobs
-from benchmarks._knobs import apply_dispatch_knobs, fused_head_requested
+from benchmarks._knobs import (apply_dispatch_knobs, fused_head_requested,
+                               remat_granularity)
 
 apply_dispatch_knobs()
 FUSED_HEAD = fused_head_requested()
+REMAT = remat_granularity()
 
 B, S = (2, 128) if SMOKE else (8, 1024)
 K = 2 if SMOKE else 32  # scan length
@@ -58,7 +60,8 @@ cfg = TransformerConfig(
     vocab_size=512 if SMOKE else 50304,
     max_position_embeddings=S,
     hidden_dropout=0.0, attention_dropout=0.0, bf16=True,
-    fused_lm_head=FUSED_HEAD, fused_lm_head_interpret=FUSED_HEAD and SMOKE)
+    fused_lm_head=FUSED_HEAD, fused_lm_head_interpret=FUSED_HEAD and SMOKE,
+    recompute_granularity=REMAT)
 model = GPTModel(cfg)
 mesh = Mesh(np.asarray(jax.devices()[:1]), (TENSOR_AXIS,))
 rs = np.random.RandomState(0)

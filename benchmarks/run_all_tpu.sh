@@ -41,6 +41,7 @@ run gpt              1200 python benchmarks/profile_gpt.py
 run gpt_rows          900 env APEX_ATTN_IMPL=rows python benchmarks/profile_gpt.py
 run gpt_fused_head    900 env APEX_FUSED_LM_HEAD=1 python benchmarks/profile_gpt.py
 run gpt_ln_pallas     900 env APEX_LN_PALLAS=1 python benchmarks/profile_gpt.py
+run gpt_remat_sel     900 env APEX_REMAT=selective python benchmarks/profile_gpt.py
 # long-sequence crossover behind the rows-vs-flash dispatch rule
 run attn_seq4096      900 env APEX_ATTN_SEQ=4096 python benchmarks/profile_attention.py
 run resnet           1200 python benchmarks/profile_resnet.py
@@ -54,5 +55,8 @@ run bench            5900 python bench.py
 # subsequent backend inits — nothing after it left to lose. Single
 # attempt: the retry ladder would re-wedge.
 run bench_b32        1500 env APEX_BENCH_BATCH=32 APEX_BENCH_ATTEMPTS=1 python bench.py
+# ...and with selective remat: the smaller backward working set may be
+# what the b=32 compile needs (round-3 stall was an oversized config)
+run bench_b32_remat  1500 env APEX_BENCH_BATCH=32 APEX_REMAT=selective APEX_BENCH_ATTEMPTS=1 python bench.py
 
 echo "=== done; feed the logs into PERF.md"

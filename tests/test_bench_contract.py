@@ -81,7 +81,8 @@ def test_watchdog_single_healthy_attempt_is_clean_headline(monkeypatch,
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
     monkeypatch.setenv("APEX_BENCH_ATTEMPTS", "1")
     monkeypatch.delenv("APEX_BENCH_SMOKE", raising=False)
-    for k in ("APEX_FUSED_LM_HEAD", "APEX_ATTN_IMPL", "APEX_LN_PALLAS"):
+    for k in ("APEX_FUSED_LM_HEAD", "APEX_ATTN_IMPL", "APEX_LN_PALLAS",
+              "APEX_REMAT"):
         monkeypatch.delenv(k, raising=False)
     rc = bench._watchdog()
     out = [l for l in capsys.readouterr().out.splitlines()
@@ -114,7 +115,8 @@ def test_watchdog_config_ladder(monkeypatch, capsys):
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
     monkeypatch.setenv("APEX_BENCH_ATTEMPTS", "3")
     monkeypatch.delenv("APEX_BENCH_SMOKE", raising=False)
-    for k in ("APEX_FUSED_LM_HEAD", "APEX_ATTN_IMPL", "APEX_LN_PALLAS"):
+    for k in ("APEX_FUSED_LM_HEAD", "APEX_ATTN_IMPL", "APEX_LN_PALLAS",
+              "APEX_REMAT"):
         monkeypatch.delenv(k, raising=False)
     rc = bench._watchdog()
     out = [l for l in capsys.readouterr().out.splitlines()
@@ -149,7 +151,8 @@ def test_watchdog_ladder_retries_unhealthy_config(monkeypatch, capsys):
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
     monkeypatch.setenv("APEX_BENCH_ATTEMPTS", "3")
     monkeypatch.delenv("APEX_BENCH_SMOKE", raising=False)
-    for k in ("APEX_FUSED_LM_HEAD", "APEX_ATTN_IMPL", "APEX_LN_PALLAS"):
+    for k in ("APEX_FUSED_LM_HEAD", "APEX_ATTN_IMPL", "APEX_LN_PALLAS",
+              "APEX_REMAT"):
         monkeypatch.delenv(k, raising=False)
     rc = bench._watchdog()
     out = [l for l in capsys.readouterr().out.splitlines()
@@ -199,7 +202,8 @@ def test_watchdog_ladder_retries_degraded_fused_config(monkeypatch, capsys):
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
     monkeypatch.setenv("APEX_BENCH_ATTEMPTS", "3")
     monkeypatch.delenv("APEX_BENCH_SMOKE", raising=False)
-    for k in ("APEX_FUSED_LM_HEAD", "APEX_ATTN_IMPL", "APEX_LN_PALLAS"):
+    for k in ("APEX_FUSED_LM_HEAD", "APEX_ATTN_IMPL", "APEX_LN_PALLAS",
+              "APEX_REMAT"):
         monkeypatch.delenv(k, raising=False)
     rc = bench._watchdog()
     out = [l for l in capsys.readouterr().out.splitlines()
@@ -227,7 +231,8 @@ def test_watchdog_cpu_only_box_runs_once(monkeypatch, capsys):
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
     monkeypatch.setenv("APEX_BENCH_ATTEMPTS", "3")
     monkeypatch.delenv("APEX_BENCH_SMOKE", raising=False)
-    for k in ("APEX_FUSED_LM_HEAD", "APEX_ATTN_IMPL", "APEX_LN_PALLAS"):
+    for k in ("APEX_FUSED_LM_HEAD", "APEX_ATTN_IMPL", "APEX_LN_PALLAS",
+              "APEX_REMAT"):
         monkeypatch.delenv(k, raising=False)
     rc = bench._watchdog()
     out = [l for l in capsys.readouterr().out.splitlines()
