@@ -400,3 +400,34 @@ def test_minimal_gpt_loss_parity_vs_single_device():
     ref = reference_first_step_loss(
         cfg, pp, toy_batch(cfg.vocab_size, 4, 2 * dp, 16))
     assert abs(losses[0] - ref) <= 0.05, (losses[0], ref)
+
+
+@pytest.mark.slow  # pytest twin of the round-5 dryrun_multichip check
+def test_minimal_gpt_trajectory_and_grad_norm_parity():
+    """3 training steps of the (2, 2, 2) run track the sequential
+    1-device replay in BOTH per-step loss and unscaled global grad norm
+    — the trajectory version of the parity above (a wrong-but-small
+    gradient error passes a single-step loss check but not this)."""
+    from apex_tpu.transformer.testing import TransformerConfig
+    from apex_tpu.transformer.testing.minimal import (
+        reference_training,
+        run_minimal_gpt_training,
+        toy_batch,
+    )
+
+    pp, dp, tp = 2, 2, 2
+    cfg = TransformerConfig(
+        hidden_size=64, num_layers=2 * pp, num_attention_heads=4,
+        vocab_size=128, max_position_embeddings=16,
+        hidden_dropout=0.0, attention_dropout=0.0, bf16=True,
+        apply_query_key_layer_scaling=False)
+    losses, gnorms = run_minimal_gpt_training(
+        n_devices=8, cfg=cfg, topology=(pp, dp, tp), num_microbatches=4,
+        micro_batch_size=2, seq_len=16, num_steps=3,
+        return_grad_norms=True)
+    ref_losses, ref_gnorms = reference_training(
+        cfg, pp, toy_batch(cfg.vocab_size, 4, 2 * dp, 16), num_steps=3)
+    for l, rl in zip(losses, ref_losses):
+        assert abs(l - rl) <= 0.05, (losses, ref_losses)
+    for g, rg in zip(gnorms, ref_gnorms):
+        assert abs(g - rg) <= 0.05 * max(rg, 1e-6), (gnorms, ref_gnorms)
