@@ -15,7 +15,9 @@ knob's comment):
 * ``"split"``: a q-major dq pass that recomputes S and P from
   (q, k, v), forms dP = dO V^T, uses D = rowsum(dO * O) = rowsum(P * dP)
   to avoid needing O, writes dQ = dS K — and emits the per-row softmax
-  stats (m, l, D) as [b, h, sq] fp32 byproducts; then a k-major dk/dv
+  stats (m, l, D) as [b, h, sq, 1] fp32 byproducts (the trailing 1 keeps
+  the block's last dim equal to the array dim, satisfying Mosaic's
+  last-two-dims tiling rule); then a k-major dk/dv
   pass where each (b, h, k-block) grid step reconstructs P row-exactly
   from those stats and owns its [bk, d] dk/dv outputs outright (no
   accumulation across grid steps). Eligibility is VMEM-gated
@@ -92,8 +94,13 @@ def _masks(iq, bq, rows, sk, causal, seg_q, seg_kv, col0=0,
         col = col0 + lax.broadcasted_iota(jnp.int32, (rows, sk), 1)
         masked = col > row
     if seg_q is not None:
-        sq_row = seg_q[0, :] if seg_rows is None else seg_rows
-        skv_row = seg_kv[0, :]
+        # seg_q is [1, bq|sq, 1] (sublane-major), seg_kv [1, 1, sk|bk]
+        # (lane-major) — block sizes depend on the call site (q-major
+        # passes tile seg_q; the k-major pass tiles seg_kv instead and
+        # overrides rows via seg_rows); each layout matches the axis it
+        # broadcasts along below
+        sq_row = seg_q[0, :, 0] if seg_rows is None else seg_rows
+        skv_row = seg_kv[0, 0, :]
         diff = sq_row[:, None] != skv_row[None, :]
         masked = diff if masked is None else masked | diff
     return masked
@@ -429,9 +436,13 @@ def _bwd_dq_kernel(*refs, scale, causal, has_seg, bq):
     dq = lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
                          preferred_element_type=jnp.float32)
     dq_ref[0, 0] = dq.astype(dq_ref.dtype)
-    m_ref[0, 0] = m[:, 0]
-    l_ref[0, 0] = tot[:, 0]
-    dcol_ref[0, 0] = dcol[:, 0]
+    # stats refs are [bq, 1] (the stats arrays carry a trailing 1 so the
+    # block's last dim equals the array dim — Mosaic requires the last
+    # two block dims be (8, 128)-divisible or full; a 3-D (1, 1, bq)
+    # block has a bare 1 against the h axis and fails to lower)
+    m_ref[0, 0] = m
+    l_ref[0, 0] = tot
+    dcol_ref[0, 0] = dcol
 
 
 def _bwd_dq_kernel_chunked(*refs, scale, causal, has_seg, bq):
@@ -486,9 +497,9 @@ def _bwd_dq_kernel_chunked(*refs, scale, causal, has_seg, bq):
                 ds[:, sl].astype(q.dtype), kc, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
     dq_ref[0, 0] = acc_scr[...].astype(dq_ref.dtype)
-    m_ref[0, 0] = m[:, 0]
-    l_ref[0, 0] = tot[:, 0]
-    dcol_ref[0, 0] = dcol[:, 0]
+    m_ref[0, 0] = m          # [bq, 1] refs — see _bwd_dq_kernel
+    l_ref[0, 0] = tot
+    dcol_ref[0, 0] = dcol
 
 
 def _bwd_dkv_kernel(*refs, scale, causal, has_seg, bq, sq):
@@ -517,23 +528,23 @@ def _bwd_dkv_kernel(*refs, scale, causal, has_seg, bq, sq):
         def _chunk(c=c):
             qc = q_ref[0, 0, c * bq:(c + 1) * bq, :]
             doc = do_ref[0, 0, c * bq:(c + 1) * bq, :]
-            m = m_ref[0, 0, c * bq:(c + 1) * bq]
-            tot = l_ref[0, 0, c * bq:(c + 1) * bq]
-            dcol = dcol_ref[0, 0, c * bq:(c + 1) * bq]
+            m = m_ref[0, 0, c * bq:(c + 1) * bq, :]       # [bq, 1]
+            tot = l_ref[0, 0, c * bq:(c + 1) * bq, :]
+            dcol = dcol_ref[0, 0, c * bq:(c + 1) * bq, :]
 
             s = lax.dot_general(qc, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
             s = s * jnp.float32(scale)
 
             seg_rows = (None if sq_ref is None
-                        else sq_ref[0, c * bq:(c + 1) * bq])
+                        else sq_ref[0, c * bq:(c + 1) * bq, 0])
             masked = _masks(c, bq, bq, bk, causal, sq_ref, skv_ref,
                             col0=ik * bk, seg_rows=seg_rows)
-            p = _p_from_stats(s, m[:, None], tot[:, None], masked)
+            p = _p_from_stats(s, m, tot, masked)
 
             dp = lax.dot_general(doc, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-            ds = (p * (dp - dcol[:, None]) * jnp.float32(scale)).astype(
+            ds = (p * (dp - dcol) * jnp.float32(scale)).astype(
                 qc.dtype)
             p_lo = p.astype(qc.dtype)
 
@@ -561,8 +572,16 @@ def _specs(b, h, bq, sq, sk, d, has_seg):
     kvspec = pl.BlockSpec((1, 1, sk, d), lambda ib, ih, iq: (ib, ih, 0, 0))
     ins = [qspec, kvspec, kvspec]
     if has_seg:
-        ins.append(pl.BlockSpec((1, bq), lambda ib, ih, iq: (ib, iq)))
-        ins.append(pl.BlockSpec((1, sk), lambda ib, ih, iq: (ib, 0)))
+        # Mosaic's last-two-dims rule: each block dim must be (8, 128)-
+        # divisible or span the full array dim. A 2-D (1, s) block over
+        # [b, s] puts a bare 1 against the batch axis and fails it, so
+        # seg_q travels SUBLANE-major as [b, sq, 1] — its (1, bq, 1)
+        # block needs only 8-divisibility on bq, legal for every block
+        # size _pick_bq can produce — while seg_kv stays LANE-major as
+        # [b, 1, sk] with the always-full (and always-legal) (1, 1, sk)
+        # block. Each layout matches the axis _masks broadcasts it along.
+        ins.append(pl.BlockSpec((1, bq, 1), lambda ib, ih, iq: (ib, iq, 0)))
+        ins.append(pl.BlockSpec((1, 1, sk), lambda ib, ih, iq: (ib, 0, 0)))
     return ins, qspec, kvspec
 
 
@@ -570,7 +589,10 @@ def _seg_ops(segment_ids):
     if segment_ids is None:
         return []
     seg_q, seg_kv = segment_ids
-    return [seg_q.astype(jnp.int32), seg_kv.astype(jnp.int32)]
+    # seg_q [b, s] -> [b, s, 1] (sublane-major), seg_kv -> [b, 1, s]
+    # (lane-major): see the seg BlockSpec note in _specs
+    return [seg_q.astype(jnp.int32)[:, :, None],
+            seg_kv.astype(jnp.int32)[:, None, :]]
 
 
 def _chunked(causal, bq, sq, sk):
@@ -728,8 +750,10 @@ def _bwd_split(causal, sm_scale, interpret, block_q, res, g):
     bq = _pick_bq(sq, sk, block_q)
     has_seg = segment_ids is not None
     ins, qspec, kvspec = _specs(b, h, bq, sq, sk, d, has_seg)
-    vecspec = pl.BlockSpec((1, 1, bq), lambda ib, ih, iq: (ib, ih, iq))
-    vecshape = jax.ShapeDtypeStruct((b, h, sq), jnp.float32)
+    # stats carry a trailing 1 (block last dim == array dim) so the
+    # (m, l, D) outputs satisfy Mosaic's last-two-dims rule on device
+    vecspec = pl.BlockSpec((1, 1, bq, 1), lambda ib, ih, iq: (ib, ih, iq, 0))
+    vecshape = jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32)
 
     dq_kern, dq_scratch = _bwd_dq_kernel, []
     if _chunked(causal, bq, sq, sk):
@@ -751,11 +775,16 @@ def _bwd_split(causal, sm_scale, interpret, block_q, res, g):
     bk = bq  # k-blocks reuse the VMEM-validated row block size
     fullq = pl.BlockSpec((1, 1, sq, d), lambda ib, ih, ik: (ib, ih, 0, 0))
     kvblk = pl.BlockSpec((1, 1, bk, d), lambda ib, ih, ik: (ib, ih, ik, 0))
-    fullvec = pl.BlockSpec((1, 1, sq), lambda ib, ih, ik: (ib, ih, 0))
+    fullvec = pl.BlockSpec((1, 1, sq, 1), lambda ib, ih, ik: (ib, ih, 0, 0))
     dkv_ins = [fullq, kvblk, kvblk]
     if has_seg:
-        dkv_ins.append(pl.BlockSpec((1, sq), lambda ib, ih, ik: (ib, 0)))
-        dkv_ins.append(pl.BlockSpec((1, bk), lambda ib, ih, ik: (ib, ik)))
+        # seg_q full-length sublane-major (q is chunked in-kernel);
+        # seg_kv's (1, 1, bk) lane-dim block relies on _split_ok's
+        # bq % 128 gate (bk = bq) for alignment
+        dkv_ins.append(
+            pl.BlockSpec((1, sq, 1), lambda ib, ih, ik: (ib, 0, 0)))
+        dkv_ins.append(
+            pl.BlockSpec((1, 1, bk), lambda ib, ih, ik: (ib, 0, ik)))
     dkv_ins += [fullq, fullvec, fullvec, fullvec]
 
     dk, dv = pl.pallas_call(
@@ -778,8 +807,11 @@ def _split_ok(sq, sk, d, bq, itemsize):
     [sq, d] q and dO resident per grid step (the monolithic backward
     streams q instead), holds 3 [bq, bq] fp32 chunk arrays + 2 [bq, d]
     accumulators + 3 [sq] stat vectors, and unrolls sq/bq chunks."""
-    # bq % 128: the stat vectors are emitted as [1, 1, bq] minor-dim
-    # blocks, which Mosaic requires lane-aligned
+    # bq % 128: the k-major pass tiles k/v (and seg_kv) into (.., bk)
+    # LANE-dim blocks with bk = bq, and every in-kernel
+    # [:, c*bq:(c+1)*bq] chunk slice cuts the lane axis — both need
+    # 128-alignment under Mosaic. (The stat vectors themselves are
+    # [.., bq, 1] sublane-major and only need bq % 8.)
     if sk % bq or bq % 128 or sq // bq > 32:
         return False
     resident = (2 * sq * d * itemsize      # q, dO
