@@ -9,8 +9,8 @@ over the FULL key row, and the output matmul in one kernel — no online
 max/sum rescaling passes, no [s, s] tensor in HBM.
 
 Backward comes in two structures behind the measured ``BWD_IMPL`` knob
-(monolithic is the default until the queued TPU A/B decides — see the
-knob's comment):
+(monolithic is the device-measured training-protocol winner and the
+default — see the knob's comment and PERF.md §10):
 
 * ``"split"``: a q-major dq pass that recomputes S and P from
   (q, k, v), forms dP = dO V^T, uses D = rowsum(dO * O) = rowsum(P * dP)
@@ -615,11 +615,10 @@ def _pick_bq(sq, sk, block_q, n_arrays=_BWD_ARRAYS):
 # Backward structure: "monolithic" = one q-major kernel accumulating
 # dk/dv across the sequential grid; "split" = a q-major dq pass (emitting
 # the (m, l, D) row stats) + a k-major dk/dv pass where each k-block is
-# computed exactly once. Measured knob (PERF.md §3/§7): the winner on the
-# fwd+d(q,k,v) protocol becomes the default — monolithic holds the seat
-# until the split A/B lands (split is interpret-parity-proven but its
-# TPU timing is queued on the relay; profile_attention.py carries the
-# decision rows).
+# computed exactly once. Measured knob — the device A/B landed (PERF.md
+# §10): monolithic wins the fwd+d(q,k,v) training protocol (1.509 vs
+# 2.071 ms at the GPT-2 shape) and keeps the default; split wins the
+# dq-only protocol 1.5x and remains the choice for no-kv-grad paths.
 BWD_IMPL = "monolithic"
 
 

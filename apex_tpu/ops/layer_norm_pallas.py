@@ -4,8 +4,10 @@ The TPU counterpart of the reference's hand-written LN kernels
 (csrc/layer_norm_cuda_kernel.cu:68-260 warp-shuffle Welford;
 contrib/csrc/layer_norm/ln_fwd/bwd_kernels.cuh "FastLayerNorm"). One VMEM
 pass per row block: fp32 statistics, normalize, affine — fwd saves only
-the [rows] (mean, rstd) vectors; bwd recomputes x̂ from x and produces dx
-plus per-block (dw, db) partial sums reduced outside the kernel.
+the [rows, 1] (mean, rstd) stat columns (2-D so the blocks satisfy
+Mosaic's last-two-dims rule); bwd recomputes x̂ from x and produces dx
+plus per-block [nblocks, 1, hidden] (dw, db) partial sums reduced
+outside the kernel.
 
 LayerNorm is HBM-bandwidth-bound, so the jnp path (XLA-fused) is already
 near the roofline for most shapes (measured — PERF.md §4);
@@ -59,25 +61,31 @@ def _fwd_kernel(x_ref, w_ref, b_ref, y_ref, mean_ref, rstd_ref, *, eps,
     if has_b:
         y = y + b_ref[...].astype(jnp.float32)[None, :]
     y_ref[...] = y.astype(y_ref.dtype)
-    mean_ref[...] = mean
-    rstd_ref[...] = rstd
+    # stats are [br, 1] 2-D: a rank-1 (br,) block is lane-dim under
+    # Mosaic's last-two-dims rule and only legal when br % 128 == 0 or
+    # br == rows; sublane-major [rows, 1] is legal for every br >= 8
+    mean_ref[...] = mean[:, None]
+    rstd_ref[...] = rstd[:, None]
 
 
 def _bwd_kernel(x_ref, w_ref, mean_ref, rstd_ref, dy_ref, dx_ref, dw_ref,
                 db_ref, *, has_w):
     x = x_ref[...].astype(jnp.float32)
     dy = dy_ref[...].astype(jnp.float32)
-    mean = mean_ref[...]
+    mean = mean_ref[...]          # [br, 1] — see _fwd_kernel's note
     rstd = rstd_ref[...]
-    xhat = (x - mean[:, None]) * rstd[:, None]
+    xhat = (x - mean) * rstd
     wg = dy * w_ref[...].astype(jnp.float32)[None, :] if has_w else dy
     m1 = jnp.mean(wg, axis=1)
     m2 = jnp.mean(wg * xhat, axis=1)
-    dx = (wg - m1[:, None] - xhat * m2[:, None]) * rstd[:, None]
+    dx = (wg - m1[:, None] - xhat * m2[:, None]) * rstd
     dx_ref[...] = dx.astype(dx_ref.dtype)
-    # per-block affine-grad partials, reduced over blocks by the caller
-    dw_ref[...] = jnp.sum(dy * xhat, axis=0)[None, :]
-    db_ref[...] = jnp.sum(dy, axis=0)[None, :]
+    # per-block affine-grad partials, reduced over blocks by the caller.
+    # [nblocks, 1, hidden] with (1, 1, hidden) blocks: a 2-D (1, hidden)
+    # block over [nblocks, hidden] puts a bare 1 against the block axis
+    # and fails Mosaic's last-two-dims rule on device
+    dw_ref[...] = jnp.sum(dy * xhat, axis=0)[None, None, :]
+    db_ref[...] = jnp.sum(dy, axis=0)[None, None, :]
 
 
 def supported(rows, hidden):
@@ -121,13 +129,13 @@ def _fwd(x2d, weight, bias, eps, interpret):
         ],
         out_specs=[
             pl.BlockSpec((br, hidden), lambda i: (i, 0)),
-            pl.BlockSpec((br,), lambda i: (i,)),
-            pl.BlockSpec((br,), lambda i: (i,)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((rows, hidden), x2d.dtype),
-            jax.ShapeDtypeStruct((rows,), jnp.float32),
-            jax.ShapeDtypeStruct((rows,), jnp.float32),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
         ],
         interpret=interpret,
     )(x2d, w_in, b_in)
@@ -150,24 +158,24 @@ def _bwd_rule(eps, interpret, res, dy):
         in_specs=[
             pl.BlockSpec((br, hidden), lambda i: (i, 0)),
             pl.BlockSpec((hidden,), lambda i: (0,)),
-            pl.BlockSpec((br,), lambda i: (i,)),
-            pl.BlockSpec((br,), lambda i: (i,)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
             pl.BlockSpec((br, hidden), lambda i: (i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((br, hidden), lambda i: (i, 0)),
-            pl.BlockSpec((1, hidden), lambda i: (i, 0)),
-            pl.BlockSpec((1, hidden), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1, hidden), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, hidden), lambda i: (i, 0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((rows, hidden), x2d.dtype),
-            jax.ShapeDtypeStruct((rows // br, hidden), jnp.float32),
-            jax.ShapeDtypeStruct((rows // br, hidden), jnp.float32),
+            jax.ShapeDtypeStruct((rows // br, 1, hidden), jnp.float32),
+            jax.ShapeDtypeStruct((rows // br, 1, hidden), jnp.float32),
         ],
         interpret=interpret,
     )(x2d, w_in, mean, rstd, dy)
-    dw = jnp.sum(dw_part, axis=0) if has_w else None
-    db = jnp.sum(db_part, axis=0) if has_b else None
+    dw = jnp.sum(dw_part, axis=(0, 1)) if has_w else None
+    db = jnp.sum(db_part, axis=(0, 1)) if has_b else None
     return dx, dw, db
 
 
