@@ -82,7 +82,12 @@ for d in "$OUT"/pass*; do
     [ "$n" -gt "$PASS" ] && PASS=$n
 done
 [ "$PASS" -gt 0 ] && echo "resuming after existing pass$PASS in $OUT"
-if [ "$PASS" -gt 0 ] && bench_healthy "$OUT/pass$PASS/bench.log"; then
+# a healthy headline can come from the opening bench_first rung OR the
+# end-of-queue full-ladder bench (run_all_tpu.sh) — gate on either
+pass_has_headline() {  # pass_has_headline <pass_dir>
+    bench_healthy "$1/bench_first.log" || bench_healthy "$1/bench.log"
+}
+if [ "$PASS" -gt 0 ] && pass_has_headline "$OUT/pass$PASS"; then
     echo "pass$PASS already holds a device-speed bench; nothing to do"
     exit 0
 fi
@@ -104,7 +109,7 @@ while true; do
         # collection. Keep looping until the headline bench ran at
         # device speed (bench.py stamps relay-degraded runs with a
         # 'note' and outright failures with an 'error').
-        if bench_healthy "$PASS_OUT/bench.log"; then
+        if pass_has_headline "$PASS_OUT"; then
             echo "[$(date +%H:%M:%S)] bench is device-speed; done"
             exit 0
         fi

@@ -19,12 +19,17 @@ run() {  # run <name> <timeout_s> <cmd...>
     [ $rc -ne 0 ] && echo "    rc=$rc (see $OUT/$name.log)"
 }
 
-# Small-HBM harnesses first: the relay's observed degraded mode
+# bench.py FIRST (round-5 lesson, PERF.md §10b): the scored headline
+# must get the window's opening minutes — the round-5 window lasted 50
+# minutes and small-HBM-first spent 40 of them on microbenches before
+# the headline's chance. One attempt here (the full 3-attempt retry
+# envelope would eat a short window; the retry pass at the END of the
+# queue still carries the full ladder).
+run bench_first      1900 env APEX_BENCH_ATTEMPTS=1 python bench.py
+# Then the small-HBM harnesses: the relay's observed degraded mode
 # (PERF.md §6) selectively starves large-HBM programs while small ones
-# run at device speed, so a partially-healthy window should be spent on
-# the microbenches before the big training-step programs. bench.py last:
-# it retries through flaps (up to 3 watchdogged attempts of
-# APEX_BENCH_TIMEOUT=1800s each + waits) — budget the full envelope.
+# run at device speed, so a partially-healthy window is still best spent
+# on the microbenches before the big training-step programs.
 run attention         900 python benchmarks/profile_attention.py
 run layernorm         900 python benchmarks/profile_layernorm.py
 run softmax           900 python benchmarks/profile_softmax.py
@@ -49,6 +54,8 @@ run pretrain         1800 python benchmarks/profile_pretrain.py
 # L1-analog convergence curves (GPT + RN50, O0 vs O2 + impl-parity leg):
 # 6 short training runs; the traces land in benchmarks/curves/
 run convergence      2400 python benchmarks/profile_convergence.py
+# full-ladder bench retry: if bench_first already landed healthy this is
+# one cached-compile re-measurement plus the b=16 upside attempt
 run bench            5900 python bench.py
 # b=32 amortization probe LAST: its compile stalled the tunneled
 # remote-compile helper once (PERF.md) and a wedged client can poison

@@ -38,10 +38,10 @@ def test_bench_smoke_json_contract():
     assert "error" not in rec, rec
 
 
-def _fake_rec(value, fused):
+def _fake_rec(value, b16):
     return {"metric": "gpt2s_train_tokens_per_sec (tpu)", "value": value,
             "unit": "tokens/s", "vs_baseline": 1.0, "mfu": 0.4,
-            "config": {"fused_lm_head": fused}}
+            "config": {"batch": 16 if b16 else 8, "fused_lm_head": False}}
 
 
 def test_ladder_attempt_one_is_default_config(monkeypatch):
@@ -53,7 +53,7 @@ def test_ladder_attempt_one_is_default_config(monkeypatch):
     import bench
 
     for k in ("APEX_FUSED_LM_HEAD", "APEX_ATTN_IMPL", "APEX_LN_PALLAS",
-              "APEX_BENCH_SMOKE"):
+              "APEX_BENCH_BATCH", "APEX_BENCH_SMOKE"):
         monkeypatch.delenv(k, raising=False)
     for attempts in (1, 2, 3, 5):
         ladder = bench._config_ladder(attempts, smoke=False)
@@ -82,7 +82,7 @@ def test_watchdog_single_healthy_attempt_is_clean_headline(monkeypatch,
     monkeypatch.setenv("APEX_BENCH_ATTEMPTS", "1")
     monkeypatch.delenv("APEX_BENCH_SMOKE", raising=False)
     for k in ("APEX_FUSED_LM_HEAD", "APEX_ATTN_IMPL", "APEX_LN_PALLAS",
-              "APEX_REMAT"):
+              "APEX_REMAT", "APEX_BENCH_BATCH"):
         monkeypatch.delenv(k, raising=False)
     rc = bench._watchdog()
     out = [l for l in capsys.readouterr().out.splitlines()
@@ -93,22 +93,22 @@ def test_watchdog_single_healthy_attempt_is_clean_headline(monkeypatch,
     rec = json.loads(out[0])
     assert rec["value"] == 100.0
     assert "note" not in rec and "error" not in rec
-    assert rec["config"]["fused_lm_head"] is False
+    assert rec["config"]["batch"] == 8
 
 
 def test_watchdog_config_ladder(monkeypatch, capsys):
-    """The retry ladder A/Bs the fused-LM-head config: both configs get a
-    healthy attempt, the higher-throughput line wins, exactly one JSON
-    line is printed."""
+    """The retry ladder A/Bs the b=16 amortization config: both configs
+    get a healthy attempt, the higher-throughput line wins, exactly one
+    JSON line is printed."""
     sys.path.insert(0, REPO)
     import bench
 
     calls = []
 
     def fake_attempt(state, extra_env=None):
-        fused = bool((extra_env or {}).get("APEX_FUSED_LM_HEAD"))
-        calls.append(fused)
-        rec = _fake_rec(120.0 if fused else 100.0, fused)
+        b16 = (extra_env or {}).get("APEX_BENCH_BATCH") == "16"
+        calls.append(b16)
+        rec = _fake_rec(120.0 if b16 else 100.0, b16)
         return json.dumps(rec), rec, 0
 
     monkeypatch.setattr(bench, "_attempt_once", fake_attempt)
@@ -116,7 +116,7 @@ def test_watchdog_config_ladder(monkeypatch, capsys):
     monkeypatch.setenv("APEX_BENCH_ATTEMPTS", "3")
     monkeypatch.delenv("APEX_BENCH_SMOKE", raising=False)
     for k in ("APEX_FUSED_LM_HEAD", "APEX_ATTN_IMPL", "APEX_LN_PALLAS",
-              "APEX_REMAT"):
+              "APEX_REMAT", "APEX_BENCH_BATCH"):
         monkeypatch.delenv(k, raising=False)
     rc = bench._watchdog()
     out = [l for l in capsys.readouterr().out.splitlines()
@@ -125,12 +125,12 @@ def test_watchdog_config_ladder(monkeypatch, capsys):
     assert calls == [False, True]  # both configs, then early stop
     assert len(out) == 1
     rec = json.loads(out[0])
-    assert rec["value"] == 120.0 and rec["config"]["fused_lm_head"]
+    assert rec["value"] == 120.0 and rec["config"]["batch"] == 16
 
 
 def test_watchdog_ladder_retries_unhealthy_config(monkeypatch, capsys):
     """A degraded base attempt gets retried on the flap-retry slot after
-    the fused attempt lands healthy; an explicit knob pin disables the
+    the b=16 attempt lands healthy; an explicit knob pin disables the
     ladder entirely."""
     sys.path.insert(0, REPO)
     import bench
@@ -138,13 +138,13 @@ def test_watchdog_ladder_retries_unhealthy_config(monkeypatch, capsys):
     calls = []
 
     def fake_attempt(state, extra_env=None):
-        fused = bool((extra_env or {}).get("APEX_FUSED_LM_HEAD"))
-        calls.append(fused)
+        b16 = (extra_env or {}).get("APEX_BENCH_BATCH") == "16"
+        calls.append(b16)
         if len(calls) == 1:
-            rec = dict(_fake_rec(5.0, fused), note="relay degraded",
+            rec = dict(_fake_rec(5.0, b16), note="relay degraded",
                        degraded_kind="relay")
         else:
-            rec = _fake_rec(120.0 if fused else 100.0, fused)
+            rec = _fake_rec(120.0 if b16 else 100.0, b16)
         return json.dumps(rec), rec, 0
 
     monkeypatch.setattr(bench, "_attempt_once", fake_attempt)
@@ -152,13 +152,13 @@ def test_watchdog_ladder_retries_unhealthy_config(monkeypatch, capsys):
     monkeypatch.setenv("APEX_BENCH_ATTEMPTS", "3")
     monkeypatch.delenv("APEX_BENCH_SMOKE", raising=False)
     for k in ("APEX_FUSED_LM_HEAD", "APEX_ATTN_IMPL", "APEX_LN_PALLAS",
-              "APEX_REMAT"):
+              "APEX_REMAT", "APEX_BENCH_BATCH"):
         monkeypatch.delenv(k, raising=False)
     rc = bench._watchdog()
     out = [l for l in capsys.readouterr().out.splitlines()
            if l.startswith("{")]
     assert rc == 0
-    assert calls == [False, True, False]  # degraded base retried last
+    assert calls == [False, True, False]  # degraded b=8 base retried last
     assert json.loads(out[0])["value"] == 120.0
 
     # explicit pin: the ladder collapses to the caller's env verbatim
@@ -169,7 +169,10 @@ def test_watchdog_ladder_retries_unhealthy_config(monkeypatch, capsys):
         merged = dict(os.environ, **(extra_env or {}))
         fused = merged.get("APEX_FUSED_LM_HEAD") == "1"
         calls.append(fused)
-        rec = _fake_rec(120.0, fused)
+        # the pin is a fused-head pin, not a batch pin: the fabricated
+        # record keeps the default batch
+        rec = dict(_fake_rec(120.0, False))
+        rec["config"]["fused_lm_head"] = fused
         return json.dumps(rec), rec, 0
 
     monkeypatch.setattr(bench, "_attempt_once", fake_pinned)
@@ -179,9 +182,9 @@ def test_watchdog_ladder_retries_unhealthy_config(monkeypatch, capsys):
     assert calls == [True]  # pinned config, healthy first attempt, done
 
 
-def test_watchdog_ladder_retries_degraded_fused_config(monkeypatch, capsys):
+def test_watchdog_ladder_retries_degraded_b16_config(monkeypatch, capsys):
     """The spare attempt goes to whichever config lacks a healthy line —
-    including one whose original slot already ran (fused degraded on
+    including one whose original slot already ran (b=16 degraded on
     attempt 2 gets attempt 3)."""
     sys.path.insert(0, REPO)
     import bench
@@ -189,13 +192,13 @@ def test_watchdog_ladder_retries_degraded_fused_config(monkeypatch, capsys):
     calls = []
 
     def fake_attempt(state, extra_env=None):
-        fused = bool((extra_env or {}).get("APEX_FUSED_LM_HEAD"))
-        calls.append(fused)
-        if len(calls) == 2:  # the fused slot flaps
-            rec = dict(_fake_rec(5.0, fused), note="relay degraded",
+        b16 = (extra_env or {}).get("APEX_BENCH_BATCH") == "16"
+        calls.append(b16)
+        if len(calls) == 2:  # the b=16 slot flaps
+            rec = dict(_fake_rec(5.0, b16), note="relay degraded",
                        degraded_kind="relay")
         else:
-            rec = _fake_rec(130.0 if fused else 100.0, fused)
+            rec = _fake_rec(130.0 if b16 else 100.0, b16)
         return json.dumps(rec), rec, 0
 
     monkeypatch.setattr(bench, "_attempt_once", fake_attempt)
@@ -203,13 +206,13 @@ def test_watchdog_ladder_retries_degraded_fused_config(monkeypatch, capsys):
     monkeypatch.setenv("APEX_BENCH_ATTEMPTS", "3")
     monkeypatch.delenv("APEX_BENCH_SMOKE", raising=False)
     for k in ("APEX_FUSED_LM_HEAD", "APEX_ATTN_IMPL", "APEX_LN_PALLAS",
-              "APEX_REMAT"):
+              "APEX_REMAT", "APEX_BENCH_BATCH"):
         monkeypatch.delenv(k, raising=False)
     rc = bench._watchdog()
     out = [l for l in capsys.readouterr().out.splitlines()
            if l.startswith("{")]
     assert rc == 0
-    assert calls == [False, True, True]  # fused retried on the spare slot
+    assert calls == [False, True, True]  # b=16 retried on the spare slot
     assert json.loads(out[0])["value"] == 130.0
 
 
@@ -222,7 +225,7 @@ def test_watchdog_cpu_only_box_runs_once(monkeypatch, capsys):
     calls = []
 
     def fake_attempt(state, extra_env=None):
-        calls.append(bool((extra_env or {}).get("APEX_FUSED_LM_HEAD")))
+        calls.append((extra_env or {}).get("APEX_BENCH_BATCH") == "16")
         rec = dict(_fake_rec(90.0, False),
                    metric="gpt2s_train_tokens_per_sec (cpu)")
         return json.dumps(rec), rec, 0
@@ -232,7 +235,7 @@ def test_watchdog_cpu_only_box_runs_once(monkeypatch, capsys):
     monkeypatch.setenv("APEX_BENCH_ATTEMPTS", "3")
     monkeypatch.delenv("APEX_BENCH_SMOKE", raising=False)
     for k in ("APEX_FUSED_LM_HEAD", "APEX_ATTN_IMPL", "APEX_LN_PALLAS",
-              "APEX_REMAT"):
+              "APEX_REMAT", "APEX_BENCH_BATCH"):
         monkeypatch.delenv(k, raising=False)
     rc = bench._watchdog()
     out = [l for l in capsys.readouterr().out.splitlines()
