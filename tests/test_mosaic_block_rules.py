@@ -1,0 +1,200 @@
+"""CPU-side enforcement of Mosaic's block-shape lowering rules.
+
+Round 5's first device window found two kernels whose interpret-mode
+parity was perfect but whose backward failed to LOWER on real TPU
+(attention split-bwd stats, layer-norm affine-grad partials): Mosaic
+requires each block's last two dims to be (8, 128)-divisible or span
+the full array dim, and interpret mode never checks it. This test
+mirrors the exact rule from jax's Mosaic lowering
+(jax/_src/pallas/mosaic/lowering.py `_check_block_mappings`, incl. the
+rank-1 packing variant) and applies it to every ``pallas_call`` found in
+the jaxpr of every kernel entry point — so the whole defect class is
+caught at test time without a device.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax._src import core as jax_core
+from jax._src.pallas import core as pallas_core
+
+
+def _iter_jaxprs(jaxpr):
+    """Yield *jaxpr* and every jaxpr reachable through eqn params."""
+    yield jaxpr
+    for eqn in jaxpr.eqns:
+        for v in eqn.params.values():
+            vs = v if isinstance(v, (tuple, list)) else (v,)
+            for x in vs:
+                if isinstance(x, jax_core.ClosedJaxpr):
+                    yield from _iter_jaxprs(x.jaxpr)
+                elif isinstance(x, jax_core.Jaxpr):
+                    yield from _iter_jaxprs(x)
+
+
+def _mosaic_block_rule_violations(fn, *args):
+    """All (kernel, block_shape, array_shape) triples in *fn*'s jaxpr
+    that would fail Mosaic's `_check_block_mappings` on device."""
+    jaxpr = jax.make_jaxpr(fn)(*args).jaxpr
+    bad = []
+    for j in _iter_jaxprs(jaxpr):
+        for eqn in j.eqns:
+            if eqn.primitive.name != "pallas_call":
+                continue
+            gm = eqn.params["grid_mapping"]
+            name = eqn.params.get("debug_info")
+            for bm in gm.block_mappings:
+                bs = pallas_core._get_block_shape(bm.block_shape)
+                ashape = bm.array_aval.shape
+                rank = len(bs)
+                if rank == 0:
+                    continue  # scalar-prefetch etc.
+                bs0, as0 = bs[-1], ashape[-1]
+                if rank >= 2:
+                    bs1, as1 = bs[-2], ashape[-2]
+                    ok = ((bs0 == as0 or bs0 % 128 == 0)
+                          and (bs1 == as1 or bs1 % 8 == 0))
+                else:
+                    bits = jnp.dtype(bm.array_aval.dtype).itemsize * 8
+                    tiling = 128 * (32 // bits)
+                    ok = bs0 == as0 or bs0 % tiling == 0
+                if not ok:
+                    bad.append((str(name), bs, ashape))
+    return bad
+
+
+def _assert_clean(fn, *args):
+    bad = _mosaic_block_rule_violations(fn, *args)
+    assert not bad, f"Mosaic block-rule violations: {bad}"
+
+
+# ---------------------------------------------------------------------------
+# attention rows kernel: every structure the dispatch can reach
+# ---------------------------------------------------------------------------
+
+def _attn_args(b=2, h=3, sq=256, sk=256, d=64, dtype=jnp.bfloat16):
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(k1, (b, h, sq, d), dtype)
+    k = jax.random.normal(k2, (b, h, sk, d), dtype)
+    v = jax.random.normal(k3, (b, h, sk, d), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("bwd_impl", ["monolithic", "split"])
+@pytest.mark.parametrize("seg", [False, True])
+def test_attention_rows_grad_specs(bwd_impl, seg):
+    from apex_tpu.ops.attention_pallas import fused_attention_rows
+
+    q, k, v = _attn_args()
+    segs = None
+    if seg:
+        s = jnp.zeros((q.shape[0], q.shape[2]), jnp.int32)
+        segs = (s, s)
+
+    def loss(q, k, v):
+        o = fused_attention_rows(q, k, v, True, 0.125, segs, False, None,
+                                 bwd_impl)
+        return o.astype(jnp.float32).sum()
+
+    _assert_clean(jax.grad(loss, argnums=(0, 1, 2)), q, k, v)
+
+
+@pytest.mark.parametrize("block_q", [8, 64, 128])
+def test_attention_rows_small_blocks_with_segs(block_q):
+    """The seg BlockSpec regression: sub-128 q blocks must stay legal
+    (the old (1, bq) 2-D layout was not)."""
+    from apex_tpu.ops.attention_pallas import fused_attention_rows
+
+    q, k, v = _attn_args()
+    s = jnp.zeros((q.shape[0], q.shape[2]), jnp.int32)
+
+    def loss(q, k, v):
+        o = fused_attention_rows(q, k, v, True, 0.125, (s, s), False,
+                                 block_q, "monolithic")
+        return o.astype(jnp.float32).sum()
+
+    _assert_clean(jax.grad(loss, argnums=(0, 1, 2)), q, k, v)
+
+
+def test_attention_rows_dropout_specs():
+    from apex_tpu.ops.attention_pallas import fused_attention_rows
+
+    q, k, v = _attn_args()
+    seed = jnp.ones((1, 1), jnp.int32)
+
+    def loss(q, k, v):
+        o = fused_attention_rows(q, k, v, False, 0.125, None, False, None,
+                                 None, 0.1, seed)
+        return o.astype(jnp.float32).sum()
+
+    _assert_clean(jax.grad(loss, argnums=(0, 1, 2)), q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# layer norm: the shapes the round-5 window caught plus odd blockings
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("rows,hidden", [(16, 768), (2048, 768), (256, 1024)])
+def test_layer_norm_specs(rows, hidden):
+    from apex_tpu.ops.layer_norm_pallas import layer_norm
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (rows, hidden),
+                          jnp.bfloat16)
+    w = jnp.ones((hidden,), jnp.float32)
+    b = jnp.zeros((hidden,), jnp.float32)
+
+    def loss(x, w, b):
+        return layer_norm(x, w, b).astype(jnp.float32).sum()
+
+    _assert_clean(jax.grad(loss, argnums=(0, 1, 2)), x, w, b)
+
+
+# ---------------------------------------------------------------------------
+# fused softmax + fused linear-CE (device-proven; pinned against drift)
+# ---------------------------------------------------------------------------
+
+def test_softmax_specs():
+    from apex_tpu.ops.softmax_pallas import scaled_masked_softmax
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 256, 256),
+                          jnp.bfloat16)
+
+    def loss(x):
+        return scaled_masked_softmax(
+            x, None, scale=1.0, causal=True).astype(jnp.float32).sum()
+
+    _assert_clean(jax.grad(loss), x)
+
+
+def test_xent_specs():
+    from apex_tpu.ops.xent_pallas import linear_cross_entropy
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (512, 256), jnp.bfloat16)
+    e = jax.random.normal(jax.random.PRNGKey(1), (1024, 256), jnp.bfloat16)
+    labels = jnp.zeros((512,), jnp.int32)
+
+    def loss(x, e):
+        return linear_cross_entropy(x, e, labels).mean()
+
+    _assert_clean(jax.grad(loss, argnums=(0, 1)), x, e)
+
+
+def test_xent_sharded_specs():
+    from apex_tpu.ops.xent_pallas import linear_cross_entropy_sharded
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    import numpy as np
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("tp",))
+    x = jax.random.normal(jax.random.PRNGKey(0), (512, 256), jnp.bfloat16)
+    e = jax.random.normal(jax.random.PRNGKey(1), (1024, 256), jnp.bfloat16)
+    labels = jnp.zeros((512,), jnp.int32)
+
+    def loss(x, e):
+        f = jax.shard_map(
+            lambda x, e: linear_cross_entropy_sharded(x, e, labels, "tp"),
+            mesh=mesh, in_specs=(P(), P("tp")), out_specs=P(),
+            check_vma=False)
+        return f(x, e).mean()
+
+    _assert_clean(jax.grad(loss, argnums=(0, 1)), x, e)
