@@ -32,15 +32,21 @@ def _iter_jaxprs(jaxpr):
                     yield from _iter_jaxprs(x)
 
 
-def _mosaic_block_rule_violations(fn, *args):
-    """All (kernel, block_shape, array_shape) triples in *fn*'s jaxpr
-    that would fail Mosaic's `_check_block_mappings` on device."""
+def _pallas_call_stats(fn, *args):
+    """(violations, pallas_call_count) over *fn*'s jaxpr: every
+    (kernel, block_shape, array_shape) triple that would fail Mosaic's
+    `_check_block_mappings` on device, plus how many pallas_calls were
+    seen at all (so composition tests can assert non-vacuity — a
+    dispatch gate silently dropping kernels must fail loudly, not pass
+    an empty check)."""
     jaxpr = jax.make_jaxpr(fn)(*args).jaxpr
     bad = []
+    count = 0
     for j in _iter_jaxprs(jaxpr):
         for eqn in j.eqns:
             if eqn.primitive.name != "pallas_call":
                 continue
+            count += 1
             gm = eqn.params["grid_mapping"]
             name = eqn.params.get("debug_info")
             for bm in gm.block_mappings:
@@ -60,12 +66,19 @@ def _mosaic_block_rule_violations(fn, *args):
                     ok = bs0 == as0 or bs0 % tiling == 0
                 if not ok:
                     bad.append((str(name), bs, ashape))
-    return bad
+    return bad, count
 
 
-def _assert_clean(fn, *args):
-    bad = _mosaic_block_rule_violations(fn, *args)
+def _mosaic_block_rule_violations(fn, *args):
+    return _pallas_call_stats(fn, *args)[0]
+
+
+def _assert_clean(fn, *args, min_calls=1):
+    bad, count = _pallas_call_stats(fn, *args)
     assert not bad, f"Mosaic block-rule violations: {bad}"
+    assert count >= min_calls, (
+        f"vacuous check: only {count} pallas_calls traced "
+        f"(expected >= {min_calls}) — a dispatch gate dropped the kernel")
 
 
 # ---------------------------------------------------------------------------
@@ -198,3 +211,74 @@ def test_xent_sharded_specs():
         return f(x, e).mean()
 
     _assert_clean(jax.grad(loss, argnums=(0, 1)), x, e)
+
+
+# ---------------------------------------------------------------------------
+# model-level composition: the exact graphs the step-level A/B rungs
+# compile on device (whose round-5 compiles hit the relay wedge before
+# Mosaic could check them)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.parametrize("impl,fused,drop", [
+    ("rows", False, 0.0),      # APEX_ATTN_IMPL=rows step
+    ("flash", True, 0.0),      # APEX_FUSED_LM_HEAD=1 step
+    ("rows", False, 0.1),      # in-kernel-dropout training step
+])
+def test_gpt_step_graph_specs(impl, fused, drop, monkeypatch):
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from apex_tpu.ops import attention as attn_mod
+    from apex_tpu.ops.attention import set_default_impl
+    from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+    from apex_tpu.transformer.testing import GPTModel, TransformerConfig
+
+    # make_jaxpr only TRACES — Mosaic lowering never runs — so the
+    # platform gate can be lifted to expose the real TPU kernel graphs
+    # on the CPU box (without it the dispatch falls through to dense
+    # and the whole check is vacuous)
+    monkeypatch.setattr(attn_mod, "_tpu_available", lambda: True)
+    prev_impl = attn_mod._DEFAULT_IMPL
+    set_default_impl(impl)
+    try:
+        cfg = TransformerConfig(
+            hidden_size=768, num_layers=2, num_attention_heads=12,
+            vocab_size=50304, max_position_embeddings=1024,
+            hidden_dropout=0.0, attention_dropout=drop, bf16=True,
+            fused_lm_head=fused, fused_lm_head_interpret=fused)
+        model = GPTModel(cfg)
+        b, s = 8, 1024
+        rs = np.random.RandomState(0)
+        ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (b, s)), jnp.int32)
+        pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :],
+                               (b, s))
+        labels = jnp.asarray(rs.randint(0, cfg.vocab_size, (b, s)),
+                             jnp.int32)
+        mesh = Mesh(np.asarray(jax.devices()[:1]), (TENSOR_AXIS,))
+        params = jax.jit(jax.shard_map(
+            lambda ids, pos: model.init(
+                jax.random.PRNGKey(0), ids, pos, None)["params"],
+            mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+            check_vma=False))(ids, pos)
+
+        def loss_fn(p):
+            kw = (dict(deterministic=False,
+                       rngs={"dropout": jax.random.PRNGKey(7)})
+                  if drop else {})
+            per_tok = model.apply({"params": p}, ids, pos, None, labels,
+                                  **kw)
+            return jnp.mean(per_tok)
+
+        def step(p):
+            f = jax.shard_map(lambda p: jax.grad(loss_fn)(p), mesh=mesh,
+                              in_specs=(P(),), out_specs=P(),
+                              check_vma=False)
+            return f(p)
+
+        # 2 layers x fwd+bwd attention kernels = at least 4 pallas_calls
+        # in every parametrization (the fused-head row adds the CE
+        # kernels on top) — the non-vacuity floor
+        _assert_clean(step, params, min_calls=4)
+    finally:
+        set_default_impl(prev_impl)
