@@ -42,6 +42,14 @@ run xent             1200 python benchmarks/profile_xent.py
 # number and the delta quantifies the cap (VERDICT r4 missing #2)
 run xent_rb256        900 env APEX_XENT_ROW_BLOCK=256 python benchmarks/profile_xent.py
 run gpt              1200 python benchmarks/profile_gpt.py
+# NEVER-measured BASELINE harnesses (configs 1-4) outrank the step A/Bs
+# (whose defaults already carry kernel-level measurements, PERF.md §10b)
+# — a short window must land the missing evidence class first
+run resnet           1200 python benchmarks/profile_resnet.py
+run pretrain         1800 python benchmarks/profile_pretrain.py
+# L1-analog convergence curves (GPT + RN50, O0 vs O2 + impl-parity leg):
+# 6 short training runs; the traces land in benchmarks/curves/
+run convergence      2400 python benchmarks/profile_convergence.py
 # step-level A/B halves of the late-kernel decision procedures (PERF.md §7)
 run gpt_rows          900 env APEX_ATTN_IMPL=rows python benchmarks/profile_gpt.py
 run gpt_fused_head    900 env APEX_FUSED_LM_HEAD=1 python benchmarks/profile_gpt.py
@@ -49,11 +57,6 @@ run gpt_ln_pallas     900 env APEX_LN_PALLAS=1 python benchmarks/profile_gpt.py
 run gpt_remat_sel     900 env APEX_REMAT=selective python benchmarks/profile_gpt.py
 # long-sequence crossover behind the rows-vs-flash dispatch rule
 run attn_seq4096      900 env APEX_ATTN_SEQ=4096 python benchmarks/profile_attention.py
-run resnet           1200 python benchmarks/profile_resnet.py
-run pretrain         1800 python benchmarks/profile_pretrain.py
-# L1-analog convergence curves (GPT + RN50, O0 vs O2 + impl-parity leg):
-# 6 short training runs; the traces land in benchmarks/curves/
-run convergence      2400 python benchmarks/profile_convergence.py
 # full-ladder bench retry: if bench_first already landed healthy this is
 # one cached-compile re-measurement plus the b=16 upside attempt
 run bench            5900 python bench.py

@@ -219,6 +219,31 @@ def test_xent_sharded_specs():
 # Mosaic could check them)
 # ---------------------------------------------------------------------------
 
+def _assert_step_graph_clean(model, init_args, loss_fn, min_calls=4):
+    """Shared scaffolding for model-level composition checks: init the
+    model's params under a 1-device shard_map on TENSOR_AXIS, wrap
+    ``loss_fn(params)``'s grad in the same mapping, and assert the traced
+    step graph is block-rule clean and non-vacuous."""
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]), (TENSOR_AXIS,))
+    params = jax.jit(jax.shard_map(
+        lambda *a: model.init(jax.random.PRNGKey(0), *a)["params"],
+        mesh=mesh, in_specs=(P(),) * len(init_args), out_specs=P(),
+        check_vma=False))(*init_args)
+
+    def step(p):
+        f = jax.shard_map(lambda p: jax.grad(loss_fn)(p), mesh=mesh,
+                          in_specs=(P(),), out_specs=P(),
+                          check_vma=False)
+        return f(p)
+
+    _assert_clean(step, params, min_calls=min_calls)
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("impl,fused,drop", [
     ("rows", False, 0.0),      # APEX_ATTN_IMPL=rows step
@@ -227,11 +252,9 @@ def test_xent_sharded_specs():
 ])
 def test_gpt_step_graph_specs(impl, fused, drop, monkeypatch):
     import numpy as np
-    from jax.sharding import Mesh, PartitionSpec as P
 
     from apex_tpu.ops import attention as attn_mod
     from apex_tpu.ops.attention import set_default_impl
-    from apex_tpu.transformer.parallel_state import TENSOR_AXIS
     from apex_tpu.transformer.testing import GPTModel, TransformerConfig
 
     # make_jaxpr only TRACES — Mosaic lowering never runs — so the
@@ -255,12 +278,6 @@ def test_gpt_step_graph_specs(impl, fused, drop, monkeypatch):
                                (b, s))
         labels = jnp.asarray(rs.randint(0, cfg.vocab_size, (b, s)),
                              jnp.int32)
-        mesh = Mesh(np.asarray(jax.devices()[:1]), (TENSOR_AXIS,))
-        params = jax.jit(jax.shard_map(
-            lambda ids, pos: model.init(
-                jax.random.PRNGKey(0), ids, pos, None)["params"],
-            mesh=mesh, in_specs=(P(), P()), out_specs=P(),
-            check_vma=False))(ids, pos)
 
         def loss_fn(p):
             kw = (dict(deterministic=False,
@@ -270,15 +287,41 @@ def test_gpt_step_graph_specs(impl, fused, drop, monkeypatch):
                                   **kw)
             return jnp.mean(per_tok)
 
-        def step(p):
-            f = jax.shard_map(lambda p: jax.grad(loss_fn)(p), mesh=mesh,
-                              in_specs=(P(),), out_specs=P(),
-                              check_vma=False)
-            return f(p)
-
         # 2 layers x fwd+bwd attention kernels = at least 4 pallas_calls
         # in every parametrization (the fused-head row adds the CE
         # kernels on top) — the non-vacuity floor
-        _assert_clean(step, params, min_calls=4)
+        _assert_step_graph_clean(model, (ids, pos, None), loss_fn)
     finally:
         set_default_impl(prev_impl)
+
+
+@pytest.mark.slow
+def test_bert_padding_dropout_step_graph_specs(monkeypatch):
+    """BERT's padding-mask training-with-dropout step — the path that
+    feeds [b, s] validity to the rows kernel as segment ids (the exact
+    layout the round-5 seg-spec fix changed)."""
+    import numpy as np
+
+    from apex_tpu.ops import attention as attn_mod
+    from apex_tpu.transformer.testing import BertModel, TransformerConfig
+
+    monkeypatch.setattr(attn_mod, "_tpu_available", lambda: True)
+    b, s = 8, 1024
+    cfg = TransformerConfig(
+        hidden_size=768, num_layers=2, num_attention_heads=12,
+        vocab_size=50304, max_position_embeddings=s,
+        hidden_dropout=0.0, attention_dropout=0.1, bf16=True,
+        bert_binary_head=False, fused_attention_dropout=True)
+    model = BertModel(cfg)
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (b, s)), jnp.int32)
+    mask = jnp.ones((b, s), jnp.int32).at[:, s - 64:].set(0)  # tail pads
+    labels = jnp.asarray(rs.randint(0, cfg.vocab_size, (b, s)), jnp.int32)
+
+    def loss_fn(p):
+        per_tok, _ = model.apply(
+            {"params": p}, ids, mask, lm_labels=labels,
+            deterministic=False, rngs={"dropout": jax.random.PRNGKey(3)})
+        return jnp.mean(per_tok)
+
+    _assert_step_graph_clean(model, (ids, mask), loss_fn)
