@@ -35,7 +35,8 @@ SUITES = {
                 "test_contrib_spatial.py",
                 "test_contrib_sparsity_permutation.py"],
     "ops": ["test_ops_attention.py", "test_softmax_pallas.py",
-            "test_attention_pallas.py", "test_xent_pallas.py"],
+            "test_attention_pallas.py", "test_xent_pallas.py",
+            "test_mosaic_block_rules.py"],
     "api_parity": ["test_api_parity_round3.py"],
     "harness": ["test_run_tests.py", "test_bench_contract.py"],
     "checkpoint": ["test_checkpoint.py"],
