@@ -324,7 +324,7 @@ def _config_ladder(attempts, smoke):
     return [{}, {"APEX_BENCH_BATCH": "16"}] + [{}] * (attempts - 2)
 
 
-def _attempt_once(state, extra_env=None):
+def _attempt_once(state, extra_env=None, timeout_cap=None):
     """One watchdogged run of main() in a subprocess.
 
     Returns ``(line, record, returncode_or_None)`` — line and record are
@@ -333,15 +333,18 @@ def _attempt_once(state, extra_env=None):
     returns returncode None). A wedged
     TPU relay — observed round 3, even backend init hangs, PERF.md §6 —
     must produce an honest error line, not hang the caller forever, so
-    the child gets a hard timeout. The live Popen handle is parked in
-    ``state["child"]`` so the SIGTERM handler can take down exactly the
-    in-flight attempt (not the whole process group, which may be shared
-    with a supervising driver).
+    the child gets a hard timeout (capped via ``timeout_cap`` when the
+    init pre-flight already proved the relay init-wedged). The live
+    Popen handle is parked in ``state["child"]`` so the SIGTERM handler
+    can take down exactly the in-flight attempt (not the whole process
+    group, which may be shared with a supervising driver).
     """
     import subprocess
 
     env = dict(os.environ, APEX_BENCH_INNER="1", **(extra_env or {}))
     timeout = int(os.environ.get("APEX_BENCH_TIMEOUT", "1800"))
+    if timeout_cap is not None:
+        timeout = min(timeout, timeout_cap)
     label = ("cpu" if os.environ.get("APEX_BENCH_SMOKE") == "1"
              else "tpu")
 
@@ -461,6 +464,16 @@ def _watchdog():
     healthy_configs = set()
     next_wait = retry_wait
     last_outcome = "relay-bound"
+    # Lazy wedge cap: the first attempt always gets the full
+    # APEX_BENCH_TIMEOUT (a degraded-but-live run that needs it keeps
+    # it, and a healthy run costs nothing extra). Once an attempt TIMES
+    # OUT — this relay needed more than the full budget, the §6
+    # wedge/starvation signature — the remaining attempts run under a
+    # 600s cap: they can only succeed if the relay improved, and an
+    # improved (healthy) run finishes well under 600s, so the cap
+    # trades nothing except the hours a wedged relay would otherwise
+    # burn (observed: init-hung children ride their entire timeout).
+    timeout_cap = None
     for i in range(attempts):
         cfg_key = json.dumps(ladder[i], sort_keys=True)
         # a config whose measurement is already in hand needn't re-run;
@@ -488,7 +501,14 @@ def _watchdog():
                       file=sys.stderr, flush=True)
                 time.sleep(next_wait)
             next_wait = retry_wait
-        line, rec, rc = _attempt_once(state, ladder[i])
+        line, rec, rc = _attempt_once(state, ladder[i],
+                                      timeout_cap=timeout_cap)
+        if rc is None and rec is not None and "error" in rec:
+            # rc None + fabricated error record = the attempt rode its
+            # ENTIRE budget without producing a JSON line (wedge
+            # signature; a teardown-wedge after printing returns the
+            # real record instead) — cap the remaining attempts
+            timeout_cap = 600
         if rec is None:
             # only a crash lands here (the timeout path always
             # fabricates an error record): the child exited with no

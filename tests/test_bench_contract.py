@@ -72,7 +72,7 @@ def test_watchdog_single_healthy_attempt_is_clean_headline(monkeypatch,
 
     calls = []
 
-    def fake_attempt(state, extra_env=None):
+    def fake_attempt(state, extra_env=None, **kw):
         calls.append(dict(extra_env or {}))
         rec = _fake_rec(100.0, False)
         return json.dumps(rec), rec, 0
@@ -105,7 +105,7 @@ def test_watchdog_config_ladder(monkeypatch, capsys):
 
     calls = []
 
-    def fake_attempt(state, extra_env=None):
+    def fake_attempt(state, extra_env=None, **kw):
         b16 = (extra_env or {}).get("APEX_BENCH_BATCH") == "16"
         calls.append(b16)
         rec = _fake_rec(120.0 if b16 else 100.0, b16)
@@ -137,7 +137,7 @@ def test_watchdog_ladder_retries_unhealthy_config(monkeypatch, capsys):
 
     calls = []
 
-    def fake_attempt(state, extra_env=None):
+    def fake_attempt(state, extra_env=None, **kw):
         b16 = (extra_env or {}).get("APEX_BENCH_BATCH") == "16"
         calls.append(b16)
         if len(calls) == 1:
@@ -165,7 +165,7 @@ def test_watchdog_ladder_retries_unhealthy_config(monkeypatch, capsys):
     calls.clear()
     monkeypatch.setenv("APEX_FUSED_LM_HEAD", "1")
 
-    def fake_pinned(state, extra_env=None):
+    def fake_pinned(state, extra_env=None, **kw):
         merged = dict(os.environ, **(extra_env or {}))
         fused = merged.get("APEX_FUSED_LM_HEAD") == "1"
         calls.append(fused)
@@ -191,7 +191,7 @@ def test_watchdog_ladder_retries_degraded_b16_config(monkeypatch, capsys):
 
     calls = []
 
-    def fake_attempt(state, extra_env=None):
+    def fake_attempt(state, extra_env=None, **kw):
         b16 = (extra_env or {}).get("APEX_BENCH_BATCH") == "16"
         calls.append(b16)
         if len(calls) == 2:  # the b=16 slot flaps
@@ -224,7 +224,7 @@ def test_watchdog_cpu_only_box_runs_once(monkeypatch, capsys):
 
     calls = []
 
-    def fake_attempt(state, extra_env=None):
+    def fake_attempt(state, extra_env=None, **kw):
         calls.append((extra_env or {}).get("APEX_BENCH_BATCH") == "16")
         rec = dict(_fake_rec(90.0, False),
                    metric="gpt2s_train_tokens_per_sec (cpu)")
@@ -243,3 +243,48 @@ def test_watchdog_cpu_only_box_runs_once(monkeypatch, capsys):
     assert rc == 0
     assert calls == [False]
     assert json.loads(out[0])["value"] == 90.0
+
+
+def test_watchdog_lazy_cap_after_timeout(monkeypatch, capsys):
+    """A first attempt that rides its entire budget without a JSON line
+    (rc None + fabricated error record — the wedge signature) arms a
+    600s cap for the remaining attempts; completed attempts (healthy or
+    degraded, any length) never arm it."""
+    sys.path.insert(0, REPO)
+    import bench
+
+    caps = []
+
+    def fake_timeout_attempt(state, extra_env=None, timeout_cap=None):
+        caps.append(timeout_cap)
+        rec = {"metric": "gpt2s_train_tokens_per_sec (tpu)", "value": 0,
+               "unit": "tokens/s", "vs_baseline": 0, "mfu": None,
+               "error": "bench timed out after 1800s"}
+        return json.dumps(rec), rec, None   # rc None = timeout path
+
+    monkeypatch.setattr(bench, "_attempt_once", fake_timeout_attempt)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.setenv("APEX_BENCH_ATTEMPTS", "3")
+    monkeypatch.delenv("APEX_BENCH_SMOKE", raising=False)
+    for k in ("APEX_FUSED_LM_HEAD", "APEX_ATTN_IMPL", "APEX_LN_PALLAS",
+              "APEX_REMAT", "APEX_BENCH_BATCH"):
+        monkeypatch.delenv(k, raising=False)
+    rc = bench._watchdog()
+    capsys.readouterr()
+    assert rc == 1  # error line only: no real measurement
+    assert caps == [None, 600, 600]
+
+    # a COMPLETED degraded attempt (rc 0) must not arm the cap
+    caps.clear()
+
+    def fake_degraded_attempt(state, extra_env=None, timeout_cap=None):
+        caps.append(timeout_cap)
+        rec = dict(_fake_rec(5.0, False), note="relay degraded",
+                   degraded_kind="relay")
+        return json.dumps(rec), rec, 0
+
+    monkeypatch.setattr(bench, "_attempt_once", fake_degraded_attempt)
+    rc = bench._watchdog()
+    capsys.readouterr()
+    assert rc == 0
+    assert caps == [None, None, None]
