@@ -65,6 +65,7 @@ def __getattr__(name):
         "RNN",
         "ops",
         "checkpoint",
+        "telemetry",
     ):
         return importlib.import_module(f"apex_tpu.{name}")
     raise AttributeError(f"module 'apex_tpu' has no attribute {name!r}")

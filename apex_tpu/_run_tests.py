@@ -39,6 +39,7 @@ SUITES = {
             "test_mosaic_block_rules.py"],
     "api_parity": ["test_api_parity_round3.py"],
     "harness": ["test_run_tests.py", "test_bench_contract.py"],
+    "telemetry": ["test_telemetry.py", "test_bench_labels.py"],
     "checkpoint": ["test_checkpoint.py"],
     "data": ["test_data.py"],
     "examples": ["test_examples.py"],

@@ -121,6 +121,21 @@ class LossScaler:
         new_state = self.update(state, found_inf)
         return grads, new_state, found_inf
 
+    # -- telemetry provider (apex_tpu.telemetry.metrics) --
+    @staticmethod
+    def metrics(state):
+        """The scaler's in-step telemetry scalars, as traced values.
+
+        Pure and ungated — always returns the dict; the process-wide
+        telemetry switch lives in the caller's ``telemetry.collect`` /
+        ``telemetry.enabled()`` trace-time branch (the same explicit-
+        request-vs-preference asymmetry as the kernel knobs)."""
+        return {
+            "loss_scale": state.loss_scale,
+            "overflow": state.overflow,
+            "unskipped": state.unskipped,
+        }
+
     # -- persistence: apex/amp/frontend.py:361-400 --
     @staticmethod
     def state_dict(state):

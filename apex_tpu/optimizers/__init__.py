@@ -6,6 +6,7 @@ in two forms: the optax-style transform (``fused_adam(...)``) for jit/pjit
 training loops, and the torch-like class (``FusedAdam``) for API parity.
 """
 
+from apex_tpu.optimizers._base import grad_norm_stats
 from apex_tpu.optimizers.fused_adam import FusedAdam, fused_adam, FusedAdamState
 from apex_tpu.optimizers.fused_sgd import FusedSGD, fused_sgd, FusedSGDState
 from apex_tpu.optimizers.fused_lamb import FusedLAMB, fused_lamb, FusedLAMBState
@@ -26,4 +27,5 @@ __all__ = [
     "FusedNovoGrad", "fused_novograd", "FusedNovoGradState",
     "FusedAdagrad", "fused_adagrad", "FusedAdagradState",
     "FusedMixedPrecisionLamb", "fused_mixed_precision_lamb",
+    "grad_norm_stats",
 ]

@@ -9,12 +9,41 @@ functional transform directly.
 import jax
 
 
+def grad_norm_stats(grads):
+    """Telemetry provider: ``{"grad_norm", "grad_max"}`` over a grad
+    pytree (fp32 math, traced values — safe inside a jitted step).
+
+    Pure and ungated, like ``LossScaler.metrics``: the process-wide
+    telemetry switch is the caller's trace-time
+    ``apex_tpu.telemetry.enabled()`` branch, so a disabled step never
+    builds these reductions into its jaxpr. The norm is the
+    multi_tensor substrate's per-tensor reduction (NOT the flat
+    ``multi_tensor_l2norm`` — its concat is the layout PERF.md §2
+    measured against for in-step use)."""
+    import jax.numpy as jnp
+
+    from apex_tpu.multi_tensor_apply.multi_tensor_apply import (
+        multi_tensor_l2norm_per_tensor)
+
+    leaves = jax.tree_util.tree_leaves(grads)
+    gnorm, _ = multi_tensor_l2norm_per_tensor(leaves)
+    if not leaves:
+        return {"grad_norm": gnorm, "grad_max": jnp.zeros((), jnp.float32)}
+    gmax = jnp.stack([jnp.max(jnp.abs(g.astype(jnp.float32)))
+                      for g in leaves]).max()
+    return {"grad_norm": gnorm, "grad_max": gmax}
+
+
 class FusedOptimizerBase:
     def __init__(self, params, defaults):
         self.defaults = dict(defaults)
         self.param_groups = self._make_groups(params)
         self._states = [None] * len(self.param_groups)
         self._txs = [None] * len(self.param_groups)
+        # grad-norm telemetry from the last step() (None until a step
+        # ran with apex_tpu.telemetry enabled); eager-path analog of the
+        # in-step aux outputs a jitted loop threads itself
+        self.last_grad_stats = None
 
     def _make_groups(self, params):
         if isinstance(params, dict):
@@ -40,6 +69,11 @@ class FusedOptimizerBase:
             not grads or not isinstance(grads[0], (list, tuple))
         ):
             grads = [grads]
+        from apex_tpu import telemetry
+
+        if telemetry.enabled():
+            flat = [g for gs in grads for g in gs]
+            self.last_grad_stats = grad_norm_stats(flat)
         out = []
         for i, (group, g) in enumerate(zip(self.param_groups, grads)):
             # rebuild the cached transform only when group hyperparams change

@@ -1,0 +1,54 @@
+"""apex_tpu.telemetry — observability layer: metrics, tracing, run ledger.
+
+Three parts, all built around one rule: **disabled is free**. The repo's
+measurement discipline (PERF.md §0) pins every headline number to a
+committed method; an observability layer that perturbed the measured
+program would invalidate the pins it exists to protect.
+
+* ``metrics`` — registry + JSONL sink for in-step training scalars
+  (loss-scale trajectory, overflow/skip events, grad-norm stats,
+  tokens/s). Scalars are collected INSIDE the jitted step as auxiliary
+  outputs stacked by the training scan and fetched with the existing
+  1-element-sync pattern — never via host callbacks (on this backend
+  they dial the relay). The enabled/disabled switch is a Python
+  (trace-time) bool: with telemetry off the instrumented step traces to
+  a byte-identical jaxpr (asserted by tests/test_telemetry.py).
+* ``tracing`` — the single implementation of the PERF.md §0 timing rules
+  (K-scan chaining, traced-eps feedback, 1-element sync, dispatch-
+  overhead calibration). ``benchmarks/_timing.py`` re-exports it; the
+  profile harnesses share :class:`~apex_tpu.telemetry.tracing.Tracer`
+  so every emitted number carries its calibration metadata.
+* ``ledger`` — every bench/profile invocation appends one structured
+  record (git SHA, APEX_* knob pins, dispatch overhead, K, relay stamp,
+  platform, span rows) to ``benchmarks/ledger.jsonl``. PERF.md table
+  captions cite records as ``ledger:<id>``; ``tools/check_bench_labels.py``
+  (tier-1) cross-checks captions against records.
+
+Env knobs: ``APEX_TELEMETRY=1`` turns in-step metric collection on;
+``APEX_TELEMETRY_PATH`` points the metrics JSONL sink;
+``APEX_TELEMETRY_LEDGER`` overrides the ledger path (smoke-mode runs
+skip the ledger write unless it is set).
+"""
+
+from apex_tpu.telemetry import ledger, metrics  # noqa: F401 (jax-free)
+from apex_tpu.telemetry.metrics import (  # noqa: F401
+    MetricsWriter,
+    collect,
+    disable,
+    enable,
+    enabled,
+    read_metrics,
+    register,
+    reset_enabled,
+)
+
+
+def __getattr__(name):
+    # tracing imports jax at module import time; keep it lazy so the
+    # jax-free parts (ledger, metrics registry) stay importable before a
+    # harness has decided its backend (the _smoke.py ordering contract).
+    if name == "tracing":
+        import importlib
+
+        return importlib.import_module("apex_tpu.telemetry.tracing")
+    raise AttributeError(f"module 'apex_tpu.telemetry' has no attribute {name!r}")

@@ -1,0 +1,160 @@
+"""Run ledger: one structured JSONL record per bench/profile invocation.
+
+Every measurement harness appends a record — git SHA, APEX_* knob pins,
+measured dispatch overhead, scan length K, relay-degradation stamp,
+platform, per-span rows — to ``benchmarks/ledger.jsonl``. PERF.md table
+captions cite records as ``ledger:<id>`` and
+``tools/check_bench_labels.py`` (run in the tier-1 suite, like
+``check_api_parity.py``) cross-checks the captions against the records,
+so label drift of the kind that shipped the §10 "68–75 ms" caption over
+an 82.6 ms log is mechanically detectable instead of a prose audit.
+
+Record ids are content hashes (``lg-`` + sha1 of the canonical record
+sans ``id``), so a record edited after the fact no longer matches its
+own id — the checker flags that too.
+
+Writes are best-effort and NEVER raise: bench.py's one-JSON-line
+contract must survive a read-only checkout. Smoke-mode runs
+(``APEX_BENCH_SMOKE=1``) skip the write unless ``APEX_TELEMETRY_LEDGER``
+explicitly points somewhere — CPU sanity numbers do not belong in the
+measurement ledger.
+"""
+
+import hashlib
+import json
+import os
+import time
+
+REQUIRED_FIELDS = ("id", "ts", "harness", "git_sha", "platform", "knobs",
+                   "dispatch_overhead_ms", "k", "relay")
+
+
+def repo_root():
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def default_path():
+    return os.path.join(repo_root(), "benchmarks", "ledger.jsonl")
+
+
+def ledger_path():
+    return os.environ.get("APEX_TELEMETRY_LEDGER") or default_path()
+
+
+def knob_pins(env=None):
+    """Every ``APEX_*`` env var, sorted — the process-wide knob pins.
+    Per-call knobs (e.g. bench.py's ``config`` dict) ride in ``extra``."""
+    env = os.environ if env is None else env
+    return {k: env[k] for k in sorted(env) if k.startswith("APEX_")}
+
+
+def git_sha():
+    """HEAD commit of the repo (None when git is unavailable)."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"], cwd=repo_root(), timeout=10,
+            capture_output=True, text=True)
+        sha = out.stdout.strip()
+        return sha if out.returncode == 0 and sha else None
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def record_id(rec):
+    """Deterministic short id: sha1 over the canonical record sans id."""
+    body = json.dumps({k: v for k, v in rec.items() if k != "id"},
+                      sort_keys=True)
+    return "lg-" + hashlib.sha1(body.encode()).hexdigest()[:10]
+
+
+def make_record(harness, platform, dispatch_overhead_ms, k, relay=None,
+                knobs=None, git=None, ts=None, extra=None):
+    """Build (but do not write) a ledger record with its content id.
+
+    ``relay`` is the degradation stamp: ``{"degraded": bool|None,
+    "kind": str|None}`` — None/None when the harness has no detector
+    (most profile harnesses; bench.py fills in its MFU-envelope
+    verdict)."""
+    rec = {
+        "ts": round(time.time(), 3) if ts is None else ts,
+        "harness": harness,
+        "git_sha": git_sha() if git is None else git,
+        "platform": platform,
+        "knobs": knob_pins() if knobs is None else dict(knobs),
+        "dispatch_overhead_ms": dispatch_overhead_ms,
+        "k": k,
+        "relay": ({"degraded": None, "kind": None} if relay is None
+                  else dict(relay)),
+    }
+    if extra:
+        rec.update(extra)
+    rec["id"] = record_id(rec)
+    return rec
+
+
+def append_record(harness, platform, dispatch_overhead_ms, k, relay=None,
+                  knobs=None, extra=None, path=None):
+    """Append one record; returns its id, or None when the write was
+    skipped (smoke mode without an explicit path) or failed (never
+    raises — see module docstring)."""
+    try:
+        if path is None:
+            if (os.environ.get("APEX_BENCH_SMOKE") == "1"
+                    and not os.environ.get("APEX_TELEMETRY_LEDGER")):
+                return None
+            path = ledger_path()
+        rec = make_record(harness, platform, dispatch_overhead_ms, k,
+                          relay=relay, knobs=knobs, extra=extra)
+        with open(path, "a") as f:
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+        return rec["id"]
+    except Exception:
+        return None
+
+
+def read_ledger(path=None):
+    """Parse a ledger file into a list of records. Raises ValueError
+    (with the line number) on an unparseable line — a corrupt ledger is
+    a finding, not something to skip past silently."""
+    path = path or ledger_path()
+    records = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError as e:
+                raise ValueError(f"{path}:{lineno}: unparseable ledger "
+                                 f"line ({e})") from None
+    return records
+
+
+def validate_record(rec):
+    """Schema problems for one record (empty list = clean)."""
+    problems = []
+    for field in REQUIRED_FIELDS:
+        if field not in rec:
+            problems.append(f"missing field {field!r}")
+    if not isinstance(rec.get("knobs", {}), dict):
+        problems.append("knobs is not a dict")
+    relay = rec.get("relay")
+    if relay is not None and not isinstance(relay, dict):
+        problems.append("relay is not a dict")
+    oh = rec.get("dispatch_overhead_ms")
+    if oh is not None and not isinstance(oh, (int, float)):
+        problems.append("dispatch_overhead_ms is not numeric")
+    if "k" in rec and rec["k"] is not None \
+            and not isinstance(rec["k"], int):
+        problems.append("k is not an int")
+    if "id" in rec and all(f in rec for f in REQUIRED_FIELDS):
+        want = record_id(rec)
+        if rec["id"] != want:
+            problems.append(
+                f"id {rec['id']!r} does not match record content "
+                f"(expected {want!r}) — record edited after the fact?")
+    return problems

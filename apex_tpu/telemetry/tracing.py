@@ -1,0 +1,210 @@
+"""Calibrated span/timer API — ONE implementation of the PERF.md §0 rules.
+
+Three facts (measured; the calibration experiments are in PERF.md §0)
+shape every benchmark in this tree:
+
+  1. each jit dispatch pays ~30-70 ms of relay latency — so measured
+     programs run K chained iterations inside ONE ``lax.scan`` dispatch;
+  2. ``block_until_ready`` resolves before device execution completes —
+     so synchronization is a 1-element device fetch (:func:`sync`);
+  3. a literal-0 feedback chaining the scan carry is constant-folded,
+     letting XLA hoist the loop-invariant body out of the scan — so the
+     chain factor ``eps`` is a TRACED runtime scalar (0.0 to warm,
+     1e-30 when timing, which also defeats same-args result caching).
+
+Before this module, those rules lived as a convention each
+``benchmarks/profile_*.py`` hand-rolled around ``_timing.py``'s
+primitives — and the emitted numbers carried their calibration only as
+prose. :class:`Tracer` owns the scan length K and the measured
+per-dispatch overhead for a run; every :class:`Span` it emits carries
+that calibration metadata, and :meth:`Tracer.flush_ledger` writes the
+whole run (spans + knob pins + git SHA + platform) as one
+``benchmarks/ledger.jsonl`` record. ``benchmarks/_timing.py`` re-exports
+the primitives, so existing call sites keep working unchanged.
+"""
+
+import dataclasses
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def sync(x):
+    """Wait for device execution by fetching one element."""
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    return np.asarray(jnp.ravel(leaf)[:1])
+
+
+def measure_dispatch_overhead(k):
+    """Fixed per-dispatch tunnel latency: best-of-3 trivial k-iter scans."""
+    def run(c, eps):
+        def body(c, _):
+            return c + eps, ()
+        c, _ = lax.scan(body, c, jnp.arange(k))
+        return c
+
+    f = jax.jit(run)
+    sync(f(jnp.float32(0.0), jnp.float32(0.0)))
+    best = float("inf")
+    for i in range(3):
+        t0 = time.perf_counter()
+        sync(f(jnp.float32(0.0), jnp.float32(1e-30 * (i + 1))))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def bench_k(smoke, default=128):
+    """Scan length for kernel-level microbenches (env ``APEX_BENCH_K``).
+
+    The relay's ±30 ms dispatch-overhead variance divides by K, so sub-ms
+    kernel rows need K >> 32 to resolve (~±0.25 ms at the 128 default);
+    scan length does not grow the compiled program. Step-level harnesses
+    (profile_gpt etc.) keep their own smaller fixed K — their rows are
+    10–100 ms, where K=16–32 noise is already <5%.
+    """
+    import os
+
+    return 2 if smoke else int(os.environ.get("APEX_BENCH_K", str(default)))
+
+
+@dataclasses.dataclass
+class Span:
+    """One measured row and the calibration it was taken under.
+
+    ``seconds`` is the per-iteration time with the dispatch overhead
+    already subtracted (None when the row failed to run — ``error``
+    holds the reason, so a window's failures reach the ledger too)."""
+
+    name: str
+    seconds: float  # per-iteration, overhead-subtracted; None on error
+    total_s: float  # raw wall time of the timed dispatch
+    k: int
+    overhead_s: float
+    method: str = "scan-chain"  # the PERF.md §0 protocol
+    flops_per_iter: float = None
+    error: str = None
+    extra: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def ms(self):
+        return None if self.seconds is None else self.seconds * 1e3
+
+    def tflops(self):
+        if self.seconds is None or not self.flops_per_iter:
+            return None
+        return self.flops_per_iter / self.seconds / 1e12
+
+    def mfu(self, peak_flops):
+        if self.seconds is None or not self.flops_per_iter or not peak_flops:
+            return None
+        return self.flops_per_iter / self.seconds / peak_flops
+
+    def format_row(self, peak_flops=None, width=28, ms_prec=2):
+        """The harness table row (name, ms, optional TF/s + MFU)."""
+        if self.seconds is None:
+            return f"{self.name:{width}s} FAILED: {self.error}"
+        extra = ""
+        if self.flops_per_iter and peak_flops:
+            extra = (f"  {self.tflops():6.1f} TF/s"
+                     f"  MFU={self.mfu(peak_flops) * 100:5.1f}%")
+        return f"{self.name:{width}s} {self.ms:8.{ms_prec}f} ms{extra}"
+
+    def as_record(self):
+        rec = {"name": self.name,
+               "ms": None if self.ms is None else round(self.ms, 4),
+               "k": self.k,
+               "dispatch_overhead_ms": round(self.overhead_s * 1e3, 2),
+               "method": self.method}
+        if self.error is not None:
+            rec["error"] = self.error
+        rec.update(self.extra)
+        return rec
+
+
+class Tracer:
+    """Calibrated timing context for one harness run.
+
+    Calibrates the per-dispatch overhead once (``overhead=`` injects a
+    pre-measured value — e.g. bench.py measures before compiling), then
+    times rows via :meth:`scan_time` / :meth:`time_call`; spans
+    accumulate for :meth:`flush_ledger`.
+    """
+
+    def __init__(self, k, overhead=None, peak_flops=None):
+        self.k = int(k)
+        self.overhead = (measure_dispatch_overhead(self.k)
+                         if overhead is None else float(overhead))
+        self.peak_flops = peak_flops
+        self.spans = []
+
+    @property
+    def overhead_ms(self):
+        return self.overhead * 1e3
+
+    def time_call(self, name, call, warm_args, timed_args,
+                  flops_per_iter=None, extra=None, on_fail="raise",
+                  sync_out=sync):
+        """Warm (compile + drain) with ``warm_args``, then time one
+        dispatch of ``call(*timed_args)``; per-iteration time = (wall -
+        overhead) / K. The two argument tuples must differ in a traced
+        value (the eps chain) or the relay may serve a cached result.
+        ``on_fail="span"`` records a failed row instead of raising (the
+        sweep-harness pattern: one unlowered config must not kill the
+        window's remaining rows)."""
+        try:
+            sync_out(call(*warm_args))
+        except Exception as e:
+            if on_fail != "span":
+                raise
+            span = Span(name, None, None, self.k, self.overhead,
+                        flops_per_iter=flops_per_iter,
+                        error=f"{type(e).__name__}: {str(e)[:100]}",
+                        extra=dict(extra or {}))
+            self.spans.append(span)
+            return span
+        t0 = time.perf_counter()
+        sync_out(call(*timed_args))
+        total = time.perf_counter() - t0
+        span = Span(name, (total - self.overhead) / self.k, total, self.k,
+                    self.overhead, flops_per_iter=flops_per_iter,
+                    extra=dict(extra or {}))
+        self.spans.append(span)
+        return span
+
+    def scan_time(self, name, make_body, carry0, ops, wrap=None,
+                  flops_per_iter=None, extra=None, on_fail="raise"):
+        """The §0 protocol in one call. ``make_body(eps, *ops)`` returns
+        ``body(carry, t) -> (carry, metric)``; ``ops`` (big arrays) are
+        jit ARGUMENTS — closure-captured constants would be inlined into
+        the HLO payload and overflow the remote-compile tunnel. ``wrap``
+        maps the run function before jit (e.g. a shard_map)."""
+        k = self.k
+
+        def run(carry0, eps, *ops):
+            body = make_body(eps, *ops)
+            return lax.scan(body, carry0, jnp.arange(k))
+
+        f = jax.jit(run if wrap is None else wrap(run))
+        return self.time_call(
+            name, f, (carry0, jnp.float32(0.0)) + tuple(ops),
+            (carry0, jnp.float32(1e-30)) + tuple(ops),
+            flops_per_iter=flops_per_iter, extra=extra, on_fail=on_fail)
+
+    def flush_ledger(self, harness, platform=None, relay=None, extra=None,
+                     path=None):
+        """Append this run (calibration + every span) as one ledger
+        record; returns the record id (None when the write was skipped
+        or failed — see ledger.append_record)."""
+        from apex_tpu.telemetry import ledger
+
+        if platform is None:
+            platform = jax.devices()[0].platform
+        payload = {"spans": [s.as_record() for s in self.spans]}
+        payload.update(extra or {})
+        return ledger.append_record(
+            harness=harness, platform=platform,
+            dispatch_overhead_ms=round(self.overhead_ms, 2), k=self.k,
+            relay=relay, extra=payload, path=path)

@@ -1,6 +1,11 @@
 """Benchmark: flagship GPT training-step throughput on the local device.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "mfu"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "mfu",
+"dispatch_overhead_ms", "relay_degraded", "ledger_id", "config"}.
+Every invocation also appends a structured record (git SHA, knob pins,
+calibration, relay stamp) to benchmarks/ledger.jsonl via
+apex_tpu.telemetry.ledger — "ledger_id" names it, so the headline
+number can be traced back to exactly what was measured.
 
 The measured program is the full apex-equivalent training step — bf16
 forward/backward (amp O2 semantics), dynamic loss scaling, fused Adam —
@@ -38,6 +43,56 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 # env default and the baseline-seeding guard so a future measured flip
 # cannot update one and orphan the other.
 DEFAULT_TPU_BATCH = 8
+
+
+def make_one_step(model, scaler, tx):
+    """The flagship amp-O2 training step: bf16 fwd/bwd, dynamic loss
+    scaling, fused Adam, skip-step selects.
+
+    Module-level so tests/test_telemetry.py can assert the zero-cost
+    telemetry rule directly on the measured program: with telemetry
+    disabled the returned step traces to a jaxpr byte-identical to the
+    uninstrumented step.
+
+    Returns ``one_step(params, opt_state, scaler_state, ids, pos,
+    labels) -> (params, opt_state, scaler_state, loss, aux)`` where
+    ``aux`` is None (an empty pytree — adds nothing to the compiled
+    program) with telemetry disabled, else the in-step scalar dict
+    (loss / loss_scale / overflow / unskipped / grad_norm / grad_max)
+    that rides the training scan's stacked outputs.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu import telemetry
+    from apex_tpu.optimizers import grad_norm_stats
+
+    def one_step(params, opt_state, scaler_state, ids, pos, labels):
+        def loss_fn(p):
+            per_tok = model.apply({"params": p}, ids, pos, None, labels)
+            return jnp.mean(per_tok) * scaler_state.loss_scale
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        grads, found_inf = scaler.unscale(grads, scaler_state)
+        new_scaler_state = scaler.update(scaler_state, found_inf)
+        updates, new_opt_state = tx.update(grads, opt_state, params)
+        new_params = jax.tree_util.tree_map(
+            lambda p, u: jnp.where(found_inf, p, p + u.astype(p.dtype)),
+            params, updates)
+        new_opt_state = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(found_inf, old, new),
+            new_opt_state, opt_state)
+        unscaled_loss = loss / scaler_state.loss_scale
+        aux = None
+        if telemetry.enabled():  # trace-time branch: disabled is free
+            aux = telemetry.collect(
+                None, loss=unscaled_loss,
+                **scaler.metrics(new_scaler_state),
+                **grad_norm_stats(grads))
+        return (new_params, new_opt_state, new_scaler_state,
+                unscaled_loss, aux)
+
+    return one_step
 
 
 def main():
@@ -117,38 +172,22 @@ def main():
     opt_state = jax.jit(lambda p: tx.init(p))(params)
     scaler_state = scaler.init()
 
-    def one_step(params, opt_state, scaler_state, ids, pos, labels):
-        def loss_fn(p):
-            per_tok = model.apply({"params": p}, ids, pos, None, labels)
-            return jnp.mean(per_tok) * scaler_state.loss_scale
-
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        grads, found_inf = scaler.unscale(grads, scaler_state)
-        new_scaler_state = scaler.update(scaler_state, found_inf)
-        updates, new_opt_state = tx.update(grads, opt_state, params)
-        new_params = jax.tree_util.tree_map(
-            lambda p, u: jnp.where(found_inf, p, p + u.astype(p.dtype)),
-            params, updates)
-        new_opt_state = jax.tree_util.tree_map(
-            lambda new, old: jnp.where(found_inf, old, new),
-            new_opt_state, opt_state)
-        return (new_params, new_opt_state, new_scaler_state,
-                loss / scaler_state.loss_scale)
+    one_step = make_one_step(model, scaler, tx)
 
     def run(params, opt_state, scaler_state, eps, ids, pos, labels):
         def local(params, opt_state, scaler_state, eps, ids, pos, labels):
             def body(carry, _):
                 p, o, ss = carry
-                p, o, ss, loss = one_step(p, o, ss, ids, pos, labels)
-                return (p, o, ss), loss
+                p, o, ss, loss, aux = one_step(p, o, ss, ids, pos, labels)
+                return (p, o, ss), (loss, aux)
 
-            (params, opt_state, scaler_state), losses = lax.scan(
+            (params, opt_state, scaler_state), (losses, aux) = lax.scan(
                 body, (params, opt_state, scaler_state), jnp.arange(iters))
             # adding the traced eps (0 warm / 1e-30 timed) to the output
             # varies the call signature-values between warmup and timing,
             # defeating any same-args result caching in the relay; the
             # compute chain itself is kept live by the params carry
-            return params, opt_state, scaler_state, losses + eps
+            return params, opt_state, scaler_state, losses + eps, aux
 
         return jax.shard_map(
             local, mesh=mesh, in_specs=(P(),) * 7, out_specs=P(),
@@ -164,7 +203,7 @@ def main():
     # compile + warm + drain (donated inputs: rebind the carried state)
     print(f"# compiling {iters}-step scan at b={b} s={s} ...",
           file=sys.stderr, flush=True)
-    params, opt_state, scaler_state, losses = step(
+    params, opt_state, scaler_state, losses, _ = step(
         params, opt_state, scaler_state, jnp.float32(0.0), ids, pos, labels)
     sync(losses)
     print("# compiled; timing", file=sys.stderr, flush=True)
@@ -174,6 +213,18 @@ def main():
     sync(out[3])
     dt = (time.perf_counter() - t0 - overhead) / iters
 
+    from apex_tpu import telemetry
+
+    def ledger_record(degraded, kind, **extra):
+        # every invocation — including an unusable one — lands in the
+        # run ledger; a window's failures are evidence too (§6)
+        return telemetry.ledger.append_record(
+            harness="bench", platform=platform,
+            dispatch_overhead_ms=round(overhead * 1e3, 1), k=iters,
+            relay={"degraded": degraded, "kind": kind},
+            extra=dict({"metric": f"gpt2s_train_tokens_per_sec ({platform})"},
+                       **extra))
+
     if dt <= 0:
         # the dispatch-overhead calibration ran in a slower relay regime
         # than the timed scan (the relay flaps) — the subtraction went
@@ -181,6 +232,9 @@ def main():
         print(json.dumps({
             "metric": f"gpt2s_train_tokens_per_sec ({platform})",
             "value": 0, "unit": "tokens/s", "vs_baseline": 0, "mfu": None,
+            "dispatch_overhead_ms": round(overhead * 1e3, 1),
+            "relay_degraded": True,
+            "ledger_id": ledger_record(True, "calibration-flap", value=0),
             "error": "non-positive step time after overhead subtraction "
                      "(relay flap straddled the calibration); "
                      "measurement unusable"}), flush=True)
@@ -230,6 +284,22 @@ def main():
     # the same "not comparable" sentinel the watchdog's error line uses
     vs_baseline = tokens_per_sec / baselines[key] if key in baselines else 0.0
 
+    config = {
+        "batch": b,
+        "fused_lm_head": bool(fused_head),
+        "attn_impl": os.environ.get("APEX_ATTN_IMPL", "flash"),
+        "ln_pallas": os.environ.get("APEX_LN_PALLAS") == "1",
+        "remat": remat,
+        # telemetry-on measures the INSTRUMENTED program (aux outputs in
+        # the timed scan) — the label must say so (pin-the-label rule);
+        # the default-off path is jaxpr-identical to uninstrumented
+        "telemetry": bool(telemetry.enabled()),
+    }
+    degraded_kind = (("implausible" if implausible else "relay")
+                     if degraded else None)
+    ledger_id = ledger_record(
+        bool(degraded), degraded_kind, value=round(tokens_per_sec, 1),
+        unit="tokens/s", mfu=mfu, config=config)
     result = {
         "metric": f"gpt2s_train_tokens_per_sec ({platform})",
         "value": round(tokens_per_sec, 1),
@@ -237,21 +307,29 @@ def main():
         "vs_baseline": round(vs_baseline, 4),
         "mfu": mfu,
         "dispatch_overhead_ms": round(overhead * 1e3, 1),
+        "relay_degraded": bool(degraded),
+        "ledger_id": ledger_id,
         # the active kernel dispatch, so a watchdog-selected best line
         # self-describes (the ladder A/Bs configs across attempts)
-        "config": {
-            "batch": b,
-            "fused_lm_head": bool(fused_head),
-            "attn_impl": os.environ.get("APEX_ATTN_IMPL", "flash"),
-            "ln_pallas": os.environ.get("APEX_LN_PALLAS") == "1",
-            "remat": remat,
-        },
+        "config": config,
     }
+    if telemetry.enabled():
+        # flush the in-step scalars (stacked by the timed scan) + the
+        # host-derived throughput to the metrics sink — AFTER the timed
+        # region, fetched with plain np.asarray (no callbacks)
+        try:
+            stacked = {k_: np.asarray(v) for k_, v in out[4].items()}
+            writer = telemetry.MetricsWriter()
+            writer.append_steps(stacked, run=ledger_id)
+            writer.append({"run": ledger_id,
+                           "tokens_per_sec": round(tokens_per_sec, 1)})
+        except Exception as e:  # never break the one-JSON-line contract
+            print(f"# telemetry metrics write failed: {e}",
+                  file=sys.stderr, flush=True)
     if degraded:
         # structured kind alongside the prose note: the watchdog's
         # best-selection tiers on this, never on the wording
-        result["degraded_kind"] = ("implausible" if implausible
-                                   else "relay")
+        result["degraded_kind"] = degraded_kind
         result["note"] = (
             "implausible MFU — the relay flap straddled the dispatch-"
             "overhead calibration and inflated the number; unreliable"
@@ -329,15 +407,17 @@ def _attempt_once(state, extra_env=None, timeout_cap=None):
 
     Returns ``(line, record, returncode_or_None)`` — line and record are
     None when the child produced no parseable JSON (only possible for a
-    crash: the timeout path always fabricates an error record, and
-    returns returncode None). A wedged
+    crash: the timeout path always fabricates an error record, stamped
+    ``"timed_out": true``, and returns returncode None). A wedged
     TPU relay — observed round 3, even backend init hangs, PERF.md §6 —
     must produce an honest error line, not hang the caller forever, so
-    the child gets a hard timeout (capped via ``timeout_cap`` when the
-    init pre-flight already proved the relay init-wedged). The live
-    Popen handle is parked in ``state["child"]`` so the SIGTERM handler
-    can take down exactly the in-flight attempt (not the whole process
-    group, which may be shared with a supervising driver).
+    the child gets a hard timeout. ``timeout_cap`` shortens that budget;
+    the watchdog arms it after an earlier attempt rode its ENTIRE
+    timeout without printing a JSON line (the wedge signature — there is
+    no init pre-flight, the evidence is always a prior attempt). The
+    live Popen handle is parked in ``state["child"]`` so the SIGTERM
+    handler can take down exactly the in-flight attempt (not the whole
+    process group, which may be shared with a supervising driver).
     """
     import subprocess
 
@@ -375,6 +455,11 @@ def _attempt_once(state, extra_env=None, timeout_cap=None):
             "unit": "tokens/s",
             "vs_baseline": 0,
             "mfu": None,
+            # structured wedge marker: the lazy-cap arming keys on THIS,
+            # never on the error wording — a real error record forwarded
+            # after a teardown wedge must not arm the cap
+            "timed_out": True,
+            "relay_degraded": True,
             "error": f"bench timed out after {timeout}s (TPU relay "
                      "unresponsive — see PERF.md §6; device-side numbers "
                      "for this tree are in PERF.md §1)",
@@ -469,10 +554,14 @@ def _watchdog():
     # it, and a healthy run costs nothing extra). Once an attempt TIMES
     # OUT — this relay needed more than the full budget, the §6
     # wedge/starvation signature — the remaining attempts run under a
-    # 600s cap: they can only succeed if the relay improved, and an
-    # improved (healthy) run finishes well under 600s, so the cap
-    # trades nothing except the hours a wedged relay would otherwise
-    # burn (observed: init-hung children ride their entire timeout).
+    # 900s cap. A healthy retry finishes well under it; 900s (vs the
+    # 600s this started as) covers the observed degraded-attempt
+    # envelope (round-5 window attempts ran ~4 min, with slow-compile
+    # headroom), so a degraded-but-COMPLETE retry still lands as a real
+    # rc-0 measurement instead of being converted into a fabricated
+    # timeout. What the cap trades away is only the hours a wedged
+    # relay would otherwise burn (observed: init-hung children ride
+    # their entire timeout).
     timeout_cap = None
     for i in range(attempts):
         cfg_key = json.dumps(ladder[i], sort_keys=True)
@@ -503,12 +592,16 @@ def _watchdog():
             next_wait = retry_wait
         line, rec, rc = _attempt_once(state, ladder[i],
                                       timeout_cap=timeout_cap)
-        if rc is None and rec is not None and "error" in rec:
-            # rc None + fabricated error record = the attempt rode its
-            # ENTIRE budget without producing a JSON line (wedge
-            # signature; a teardown-wedge after printing returns the
-            # real record instead) — cap the remaining attempts
-            timeout_cap = 600
+        if rc is None and rec is not None and rec.get("timed_out"):
+            # rc None + the fabricated timed_out record = the attempt
+            # rode its ENTIRE budget without producing a JSON line
+            # (wedge signature) — cap the remaining attempts. Keyed on
+            # the structured timed_out stamp, NOT on the presence of an
+            # error: a teardown-wedge after printing a real error
+            # record (e.g. the calibration-flap line) forwards that
+            # record with rc None too, and a completed attempt must
+            # never arm the cap (ADVICE r5).
+            timeout_cap = 900
         if rec is None:
             # only a crash lands here (the timeout path always
             # fabricates an error record): the child exited with no

@@ -1,58 +1,17 @@
 """Shared timing calibration for the axon-tunneled TPU backend.
 
-Three facts (measured, see PERF.md) shape every benchmark in this tree:
-
-  1. each jit dispatch pays ~30-70 ms of relay latency — so measured
-     programs run K chained iterations inside ONE ``lax.scan`` dispatch;
-  2. ``block_until_ready`` resolves before device execution completes —
-     so synchronization is a 1-element device fetch;
-  3. a literal-0 feedback chaining the scan carry is constant-folded,
-     letting XLA hoist the loop-invariant body out of the scan — so the
-     chain factor ``eps`` is a TRACED runtime scalar (0.0 to warm,
-     1e-30 when timing, which also defeats any same-args result caching).
+The implementation moved to ``apex_tpu.telemetry.tracing`` (the span/
+timer layer every harness now shares — its module docstring carries the
+three measured facts behind the rules: K-scan chaining, 1-element-fetch
+sync, traced-eps feedback). This module re-exports the primitives so
+existing call sites and the PERF.md §0 references to
+``benchmarks/_timing.py`` keep resolving.
 """
 
-import time
-
-import numpy as np
-import jax
-import jax.numpy as jnp
-from jax import lax
-
-
-def sync(x):
-    """Wait for device execution by fetching one element."""
-    leaf = jax.tree_util.tree_leaves(x)[0]
-    return np.asarray(jnp.ravel(leaf)[:1])
-
-
-def measure_dispatch_overhead(k):
-    """Fixed per-dispatch tunnel latency: best-of-3 trivial k-iter scans."""
-    def run(c, eps):
-        def body(c, _):
-            return c + eps, ()
-        c, _ = lax.scan(body, c, jnp.arange(k))
-        return c
-
-    f = jax.jit(run)
-    sync(f(jnp.float32(0.0), jnp.float32(0.0)))
-    best = float("inf")
-    for i in range(3):
-        t0 = time.perf_counter()
-        sync(f(jnp.float32(0.0), jnp.float32(1e-30 * (i + 1))))
-        best = min(best, time.perf_counter() - t0)
-    return best
-
-
-def bench_k(smoke, default=128):
-    """Scan length for kernel-level microbenches (env ``APEX_BENCH_K``).
-
-    The relay's ±30 ms dispatch-overhead variance divides by K, so sub-ms
-    kernel rows need K >> 32 to resolve (~±0.25 ms at the 128 default);
-    scan length does not grow the compiled program. Step-level harnesses
-    (profile_gpt etc.) keep their own smaller fixed K — their rows are
-    10–100 ms, where K=16–32 noise is already <5%.
-    """
-    import os
-
-    return 2 if smoke else int(os.environ.get("APEX_BENCH_K", str(default)))
+from apex_tpu.telemetry.tracing import (  # noqa: F401
+    Span,
+    Tracer,
+    bench_k,
+    measure_dispatch_overhead,
+    sync,
+)

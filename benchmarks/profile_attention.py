@@ -7,7 +7,6 @@ apex_tpu/ops/attention.py.
 
 import os
 import sys
-import time
 
 import numpy as np
 import jax
@@ -20,8 +19,7 @@ from benchmarks._smoke import smoke_mode  # noqa: E402
 
 SMOKE = smoke_mode("APEX_BENCH_SMOKE")  # force-CPU tiny sanity mode
 
-from benchmarks._timing import (bench_k, measure_dispatch_overhead,
-                                sync)  # noqa: E402
+from benchmarks._timing import Tracer, bench_k  # noqa: E402
 
 B, H, S, D = (2, 2, 128, 32) if SMOKE else (8, 12, 1024, 64)
 # APEX_ATTN_SEQ overrides s (batch rescaled toward constant b*s tokens)
@@ -73,23 +71,24 @@ def measure(name, attn_fn, wrt_qkv=False, fwd_only=False):
         return qc, ls
 
     f = jax.jit(run)
-    try:
-        sync(f(q0, jnp.float32(0.0), k0, v0))
-    except Exception as e:
-        print(f"{name:40s} FAILED: {type(e).__name__}: {str(e)[:100]}")
-        return None
-    t0 = time.perf_counter()
-    sync(f(q0, jnp.float32(1e-30), k0, v0))
-    dt = (time.perf_counter() - t0 - OVERHEAD) / K
     flops = FLOPS // 3 if fwd_only else FLOPS  # fwd is 1/3 of fwd+bwd
-    print(f"{name:40s} {dt*1e3:8.3f} ms  {flops/dt/1e12:6.1f} TF/s"
-          f"  MFU={flops/dt/PEAK*100:5.1f}%")
+    protocol = ("fwd-only" if fwd_only
+                else "fwd+d(q,k,v)" if wrt_qkv else "fwd+dq")
+    span = TRACER.time_call(
+        name, f, (q0, jnp.float32(0.0), k0, v0),
+        (q0, jnp.float32(1e-30), k0, v0), flops_per_iter=flops,
+        extra={"protocol": protocol}, on_fail="span")
+    if span.seconds is None:
+        print(f"{name:40s} FAILED: {span.error}")
+        return None
+    print(span.format_row(PEAK, width=40, ms_prec=3))
     MEASURED.append(name)
-    return dt
+    return span.seconds
 
 
-OVERHEAD = measure_dispatch_overhead(K)
-print(f"dispatch overhead {OVERHEAD*1e3:.1f} ms; shape b={B} h={H} s={S} d={D}")
+TRACER = Tracer(K, peak_flops=PEAK)
+print(f"dispatch overhead {TRACER.overhead_ms:.1f} ms; "
+      f"shape b={B} h={H} s={S} d={D}")
 
 from jax.experimental.pallas.ops.tpu import flash_attention as fa
 
@@ -233,6 +232,11 @@ if not SMOKE and ap.supported(S, S, D):
                 lambda q, k, v: _dense_attention(q, k, v, True, float(sm),
                                                  None),
                 wrt_qkv=True)
+
+# ledger first, exit-check second: a window where every config failed
+# is evidence that belongs in the ledger too (the spans carry errors)
+TRACER.flush_ledger("profile_attention", extra={
+    "shape": {"b": B, "h": H, "s": S, "d": D}})
 
 if not MEASURED:
     print("ERROR: no configuration produced a measurement")

@@ -18,12 +18,10 @@ Results feed PERF.md; run on the real TPU:
 
 import os
 import sys
-import time
 
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -32,7 +30,7 @@ from benchmarks._smoke import smoke_mode  # noqa: E402
 
 SMOKE = smoke_mode("APEX_BENCH_SMOKE")  # force-CPU tiny sanity mode
 
-from benchmarks._timing import measure_dispatch_overhead, sync  # noqa: E402
+from benchmarks._timing import Tracer  # noqa: E402
 
 from apex_tpu.amp.scaler import LossScaler
 from apex_tpu.optimizers.fused_adam import fused_adam
@@ -79,34 +77,23 @@ params = jax.jit(shmap(
     lambda i, p: model.init(jax.random.PRNGKey(0), i, p, None)["params"],
     2))(ids, pos)
 n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
-OVERHEAD = measure_dispatch_overhead(K)
+TRACER = Tracer(K, peak_flops=PEAK)
 print(f"params: {n_params/1e6:.1f}M   (method: {K}-step lax.scan, 1 dispatch,"
-      f" dispatch overhead {OVERHEAD*1e3:.1f} ms subtracted)")
+      f" dispatch overhead {TRACER.overhead_ms:.1f} ms subtracted)")
 
 
 def scan_time(name, make_body, carry0, ops, flops_per_iter=None):
-    """make_body(eps, *ops) -> body(carry, _) -> (carry, metric). ``ops``
-    (big arrays) are jit ARGUMENTS — closure-captured constants would be
-    inlined into the HLO payload and overflow the remote-compile tunnel.
-    ``eps`` is a TRACED runtime ~0 used to chain iterations (carry +=
-    eps*feedback) — a literal 0.0 would be constant-folded, letting XLA
-    hoist the loop-invariant body out of the scan entirely."""
-    def run(carry0, eps, *ops):
-        body = make_body(eps, *ops)
-        carry, ms = lax.scan(body, carry0, jnp.arange(K))
-        return carry, ms
-
-    f = jax.jit(shmap(run, 2 + len(ops)))
-    sync(f(carry0, jnp.float32(0.0), *ops))  # compile + warm + drain
-    t0 = time.perf_counter()
-    sync(f(carry0, jnp.float32(1e-30), *ops))
-    dt = (time.perf_counter() - t0 - OVERHEAD) / K
-    extra = ""
-    if flops_per_iter:
-        extra = (f"  {flops_per_iter/dt/1e12:6.1f} TF/s"
-                 f"  MFU={flops_per_iter/dt/PEAK*100:5.1f}%")
-    print(f"{name:28s} {dt*1000:8.2f} ms{extra}")
-    return dt
+    """make_body(eps, *ops) -> body(carry, _) -> (carry, metric); the §0
+    protocol (K-scan, traced eps, overhead subtraction) via the shared
+    Tracer — every row lands in the run's ledger record with its
+    calibration metadata. ``ops`` (big arrays) are jit ARGUMENTS —
+    closure-captured constants would be inlined into the HLO payload
+    and overflow the remote-compile tunnel."""
+    span = TRACER.scan_time(name, make_body, carry0, ops,
+                            wrap=lambda run: shmap(run, 2 + len(ops)),
+                            flops_per_iter=flops_per_iter)
+    print(span.format_row(PEAK))
+    return span.seconds
 
 
 model_flops_fwd = 2 * n_params * B * S
@@ -309,3 +296,7 @@ if not SMOKE or os.environ.get("APEX_BENCH_DROPOUT_SMOKE") == "1":
                         (_dparams, _dopt, scaler.init()),
                         (ids, pos, labels), flops_per_iter=model_flops_fb)
         print(f"{'':28s} -> {B*S/t_d:.0f} tok/s")
+
+# one ledger record for the whole run: calibration + every span above
+TRACER.flush_ledger("profile_gpt", extra={
+    "shape": {"b": B, "s": S, "params_m": round(n_params / 1e6, 1)}})

@@ -16,12 +16,10 @@ reduction. v5e HBM ~819 GB/s.
 
 import os
 import sys
-import time
 
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax import lax
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
@@ -29,16 +27,16 @@ from benchmarks._smoke import smoke_mode  # noqa: E402
 
 SMOKE = smoke_mode("APEX_BENCH_SMOKE")  # force-CPU tiny sanity mode
 
-from benchmarks._timing import (bench_k, measure_dispatch_overhead,
-                                sync)  # noqa: E402
+from benchmarks._timing import Tracer, bench_k  # noqa: E402
 
 from apex_tpu.normalization.fused_layer_norm import fused_layer_norm
 
 K = bench_k(SMOKE)  # see benchmarks/_timing.bench_k
 HBM = 819e9  # v5e
 
-OVERHEAD = measure_dispatch_overhead(K)
-print(f"dispatch overhead {OVERHEAD*1e3:.1f} ms; HBM roofline {HBM/1e9:.0f} GB/s")
+TRACER = Tracer(K)
+print(f"dispatch overhead {TRACER.overhead_ms:.1f} ms; "
+      f"HBM roofline {HBM/1e9:.0f} GB/s")
 
 ROWS = 256 if SMOKE else 8 * 1024  # GPT-2-small b*s
 
@@ -62,22 +60,17 @@ def run_case(hidden, use_pallas=False):
             return (w - eps * gw, b - eps * gb), l
         return body
 
-    def run(carry, eps, *ops):
-        body = fb(eps, *ops)
-        return lax.scan(body, carry, jnp.arange(K))
-
-    f = jax.jit(run)
-    sync(f((w0, b0), jnp.float32(0.0), x0, w0, b0))
-    t0 = time.perf_counter()
-    sync(f((w0, b0), jnp.float32(1e-30), x0, w0, b0))
-    dt = (time.perf_counter() - t0 - OVERHEAD) / K
+    tag = "pallas" if use_pallas else "jnp"
+    span = TRACER.scan_time(f"h={hidden} {tag}", fb, (w0, b0), (x0, w0, b0),
+                            extra={"hidden": hidden, "rows": ROWS,
+                                   "impl": tag})
+    dt = span.seconds
 
     n = ROWS * hidden
     # fwd: read x, write y; bwd: read x (rematerialized stats), read dy
     # (fused away here — dy comes from y), write dx. Conservative floor:
     # 4 bf16 passes over the tensor.
     bytes_min = 4 * 2 * n
-    tag = "pallas" if use_pallas else "jnp"
     print(f"h={hidden:5d} {tag:6s}: {dt*1e3:7.3f} ms  "
           f"{bytes_min/dt/1e9:6.0f} GB/s effective  "
           f"({bytes_min/dt/HBM*100:5.1f}% of HBM roofline)")
@@ -93,3 +86,5 @@ for h in ((256,) if SMOKE else (768, 1024, 4096, 8192, 12288)):
     if would_use_pallas((ROWS, h), use_pallas=True):
         pal = run_case(h, use_pallas=True)
         print(f"{'':13s} pallas/jnp = {pal/base:.2f}x")
+
+TRACER.flush_ledger("profile_layernorm", extra={"rows": ROWS})

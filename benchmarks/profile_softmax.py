@@ -14,12 +14,10 @@ Run on TPU: PYTHONPATH=/root/repo python benchmarks/profile_softmax.py
 
 import os
 import sys
-import time
 
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax import lax
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
@@ -27,8 +25,7 @@ from benchmarks._smoke import smoke_mode  # noqa: E402
 
 SMOKE = smoke_mode("APEX_BENCH_SMOKE")  # force-CPU tiny sanity mode
 
-from benchmarks._timing import (bench_k, measure_dispatch_overhead,
-                                sync)  # noqa: E402
+from benchmarks._timing import Tracer, bench_k  # noqa: E402
 
 from apex_tpu.ops import softmax_pallas
 from apex_tpu.transformer.functional.fused_softmax import (
@@ -39,8 +36,8 @@ from apex_tpu.transformer.functional.fused_softmax import (
 K = bench_k(SMOKE)  # see benchmarks/_timing.bench_k
 HBM = 819e9  # v5e
 
-OVERHEAD = measure_dispatch_overhead(K)
-print(f"dispatch overhead {OVERHEAD*1e3:.1f} ms; "
+TRACER = Tracer(K)
+print(f"dispatch overhead {TRACER.overhead_ms:.1f} ms; "
       f"HBM roofline {HBM/1e9:.0f} GB/s")
 
 
@@ -53,7 +50,9 @@ def run_case(name, b, np_, sq, sk, causal, use_pallas):
 
     # mask rides as a jit argument — closure capture would inline the
     # [b, 1, sq, sk] constant into the HLO payload (remote-compile limit)
-    def make_body(eps, m):
+    def make_body(eps, *ops):
+        m = ops[0] if ops else None
+
         def body(carry, _):
             def f(x):
                 if use_pallas:
@@ -69,16 +68,11 @@ def run_case(name, b, np_, sq, sk, causal, use_pallas):
             return carry - eps.astype(carry.dtype) * g, l
         return body
 
-    def run(carry, eps, *ops):
-        m = ops[0] if ops else None
-        return lax.scan(make_body(eps, m), carry, jnp.arange(K))
-
     mask_ops = () if mask is None else (mask,)
-    f = jax.jit(run)
-    sync(f(x0, jnp.float32(0.0), *mask_ops))
-    t0 = time.perf_counter()
-    sync(f(x0, jnp.float32(1e-30), *mask_ops))
-    dt = (time.perf_counter() - t0 - OVERHEAD) / K
+    span = TRACER.scan_time(name, make_body, x0, mask_ops,
+                            extra={"shape": [b, np_, sq, sk],
+                                   "causal": causal, "pallas": use_pallas})
+    dt = span.seconds
 
     n = b * np_ * sq * sk
     # fwd: read x, write y; bwd: read y, read g, write dx → 5 bf16 passes
@@ -99,3 +93,5 @@ for (b, np_, sq, sk) in SHAPES:
         pal = run_case(f"pallas {kind} b{b} h{np_} s{sq}", b, np_, sq, sk,
                        causal, use_pallas=True)
         print(f"{'':34s} pallas/jnp = {pal/base:.2f}x")
+
+TRACER.flush_ledger("profile_softmax")

@@ -13,7 +13,6 @@ Run:  python benchmarks/profile_xent.py
 
 import os
 import sys
-import time
 
 import numpy as np
 import jax
@@ -26,8 +25,7 @@ from benchmarks._smoke import smoke_mode  # noqa: E402
 
 SMOKE = smoke_mode("APEX_BENCH_SMOKE")  # force-CPU tiny sanity mode
 
-from benchmarks._timing import (bench_k, measure_dispatch_overhead,  # noqa: E402
-                                sync)
+from benchmarks._timing import Tracer, bench_k  # noqa: E402
 
 from apex_tpu.ops import xent_pallas as xp  # noqa: E402
 
@@ -109,24 +107,22 @@ def measure(name, fn, n):
         peak = getattr(stats, "temp_size_in_bytes", None)
     except Exception:
         compiled, peak = f, None
-    try:
-        out = compiled(x0, e0, jnp.float32(0.0), labels)
-        sync(out[1])
-    except Exception as e:
-        print(f"{name:34s} FAILED: {type(e).__name__}: {str(e)[:100]}")
-        return
-    t0 = time.perf_counter()
-    out = compiled(x0, e0, jnp.float32(1e-30), labels)
-    sync(out[1])
-    dt = (time.perf_counter() - t0 - OVERHEAD) / K
     flops = FLOPS_PER_ROW * n
+    span = TRACER.time_call(
+        name, compiled, (x0, e0, jnp.float32(0.0), labels),
+        (x0, e0, jnp.float32(1e-30), labels), flops_per_iter=flops,
+        extra={"n": n, "peak_temp_bytes": peak}, on_fail="span")
+    if span.seconds is None:
+        print(f"{name:34s} FAILED: {span.error}")
+        return
+    dt = span.seconds
     mem = f"  peak-temp {peak/1e9:5.2f} GB" if peak is not None else ""
     print(f"{name:34s} {dt*1e3:8.2f} ms  {flops/dt/1e12:6.1f} TF/s"
           f"  MFU={flops/dt/PEAK*100:5.1f}%{mem}")
 
 
-OVERHEAD = measure_dispatch_overhead(K)
-print(f"LM head h={H} V={V} (K={K}, overhead {OVERHEAD*1e3:.1f} ms)")
+TRACER = Tracer(K, peak_flops=PEAK)
+print(f"LM head h={H} V={V} (K={K}, overhead {TRACER.overhead_ms:.1f} ms)")
 
 # Fused (small-HBM) cases first: the relay's degraded mode selectively
 # starves programs with large HBM working sets (PERF.md §6), and the
@@ -140,3 +136,5 @@ for label, fn in (("fused linear-CE kernel", fused),
     for b in ((8, 16) if ON_TPU else (2,)):
         n = b * 1024 if ON_TPU else b * 64
         measure(f"{label} b={b}", fn, n)
+
+TRACER.flush_ledger("profile_xent", extra={"h": H, "v": V})

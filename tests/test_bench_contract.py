@@ -14,13 +14,16 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.mark.slow
-def test_bench_smoke_json_contract():
+def test_bench_smoke_json_contract(tmp_path):
     # one attempt with a sub-test-timeout budget: bench's own timeout
     # path then fires first on a slow box, yielding a deterministic
     # error-JSON line instead of subprocess.run SIGKILLing the watchdog
     # (which would bypass its SIGTERM flush and orphan the inner child)
+    ledger_path = str(tmp_path / "ledger.jsonl")
     env = dict(os.environ, APEX_BENCH_SMOKE="1", APEX_BENCH_ATTEMPTS="1",
-               APEX_BENCH_TIMEOUT="420")
+               APEX_BENCH_TIMEOUT="420", APEX_TELEMETRY="1",
+               APEX_TELEMETRY_LEDGER=ledger_path,
+               APEX_TELEMETRY_PATH=str(tmp_path / "metrics.jsonl"))
     env.pop("JAX_PLATFORMS", None)  # smoke_mode forces CPU itself
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
@@ -31,11 +34,29 @@ def test_bench_smoke_json_contract():
     # record) is a contract break even if the last line is well-formed
     assert len(lines) == 1, out.stdout[-2000:]
     rec = json.loads(lines[-1])
-    for field in ("metric", "value", "unit", "vs_baseline", "mfu"):
+    for field in ("metric", "value", "unit", "vs_baseline", "mfu",
+                  "dispatch_overhead_ms", "relay_degraded", "ledger_id"):
         assert field in rec, rec
     assert rec["unit"] == "tokens/s"
     assert rec["value"] > 0, rec
     assert "error" not in rec, rec
+    assert rec["relay_degraded"] is False, rec
+    # the invocation landed in the run ledger, and the printed line
+    # points at exactly that record
+    sys.path.insert(0, REPO)
+    from apex_tpu.telemetry import ledger as tledger
+
+    records = tledger.read_ledger(ledger_path)
+    assert rec["ledger_id"] in {r["id"] for r in records}, records
+    for r in records:
+        assert tledger.validate_record(r) == [], r
+    # the in-step metrics (APEX_TELEMETRY=1) reached the JSONL sink
+    from apex_tpu.telemetry import read_metrics
+
+    rows = read_metrics(str(tmp_path / "metrics.jsonl"))
+    step_rows = [r for r in rows if "loss_scale" in r]
+    assert len(step_rows) >= 3, rows  # smoke runs a 3-iteration scan
+    assert all(r.get("run") == rec["ledger_id"] for r in step_rows)
 
 
 def _fake_rec(value, b16):
@@ -247,8 +268,8 @@ def test_watchdog_cpu_only_box_runs_once(monkeypatch, capsys):
 
 def test_watchdog_lazy_cap_after_timeout(monkeypatch, capsys):
     """A first attempt that rides its entire budget without a JSON line
-    (rc None + fabricated error record — the wedge signature) arms a
-    600s cap for the remaining attempts; completed attempts (healthy or
+    (rc None + fabricated timed_out record — the wedge signature) arms a
+    900s cap for the remaining attempts; completed attempts (healthy or
     degraded, any length) never arm it."""
     sys.path.insert(0, REPO)
     import bench
@@ -259,6 +280,7 @@ def test_watchdog_lazy_cap_after_timeout(monkeypatch, capsys):
         caps.append(timeout_cap)
         rec = {"metric": "gpt2s_train_tokens_per_sec (tpu)", "value": 0,
                "unit": "tokens/s", "vs_baseline": 0, "mfu": None,
+               "timed_out": True, "relay_degraded": True,
                "error": "bench timed out after 1800s"}
         return json.dumps(rec), rec, None   # rc None = timeout path
 
@@ -272,7 +294,7 @@ def test_watchdog_lazy_cap_after_timeout(monkeypatch, capsys):
     rc = bench._watchdog()
     capsys.readouterr()
     assert rc == 1  # error line only: no real measurement
-    assert caps == [None, 600, 600]
+    assert caps == [None, 900, 900]
 
     # a COMPLETED degraded attempt (rc 0) must not arm the cap
     caps.clear()
@@ -287,4 +309,38 @@ def test_watchdog_lazy_cap_after_timeout(monkeypatch, capsys):
     rc = bench._watchdog()
     capsys.readouterr()
     assert rc == 0
+    assert caps == [None, None, None]
+
+
+def test_watchdog_real_error_record_does_not_arm_cap(monkeypatch, capsys):
+    """A REAL error record forwarded after a teardown wedge (rc None,
+    no timed_out stamp — e.g. the calibration-flap line printed before
+    the child wedged) must NOT arm the lazy cap: the attempt completed
+    its measurement; only riding the whole budget with no JSON line is
+    wedge evidence (ADVICE r5 on the old any-rc-None-error condition)."""
+    sys.path.insert(0, REPO)
+    import bench
+
+    caps = []
+
+    def fake_teardown_wedge(state, extra_env=None, timeout_cap=None):
+        caps.append(timeout_cap)
+        rec = {"metric": "gpt2s_train_tokens_per_sec (tpu)", "value": 0,
+               "unit": "tokens/s", "vs_baseline": 0, "mfu": None,
+               "error": "non-positive step time after overhead "
+                        "subtraction (relay flap straddled the "
+                        "calibration); measurement unusable"}
+        # rc None: the child printed the record, then wedged in teardown
+        return json.dumps(rec), rec, None
+
+    monkeypatch.setattr(bench, "_attempt_once", fake_teardown_wedge)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.setenv("APEX_BENCH_ATTEMPTS", "3")
+    monkeypatch.delenv("APEX_BENCH_SMOKE", raising=False)
+    for k in ("APEX_FUSED_LM_HEAD", "APEX_ATTN_IMPL", "APEX_LN_PALLAS",
+              "APEX_REMAT", "APEX_BENCH_BATCH"):
+        monkeypatch.delenv(k, raising=False)
+    rc = bench._watchdog()
+    capsys.readouterr()
+    assert rc == 1  # error line only: no real measurement
     assert caps == [None, None, None]

@@ -1,0 +1,126 @@
+"""tools/check_bench_labels.py — the PERF.md-caption/ledger cross-check
+runs in the tier-1 suite (like tools/check_api_parity.py) and passes on
+the repo's own corrected PERF.md + seeded ledger; a seeded drift
+fixture (the §10 "68–75 ms over an 82.6 ms log" class) must fail."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from apex_tpu.telemetry import ledger
+
+TOOL = os.path.join(REPO, "tools", "check_bench_labels.py")
+
+
+def _run(*args):
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="")  # jax-free tool; keep
+    # the subprocess clear of the sitecustomize axon dial regardless
+    return subprocess.run([sys.executable, TOOL, *args],
+                          capture_output=True, text=True, timeout=120,
+                          env=env)
+
+
+def _seed(tmp_path, overhead_ms=82.6):
+    rec = ledger.make_record(
+        harness="profile_attention", platform="tpu",
+        dispatch_overhead_ms=overhead_ms, k=128,
+        relay={"degraded": False, "kind": None}, knobs={}, git="abc",
+        ts=1000.0)
+    lpath = tmp_path / "ledger.jsonl"
+    lpath.write_text(json.dumps(rec, sort_keys=True) + "\n")
+    return rec, str(lpath)
+
+
+def test_repo_perf_and_ledger_are_clean():
+    """The tier-1 gate: the committed PERF.md + benchmarks/ledger.jsonl
+    pass (the §10 caption now states the cited log's 82.6 ms)."""
+    out = _run("--verbose")
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "OK" in out.stdout
+
+
+def test_seeded_drift_fixture_fails(tmp_path):
+    rec, lpath = _seed(tmp_path)
+    perf = tmp_path / "PERF.md"
+    perf.write_text(
+        "# fixture\n\nAttention rows (dispatch overhead 68–75 ms "
+        f"subtracted; ledger:{rec['id']}):\n\n| a | b |\n")
+    out = _run("--perf", str(perf), "--ledger", lpath)
+    assert out.returncode == 1, out.stdout
+    assert "label drift" in out.stdout
+
+
+def test_matching_caption_passes(tmp_path):
+    rec, lpath = _seed(tmp_path)
+    perf = tmp_path / "PERF.md"
+    perf.write_text(
+        "# fixture\n\nAttention rows (dispatch overhead 82.6 ms "
+        f"subtracted; ledger:{rec['id']}):\n")
+    out = _run("--perf", str(perf), "--ledger", lpath)
+    assert out.returncode == 0, out.stdout
+    # a range caption passes only when it brackets the measured value
+    perf.write_text(
+        "# fixture\n\nrows (dispatch overhead 80–85 ms subtracted; "
+        f"ledger:{rec['id']}):\n")
+    assert _run("--perf", str(perf), "--ledger", lpath).returncode == 0
+
+
+def test_ab_paragraph_with_two_citations_passes(tmp_path):
+    """A comparison paragraph citing TWO records with different
+    overheads is legitimate: each stated overhead must match at least
+    one cited record, not all of them."""
+    rec_a = ledger.make_record("profile_attention", "tpu", 68.3, 128,
+                               git="abc", ts=1000.0, knobs={})
+    rec_b = ledger.make_record("profile_attention", "tpu", 82.6, 128,
+                               git="abc", ts=2000.0, knobs={})
+    lpath = tmp_path / "ledger.jsonl"
+    lpath.write_text("".join(json.dumps(r, sort_keys=True) + "\n"
+                             for r in (rec_a, rec_b)))
+    perf = tmp_path / "PERF.md"
+    perf.write_text(
+        "# fixture\n\npre-fix run (dispatch overhead 68.3 ms; "
+        f"ledger:{rec_a['id']}) vs post-fix (dispatch overhead 82.6 ms; "
+        f"ledger:{rec_b['id']}):\n")
+    out = _run("--perf", str(perf), "--ledger", str(lpath))
+    assert out.returncode == 0, out.stdout
+    # ...but an overhead NEITHER record measured still fails
+    perf.write_text(
+        f"# fixture\n\nrows (dispatch overhead 75.0 ms; "
+        f"ledger:{rec_a['id']} ledger:{rec_b['id']}):\n")
+    out = _run("--perf", str(perf), "--ledger", str(lpath))
+    assert out.returncode == 1 and "label drift" in out.stdout
+
+
+def test_unresolved_citation_fails(tmp_path):
+    _, lpath = _seed(tmp_path)
+    perf = tmp_path / "PERF.md"
+    perf.write_text("# fixture\n\nrows (ledger:lg-ffffffffff):\n")
+    out = _run("--perf", str(perf), "--ledger", lpath)
+    assert out.returncode == 1
+    assert "no ledger record" in out.stdout
+
+
+def test_tampered_record_fails(tmp_path):
+    rec, _ = _seed(tmp_path)
+    tampered = dict(rec, dispatch_overhead_ms=68.0)  # id now stale
+    lpath = tmp_path / "tampered.jsonl"
+    lpath.write_text(json.dumps(tampered, sort_keys=True) + "\n")
+    perf = tmp_path / "PERF.md"
+    perf.write_text("# fixture\n\nno citations here\n")
+    out = _run("--perf", str(perf), "--ledger", str(lpath))
+    assert out.returncode == 1
+    assert "does not match record content" in out.stdout
+
+
+def test_corrupt_ledger_fails(tmp_path):
+    lpath = tmp_path / "ledger.jsonl"
+    lpath.write_text("not json\n")
+    perf = tmp_path / "PERF.md"
+    perf.write_text("# fixture\n")
+    out = _run("--perf", str(perf), "--ledger", str(lpath))
+    assert out.returncode == 1
+    assert "unparseable" in out.stdout

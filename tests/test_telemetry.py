@@ -1,0 +1,531 @@
+"""apex_tpu.telemetry: metrics registry/sink round-trip, the zero-cost
+rule (disabled telemetry leaves the jitted GPT training step's jaxpr
+byte-identical), ledger schema + content-hash ids, and the shared
+Tracer. All CPU-tier (the conftest 8-device CPU mesh), fast."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from apex_tpu import telemetry
+from apex_tpu.telemetry import ledger, metrics
+from apex_tpu.telemetry.tracing import Tracer
+
+
+@pytest.fixture(autouse=True)
+def _reset_telemetry():
+    telemetry.reset_enabled()
+    yield
+    telemetry.reset_enabled()
+
+
+# --------------------------------------------------------------------------
+# metrics registry + sink
+
+
+def test_registry_round_trip(tmp_path):
+    spec = metrics.register("test_custom_metric", unit="ms",
+                            description="round-trip fixture")
+    assert metrics.spec("test_custom_metric") == spec
+    # idempotent for the identical spec, ValueError on a conflicting one
+    assert metrics.register("test_custom_metric", unit="ms",
+                            description="round-trip fixture") == spec
+    with pytest.raises(ValueError):
+        metrics.register("test_custom_metric", unit="s")
+
+    path = str(tmp_path / "metrics.jsonl")
+    writer = metrics.MetricsWriter(path)
+    n = writer.append_steps(
+        {"loss": np.asarray([3.0, 2.5, 2.0]),
+         "loss_scale": np.asarray([65536.0, 65536.0, 65536.0]),
+         "test_custom_metric": np.float32(1.5)},  # scalar broadcasts
+        run="lg-0000000000")
+    assert n == 3
+    writer.append({"run": "lg-0000000000", "tokens_per_sec": 123.4})
+    rows = metrics.read_metrics(path)
+    assert len(rows) == 4
+    assert [r["loss"] for r in rows[:3]] == [3.0, 2.5, 2.0]
+    assert all(r["test_custom_metric"] == 1.5 for r in rows[:3])
+    assert all(r["run"] == "lg-0000000000" for r in rows)
+    assert rows[3]["tokens_per_sec"] == 123.4
+
+
+def test_writer_strict_mode(tmp_path):
+    writer = metrics.MetricsWriter(str(tmp_path / "m.jsonl"), strict=True)
+    with pytest.raises(KeyError):
+        writer.append_steps({"never_registered_xyz": np.asarray([1.0])})
+    # non-strict auto-registers instead of losing the data
+    lax_writer = metrics.MetricsWriter(str(tmp_path / "m.jsonl"))
+    assert lax_writer.append_steps({"auto_registered_xyz":
+                                    np.asarray([1.0])}) == 1
+    assert metrics.spec("auto_registered_xyz") is not None
+
+
+def test_writer_length_handling(tmp_path):
+    writer = metrics.MetricsWriter(str(tmp_path / "m.jsonl"))
+    # shape-[1] arrays broadcast like scalars (a run-level value riding
+    # alongside [K] step arrays)
+    n = writer.append_steps({"loss": np.asarray([1.0, 2.0]),
+                             "tokens_per_sec": np.asarray([9.0])})
+    assert n == 2
+    rows = metrics.read_metrics(str(tmp_path / "m.jsonl"))
+    assert [r["tokens_per_sec"] for r in rows] == [9.0, 9.0]
+    # genuinely mismatched [k] lengths fail up front, not mid-write
+    with pytest.raises(ValueError, match="mismatched"):
+        writer.append_steps({"a": np.asarray([1.0, 2.0]),
+                             "b": np.asarray([1.0, 2.0, 3.0])})
+
+
+def test_collect_gates_on_enabled():
+    telemetry.disable()
+    assert telemetry.collect(None, a=jnp.float32(1.0)) is None
+    base = {"a": 1}
+    assert telemetry.collect(base, b=2) is base  # untouched passthrough
+    telemetry.enable()
+    out = telemetry.collect(None, a=1.0)
+    assert out == {"a": 1.0}
+    out2 = telemetry.collect(out, b=2.0)
+    assert out2 == {"a": 1.0, "b": 2.0} and out == {"a": 1.0}
+
+
+def test_enabled_env_default(monkeypatch):
+    telemetry.reset_enabled()
+    monkeypatch.delenv("APEX_TELEMETRY", raising=False)
+    assert not telemetry.enabled()
+    monkeypatch.setenv("APEX_TELEMETRY", "1")
+    assert telemetry.enabled()
+    telemetry.disable()  # programmatic override beats the env
+    assert not telemetry.enabled()
+
+
+# --------------------------------------------------------------------------
+# providers
+
+
+def test_scaler_metrics_provider():
+    from apex_tpu.amp.scaler import LossScaler
+
+    scaler = LossScaler()
+    state = scaler.init()
+    m = scaler.metrics(state)
+    assert set(m) == {"loss_scale", "overflow", "unskipped"}
+    assert float(m["loss_scale"]) == 2.0 ** 16
+    assert not bool(m["overflow"])
+
+
+def test_grad_norm_stats_provider():
+    from apex_tpu.optimizers import grad_norm_stats
+
+    grads = {"a": jnp.asarray([3.0, 4.0]), "b": jnp.asarray([[-12.0]])}
+    stats = grad_norm_stats(grads)
+    assert np.isclose(float(stats["grad_norm"]), 13.0)
+    assert float(stats["grad_max"]) == 12.0
+
+
+def test_stateful_optimizer_stashes_grad_stats():
+    from apex_tpu.optimizers import FusedAdam
+
+    params = [jnp.ones((4,)), jnp.ones((2, 2))]
+    grads = [jnp.full((4,), 2.0), jnp.zeros((2, 2))]
+    opt = FusedAdam(params, lr=1e-3)
+    telemetry.disable()
+    opt.step(grads)
+    assert opt.last_grad_stats is None
+    telemetry.enable()
+    opt.step(grads)
+    assert np.isclose(float(opt.last_grad_stats["grad_norm"]), 4.0)
+    assert float(opt.last_grad_stats["grad_max"]) == 2.0
+
+
+# --------------------------------------------------------------------------
+# the zero-cost rule: disabled telemetry never perturbs the measured step
+
+
+class _TinyLM:
+    """Stand-in with GPTModel's apply signature: embed → logits → CE per
+    token. bench.make_one_step's telemetry branch is model-independent,
+    so byte-identity of the step jaxpr proven on this model IS the
+    zero-cost property of the instrumented bench step; the GPTModel
+    variant below re-proves it on the flagship model where the
+    container's jax supports tracing it (the TPU host; this container's
+    jax predates lax.axis_size — the seed's pre-existing skew)."""
+
+    def apply(self, variables, ids, pos, mask, labels):
+        p = variables["params"]
+        h = p["emb"][ids] + p["posemb"][pos]
+        logits = h.astype(jnp.float32) @ p["w"]
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, labels[..., None], -1)[..., 0]
+        return lse - tgt
+
+
+def _bench_fixture(vocab=64, hidden=16, b=2, s=16):
+    from apex_tpu.amp.scaler import LossScaler
+    from apex_tpu.optimizers.fused_adam import fused_adam
+
+    model = _TinyLM()
+    scaler = LossScaler()
+    tx = fused_adam(learning_rate=1e-4)
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, vocab, (b, s)), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None], (b, s))
+    labels = jnp.asarray(rs.randint(0, vocab, (b, s)), jnp.int32)
+    params = {
+        "emb": jnp.asarray(rs.randn(vocab, hidden) * 0.1, jnp.bfloat16),
+        "posemb": jnp.asarray(rs.randn(s, hidden) * 0.1, jnp.bfloat16),
+        "w": jnp.asarray(rs.randn(hidden, vocab) * 0.1, jnp.float32),
+    }
+    return model, scaler, tx, params, tx.init(params), scaler.init(), \
+        ids, pos, labels
+
+
+def _reference_step_fn(model, scaler, tx):
+    """Frozen copy of the pre-telemetry (HEAD) bench.py step body — the
+    uninstrumented program every pinned measurement ran."""
+
+    def reference_step(params, opt_state, scaler_state, ids, pos, labels):
+        def loss_fn(p):
+            per_tok = model.apply({"params": p}, ids, pos, None, labels)
+            return jnp.mean(per_tok) * scaler_state.loss_scale
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        grads, found_inf = scaler.unscale(grads, scaler_state)
+        new_scaler_state = scaler.update(scaler_state, found_inf)
+        updates, new_opt_state = tx.update(grads, opt_state, params)
+        new_params = jax.tree_util.tree_map(
+            lambda p, u: jnp.where(found_inf, p, p + u.astype(p.dtype)),
+            params, updates)
+        new_opt_state = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(found_inf, old, new),
+            new_opt_state, opt_state)
+        return (new_params, new_opt_state, new_scaler_state,
+                loss / scaler_state.loss_scale)
+
+    return reference_step
+
+
+def test_disabled_telemetry_jaxpr_is_byte_identical():
+    """The acceptance gate: with telemetry disabled, bench.py's
+    instrumented training step traces to a jaxpr byte-identical to the
+    uninstrumented (pre-telemetry HEAD) step — observability adds zero
+    cost to pinned measurements."""
+    import bench
+
+    (model, scaler, tx, params, opt_state, scaler_state,
+     ids, pos, labels) = _bench_fixture()
+    reference_step = _reference_step_fn(model, scaler, tx)
+
+    args = (params, opt_state, scaler_state, ids, pos, labels)
+    telemetry.disable()
+    one_step = bench.make_one_step(model, scaler, tx)
+    got = str(jax.make_jaxpr(one_step)(*args))
+    want = str(jax.make_jaxpr(reference_step)(*args))
+    assert got == want, "disabled telemetry changed the step's jaxpr"
+
+    # sanity that the instrumentation exists at all: enabled-mode aux
+    # outputs (loss_scale/overflow/grad_norm/...) change the trace.
+    # NB a FRESH closure: jax caches traces per function object, so
+    # re-tracing the same one_step would return the disabled jaxpr.
+    telemetry.enable()
+    one_step = bench.make_one_step(model, scaler, tx)
+    enabled_jaxpr = str(jax.make_jaxpr(one_step)(*args))
+    assert enabled_jaxpr != want
+    _, _, _, _, aux = one_step(*args)
+    assert aux is not None and {"loss", "loss_scale", "overflow",
+                                "grad_norm"} <= set(aux)
+
+
+def test_disabled_telemetry_jaxpr_gpt_model():
+    """The same byte-identity on the flagship GPTModel step bench.py
+    actually measures. The model needs a bound tensor-parallel axis
+    (shard_map) to trace; where this container's jax predates the APIs
+    the model uses (the seed's pre-existing version skew), skip — the
+    _TinyLM variant above still pins the mechanism."""
+    import bench
+    from apex_tpu.amp.scaler import LossScaler
+    from apex_tpu.optimizers.fused_adam import fused_adam
+
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is None:
+        pytest.skip("jax.shard_map unavailable in this container "
+                    "(pre-existing skew)")
+    from jax.sharding import Mesh, PartitionSpec as P
+    from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+    from apex_tpu.transformer.testing import GPTModel, TransformerConfig
+
+    cfg = TransformerConfig(
+        hidden_size=32, num_layers=1, num_attention_heads=4,
+        vocab_size=64, max_position_embeddings=16,
+        hidden_dropout=0.0, attention_dropout=0.0, bf16=True)
+    model = GPTModel(cfg)
+    scaler = LossScaler()
+    tx = fused_adam(learning_rate=1e-4)
+    b, s = 2, 16
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (b, s)), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None], (b, s))
+    labels = jnp.asarray(rs.randint(0, cfg.vocab_size, (b, s)), jnp.int32)
+    mesh = Mesh(np.asarray(jax.devices()[:1]), (TENSOR_AXIS,))
+
+    def shmap(f, n):
+        return shard_map(f, mesh=mesh, in_specs=(P(),) * n, out_specs=P(),
+                         check_vma=False)
+
+    try:
+        params = jax.jit(shmap(
+            lambda i, p: model.init(jax.random.PRNGKey(0), i, p,
+                                    None)["params"], 2))(ids, pos)
+    except (AttributeError, TypeError) as e:
+        pytest.skip(f"container jax cannot trace GPTModel: {e}")
+    opt_state = tx.init(params)
+    args = (params, opt_state, scaler.init(), ids, pos, labels)
+
+    telemetry.disable()
+    got = str(jax.make_jaxpr(
+        shmap(bench.make_one_step(model, scaler, tx), 6))(*args))
+    want = str(jax.make_jaxpr(
+        shmap(_reference_step_fn(model, scaler, tx), 6))(*args))
+    assert got == want, "disabled telemetry changed the GPT step's jaxpr"
+
+
+def test_aux_stacks_through_scan_and_flushes(tmp_path):
+    """The bench.py main() protocol minus the shard_map wrapper: the
+    enabled step's aux scalars stack across the K-iteration training
+    scan, fetch as [K] arrays, and flush to the metrics sink one row
+    per step."""
+    import bench
+    from jax import lax
+
+    (model, scaler, tx, params, opt_state, scaler_state,
+     ids, pos, labels) = _bench_fixture()
+    telemetry.enable()
+    one_step = bench.make_one_step(model, scaler, tx)
+    iters = 3
+
+    def run(params, opt_state, scaler_state, eps, ids, pos, labels):
+        def body(carry, _):
+            p, o, ss = carry
+            p, o, ss, loss, aux = one_step(p, o, ss, ids, pos, labels)
+            return (p, o, ss), (loss, aux)
+
+        (params, opt_state, scaler_state), (losses, aux) = lax.scan(
+            body, (params, opt_state, scaler_state), jnp.arange(iters))
+        return params, opt_state, scaler_state, losses + eps, aux
+
+    out = jax.jit(run)(params, opt_state, scaler_state, jnp.float32(0.0),
+                       ids, pos, labels)
+    aux = out[4]
+    assert {"loss", "loss_scale", "overflow", "grad_norm"} <= set(aux)
+    assert all(np.asarray(v).shape == (iters,) for v in aux.values())
+    np.testing.assert_allclose(np.asarray(aux["loss"]),
+                               np.asarray(out[3]), rtol=1e-5)
+    assert float(aux["grad_norm"][0]) > 0
+
+    writer = metrics.MetricsWriter(str(tmp_path / "m.jsonl"))
+    n = writer.append_steps({k: np.asarray(v) for k, v in aux.items()},
+                            run="lg-0000000000")
+    assert n == iters
+    rows = metrics.read_metrics(str(tmp_path / "m.jsonl"))
+    assert [r["step"] for r in rows] == [0, 1, 2]
+    assert rows[0]["loss_scale"] == 2.0 ** 16
+
+    # disabled: the same scan carries no aux at all (fresh closures —
+    # jax caches traces per function object)
+    telemetry.disable()
+    one_step = bench.make_one_step(model, scaler, tx)
+
+    def run_disabled(params, opt_state, scaler_state, eps, ids, pos,
+                     labels):
+        def body(carry, _):
+            p, o, ss = carry
+            p, o, ss, loss, aux = one_step(p, o, ss, ids, pos, labels)
+            return (p, o, ss), (loss, aux)
+
+        (params, opt_state, scaler_state), (losses, aux) = lax.scan(
+            body, (params, opt_state, scaler_state), jnp.arange(iters))
+        return params, opt_state, scaler_state, losses + eps, aux
+
+    out = jax.jit(run_disabled)(params, opt_state, scaler_state,
+                                jnp.float32(0.0), ids, pos, labels)
+    assert out[4] is None
+
+
+def test_disabled_aux_is_empty_pytree():
+    """aux=None contributes no outputs: scan/jit treat the 5-tuple step
+    exactly like the old 4-tuple one."""
+    import bench
+
+    (model, scaler, tx, params, opt_state, scaler_state,
+     ids, pos, labels) = _bench_fixture()
+    telemetry.disable()
+    one_step = bench.make_one_step(model, scaler, tx)
+    out = one_step(params, opt_state, scaler_state, ids, pos, labels)
+    assert out[4] is None
+    assert jax.tree_util.tree_leaves(out[4]) == []
+
+
+# --------------------------------------------------------------------------
+# ledger
+
+
+def test_ledger_record_schema_and_content_id(tmp_path):
+    rec = ledger.make_record(
+        harness="unit", platform="cpu", dispatch_overhead_ms=1.5, k=8,
+        relay={"degraded": False, "kind": None}, knobs={"APEX_X": "1"},
+        git="deadbeef", ts=1234.0)
+    assert ledger.validate_record(rec) == []
+    assert rec["id"].startswith("lg-") and len(rec["id"]) == 13
+    # content-hash id: edits after the fact are detectable
+    tampered = dict(rec, dispatch_overhead_ms=68.0)
+    assert any("does not match record content" in p
+               for p in ledger.validate_record(tampered))
+
+    path = str(tmp_path / "ledger.jsonl")
+    rid = ledger.append_record(
+        harness="unit", platform="cpu", dispatch_overhead_ms=1.5, k=8,
+        path=path)
+    records = ledger.read_ledger(path)
+    assert [r["id"] for r in records] == [rid]
+    assert ledger.validate_record(records[0]) == []
+    # missing required fields are findings
+    assert any("missing field" in p
+               for p in ledger.validate_record({"id": "lg-0"}))
+
+
+def test_ledger_knob_pins():
+    pins = ledger.knob_pins({"APEX_ATTN_IMPL": "rows", "PATH": "/bin",
+                             "APEX_BENCH_K": "128"})
+    assert pins == {"APEX_ATTN_IMPL": "rows", "APEX_BENCH_K": "128"}
+
+
+def test_ledger_smoke_skip(tmp_path, monkeypatch):
+    # smoke-mode runs don't pollute the measurement ledger by default...
+    monkeypatch.setenv("APEX_BENCH_SMOKE", "1")
+    monkeypatch.delenv("APEX_TELEMETRY_LEDGER", raising=False)
+    assert ledger.append_record("unit", "cpu", 1.0, 2) is None
+    # ...but an explicit APEX_TELEMETRY_LEDGER is honored verbatim
+    path = str(tmp_path / "l.jsonl")
+    monkeypatch.setenv("APEX_TELEMETRY_LEDGER", path)
+    rid = ledger.append_record("unit", "cpu", 1.0, 2)
+    assert rid is not None and ledger.read_ledger(path)[0]["id"] == rid
+
+
+def test_ledger_write_never_raises(monkeypatch):
+    # a read-only checkout must not break the bench contract
+    assert ledger.append_record(
+        "unit", "cpu", 1.0, 2, path="/nonexistent-dir/l.jsonl") is None
+
+
+def test_read_ledger_reports_corrupt_line(tmp_path):
+    path = tmp_path / "l.jsonl"
+    path.write_text('{"ok": 1}\nnot json\n')
+    with pytest.raises(ValueError, match="2"):
+        ledger.read_ledger(str(path))
+
+
+# --------------------------------------------------------------------------
+# tracer
+
+
+def test_tracer_scan_time_and_ledger(tmp_path, monkeypatch):
+    monkeypatch.delenv("APEX_BENCH_SMOKE", raising=False)
+    tracer = Tracer(k=4, overhead=0.0, peak_flops=1e12)
+
+    def make_body(eps, x):
+        def body(carry, _):
+            carry = carry + eps * jnp.sum(x)
+            return carry, carry
+        return body
+
+    span = tracer.scan_time("unit-row", make_body, jnp.float32(0.0),
+                            (jnp.ones((8,)),), flops_per_iter=16.0,
+                            extra={"case": "unit"})
+    assert span.seconds is not None and span.seconds > 0
+    assert span.k == 4 and span.overhead_s == 0.0
+    rec = span.as_record()
+    assert rec["method"] == "scan-chain" and rec["case"] == "unit"
+    assert "ms" in span.format_row(1e12)
+
+    # wrap= is applied around the run function before jit
+    wrapped = []
+    tracer.scan_time("wrapped-row", make_body, jnp.float32(0.0),
+                     (jnp.ones((4,)),),
+                     wrap=lambda run: wrapped.append(run) or run)
+    assert len(wrapped) == 1
+
+    path = str(tmp_path / "ledger.jsonl")
+    rid = tracer.flush_ledger("unit_harness", path=path)
+    records = ledger.read_ledger(path)
+    assert records[0]["id"] == rid
+    assert records[0]["harness"] == "unit_harness"
+    assert records[0]["platform"] == "cpu"
+    assert [s["name"] for s in records[0]["spans"]] == ["unit-row",
+                                                        "wrapped-row"]
+    assert ledger.validate_record(records[0]) == []
+
+
+def test_tracer_on_fail_span():
+    tracer = Tracer(k=2, overhead=0.0)
+
+    def boom(*args):
+        raise RuntimeError("kernel does not lower")
+
+    span = tracer.time_call("bad-row", boom, (1,), (2,), on_fail="span")
+    assert span.seconds is None and "kernel does not lower" in span.error
+    assert span.as_record()["error"]
+    assert "FAILED" in span.format_row()
+    with pytest.raises(RuntimeError):
+        tracer.time_call("bad-row", boom, (1,), (2,))
+
+
+def test_timing_reexports():
+    # benchmarks/_timing.py stays the documented import surface
+    from benchmarks import _timing
+
+    assert _timing.Tracer is Tracer
+    assert callable(_timing.sync)
+    assert callable(_timing.measure_dispatch_overhead)
+    assert _timing.bench_k(True) == 2
+
+
+def test_bench_json_fields_in_fabricated_timeout_record():
+    """The watchdog's fabricated timeout record carries the structured
+    timed_out/relay_degraded stamps the lazy cap and the driver key on."""
+    import bench
+    import subprocess
+
+    class FakeProc:
+        returncode = None
+
+        def communicate(self, timeout=None):
+            if timeout is not None and not getattr(self, "_killed", False):
+                raise subprocess.TimeoutExpired("bench", timeout)
+            return "", None
+
+        def terminate(self):
+            self._killed = True
+
+        def kill(self):
+            self._killed = True
+
+    state = {"child": None}
+    orig = subprocess.Popen
+    subprocess.Popen = lambda *a, **kw: FakeProc()
+    os.environ["APEX_BENCH_TIMEOUT"] = "1"
+    try:
+        line, rec, rc = bench._attempt_once(state)
+    finally:
+        subprocess.Popen = orig
+        del os.environ["APEX_BENCH_TIMEOUT"]
+    assert rc is None
+    assert rec["timed_out"] is True and rec["relay_degraded"] is True
+    assert "error" in rec and json.loads(line) == rec

@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""Cross-check PERF.md bench-table captions against benchmarks/ledger.jsonl.
+
+The repo's measurement rule is "pin the label to what was measured"
+(CLAUDE.md); rounds 1-2 shipped wrong headline numbers and the round-5
+§10 caption said "dispatch overhead 68-75 ms" over a log that recorded
+82.6 ms — label drift that only a prose audit caught. This tool makes
+that class of drift mechanical. It runs in the tier-1 suite
+(tests/test_bench_labels.py), like tools/check_api_parity.py.
+
+Checks:
+
+1. **Ledger schema** — every ledger line parses; every record carries
+   the required fields (apex_tpu.telemetry.ledger.REQUIRED_FIELDS);
+   ids are unique AND match their record's content hash (an id is a
+   sha1 over the canonical record, so a record edited after the fact
+   no longer matches its own id).
+2. **Caption cross-check** — every ``ledger:<id>`` citation in PERF.md
+   must resolve to a ledger record, and any "dispatch overhead X ms"
+   (or "X-Y ms" range) stated in the citing paragraph must agree with
+   AT LEAST ONE cited record's ``dispatch_overhead_ms``: a single value
+   within ±0.15 ms (captions round to 0.1), a range must bracket it.
+   (At-least-one, not all: an A/B paragraph legitimately cites two
+   records with two different overheads.)
+
+New PERF.md table rows must cite their ledger record id in the caption
+(``ledger:<id>``) — uncited legacy paragraphs are not flagged, but they
+get no drift protection either.
+
+Usage: python tools/check_bench_labels.py [--perf PATH] [--ledger PATH]
+                                          [--verbose]
+Exit status: 0 when clean, 1 on any finding.
+"""
+
+import argparse
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from apex_tpu.telemetry import ledger as ledger_mod  # noqa: E402
+
+CITE_RE = re.compile(r"ledger:(lg-[0-9a-f]{10})")
+# "dispatch overhead 82.6 ms" / "dispatch overhead 68-75 ms subtracted";
+# both hyphen and en-dash spell the (drift-prone) range form
+OVERHEAD_RE = re.compile(
+    r"dispatch overhead\s+([0-9]+(?:\.[0-9]+)?)"
+    r"(?:\s*[–-]\s*([0-9]+(?:\.[0-9]+)?))?\s*ms")
+TOL_MS = 0.15  # captions round to 0.1 ms
+
+
+def _paragraphs(text):
+    """(start_lineno, paragraph_text) blocks of consecutive non-blank
+    lines — the unit a caption and its numbers share."""
+    out, block, start = [], [], None
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if line.strip():
+            if not block:
+                start = lineno
+            block.append(line)
+        elif block:
+            out.append((start, "\n".join(block)))
+            block = []
+    if block:
+        out.append((start, "\n".join(block)))
+    return out
+
+
+def check_ledger(records):
+    problems = []
+    seen = {}
+    for i, rec in enumerate(records, 1):
+        for p in ledger_mod.validate_record(rec):
+            problems.append(f"ledger record {i} ({rec.get('id', '?')}): {p}")
+        rid = rec.get("id")
+        if rid is not None:
+            if rid in seen:
+                problems.append(
+                    f"ledger record {i}: duplicate id {rid!r} "
+                    f"(first at record {seen[rid]})")
+            else:
+                seen[rid] = i
+    return problems
+
+
+def check_captions(perf_text, perf_path, records):
+    by_id = {r.get("id"): r for r in records}
+    problems = []
+    cited = 0
+    for lineno, para in _paragraphs(perf_text):
+        ids = CITE_RE.findall(para)
+        if not ids:
+            continue
+        cited += len(ids)
+        overheads = {}  # rid -> measured dispatch_overhead_ms
+        for rid in ids:
+            rec = by_id.get(rid)
+            if rec is None:
+                problems.append(
+                    f"{perf_path}:{lineno}: citation ledger:{rid} has no "
+                    f"ledger record")
+            elif rec.get("dispatch_overhead_ms") is not None:
+                overheads[rid] = rec["dispatch_overhead_ms"]
+        if not overheads:
+            continue
+        # a stated overhead must match AT LEAST ONE cited record — an
+        # A/B paragraph cites two records with two different overheads,
+        # and each stated number belongs to one of them
+        for m in OVERHEAD_RE.finditer(para):
+            lo = float(m.group(1))
+            hi = float(m.group(2)) if m.group(2) else None
+            if hi is None:
+                ok = any(abs(lo - want) <= TOL_MS
+                         for want in overheads.values())
+                stated = f"{lo:g} ms"
+            else:
+                ok = any(lo - TOL_MS <= want <= hi + TOL_MS
+                         for want in overheads.values())
+                stated = f"{lo:g}-{hi:g} ms"
+            if not ok:
+                measured = ", ".join(f"{rid}: {want:g} ms"
+                                     for rid, want in overheads.items())
+                problems.append(
+                    f"{perf_path}:{lineno}: caption states dispatch "
+                    f"overhead {stated} but no cited record measured "
+                    f"that ({measured}) — label drift")
+    return problems, cited
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--perf", default=os.path.join(REPO, "PERF.md"))
+    ap.add_argument("--ledger",
+                    default=os.path.join(REPO, "benchmarks", "ledger.jsonl"))
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    try:
+        records = ledger_mod.read_ledger(args.ledger)
+    except FileNotFoundError:
+        print(f"FAIL: ledger {args.ledger} does not exist")
+        return 1
+    except ValueError as e:
+        print(f"FAIL: {e}")
+        return 1
+    problems = check_ledger(records)
+
+    with open(args.perf) as f:
+        perf_text = f.read()
+    cap_problems, cited = check_captions(perf_text, args.perf, records)
+    problems += cap_problems
+
+    if args.verbose:
+        print(f"{len(records)} ledger records; {cited} PERF.md citations "
+              f"checked")
+    if problems:
+        for p in problems:
+            print(f"DRIFT: {p}")
+        print(f"FAIL: {len(problems)} problem(s)")
+        return 1
+    print("OK: ledger schema valid, no caption drift")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
