@@ -1,0 +1,66 @@
+"""JAX version-compat shims.
+
+This tree targets the current ``jax.shard_map(f, mesh=..., in_specs=...,
+out_specs=..., check_vma=...)`` surface. On older jax (e.g. 0.4.x) that
+API lives at ``jax.experimental.shard_map.shard_map`` with the
+replication-check kwarg still named ``check_rep``; without a shim every
+sharded code path — including ``bench.py`` and the 8-virtual-device test
+mesh — fails with ``AttributeError: module 'jax' has no attribute
+'shard_map'`` before running anything. :func:`install` bridges exactly
+that gap and is a no-op wherever ``jax.shard_map`` already exists (the
+shim never shadows a real implementation).
+"""
+
+import functools
+
+import jax
+
+
+def install():
+    """Idempotently install the handful of current-jax surfaces this
+    tree uses that an older jax spells differently. Each shim installs
+    only when the real attribute is missing — never shadows one."""
+    _install_shard_map()
+    _install_axis_size()
+
+
+def _install_shard_map():
+    if hasattr(jax, "shard_map"):
+        return
+    try:
+        from jax.experimental.shard_map import shard_map as _shard_map
+    except ImportError:  # neither surface: let call sites raise honestly
+        return
+
+    @functools.wraps(_shard_map)
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  check_vma=None, **kw):
+        if check_vma is not None and "check_rep" not in kw:
+            kw["check_rep"] = check_vma  # old name of the same knob
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kw)
+
+    jax.shard_map = shard_map
+
+
+def _install_axis_size():
+    # lax.axis_size(name): the STATIC size of a mapped axis. On old jax
+    # the same lookup lives on the trace-time axis env (a psum(1, name)
+    # would be traced, breaking static uses like shape arithmetic).
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return
+
+    def axis_size(axis_name):
+        from jax._src import core
+
+        env = core.get_axis_env()
+        if isinstance(axis_name, (tuple, list)):
+            size = 1
+            for name in axis_name:
+                size *= env.axis_size(name)
+            return size
+        return env.axis_size(axis_name)
+
+    lax.axis_size = axis_size

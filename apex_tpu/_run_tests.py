@@ -38,7 +38,8 @@ SUITES = {
             "test_attention_pallas.py", "test_xent_pallas.py",
             "test_mosaic_block_rules.py"],
     "api_parity": ["test_api_parity_round3.py"],
-    "harness": ["test_run_tests.py", "test_bench_contract.py"],
+    "harness": ["test_run_tests.py", "test_bench_contract.py",
+                "test_compile_cache.py"],
     "telemetry": ["test_telemetry.py", "test_bench_labels.py"],
     "checkpoint": ["test_checkpoint.py"],
     "data": ["test_data.py"],

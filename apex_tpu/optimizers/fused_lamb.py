@@ -6,11 +6,31 @@ the two-phase csrc/multi_tensor_l2norm_kernel.cu global-norm pass at
 fused_lamb.py:124-137). TPU design: per-leaf fp32 state — the per-tensor
 trust ratios are plain per-leaf norm reductions, and the global grad norm
 is a sum of per-leaf sums; both fuse under jit with no concat/slice of the
-whole parameter state (the flat-buffer layout measured ~2x slower on TPU —
-PERF.md §2; the flat substrate remains for the ZeRO-sharded variants where
-a flat buffer IS the shard layout).
+whole parameter state (the flat-buffer layout measured ~2x slower on TPU
+for Adam — PERF.md §2; the flat substrate remains for the ZeRO-sharded
+variants where a flat buffer IS the shard layout).
+
+``impl=`` selects the compute structure (state layout is identical —
+per-leaf fp32 m/v either way, so the knob is freely A/B-able mid-run):
+
+* ``"two_pass"`` (default, the measured seat): the per-leaf structure
+  above — phase 1 global norm, phase 2 per-leaf update loop.
+* ``"one_pass"``: a single flat-buffer sweep — all leaves concatenated
+  once, per-tensor norms via ONE ``segment_sum`` pass over the flat
+  buffer (the ``multi_tensor_lamb.cu`` stage-2 shape), every moment/
+  trust-ratio/update computed on the flat vector. Queued device A/B:
+  LAMB sits at 54.9% of its HBM floor vs Adam's 81.9% (PERF.md §10b) —
+  the per-leaf loop's many small reductions are the suspect; the flat
+  sweep replaces them with one segmented reduction. Per the
+  measured-dispatch rule the default does NOT flip until the
+  ``profile_optimizers.py`` A/B row lands on device (PERF.md §2).
+
+``APEX_LAMB_IMPL={two_pass|one_pass}`` is the process-wide preference
+(harness A/B knob); the explicit ``impl=`` argument wins and raises on an
+unknown value (explicit request ≠ preference).
 """
 
+import os
 from typing import Any, NamedTuple
 
 import jax
@@ -19,6 +39,8 @@ import optax
 
 from apex_tpu.optimizers._base import FusedOptimizerBase
 
+_IMPLS = ("two_pass", "one_pass")
+
 
 class FusedLAMBState(NamedTuple):
     count: jnp.ndarray
@@ -26,10 +48,26 @@ class FusedLAMBState(NamedTuple):
     v: Any
 
 
+def _resolve_impl(impl):
+    if impl is not None:
+        if impl not in _IMPLS:
+            raise ValueError(
+                f"fused_lamb impl={impl!r}: want one of {_IMPLS}")
+        return impl
+    env = os.environ.get("APEX_LAMB_IMPL")
+    if env in _IMPLS:
+        return env
+    if env:
+        raise ValueError(f"APEX_LAMB_IMPL={env!r}: want one of {_IMPLS}")
+    return "two_pass"
+
+
 def fused_lamb(learning_rate=1e-3, betas=(0.9, 0.999), eps=1e-6,
                weight_decay=0.01, bias_correction=True, adam_w_mode=True,
-               grad_averaging=True, max_grad_norm=1.0, use_nvlamb=False):
+               grad_averaging=True, max_grad_norm=1.0, use_nvlamb=False,
+               impl=None):
     beta1, beta2 = betas
+    impl = _resolve_impl(impl)
 
     def init(params):
         def zeros(p):
@@ -41,18 +79,19 @@ def fused_lamb(learning_rate=1e-3, betas=(0.9, 0.999), eps=1e-6,
             v=jax.tree_util.tree_map(zeros, params),
         )
 
-    def update(grads, state, params=None):
-        assert params is not None
-        leaves_g, treedef = jax.tree_util.tree_flatten(grads)
-        leaves_p = jax.tree_util.tree_leaves(params)
-        leaves_m = jax.tree_util.tree_leaves(state.m)
-        leaves_v = jax.tree_util.tree_leaves(state.v)
-        count = state.count + 1
+    def _hyper(count):
         t = count.astype(jnp.float32)
         lr = learning_rate(count) if callable(learning_rate) else learning_rate
+        beta3 = 1.0 - beta1 if grad_averaging else 1.0
+        if bias_correction:
+            bc1 = 1.0 - beta1 ** t
+            bc2 = 1.0 - beta2 ** t
+        else:
+            bc1 = bc2 = 1.0
+        return lr, beta3, bc1, bc2
 
-        gs = [g.astype(jnp.float32) for g in leaves_g]
-        ps = [p.astype(jnp.float32) for p in leaves_p]
+    def update_two_pass(gs, ps, leaves_m, leaves_v, leaves_g, count):
+        lr, beta3, bc1, bc2 = _hyper(count)
 
         # phase 1: fused global grad norm (multi_tensor_l2norm analog,
         # fused_lamb.py:124-137)
@@ -64,13 +103,6 @@ def fused_lamb(learning_rate=1e-3, betas=(0.9, 0.999), eps=1e-6,
         # phase 2: multi_tensor_lamb. MOMENT_MODE_0 (adam_w_mode=False, L2)
         # folds decay*p into the gradient before the moments; MODE_1 (adamw)
         # adds decay*p after the moment ratio (multi_tensor_lamb.cu:123-142).
-        beta3 = 1.0 - beta1 if grad_averaging else 1.0
-        if bias_correction:
-            bc1 = 1.0 - beta1 ** t
-            bc2 = 1.0 - beta2 ** t
-        else:
-            bc1 = bc2 = 1.0
-
         us, ms, vs = [], [], []
         for g, p, m, v, gl in zip(gs, ps, leaves_m, leaves_v, leaves_g):
             g_eff = g if adam_w_mode else g + weight_decay * p
@@ -90,6 +122,64 @@ def fused_lamb(learning_rate=1e-3, betas=(0.9, 0.999), eps=1e-6,
             us.append((-lr * ratio * upd).astype(gl.dtype))
             ms.append(m)
             vs.append(v)
+        return us, ms, vs
+
+    def update_one_pass(gs, ps, leaves_m, leaves_v, leaves_g, count):
+        # single flat-buffer sweep: one concat, per-tensor reductions as
+        # ONE segment_sum over the flat vector (multi_tensor_lamb.cu
+        # stage-2 analog on the optimizers._fused substrate)
+        from apex_tpu.optimizers._fused import get_meta
+
+        lr, beta3, bc1, bc2 = _hyper(count)
+        meta = get_meta(ps)
+        g_flat = meta.flatten(gs)
+        p_flat = meta.flatten(ps)
+        m_flat = meta.flatten(leaves_m)
+        v_flat = meta.flatten(leaves_v)
+
+        global_sq = jnp.sum(g_flat * g_flat)
+        if max_grad_norm is not None and max_grad_norm > 0:
+            clip = jnp.maximum(jnp.sqrt(global_sq) / max_grad_norm, 1.0)
+            g_flat = g_flat / clip
+
+        g_eff = g_flat if adam_w_mode else g_flat + weight_decay * p_flat
+        m_flat = beta1 * m_flat + beta3 * g_eff
+        v_flat = beta2 * v_flat + (1.0 - beta2) * g_eff * g_eff
+        upd = (m_flat / bc1) / (jnp.sqrt(v_flat / bc2) + eps)
+        if adam_w_mode:
+            upd = upd + weight_decay * p_flat
+
+        # per-tensor trust ratios: ONE segmented reduction per operand
+        w_sq = meta.per_tensor_sq_norms(p_flat)
+        u_sq = meta.per_tensor_sq_norms(upd)
+        w_norm = jnp.sqrt(w_sq)
+        u_norm = jnp.sqrt(u_sq)
+        ratio = jnp.where((w_norm > 0) & (u_norm > 0),
+                          w_norm / (u_norm + 1e-38), 1.0)
+        if weight_decay == 0.0 and not use_nvlamb:
+            ratio = jnp.ones_like(ratio)
+
+        u_flat = -lr * meta.broadcast_per_tensor(ratio) * upd
+        us = [u.astype(gl.dtype)
+              for u, gl in zip(meta.unflatten(
+                  u_flat, [jnp.float32] * meta.num_tensors), leaves_g)]
+        ms = meta.unflatten(m_flat, [jnp.float32] * meta.num_tensors)
+        vs = meta.unflatten(v_flat, [jnp.float32] * meta.num_tensors)
+        return us, ms, vs
+
+    def update(grads, state, params=None):
+        assert params is not None
+        leaves_g, treedef = jax.tree_util.tree_flatten(grads)
+        leaves_p = jax.tree_util.tree_leaves(params)
+        leaves_m = jax.tree_util.tree_leaves(state.m)
+        leaves_v = jax.tree_util.tree_leaves(state.v)
+        count = state.count + 1
+
+        gs = [g.astype(jnp.float32) for g in leaves_g]
+        ps = [p.astype(jnp.float32) for p in leaves_p]
+
+        fn = update_one_pass if impl == "one_pass" else update_two_pass
+        us, ms, vs = fn(gs, ps, leaves_m, leaves_v, leaves_g, count)
 
         def unflat(xs):
             return jax.tree_util.tree_unflatten(treedef, xs)

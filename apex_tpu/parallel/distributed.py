@@ -40,7 +40,11 @@ def pvary(x, axis_name):
     jax spelling (lax.pcast, with fallback to the older lax.pvary)."""
     if hasattr(jax.lax, "pcast"):
         return jax.lax.pcast(x, axis_name, to="varying")
-    return jax.lax.pvary(x, axis_name)
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, axis_name)
+    # pre-pvary jax has no replication typing to satisfy (shard_map runs
+    # with the replication check off throughout this tree) — identity
+    return x
 
 
 def allreduce_gradients(grads, axis_name="data", gradient_average=True,

@@ -151,6 +151,30 @@ def validate_record(rec):
     if "k" in rec and rec["k"] is not None \
             and not isinstance(rec["k"], int):
         problems.append("k is not an int")
+    cc = rec.get("compile_cache")
+    if cc is not None:
+        # the warm-start telemetry block (apex_tpu.compile_cache): a
+        # malformed one could silently claim a number was compile-free
+        if not isinstance(cc, dict):
+            problems.append("compile_cache is not a dict")
+        else:
+            if not isinstance(cc.get("enabled"), bool):
+                problems.append("compile_cache.enabled is not a bool")
+            for field in ("hits", "misses"):
+                v = cc.get(field)
+                if not (isinstance(v, int) and not isinstance(v, bool)
+                        and v >= 0):
+                    problems.append(
+                        f"compile_cache.{field} is not a non-negative int")
+            if cc.get("dir") is not None \
+                    and not isinstance(cc["dir"], str):
+                problems.append("compile_cache.dir is not a string")
+            age = cc.get("warm_age_s")
+            if age is not None and not (isinstance(age, (int, float))
+                                        and not isinstance(age, bool)
+                                        and age >= 0):
+                problems.append(
+                    "compile_cache.warm_age_s is not a non-negative number")
     if "id" in rec and all(f in rec for f in REQUIRED_FIELDS):
         want = record_id(rec)
         if rec["id"] != want:

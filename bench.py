@@ -95,10 +95,66 @@ def make_one_step(model, scaler, tx):
     return one_step
 
 
+def _warm_bench_programs(programs, platform=None):
+    """APEX_WARM_ONLY=1 path: AOT-compile (never run) every program of
+    the scored bench attempt, populating the persistent compile cache
+    (apex_tpu.compile_cache) so the NEXT invocation — the driver-scored
+    run — dispatches cached executables instead of compiling through
+    the relay's remote-compile helper, the component that wedges first
+    (PERF.md §10b). The heavy programs (the K-step scan and its
+    timed-rebind variant) are LOWERED and COMPILED, never executed —
+    but the caller has already RUN the init/opt-init programs to
+    produce the concrete state passed here, because only concrete args
+    reproduce the scored run's cache keys bit-for-bit. So a warm pass
+    does dispatch the (small) init programs through the relay; what it
+    never dispatches is the measured scan. Prints ONE JSON status line
+    (this mode bypasses the watchdog; the measurement contract line is
+    untouched)."""
+    from apex_tpu import compile_cache
+    from apex_tpu import telemetry
+
+    results, compiled_by_name, failed = {}, {}, None
+    for name, spec in programs.items():
+        if callable(spec):
+            # deferred program: built only once an earlier warm's
+            # compiled object exists (the timed-rebind key needs the
+            # step scan's output shardings)
+            try:
+                fn, args = spec(compiled_by_name)
+            except Exception as e:
+                results[name] = {"error":
+                                 f"{type(e).__name__}: {str(e)[:200]}"}
+                failed = name
+                continue
+        else:
+            fn, args = spec
+        try:
+            results[name], compiled_by_name[name] = \
+                compile_cache.warm(fn, args)
+        except Exception as e:  # report, keep warming the rest
+            results[name] = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+            failed = name
+    ledger_id = telemetry.ledger.append_record(
+        harness="bench_warm", platform=platform, dispatch_overhead_ms=None,
+        k=None, extra={"warm": results,
+                       "compile_cache": compile_cache.snapshot()})
+    print(json.dumps({
+        "warm_only": True,
+        "warm": results,
+        "compile_cache": compile_cache.snapshot(),
+        "ledger_id": ledger_id,
+    }), flush=True)
+    return 1 if failed else 0
+
+
 def main():
-    # smoke_mode BEFORE any backend-touching import (_smoke.py contract)
+    # smoke_mode BEFORE any backend-touching import (_smoke.py contract);
+    # it also activates the persistent compile cache (default ON for
+    # real runs, OFF for smoke; APEX_COMPILE_CACHE=1/0 overrides)
     from benchmarks._smoke import smoke_mode
     smoke_mode("APEX_BENCH_SMOKE")  # force-CPU tiny sanity mode
+
+    from apex_tpu import compile_cache
 
     import jax
     import jax.numpy as jnp
@@ -156,9 +212,8 @@ def main():
     tx = fused_adam(learning_rate=1e-4)
 
     rs = np.random.RandomState(0)
-    ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (b, s)), jnp.int32)
-    pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
-    labels = jnp.asarray(rs.randint(0, cfg.vocab_size, (b, s)), jnp.int32)
+    ids_np = rs.randint(0, cfg.vocab_size, (b, s))
+    labels_np = rs.randint(0, cfg.vocab_size, (b, s))
 
     from benchmarks._timing import measure_dispatch_overhead, sync
 
@@ -166,11 +221,10 @@ def main():
         return jax.shard_map(f, mesh=mesh, in_specs=(P(),) * n_in,
                              out_specs=P(), check_vma=False)
 
-    params = jax.jit(shmap(
+    init_fn = jax.jit(shmap(
         lambda ids, pos: model.init(jax.random.PRNGKey(0), ids, pos,
-                                    None)["params"], 2))(ids, pos)
-    opt_state = jax.jit(lambda p: tx.init(p))(params)
-    scaler_state = scaler.init()
+                                    None)["params"], 2))
+    opt_init_fn = jax.jit(lambda p: tx.init(p))
 
     one_step = make_one_step(model, scaler, tx)
 
@@ -198,6 +252,50 @@ def main():
     # the scan (the training-loop aliasing a real deployment would have)
     step = jax.jit(run, donate_argnums=(0, 1, 2))
 
+    ids = jnp.asarray(ids_np, jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
+    labels = jnp.asarray(labels_np, jnp.int32)
+    params = init_fn(ids, pos)
+    opt_state = opt_init_fn(params)
+    scaler_state = scaler.init()
+
+    if compile_cache.warm_only():
+        # AOT warm path: the init/opt-init programs were just compiled
+        # (and therefore cached) by running them above; the state they
+        # produced carries the exact shardings the scored attempt's
+        # arguments will carry, so lowering the remaining programs with
+        # these CONCRETE args reproduces the scored run's cache keys
+        # bit-for-bit (bare ShapeDtypeStruct avals do not — they drop
+        # the arg shardings and the big scan misses). Nothing below is
+        # executed or timed: compile only.
+        from apex_tpu.telemetry.tracing import _overhead_program
+
+        zero = jnp.float32(0.0)
+        step_args = (params, opt_state, scaler_state, zero, ids, pos,
+                     labels)
+
+        def timed_rebind(compiled_by_name):
+            # the TIMED dispatch rebinds the donated state to the first
+            # call's OUTPUTS; on jax versions where output shardings
+            # carry annotations the inputs lack (memory kinds), that is
+            # a distinct cache key — and a cold compile INSIDE the
+            # timed region. Reconstruct it from the warmed scan's
+            # output shardings, no execution needed.
+            compiled = compiled_by_name["step_scan"]
+            out_avals = jax.eval_shape(step, *step_args)
+            out_sds = jax.tree_util.tree_map(
+                lambda aval, sh: jax.ShapeDtypeStruct(
+                    aval.shape, aval.dtype, sharding=sh),
+                out_avals, compiled.output_shardings)
+            return step, (out_sds[0], out_sds[1], out_sds[2], zero,
+                          ids, pos, labels)
+
+        sys.exit(_warm_bench_programs({
+            "dispatch_overhead": (_overhead_program(iters), (zero, zero)),
+            "step_scan": (step, step_args),
+            "step_scan_timed_rebind": timed_rebind,
+        }, platform=platform))
+
     overhead = measure_dispatch_overhead(iters)
 
     # compile + warm + drain (donated inputs: rebind the carried state)
@@ -217,12 +315,14 @@ def main():
 
     def ledger_record(degraded, kind, **extra):
         # every invocation — including an unusable one — lands in the
-        # run ledger; a window's failures are evidence too (§6)
+        # run ledger; a window's failures are evidence too (§6). The
+        # compile_cache block proves whether the number was compile-free.
         return telemetry.ledger.append_record(
             harness="bench", platform=platform,
             dispatch_overhead_ms=round(overhead * 1e3, 1), k=iters,
             relay={"degraded": degraded, "kind": kind},
-            extra=dict({"metric": f"gpt2s_train_tokens_per_sec ({platform})"},
+            extra=dict({"metric": f"gpt2s_train_tokens_per_sec ({platform})",
+                        "compile_cache": compile_cache.snapshot()},
                        **extra))
 
     if dt <= 0:
@@ -234,6 +334,7 @@ def main():
             "value": 0, "unit": "tokens/s", "vs_baseline": 0, "mfu": None,
             "dispatch_overhead_ms": round(overhead * 1e3, 1),
             "relay_degraded": True,
+            "compile_cache": compile_cache.snapshot(),
             "ledger_id": ledger_record(True, "calibration-flap", value=0),
             "error": "non-positive step time after overhead subtraction "
                      "(relay flap straddled the calibration); "
@@ -308,6 +409,10 @@ def main():
         "mfu": mfu,
         "dispatch_overhead_ms": round(overhead * 1e3, 1),
         "relay_degraded": bool(degraded),
+        # whether this number was served from the persistent compile
+        # cache (warm-start subsystem) — misses on a warmed window mean
+        # the warm drifted from the measured program
+        "compile_cache": compile_cache.snapshot(),
         "ledger_id": ledger_id,
         # the active kernel dispatch, so a watchdog-selected best line
         # self-describes (the ladder A/Bs configs across attempts)
@@ -676,7 +781,15 @@ def _watchdog():
 
 
 if __name__ == "__main__":
-    if os.environ.get("APEX_BENCH_INNER") == "1":
+    if "--smoke" in sys.argv[1:]:
+        # CLI alias for APEX_BENCH_SMOKE=1 (inherited by the watchdog's
+        # inner attempts via the environment)
+        os.environ["APEX_BENCH_SMOKE"] = "1"
+    if os.environ.get("APEX_WARM_ONLY") == "1":
+        # warm-start pass (benchmarks/warm_cache.py): compile-only, no
+        # measurement — the retrying watchdog has nothing to rank
+        main()
+    elif os.environ.get("APEX_BENCH_INNER") == "1":
         main()
     else:
         sys.exit(_watchdog())

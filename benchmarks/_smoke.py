@@ -5,18 +5,31 @@ when the given env var is "1" it forces the CPU backend via
 ``jax.config.update`` — the axon TPU plugin overrides the ``JAX_PLATFORMS``
 env var, so the config update is the only reliable switch (same rule as
 tests/conftest.py).
+
+It is also the one choke point where every harness wires the persistent
+compile cache (``apex_tpu.compile_cache``): real (non-smoke) runs default
+it ON — the warm-start subsystem's whole point is that a probe-time
+compile pays the in-window compile tax so the scored run doesn't — while
+CPU smoke runs default OFF, mirroring the ledger's smoke rule (sanity
+artifacts don't belong in the measurement cache). ``APEX_COMPILE_CACHE``
+=1/=0 overrides either default.
 """
 
 import os
 
 import jax
 
+from apex_tpu import compile_cache
+
 
 def smoke_mode(env_var):
     """True when ``env_var`` (or the generic ``APEX_BENCH_SMOKE``) is
-    "1"; also forces the CPU backend in that case."""
+    "1"; also forces the CPU backend in that case, and activates the
+    persistent compile cache (default ON for real runs, OFF for smoke —
+    see module docstring)."""
     on = (os.environ.get(env_var) == "1"
           or os.environ.get("APEX_BENCH_SMOKE") == "1")
     if on:
         jax.config.update("jax_platforms", "cpu")
+    compile_cache.activate(default_on=not on)
     return on

@@ -192,7 +192,8 @@ make_step = make_train_step(model)
 t_step = scan_time("FULL train step", make_step,
                    (params, opt_state, scaler.init()), (ids, pos, labels),
                    flops_per_iter=model_flops_fb)
-print(f"{'':28s} -> {B*S/t_step:.0f} tok/s")
+if t_step:  # None under APEX_WARM_ONLY (compile-only, nothing timed)
+    print(f"{'':28s} -> {B*S/t_step:.0f} tok/s")
 
 # 6. trunk-only fwd+bwd (no CE head / embedding)
 from apex_tpu.transformer.testing.standalone_transformer_lm import (
@@ -295,7 +296,8 @@ if not SMOKE or os.environ.get("APEX_BENCH_DROPOUT_SMOKE") == "1":
         t_d = scan_time(f"FULL step {_label}", make_dstep,
                         (_dparams, _dopt, scaler.init()),
                         (ids, pos, labels), flops_per_iter=model_flops_fb)
-        print(f"{'':28s} -> {B*S/t_d:.0f} tok/s")
+        if t_d:  # None under APEX_WARM_ONLY
+            print(f"{'':28s} -> {B*S/t_d:.0f} tok/s")
 
 # one ledger record for the whole run: calibration + every span above
 TRACER.flush_ledger("profile_gpt", extra={

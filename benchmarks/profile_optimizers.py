@@ -87,4 +87,11 @@ def bench(name, tx, passes):
 
 bench("FusedAdam", fused_adam(1e-3), 7)
 bench("FusedLAMB", fused_lamb(1e-3), 7)
+# one-pass flat-buffer A/B (PERF.md §2 queued row): LAMB is the worst
+# fused-optimizer row at 54.9% of its HBM floor (Adam 81.9%, §10b) and
+# the per-leaf loop's many small norm reductions are the suspect — the
+# one_pass impl does ONE segment_sum sweep instead. Same state layout,
+# so the row is directly comparable; default stays two_pass until this
+# lands on device (measured-dispatch rule).
+bench("FusedLAMB 1pass", fused_lamb(1e-3, impl="one_pass"), 7)
 bench("FusedSGD", fused_sgd(1e-2, momentum=0.9), 5)

@@ -27,6 +27,12 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
 
+# version-compat shims (jax.shard_map on older jax) BEFORE any test
+# module import — test files `from jax import shard_map` directly
+from apex_tpu import _compat  # noqa: E402
+
+_compat.install()
+
 assert len(jax.devices()) == 8, (
     f"expected 8 virtual CPU devices, got {jax.devices()}"
 )

@@ -35,8 +35,12 @@ def test_bench_smoke_json_contract(tmp_path):
     assert len(lines) == 1, out.stdout[-2000:]
     rec = json.loads(lines[-1])
     for field in ("metric", "value", "unit", "vs_baseline", "mfu",
-                  "dispatch_overhead_ms", "relay_degraded", "ledger_id"):
+                  "dispatch_overhead_ms", "relay_degraded", "ledger_id",
+                  "compile_cache"):
         assert field in rec, rec
+    # warm-start telemetry block, well-formed whatever the knob state
+    assert set(rec["compile_cache"]) == {"enabled", "dir", "hits",
+                                         "misses", "warm_age_s"}
     assert rec["unit"] == "tokens/s"
     assert rec["value"] > 0, rec
     assert "error" not in rec, rec

@@ -244,3 +244,74 @@ def test_unscale_preserves_small_fp16_grads():
     assert unscaled["w"].dtype == jnp.float32
     assert not bool(found_inf)
     np.testing.assert_allclose(np.asarray(unscaled["w"]), [1e-3], rtol=1e-3)
+
+
+# ---- FusedLAMB one-pass flat-buffer impl (APEX_LAMB_IMPL) ----
+# The compute-structure knob must be a pure re-structuring: identical
+# state layout, same update values (up to flat-vs-per-leaf reduction
+# order) — so the profile_optimizers A/B row compares like with like.
+
+def _lamb_tree(seed=0, bf16_leaf=False):
+    rng = np.random.RandomState(seed)
+    params = {
+        "a": jnp.asarray(rng.randn(6, 9), jnp.float32),
+        "b": {"w": jnp.asarray(rng.randn(17), jnp.float32),
+              "x": jnp.asarray(rng.randn(2, 3, 4), jnp.float32)},
+    }
+    if bf16_leaf:
+        params["h"] = jnp.asarray(rng.randn(8, 5), jnp.bfloat16)
+    grads = jax.tree_util.tree_map(
+        lambda p: jnp.asarray(
+            rng.randn(*p.shape).astype(np.float32) * 1e-2, p.dtype), params)
+    return params, grads
+
+
+@pytest.mark.parametrize("kwargs", [
+    dict(),
+    dict(adam_w_mode=False),
+    dict(weight_decay=0.0),                   # trust-ratio-off branch
+    dict(weight_decay=0.0, use_nvlamb=True),  # ...unless nvlamb
+    dict(max_grad_norm=0.0),                  # no global clip
+    dict(bias_correction=False, grad_averaging=False),
+])
+def test_fused_lamb_one_pass_matches_two_pass(kwargs):
+    import optax
+    from apex_tpu.optimizers.fused_lamb import fused_lamb
+
+    params, grads = _lamb_tree(bf16_leaf=True)
+    tx2 = fused_lamb(1e-2, impl="two_pass", **kwargs)
+    tx1 = fused_lamb(1e-2, impl="one_pass", **kwargs)
+    p2, s2 = params, tx2.init(params)
+    p1, s1 = params, tx1.init(params)
+    for _ in range(3):  # trajectory, not just one step (bias correction)
+        u2, s2 = tx2.update(grads, s2, p2)
+        p2 = optax.apply_updates(p2, u2)
+        u1, s1 = tx1.update(grads, s1, p1)
+        p1 = optax.apply_updates(p1, u1)
+    for a, b in zip(jax.tree_util.tree_leaves(p2),
+                    jax.tree_util.tree_leaves(p1)):
+        assert a.dtype == b.dtype
+        tol = 2e-2 if a.dtype == jnp.bfloat16 else 2e-5
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=tol, atol=tol)
+    # state layout identical: the knob is freely A/B-able mid-run
+    assert (jax.tree_util.tree_structure(s2)
+            == jax.tree_util.tree_structure(s1))
+
+
+def test_fused_lamb_impl_knob_resolution(monkeypatch):
+    from apex_tpu.optimizers.fused_lamb import _resolve_impl
+
+    monkeypatch.delenv("APEX_LAMB_IMPL", raising=False)
+    assert _resolve_impl(None) == "two_pass"  # measured-dispatch default
+    monkeypatch.setenv("APEX_LAMB_IMPL", "one_pass")
+    assert _resolve_impl(None) == "one_pass"  # process-wide preference
+    assert _resolve_impl("two_pass") == "two_pass"  # explicit arg wins
+    # explicit request ≠ preference: a bad explicit value raises...
+    with pytest.raises(ValueError):
+        _resolve_impl("flat")
+    # ...and so does a bad env value (it would silently mislabel an A/B)
+    monkeypatch.setenv("APEX_LAMB_IMPL", "bogus")
+    with pytest.raises(ValueError):
+        _resolve_impl(None)

@@ -14,7 +14,10 @@ Checks:
    the required fields (apex_tpu.telemetry.ledger.REQUIRED_FIELDS);
    ids are unique AND match their record's content hash (an id is a
    sha1 over the canonical record, so a record edited after the fact
-   no longer matches its own id).
+   no longer matches its own id). Records carrying the warm-start
+   telemetry block (``compile_cache: {enabled, dir, hits, misses,
+   warm_age_s}`` — apex_tpu.compile_cache) must carry it well-formed:
+   a malformed block could silently claim a number was compile-free.
 2. **Caption cross-check** — every ``ledger:<id>`` citation in PERF.md
    must resolve to a ledger record, and any "dispatch overhead X ms"
    (or "X-Y ms" range) stated in the citing paragraph must agree with
