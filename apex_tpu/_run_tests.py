@@ -40,7 +40,8 @@ SUITES = {
     "api_parity": ["test_api_parity_round3.py"],
     "harness": ["test_run_tests.py", "test_bench_contract.py",
                 "test_compile_cache.py"],
-    "telemetry": ["test_telemetry.py", "test_bench_labels.py"],
+    "telemetry": ["test_telemetry.py", "test_bench_labels.py",
+                  "test_dispatch.py"],
     "checkpoint": ["test_checkpoint.py"],
     "data": ["test_data.py"],
     "examples": ["test_examples.py"],

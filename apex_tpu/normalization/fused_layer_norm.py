@@ -21,14 +21,38 @@ Dtype semantics mirror the reference:
 """
 
 import numbers
+import os
 
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-# Dispatch default for the Pallas row kernel (PERF.md §4 records the
-# measurement this default follows). Overridable per call.
-USE_PALLAS = False
+# Process-wide Pallas-kernel preference: tri-state. None (the shipped
+# state) = unpinned — the per-shape dispatch table (apex_tpu.dispatch,
+# op "layer_norm") is consulted and a miss means the jnp path (the
+# PERF.md §4 measured default). True/False (set_use_pallas, or
+# benchmarks/_knobs APEX_LN_PALLAS=1) pins the choice above the table.
+# Per-call ``use_pallas=`` wins over everything.
+USE_PALLAS = None
+
+
+def set_use_pallas(value):
+    """Pin the process-wide Pallas-LN preference (True/False), or un-pin
+    with None (the dispatch table then applies again).
+
+    Use THIS, not ``module.USE_PALLAS = ...`` via a package import: the
+    package re-exports the ``fused_layer_norm`` FUNCTION under the
+    module's name, so ``from apex_tpu.normalization import
+    fused_layer_norm as m; m.USE_PALLAS = True`` silently sets an
+    attribute on the function and never reaches this module — the knob
+    looked flipped while every call still ran the jnp path (caught by
+    tests/test_dispatch.py; the round-≤5 APEX_LN_PALLAS step rows were
+    affected)."""
+    global USE_PALLAS
+    if value not in (True, False, None):
+        raise ValueError(f"use_pallas must be True/False/None, "
+                         f"got {value!r}")
+    USE_PALLAS = value
 
 
 def _normalized_axes(x, normalized_shape):
@@ -40,24 +64,70 @@ def _normalized_axes(x, normalized_shape):
     return tuple(range(x.ndim - n, x.ndim)), tuple(normalized_shape)
 
 
-def would_use_pallas(x_shape, n_norm_axes=1, use_pallas=None):
-    """The exact predicate ``fused_layer_norm`` uses to dispatch to the
-    Pallas row kernel — exposed so callers (benchmark harnesses, tests)
-    can't drift from the real gate. ``use_pallas=None`` resolves to the
-    module-level ``USE_PALLAS`` default, same as ``fused_layer_norm``."""
-    if use_pallas is None:
-        use_pallas = USE_PALLAS
-    if not (use_pallas and n_norm_axes == 1):
-        return False
-    # imports below the early return: the pure-jnp default path must not
-    # require jax.experimental.pallas to be importable
-    from apex_tpu.ops.attention import _tpu_available
-    from apex_tpu.ops import layer_norm_pallas as lnp
+def _resolve_pallas(x_shape, n_norm_axes, use_pallas, dtype=None):
+    """``(use, interpret)`` for one call — THE dispatch decision.
+
+    Resolution: per-call ``use_pallas`` > module ``USE_PALLAS`` >
+    dispatch-table "layer_norm" entry for this (rows, hidden) bucket >
+    False (the §4 measured jnp default). All resolutions are
+    preferences: shapes the kernel can't handle fall back to jnp.
+    A table entry is backend-keyed, so a CPU-measured "pallas" row was
+    measured in interpret mode — it runs the same way (``interpret``
+    True off-TPU); explicit True still requires a real TPU, unchanged.
+    """
+    if n_norm_axes != 1:
+        return False, False
     hidden = x_shape[-1]
     rows = 1
     for d in x_shape[:-1]:
         rows *= d
-    return _tpu_available() and lnp.supported(rows, hidden)
+    from_table = False
+    if use_pallas is None:
+        use_pallas = USE_PALLAS
+    if use_pallas is None:
+        # the table key includes the input dtype; a caller that didn't
+        # supply one gets the built-in default rather than a consult
+        # under a guessed dtype that could diverge from the real call's
+        # (fused_layer_norm always passes x.dtype)
+        if dtype is None:
+            return False, False
+        from apex_tpu import dispatch
+
+        use_pallas = dispatch.lookup(
+            "layer_norm", dtype=dtype, rows=rows,
+            hidden=hidden) == "pallas"
+        from_table = use_pallas
+    if not use_pallas:
+        return False, False
+    # imports below the early return: the pure-jnp default path must not
+    # require jax.experimental.pallas to be importable
+    from apex_tpu.ops.attention import _tpu_available
+    from apex_tpu.ops import layer_norm_pallas as lnp
+
+    if not lnp.supported(rows, hidden):
+        return False, False
+    on_tpu = _tpu_available()
+    if from_table:
+        return True, not on_tpu
+    if not on_tpu and os.environ.get("APEX_PALLAS_INTERPRET") == "1":
+        # the CPU leg of a pinned pallas A/B (autotune_steps --smoke):
+        # run the kernel in interpret mode instead of silently falling
+        # back to jnp — a "pallas" label over a jnp run is label drift
+        return True, True
+    return on_tpu, False
+
+
+def would_use_pallas(x_shape, n_norm_axes=1, use_pallas=None, dtype=None):
+    """The exact predicate ``fused_layer_norm`` uses to dispatch to the
+    Pallas row kernel — exposed so callers (benchmark harnesses, tests)
+    can't drift from the real gate. ``use_pallas=None`` resolves to the
+    module-level ``USE_PALLAS`` preference, then the dispatch table,
+    same as ``fused_layer_norm`` — but the table consult needs the
+    input ``dtype`` (part of the table key, ``fused_layer_norm`` passes
+    ``x.dtype``); without it the unpinned answer is the built-in
+    default, never a guessed-dtype consult that could diverge from the
+    real call's."""
+    return _resolve_pallas(x_shape, n_norm_axes, use_pallas, dtype)[0]
 
 
 def fused_layer_norm(x, normalized_shape, weight=None, bias=None, eps=1e-5,
@@ -69,7 +139,9 @@ def fused_layer_norm(x, normalized_shape, weight=None, bias=None, eps=1e-5,
     axes, _ = _normalized_axes(x, normalized_shape)
     orig_dtype = x.dtype
 
-    if would_use_pallas(x.shape, len(axes), use_pallas):
+    use, interpret = _resolve_pallas(x.shape, len(axes), use_pallas,
+                                     x.dtype)
+    if use:
         from apex_tpu.ops import layer_norm_pallas as lnp
 
         hidden = x.shape[-1]
@@ -77,7 +149,8 @@ def fused_layer_norm(x, normalized_shape, weight=None, bias=None, eps=1e-5,
         y2d = lnp.layer_norm(
             x.reshape(rows, hidden),
             None if weight is None else weight.astype(jnp.float32),
-            None if bias is None else bias.astype(jnp.float32), eps)
+            None if bias is None else bias.astype(jnp.float32), eps,
+            interpret)
         return y2d.reshape(x.shape)
 
     xf = x.astype(jnp.float32)

@@ -81,17 +81,53 @@ def flash_supported(sq, sk):
 # VMEM-row kernel, ops/attention_pallas.py). The default is whichever won
 # benchmarks/profile_attention.py's fwd+d(q,k,v) decision row on the
 # round's hardware (PERF.md); set_default_impl flips it process-wide.
+# When neither a per-call impl nor the setter pins the choice, the
+# per-shape dispatch table (apex_tpu.dispatch, op "attention") is
+# consulted at trace time; a table miss lands on _DEFAULT_IMPL.
 _DEFAULT_IMPL = "flash"
+_IMPL_PINNED = False  # True once set_default_impl was called
 
 
 def set_default_impl(impl):
     """Select the TPU kernel behind ``fused_attention``: "flash" or
     "rows" (shapes the chosen kernel can't handle still fall through
-    flash → dense)."""
-    global _DEFAULT_IMPL
+    flash → dense). Pins the choice process-wide — the dispatch table
+    is no longer consulted (precedence: per-call > this setter > table
+    > built-in)."""
+    global _DEFAULT_IMPL, _IMPL_PINNED
     if impl not in ("flash", "rows"):
         raise ValueError(f"unknown attention impl {impl!r}")
     _DEFAULT_IMPL = impl
+    _IMPL_PINNED = True
+
+
+def reset_default_impl():
+    """Back to the unpinned built-in default (tests / knob teardown)."""
+    global _DEFAULT_IMPL, _IMPL_PINNED
+    _DEFAULT_IMPL = "flash"
+    _IMPL_PINNED = False
+
+
+def _effective_impl(impl, q, k):
+    """``(impl, from_table)`` for one call: per-call ``impl`` >
+    ``set_default_impl`` > dispatch-table entry for this shape bucket >
+    built-in. Table entries are preferences (measured on this backend,
+    keyed by shape bucket); unsupported shapes still fall through
+    rows → flash → dense downstream. ``from_table`` lets the rows
+    branch run a CPU-measured table choice in interpret mode — the way
+    it was measured."""
+    if impl is not None:
+        return impl, False
+    if _IMPL_PINNED:
+        return _DEFAULT_IMPL, False
+    from apex_tpu import dispatch
+
+    choice = dispatch.lookup(
+        "attention", dtype=q.dtype, b=q.shape[0], h=q.shape[1],
+        sq=q.shape[2], sk=k.shape[2], d=q.shape[3])
+    if choice:
+        return choice, True
+    return _DEFAULT_IMPL, False
 
 
 def fused_attention(q, k, v, *, causal=False, sm_scale=None,
@@ -117,7 +153,14 @@ def fused_attention(q, k, v, *, causal=False, sm_scale=None,
     if impl is not None and impl not in ("flash", "rows"):
         raise ValueError(f"unknown attention impl {impl!r}")
     sq, sk = q.shape[2], k.shape[2]
-    if (impl or _DEFAULT_IMPL) == "rows" and not force_dense:
+    # force_dense never consults the table: a consult the caller ignores
+    # would still land in the dispatch.snapshot() consult log and
+    # mislabel what a dense-baseline row actually ran
+    eff_impl, from_table = (("flash", False) if force_dense
+                            else _effective_impl(impl, q, k))
+    if eff_impl == "rows" and not force_dense:
+        import os
+
         from apex_tpu.ops import attention_pallas as ap
 
         # the *default* dispatch caps the rows kernel at the fmha-style
@@ -126,10 +169,18 @@ def fused_attention(q, k, v, *, causal=False, sm_scale=None,
         # single-pass structure saves); an explicit per-call impl="rows"
         # is honored for every supported shape so A/B rows stay truthful
         seq_ok = impl == "rows" or sk <= 2048
-        if (_tpu_available() and seq_ok
+        # off-TPU the kernel can still run in interpret mode when the
+        # choice came from a (backend-keyed, CPU-measured) table entry
+        # or the pinned-A/B CPU leg asks for it (autotune --smoke) —
+        # never silently: a "rows" label over a dense run is label drift
+        interp = (not _tpu_available()
+                  and (from_table
+                       or os.environ.get("APEX_PALLAS_INTERPRET") == "1"))
+        if ((_tpu_available() or interp) and seq_ok
                 and ap.supported(sq, sk, q.shape[-1])):
             return ap.fused_attention_rows(q, k, v, causal,
-                                           float(sm_scale), segment_ids)
+                                           float(sm_scale), segment_ids,
+                                           interp)
     use_flash = flash_supported(sq, sk) and not force_dense
     if not use_flash:
         return _dense_attention(q, k, v, causal, sm_scale, segment_ids)

@@ -619,7 +619,10 @@ def _pick_bq(sq, sk, block_q, n_arrays=_BWD_ARRAYS):
 # §10): monolithic wins the fwd+d(q,k,v) training protocol (1.509 vs
 # 2.071 ms at the GPT-2 shape) and keeps the default; split wins the
 # dq-only protocol 1.5x and remains the choice for no-kv-grad paths.
+# Unpinned calls also consult the per-shape dispatch table
+# (apex_tpu.dispatch, op "attention_bwd") below set_bwd_impl.
 BWD_IMPL = "monolithic"
+_BWD_PINNED = False  # True once set_bwd_impl was called
 
 
 def set_bwd_impl(impl):
@@ -627,11 +630,35 @@ def set_bwd_impl(impl):
     fail ``_split_ok`` fall back to monolithic silently (a model may mix
     eligible and ineligible layers); a per-call ``bwd_impl=`` is a strict
     demand and raises instead — benchmark rows use the per-call form so
-    their labels stay truthful."""
-    global BWD_IMPL
+    their labels stay truthful. Pins the choice above the dispatch
+    table."""
+    global BWD_IMPL, _BWD_PINNED
     if impl not in ("monolithic", "split"):
         raise ValueError(f"unknown rows bwd impl {impl!r}")
     BWD_IMPL = impl
+    _BWD_PINNED = True
+
+
+def reset_bwd_impl():
+    """Back to the unpinned built-in default (tests / knob teardown)."""
+    global BWD_IMPL, _BWD_PINNED
+    BWD_IMPL = "monolithic"
+    _BWD_PINNED = False
+
+
+def _effective_bwd_impl(q, k):
+    """Table-aware resolution for an unpinned backward: set_bwd_impl >
+    dispatch-table "attention_bwd" entry for this bucket > built-in.
+    Like the setter, a table "split" is a preference — ineligible shapes
+    fall back to monolithic in _bwd_rule."""
+    if _BWD_PINNED:
+        return BWD_IMPL
+    from apex_tpu import dispatch
+
+    choice = dispatch.lookup(
+        "attention_bwd", dtype=q.dtype, b=q.shape[0], h=q.shape[1],
+        sq=q.shape[2], sk=k.shape[2], d=q.shape[3])
+    return choice or BWD_IMPL
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 6, 7, 8, 9))
@@ -832,8 +859,8 @@ def _bwd_rule(causal, sm_scale, interpret, block_q, bwd_impl, dropout_p,
             raise ValueError("dropout requires the monolithic backward")
         return _bwd_monolithic(causal, sm_scale, interpret, block_q, res,
                                g, dropout_p)
-    impl = bwd_impl or BWD_IMPL
     q, k, v, _, _ = res
+    impl = bwd_impl or _effective_bwd_impl(q, k)
     sq, sk = q.shape[2], k.shape[2]
     bq = _pick_bq(sq, sk, block_q)
     ok = _split_ok(sq, sk, q.shape[3], bq, q.dtype.itemsize)

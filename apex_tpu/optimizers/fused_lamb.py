@@ -27,7 +27,10 @@ per-leaf fp32 m/v either way, so the knob is freely A/B-able mid-run):
 
 ``APEX_LAMB_IMPL={two_pass|one_pass}`` is the process-wide preference
 (harness A/B knob); the explicit ``impl=`` argument wins and raises on an
-unknown value (explicit request ≠ preference).
+unknown value (explicit request ≠ preference). Left unpinned, the
+per-shape dispatch table (apex_tpu.dispatch, op "lamb", keyed on the
+total parameter count) resolves the structure at trace time; a table
+miss keeps the measured two_pass seat.
 """
 
 import os
@@ -49,6 +52,9 @@ class FusedLAMBState(NamedTuple):
 
 
 def _resolve_impl(impl):
+    """Explicit ``impl=`` (raises on unknown — explicit request) or the
+    ``APEX_LAMB_IMPL`` process preference; None = unpinned, resolved per
+    parameter set at trace time (:func:`_table_impl`)."""
     if impl is not None:
         if impl not in _IMPLS:
             raise ValueError(
@@ -59,7 +65,19 @@ def _resolve_impl(impl):
         return env
     if env:
         raise ValueError(f"APEX_LAMB_IMPL={env!r}: want one of {_IMPLS}")
-    return "two_pass"
+    return None
+
+
+def _table_impl(leaves):
+    """Unpinned compute-structure choice: the dispatch-table "lamb"
+    entry for this parameter-count bucket (apex_tpu.dispatch — keyed on
+    total fp32 elements, the quantity the HBM-floor model is linear
+    in), else the measured two_pass seat (PERF.md §2)."""
+    from apex_tpu import dispatch
+
+    n = sum(int(p.size) for p in leaves)
+    choice = dispatch.lookup("lamb", dtype="float32", n=n)
+    return choice or "two_pass"
 
 
 def fused_lamb(learning_rate=1e-3, betas=(0.9, 0.999), eps=1e-6,
@@ -178,7 +196,8 @@ def fused_lamb(learning_rate=1e-3, betas=(0.9, 0.999), eps=1e-6,
         gs = [g.astype(jnp.float32) for g in leaves_g]
         ps = [p.astype(jnp.float32) for p in leaves_p]
 
-        fn = update_one_pass if impl == "one_pass" else update_two_pass
+        eff = impl if impl is not None else _table_impl(leaves_p)
+        fn = update_one_pass if eff == "one_pass" else update_two_pass
         us, ms, vs = fn(gs, ps, leaves_m, leaves_v, leaves_g, count)
 
         def unflat(xs):

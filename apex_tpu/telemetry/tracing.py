@@ -265,7 +265,7 @@ class Tracer:
         written record is stamped with the compile-cache telemetry
         block, so a PERF.md row can prove whether its numbers were
         taken compile-free."""
-        from apex_tpu import compile_cache
+        from apex_tpu import compile_cache, dispatch
         from apex_tpu.telemetry import ledger
 
         if compile_cache.warm_only():
@@ -273,7 +273,8 @@ class Tracer:
         if platform is None:
             platform = jax.devices()[0].platform
         payload = {"spans": [s.as_record() for s in self.spans],
-                   "compile_cache": compile_cache.snapshot()}
+                   "compile_cache": compile_cache.snapshot(),
+                   "dispatch": dispatch.snapshot()}
         payload.update(extra or {})
         return ledger.append_record(
             harness=harness, platform=platform,

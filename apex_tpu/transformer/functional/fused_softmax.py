@@ -23,9 +23,29 @@ warp-level kernels collapse into straight jnp math. What we DO preserve:
     folded in, which XLA derives automatically.
 """
 
+import os
+
 import jax.numpy as jnp
 
 from apex_tpu.transformer.enums import AttnMaskType
+
+# Process-wide Pallas-kernel preference for the fused scale-mask
+# softmax: tri-state. None (shipped) = unpinned — unpinned instances
+# consult the per-shape dispatch table (apex_tpu.dispatch, op
+# "softmax"); a miss means the jnp path (the PERF.md §4b measured
+# default). set_use_pallas(True/False) pins above the table; a
+# per-instance ``use_pallas=`` pins above everything.
+USE_PALLAS = None
+
+
+def set_use_pallas(value):
+    """Pin the process-wide softmax-kernel preference (True/False), or
+    un-pin with None (the dispatch table then applies again)."""
+    global USE_PALLAS
+    if value not in (True, False, None):
+        raise ValueError(f"use_pallas must be True/False/None, "
+                         f"got {value!r}")
+    USE_PALLAS = value
 
 
 def _softmax_fp32(x, where=None, scale=None):
@@ -92,7 +112,7 @@ class FusedScaleMaskSoftmax:
 
     def __init__(self, input_in_fp16, input_in_bf16, attn_mask_type,
                  scaled_masked_softmax_fusion, mask_func, softmax_in_fp32,
-                 scale, use_pallas=False, _pallas_interpret=False):
+                 scale, use_pallas=None, _pallas_interpret=False):
         self.input_in_fp16 = input_in_fp16
         self.input_in_bf16 = input_in_bf16
         assert not (input_in_fp16 and input_in_bf16), \
@@ -104,9 +124,11 @@ class FusedScaleMaskSoftmax:
         self.softmax_in_fp32 = softmax_in_fp32
         self.scale = scale
         # guarantee the fusion with the Pallas kernel
-        # (ops/softmax_pallas.py) instead of relying on XLA's fuser; the
-        # jnp path stays the default pending the TPU head-to-head
-        # (benchmarks/profile_softmax.py)
+        # (ops/softmax_pallas.py) instead of relying on XLA's fuser.
+        # True/False pins this instance; None defers to the module
+        # preference (set_use_pallas) then the per-shape dispatch table
+        # — a miss lands on the jnp path, the PERF.md §4b measured
+        # default (jnp won every measured shape)
         self.use_pallas = use_pallas
         self._pallas_interpret = _pallas_interpret
         assert self.scale is None or softmax_in_fp32, \
@@ -138,6 +160,35 @@ class FusedScaleMaskSoftmax:
                     return True
         return False
 
+    def _resolve_pallas(self, input):
+        """``(use, interpret)`` for one call: instance ``use_pallas`` >
+        module ``USE_PALLAS`` (set_use_pallas) > dispatch-table
+        "softmax" entry for this shape bucket > False. A table entry is
+        backend-keyed: a CPU-measured "pallas" row was measured in
+        interpret mode and runs the same way."""
+        use = self.use_pallas
+        if use is None:
+            use = USE_PALLAS
+        from_table = False
+        if use is None:
+            from apex_tpu import dispatch
+
+            b, np_, sq, sk = input.shape
+            use = dispatch.lookup("softmax", dtype=input.dtype, b=b,
+                                  h=np_, sq=sq, sk=sk) == "pallas"
+            from_table = use
+        interpret = self._pallas_interpret
+        if use and not interpret:
+            from apex_tpu.ops.attention import _tpu_available
+
+            if from_table:
+                interpret = not _tpu_available()
+            elif os.environ.get("APEX_PALLAS_INTERPRET") == "1":
+                # CPU leg of a pinned pallas A/B (autotune --smoke):
+                # interpret mode instead of a silent jnp fallback
+                interpret = not _tpu_available()
+        return bool(use), interpret
+
     def forward_fused_softmax(self, input, mask):
         """Reference: fused_softmax.py:202-223."""
         scale = self.scale if self.scale is not None else 1.0
@@ -145,21 +196,22 @@ class FusedScaleMaskSoftmax:
         if causal:
             assert input.shape[-2] == input.shape[-1], \
                 "causal mask is only for self attention"
-        if self.use_pallas:
+        use_pallas, p_interpret = self._resolve_pallas(input)
+        if use_pallas:
             from apex_tpu.ops import softmax_pallas
             from apex_tpu.ops.attention import _tpu_available
             # the fused causal path ignores an explicit mask (the
             # reference's scaled_upper_triang kernel takes none) — pass
             # None so toggling use_pallas never changes numerics
             m = None if causal or mask is None else mask.astype(bool)
-            if ((self._pallas_interpret or _tpu_available())
+            if ((p_interpret or _tpu_available())
                     and softmax_pallas.supported(input.shape[-2],
                                                  input.shape[-1])
                     and (m is None
                          or softmax_pallas.mask_supported(m, input.shape))):
                 return softmax_pallas.scaled_masked_softmax(
                     input, m, scale, causal=causal,
-                    interpret=self._pallas_interpret)
+                    interpret=p_interpret)
         if causal:
             b, np_, sq, sk = input.shape
             out = scaled_upper_triang_masked_softmax(
@@ -210,7 +262,7 @@ class GenericFusedScaleMaskSoftmax(FusedScaleMaskSoftmax):
     fused_softmax.py:240-264)."""
 
     def __init__(self, input_in_fp16, input_in_bf16, mask_func,
-                 softmax_in_fp32, scale, use_pallas=False,
+                 softmax_in_fp32, scale, use_pallas=None,
                  _pallas_interpret=False):
         super().__init__(input_in_fp16, input_in_bf16, AttnMaskType.padding,
                          True, mask_func, softmax_in_fp32, scale,
@@ -221,7 +273,7 @@ class GenericFusedScaleMaskSoftmax(FusedScaleMaskSoftmax):
         return self.scaled_masked_softmax_fusion and self.input_in_float16
 
     def forward_fused_softmax(self, input, mask):
-        if self.use_pallas:
+        if self._resolve_pallas(input)[0]:
             # same kernel dispatch (and fallback rules) as the base class
             return super().forward_fused_softmax(input, mask)
         scale = self.scale if self.scale is not None else 1.0

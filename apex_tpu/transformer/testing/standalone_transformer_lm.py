@@ -72,8 +72,11 @@ class TransformerConfig:
     attention_softmax_in_fp32: bool = False
     masked_softmax_fusion: bool = True
     # route the fused scale-mask-softmax (non-flash scores path) through
-    # the Pallas kernel (ops/softmax_pallas.py) instead of the jnp path
-    softmax_use_pallas: bool = False
+    # the Pallas kernel (ops/softmax_pallas.py) instead of the jnp path.
+    # True/False pins; None (default) = unpinned — FusedScaleMaskSoftmax
+    # consults the per-shape dispatch table (apex_tpu.dispatch), a miss
+    # meaning the measured jnp default (PERF.md §4b)
+    softmax_use_pallas: Optional[bool] = None
     # fuse the GPT LM head (logits matmul + vocab-parallel CE) into the
     # Pallas linear-cross-entropy kernel (ops/xent_pallas.py): the [n, V]
     # logits never reach HBM — at tp > 1 via the vocab-parallel variant
@@ -81,8 +84,11 @@ class TransformerConfig:
     # materialize either). Engages where the kernel applies (supported
     # shard shapes, no label smoothing, not tp>1+sequence_parallel);
     # falls back to the materialized path otherwise. _interpret is for
-    # CPU tests.
-    fused_lm_head: bool = False
+    # CPU tests. True/False pins; None (default) = unpinned — the head
+    # consults the dispatch table (op "lm_head") at trace time, a miss
+    # meaning the materialized path (the §10b measured default: fused
+    # holds 63% of materialized throughput; its win is peak memory)
+    fused_lm_head: Optional[bool] = None
     fused_lm_head_interpret: bool = False
     # training with attention_dropout > 0 (causal, no explicit mask):
     # route through the VMEM-rows kernel's in-kernel hash dropout instead
@@ -111,7 +117,10 @@ class TransformerConfig:
     moe_aux_loss_coeff: float = 1e-2
     # activation recompute (reference: --recompute-granularity full →
     # tensor_parallel.random.checkpoint per layer; here jax.checkpoint
-    # around each transformer layer)
+    # around each transformer layer). "selective"/"full" pin remat on,
+    # "none" pins it OFF; None (default) = unpinned — the trunk consults
+    # the dispatch table (op "remat") at trace time, a miss meaning no
+    # recompute (the built-in default)
     recompute_granularity: Optional[str] = None
     params_dtype: Any = jnp.float32
     fp16: bool = False
@@ -701,6 +710,37 @@ class Embedding(nn.Module):
             emb, deterministic=deterministic)
 
 
+def resolve_recompute_granularity(cfg, hidden_shape):
+    """Trace-time remat-policy resolution — the dispatch-table consumer
+    for op "remat" (apex_tpu.dispatch). An explicit config value pins:
+    "selective"/"full" turn recompute on, "none" pins it OFF; None
+    (unpinned) consults the per-shape table keyed on (b, s, hidden,
+    layers), a miss meaning no recompute (the built-in default).
+    ``hidden_shape`` is the trunk input's [s, b, h]. Returns the
+    effective granularity (None = no recompute) — the model composites
+    bake it back into the cfg they hand the trunk, so the layer-level
+    ``== "selective"`` / ``== "full"`` checks stay table-aware."""
+    g = cfg.recompute_granularity
+    if g == "none":
+        return None
+    if g is not None:
+        return g
+    from apex_tpu import dispatch
+
+    s, b = int(hidden_shape[0]), int(hidden_shape[1])
+    choice = dispatch.lookup(
+        "remat", dtype="bfloat16" if cfg.bf16 else "float32",
+        b=b, s=s, h=cfg.hidden_size, layers=cfg.num_layers)
+    return None if choice in (None, "none") else choice
+
+
+def _remat_resolved_cfg(cfg, hidden_shape):
+    """cfg with ``recompute_granularity`` resolved for this trace."""
+    return dataclasses.replace(
+        cfg, recompute_granularity=resolve_recompute_granularity(
+            cfg, hidden_shape))
+
+
 class GPTModel(nn.Module):
     """GPT language model (reference: standalone_gpt.py:111 +
     standalone_transformer_lm.py TransformerLanguageModel/Embedding).
@@ -725,27 +765,46 @@ class GPTModel(nn.Module):
     # composites build on.
 
     def _fused_head_applies(self, hidden):
-        """Whether the Pallas fused LM head replaces logits+CE for this
-        call: opt-in, a real TPU (or interpret for tests), supported
-        SHARD shapes. tp > 1 runs the vocab-parallel kernel
+        """``(applies, interpret)``: whether the Pallas fused LM head
+        replaces logits+CE for this call, and whether it runs in
+        interpret mode. ``cfg.fused_lm_head`` True/False pins; None
+        consults the dispatch table (op "lm_head", keyed on the GLOBAL
+        (n, vocab, h) shape) — a backend-keyed table "fused" measured
+        on CPU runs in interpret mode, same as it was measured. A
+        pinned True still requires a real TPU (or the explicit
+        ``fused_lm_head_interpret`` test knob), and supported SHARD
+        shapes either way. tp > 1 runs the vocab-parallel kernel
         (``linear_cross_entropy_sharded`` — per-shard online stats +
         pmax/psum combine); under sequence parallelism the standard
         pre-matmul seq gather runs first (with split-bwd, since the
         sharded head's dX is already cross-rank reduced). All static —
         the choice is baked at trace time."""
         cfg = self.cfg
-        if not cfg.fused_lm_head:
-            return False
         tp = lax.axis_size(self.axis_name)
-        from apex_tpu.ops import xent_pallas
-        from apex_tpu.ops.attention import _tpu_available
-
-        if not (cfg.fused_lm_head_interpret or _tpu_available()):
-            return False
         s, b, h = hidden.shape
         if cfg.sequence_parallel:
             s = s * tp  # hidden arrives seq-sharded; the head gathers
-        return xent_pallas.supported(b * s, cfg.vocab_size // tp, h)
+        fused = cfg.fused_lm_head
+        interpret = cfg.fused_lm_head_interpret
+        from_table = False
+        if fused is None:
+            from apex_tpu import dispatch
+
+            fused = dispatch.lookup(
+                "lm_head", dtype=hidden.dtype, n=b * s,
+                v=cfg.vocab_size, h=h) == "fused"
+            from_table = fused
+        if not fused:
+            return False, interpret
+        from apex_tpu.ops import xent_pallas
+        from apex_tpu.ops.attention import _tpu_available
+
+        if from_table and not interpret:
+            interpret = not _tpu_available()
+        if not (interpret or _tpu_available()):
+            return False, interpret
+        return (xent_pallas.supported(b * s, cfg.vocab_size // tp, h),
+                interpret)
 
     @nn.compact
     def __call__(self, input_ids, position_ids, attention_mask, labels=None,
@@ -767,6 +826,7 @@ class GPTModel(nn.Module):
             "pre_process=False requires hidden_state (the upstream "
             "pipeline stage's activation)")
 
+        cfg = _remat_resolved_cfg(cfg, hidden.shape)
         hidden = ParallelTransformer(
             cfg, self_attn_mask_type=AttnMaskType.causal,
             pre_process=self.pre_process, post_process=self.post_process,
@@ -777,7 +837,8 @@ class GPTModel(nn.Module):
         if not self.post_process:
             return hidden
 
-        if labels is not None and self._fused_head_applies(hidden):
+        fused_head, head_interpret = self._fused_head_applies(hidden)
+        if labels is not None and fused_head:
             from apex_tpu.ops import xent_pallas
 
             # the fused kernel instead of materializing [n, V] logits;
@@ -800,12 +861,12 @@ class GPTModel(nn.Module):
                 loss = xent_pallas.linear_cross_entropy(
                     x2d, word_embeddings.astype(x2d.dtype),
                     labels.reshape(-1),
-                    cfg.fused_lm_head_interpret)
+                    head_interpret)
             else:
                 loss = xent_pallas.linear_cross_entropy_sharded(
                     x2d, word_embeddings.astype(x2d.dtype),
                     labels.reshape(-1), self.axis_name,
-                    cfg.fused_lm_head_interpret, 0.0,
+                    head_interpret, 0.0,
                     not sp_gathered)
             return loss.reshape(b, s)
 
@@ -858,6 +919,7 @@ class TransformerLanguageModel(nn.Module):
         assert hidden is not None, (
             "pre_process=False requires hidden_state")
 
+        cfg = _remat_resolved_cfg(cfg, hidden.shape)
         encoder_output = ParallelTransformer(
             cfg, self_attn_mask_type=self.encoder_attn_mask_type,
             pre_process=self.pre_process, post_process=self.post_process,
@@ -1073,6 +1135,7 @@ class BertModel(nn.Module):
         assert hidden is not None, (
             "pre_process=False requires hidden_state")
 
+        cfg = _remat_resolved_cfg(cfg, hidden.shape)
         hidden = ParallelTransformer(
             cfg, self_attn_mask_type=AttnMaskType.padding,
             pre_process=self.pre_process, post_process=self.post_process,

@@ -45,6 +45,26 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_TPU_BATCH = 8
 
 
+def _default_batch(cfg, builtin, s):
+    """The bench batch: APEX_BENCH_BATCH pins; else a dispatch-table
+    "bench_batch" entry for this (s, hidden, layers) bucket — the cashed
+    b-ladder A/B (benchmarks/autotune_steps.py) — else ``builtin``."""
+    v = os.environ.get("APEX_BENCH_BATCH")
+    if v:
+        return int(v)
+    from apex_tpu import dispatch
+
+    choice = dispatch.lookup("bench_batch", dtype="bfloat16", s=s,
+                             h=cfg.hidden_size, layers=cfg.num_layers)
+    return int(choice) if choice else builtin
+
+
+def _dispatch_snapshot():
+    from apex_tpu import dispatch
+
+    return dispatch.snapshot()
+
+
 def make_one_step(model, scaler, tx):
     """The flagship amp-O2 training step: bf16 fwd/bwd, dynamic loss
     scaling, fused Adam, skip-step selects.
@@ -193,7 +213,10 @@ def main():
         # — the starvation threshold sits between the two working sets.
         # The watchdog ladder still tries b=16 as its upside attempt
         # (amortization argument); a fully-healthy window takes it.
-        b = int(os.environ.get("APEX_BENCH_BATCH", str(DEFAULT_TPU_BATCH)))
+        # APEX_BENCH_BATCH pins; unset, a dispatch-table "bench_batch"
+        # entry (the cashed b=16 A/B, benchmarks/autotune_steps.py)
+        # overrides the built-in measured default.
+        b = _default_batch(cfg, DEFAULT_TPU_BATCH, s=1024)
         s, iters = 1024, 16
         peak_flops = 197e12  # v5e bf16
     else:
@@ -201,9 +224,12 @@ def main():
             hidden_size=128, num_layers=2, num_attention_heads=4,
             vocab_size=512, max_position_embeddings=128,
             hidden_dropout=0.0, attention_dropout=0.0, bf16=True,
-            fused_lm_head=fused_head, fused_lm_head_interpret=fused_head,
+            fused_lm_head=fused_head,
+            fused_lm_head_interpret=bool(fused_head),
             recompute_granularity=remat)
-        b, s, iters = 2, 128, 3
+        # the CPU smoke honors the same batch knob/table so the b-rung
+        # A/B (autotune_steps --smoke) can exercise the ladder locally
+        b, s, iters = _default_batch(cfg, 2, s=128), 128, 3
         peak_flops = None
 
     model = GPTModel(cfg)
@@ -317,12 +343,15 @@ def main():
         # every invocation — including an unusable one — lands in the
         # run ledger; a window's failures are evidence too (§6). The
         # compile_cache block proves whether the number was compile-free.
+        from apex_tpu import dispatch as dispatch_table
+
         return telemetry.ledger.append_record(
             harness="bench", platform=platform,
             dispatch_overhead_ms=round(overhead * 1e3, 1), k=iters,
             relay={"degraded": degraded, "kind": kind},
             extra=dict({"metric": f"gpt2s_train_tokens_per_sec ({platform})",
-                        "compile_cache": compile_cache.snapshot()},
+                        "compile_cache": compile_cache.snapshot(),
+                        "dispatch": dispatch_table.snapshot()},
                        **extra))
 
     if dt <= 0:
@@ -387,9 +416,15 @@ def main():
 
     config = {
         "batch": b,
-        "fused_lm_head": bool(fused_head),
-        "attn_impl": os.environ.get("APEX_ATTN_IMPL", "flash"),
-        "ln_pallas": os.environ.get("APEX_LN_PALLAS") == "1",
+        # knob PINS, tri-state: True/False (or a string value) = pinned,
+        # None = unpinned — resolved by the dispatch table at trace
+        # time; the resolved choices are in the JSON line's "dispatch"
+        # consult log, so the label stays mechanical either way
+        "fused_lm_head": fused_head,
+        "attn_impl": os.environ.get("APEX_ATTN_IMPL"),
+        "ln_pallas": (os.environ.get("APEX_LN_PALLAS") == "1"
+                      if os.environ.get("APEX_LN_PALLAS") in ("0", "1")
+                      else None),
         "remat": remat,
         # telemetry-on measures the INSTRUMENTED program (aux outputs in
         # the timed scan) — the label must say so (pin-the-label rule);
@@ -417,6 +452,10 @@ def main():
         # the active kernel dispatch, so a watchdog-selected best line
         # self-describes (the ladder A/Bs configs across attempts)
         "config": config,
+        # which dispatch-table entries resolved this run's unpinned
+        # choices (apex_tpu.dispatch consult log) — the data-driven half
+        # of the pin-the-label rule
+        "dispatch": _dispatch_snapshot(),
     }
     if telemetry.enabled():
         # flush the in-step scalars (stacked by the timed scan) + the

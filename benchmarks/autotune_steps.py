@@ -1,0 +1,484 @@
+"""One-pass step-level autotuner: cash PERF.md's queued A/Bs as
+dispatch-table entries.
+
+Every lever toward the MFU goal has sat in PERF.md's queue as prose —
+``gpt_rows`` (APEX_ATTN_IMPL), the b=16 bench ladder rung, the two
+APEX_REMAT granularities, FusedLAMB ``one_pass``, the fused LM head and
+the Pallas LayerNorm step rows — each waiting for a human to spend
+ad-hoc relay-window minutes and then hand-edit a default. This harness
+runs the WHOLE queued set as one budgeted pass and emits
+``apex_tpu/dispatch/table.jsonl`` entries instead: the winning impl per
+``(op, shape-bucket, dtype, backend)`` key, citing the ``ledger:<id>``
+that measured it (``tools/check_bench_labels.py`` validates citation +
+knob pins in tier-1).
+
+Window discipline (PERF.md §6):
+
+* **Warm-cache-first** — ``benchmarks/warm_cache.py`` AOT-warms the A/B
+  program set (bounded to rungs whose table entry is missing) on the
+  first healthy probe, so every rung here dispatches compile-free.
+* **Budgeted** — each rung runs in its own timeoutable subprocess; a
+  global ``--budget-s`` stops launching new rungs when spent and LOGS
+  what was dropped (no silent caps).
+* **Resumable** — a rung whose table entry already exists with a
+  resolving ledger id is skipped, so a flap mid-pass costs only the
+  rungs not yet cashed; re-run the command and it continues.
+* **Table-blind measurement** — every subprocess runs with
+  ``APEX_DISPATCH=off``: baselines measure the hard-coded defaults, not
+  yesterday's table.
+
+The measured number per rung is the FULL-train-step row
+(``profile_gpt.py`` under ``APEX_GPT_ONLY_STEP=1``), bench.py's scored
+tokens/s (batch rung), or the ``profile_optimizers.py`` LAMB span pair
+(one subprocess measures both structures).
+
+Usage::
+
+    python benchmarks/autotune_steps.py             # TPU window pass
+    python benchmarks/autotune_steps.py --smoke     # CPU pass at smoke
+                                                    # shapes (backend-
+                                                    # keyed cpu entries)
+
+``--only gpt_rows,gpt_remat`` restricts the rung set; ``--table`` /
+``--ledger`` redirect the artifacts (tests use tmp paths).
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from apex_tpu import dispatch  # noqa: E402  (stdlib-only import)
+from apex_tpu.telemetry import ledger as ledger_mod  # noqa: E402
+
+
+def shape_info(smoke):
+    """The step-program shapes each rung's bucket is keyed on — must
+    mirror what the harness actually builds (profile_gpt.py / bench.py
+    smoke vs TPU branches)."""
+    if smoke:
+        return dict(b=2, s=128, h=128, layers=2, heads=4, d=32,
+                    vocab=512, bench_b0=2, bench_b1=4)
+    return dict(b=8, s=1024, h=768, layers=12, heads=12, d=64,
+                vocab=50304, bench_b0=8, bench_b1=16)
+
+
+def rung_groups(smoke):
+    """The queued A/B set, one group per dispatch-table entry. Each
+    group: op, bucket dims, dtype, candidate variants (name -> the
+    distinguishing env; None = must-be-unset, recorded as a pin the
+    label checker can verify against the ledger record)."""
+    si = shape_info(smoke)
+    gpt = dict(harness="profile_gpt", metric="FULL train step")
+    return [
+        dict(name="gpt_rows", op="attention",
+             dims=dict(b=si["b"], h=si["heads"], sq=si["s"], sk=si["s"],
+                       d=si["d"]),
+             dtype="bfloat16",
+             variants={"flash": {"APEX_ATTN_IMPL": None},
+                       "rows": {"APEX_ATTN_IMPL": "rows"}}, **gpt),
+        dict(name="gpt_ln_pallas", op="layer_norm",
+             dims=dict(rows=si["b"] * si["s"], hidden=si["h"]),
+             dtype="bfloat16",
+             variants={"jnp": {"APEX_LN_PALLAS": None},
+                       "pallas": {"APEX_LN_PALLAS": "1"}}, **gpt),
+        dict(name="gpt_fused_head", op="lm_head",
+             dims=dict(n=si["b"] * si["s"], v=si["vocab"], h=si["h"]),
+             dtype="bfloat16",
+             variants={"materialized": {"APEX_FUSED_LM_HEAD": None},
+                       "fused": {"APEX_FUSED_LM_HEAD": "1"}}, **gpt),
+        dict(name="gpt_remat", op="remat",
+             dims=dict(b=si["b"], s=si["s"], h=si["h"],
+                       layers=si["layers"]),
+             dtype="bfloat16",
+             variants={"none": {"APEX_REMAT": None},
+                       "selective": {"APEX_REMAT": "selective"},
+                       "full": {"APEX_REMAT": "full"}}, **gpt),
+        dict(name="lamb_one_pass", op="lamb", harness="profile_optimizers",
+             dims=None,  # keyed on n_params, read from the record
+             dtype="float32",
+             variants={"two_pass": "FusedLAMB",
+                       "one_pass": "FusedLAMB 1pass"}),
+        dict(name="bench_b16", op="bench_batch", harness="bench",
+             metric="tokens_per_sec",
+             dims=dict(s=si["s"], h=si["h"], layers=si["layers"]),
+             dtype="bfloat16",
+             variants={str(si["bench_b0"]): {"APEX_BENCH_BATCH": None},
+                       str(si["bench_b1"]):
+                           {"APEX_BENCH_BATCH": str(si["bench_b1"])}}),
+    ]
+
+
+def _subprocess_env(variant_env, smoke, ledger_path):
+    env = dict(os.environ)
+    # measure the BUILT-IN defaults, not yesterday's table
+    env["APEX_DISPATCH"] = "off"
+    env["APEX_TELEMETRY_LEDGER"] = os.path.abspath(ledger_path)
+    if smoke:
+        env["APEX_BENCH_SMOKE"] = "1"
+        # local CPU work must not dial the (possibly wedged) relay
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        # the CPU leg A/Bs jnp vs pallas-INTERPRET for real: without
+        # this the pinned pallas variants silently fall back to jnp
+        # off-TPU and noise picks the "winner" — label drift
+        env["APEX_PALLAS_INTERPRET"] = "1"
+    for k, v in variant_env.items():
+        if v is None:
+            env.pop(k, None)
+        else:
+            env[k] = v
+    return env
+
+
+def _new_records(ledger_path, n_before):
+    try:
+        return ledger_mod.read_ledger(ledger_path)[n_before:]
+    except (OSError, ValueError):
+        return []
+
+
+def _ledger_len(ledger_path):
+    try:
+        return len(ledger_mod.read_ledger(ledger_path))
+    except (OSError, ValueError):
+        return 0
+
+
+def _span_ms(rec, name):
+    for s in rec.get("spans", []):
+        if s.get("name") == name and s.get("ms") is not None:
+            return s["ms"]
+    return None
+
+
+def run_rung(harness, variant_env, smoke, ledger_path, timeout, log_dir,
+             tag):
+    """One timeoutable harness subprocess; returns (stdout, new ledger
+    records). Failures return (stdout-so-far, []) — the caller logs and
+    moves on (one wedged rung must not sink the pass)."""
+    cmd = [sys.executable]
+    if harness == "bench":
+        cmd += [os.path.join(REPO, "bench.py")]
+        variant_env = dict(variant_env, APEX_BENCH_ATTEMPTS="1")
+    elif harness == "profile_gpt":
+        cmd += [os.path.join(REPO, "benchmarks", "profile_gpt.py")]
+        variant_env = dict(variant_env, APEX_GPT_ONLY_STEP="1")
+    elif harness == "profile_optimizers":
+        cmd += [os.path.join(REPO, "benchmarks", "profile_optimizers.py")]
+    else:
+        raise ValueError(f"unknown harness {harness!r}")
+    env = _subprocess_env(variant_env, smoke, ledger_path)
+    n0 = _ledger_len(ledger_path)
+    try:
+        proc = subprocess.run(cmd, env=env, cwd=REPO, text=True,
+                              capture_output=True, timeout=timeout)
+        out = proc.stdout
+        if proc.returncode != 0:
+            sys.stderr.write((proc.stderr or "")[-1500:])
+            print(f"  {tag}: rc={proc.returncode}", flush=True)
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout if isinstance(e.stdout, str) else ""
+        print(f"  {tag}: timed out after {timeout}s", flush=True)
+    if log_dir:
+        try:
+            with open(os.path.join(log_dir, f"{tag}.log"), "w") as f:
+                f.write(out or "")
+        except OSError:
+            pass
+    return out or "", _new_records(ledger_path, n0)
+
+
+# A variant must beat the BUILT-IN default by this fraction before its
+# table entry flips the choice — measured-dispatch hysteresis: a noisy
+# box (or a flapping relay) must not commit a default flip the margin
+# can't distinguish from measurement noise. PERF.md §0 puts step-row
+# noise <5% at K>=16; smoke runs (K=2, shared CPU) additionally take
+# best-of-N (ctx["repeats"]) so a cold first subprocess can't decide.
+FLIP_MARGIN = 0.03
+
+
+def _measure(group, vname, venv, ctx):
+    """Measure one variant; returns {"value", "unit", "ledger",
+    "pins"} (lower-is-better for ms, higher for tokens/s) or None.
+    Shared-baseline runs are cached by (harness, pinned-env) so the
+    plain profile_gpt step is measured once across the four gpt
+    groups; ``ctx["repeats"]`` > 1 takes the best of N subprocess runs
+    (min ms / max tokens/s — outliers on a contended host are slow, so
+    best-of discards them). Tests monkeypatch THIS function."""
+    harness = group["harness"]
+    cache_key = (harness,
+                 tuple(sorted((k, v) for k, v in venv.items()
+                              if v is not None))) \
+        if isinstance(venv, dict) else (harness, vname)
+    cached = ctx["cache"].get(cache_key)
+    if cached is not None:
+        # shared-baseline reuse across groups: the measurement is the
+        # same run, but the pins recorded must be THIS group's marker
+        if isinstance(venv, dict):
+            return dict(cached, pins=dict(venv))
+        return cached
+    repeats = max(1, int(ctx.get("repeats", 1)))
+    if harness == "profile_optimizers":
+        # ONE subprocess measures both LAMB structures as pinned spans;
+        # best-of-N per span across repeats
+        for i in range(repeats):
+            out, recs = ctx["runner"](harness, {}, ctx["smoke"],
+                                      ctx["ledger"], ctx["timeout"],
+                                      ctx["log_dir"],
+                                      f"lamb_one_pass.r{i}")
+            rec = next((r for r in recs
+                        if r.get("harness") == "profile_optimizers"),
+                       None)
+            if rec is None:
+                continue
+            for name, span in (("two_pass", "FusedLAMB"),
+                               ("one_pass", "FusedLAMB 1pass")):
+                ms = _span_ms(rec, span)
+                if ms is None:
+                    continue
+                prev = ctx["cache"].get((harness, name))
+                if prev is None or ms < prev["value"]:
+                    ctx["cache"][(harness, name)] = {
+                        "value": ms, "unit": "ms",
+                        "ledger": rec.get("id"), "pins": {},
+                        "n_params": rec.get("n_params")}
+        return ctx["cache"].get((harness, vname))
+    pins = dict(venv)
+    best = None
+    for i in range(repeats):
+        tag = f"{group['name']}.{vname}" + (f".r{i}" if repeats > 1 else "")
+        out, recs = ctx["runner"](harness, venv, ctx["smoke"],
+                                  ctx["ledger"], ctx["timeout"],
+                                  ctx["log_dir"], tag)
+        result = None
+        if harness == "bench":
+            rec = None
+            for line in reversed(out.splitlines()):
+                if line.startswith("{") and line.rstrip().endswith("}"):
+                    try:
+                        rec = json.loads(line)
+                        break
+                    except ValueError:
+                        continue
+            if rec and not rec.get("error") \
+                    and not rec.get("relay_degraded") \
+                    and (rec.get("value") or 0) > 0 \
+                    and rec.get("ledger_id"):
+                # a relay-degraded line must never become a table entry
+                # — it measures the tunnel, not the chip (PERF.md §0)
+                result = {"value": rec["value"], "unit": "tokens/s",
+                          "ledger": rec["ledger_id"], "pins": pins}
+        else:  # profile_gpt
+            rec = next((r for r in reversed(recs)
+                        if r.get("harness") == "profile_gpt"), None)
+            if rec:
+                ms = _span_ms(rec, group.get("metric", "FULL train step"))
+                if ms is not None:
+                    result = {"value": ms, "unit": "ms",
+                              "ledger": rec.get("id"), "pins": pins}
+        if result is None:
+            continue
+        better = (best is None
+                  or (result["value"] < best["value"]
+                      if result["unit"] == "ms"
+                      else result["value"] > best["value"]))
+        if better:
+            best = result
+    if best:
+        ctx["cache"][cache_key] = best
+    return best
+
+
+def _upsert_entry(table_path, entry):
+    """Replace-or-append the entry for its key; corrupt lines are kept
+    verbatim (they are check_bench_labels findings, not ours to hide)."""
+    key = (entry["op"], entry["bucket"], entry["dtype"], entry["backend"])
+    lines = []
+    if os.path.exists(table_path):
+        with open(table_path) as f:
+            for line in f:
+                try:
+                    e = json.loads(line)
+                    if (e.get("op"), e.get("bucket"), e.get("dtype"),
+                            e.get("backend")) == key:
+                        continue  # superseded
+                except ValueError:
+                    pass
+                if line.strip():
+                    lines.append(line.rstrip("\n"))
+    lines.append(json.dumps(entry, sort_keys=True))
+    # atomic replace: a SIGTERM/timeout landing mid-write must not
+    # truncate the committed table (that would destroy every cashed
+    # rung and break the resume property)
+    tmp = table_path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    os.replace(tmp, table_path)
+    dispatch._reset_for_tests()  # drop the mtime cache
+
+
+def cashed(group, backend, table_path, ledger_ids):
+    """The existing table entry for this group's key IF its ledger id
+    resolves (the resume rule), else None. The lamb group's bucket is
+    record-derived, so it matches by op+backend instead."""
+    entries, _ = dispatch.load_table(table_path)
+    if group["dims"] is None:
+        for (op, _b, _d, be), e in entries.items():
+            if op == group["op"] and be == backend \
+                    and e.get("ledger") in ledger_ids:
+                return e
+        return None
+    key = (group["op"], dispatch.bucket(**group["dims"]), group["dtype"],
+           backend)
+    e = entries.get(key)
+    return e if e is not None and e.get("ledger") in ledger_ids else None
+
+
+def missing_rungs(smoke=False, table_path=None, ledger_path=None,
+                  backend=None):
+    """The rung GROUPS whose table entry is absent or stale (unresolved
+    ledger id) — the bounded warm set ``benchmarks/warm_cache.py``
+    AOT-warms ahead of this pass."""
+    table_path = table_path or dispatch.default_path()
+    ledger_path = ledger_path or ledger_mod.default_path()
+    backend = backend or ("cpu" if smoke else "tpu")
+    try:
+        ids = {r.get("id") for r in ledger_mod.read_ledger(ledger_path)}
+    except (OSError, ValueError):
+        ids = set()
+    return [g for g in rung_groups(smoke)
+            if cashed(g, backend, table_path, ids) is None]
+
+
+def main(argv=None, runner=run_rung):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="CPU pass at smoke shapes (cpu table entries)")
+    ap.add_argument("--table", default=None)
+    ap.add_argument("--ledger", default=None)
+    ap.add_argument("--budget-s", type=float, default=None,
+                    help="stop launching rungs once spent "
+                         "(default 3600, smoke 600)")
+    ap.add_argument("--rung-timeout", type=int, default=None,
+                    help="per-subprocess cap (default 900, smoke 180)")
+    ap.add_argument("--only", default=None,
+                    help="comma-separated group names")
+    ap.add_argument("--repeats", type=int, default=None,
+                    help="best-of-N runs per variant "
+                         "(default 1; smoke 2 — shared-CPU noise)")
+    ap.add_argument("--out", default=None, help="per-rung log dir")
+    args = ap.parse_args(argv)
+
+    smoke = args.smoke
+    table_path = args.table or dispatch.default_path()
+    ledger_path = args.ledger or ledger_mod.default_path()
+    budget = args.budget_s if args.budget_s is not None \
+        else (600 if smoke else 3600)
+    timeout = args.rung_timeout if args.rung_timeout is not None \
+        else (180 if smoke else 900)
+    backend = "cpu" if smoke else "tpu"
+    log_dir = args.out
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+
+    groups = rung_groups(smoke)
+    if args.only:
+        names = set(args.only.split(","))
+        unknown = names - {g["name"] for g in groups}
+        if unknown:
+            raise SystemExit(f"unknown rung(s): {sorted(unknown)}")
+        groups = [g for g in groups if g["name"] in names]
+
+    try:
+        ledger_ids = {r.get("id")
+                      for r in ledger_mod.read_ledger(ledger_path)}
+    except (OSError, ValueError):
+        ledger_ids = set()
+
+    ctx = {"cache": {}, "runner": runner, "smoke": smoke,
+           "ledger": ledger_path, "timeout": timeout, "log_dir": log_dir,
+           "repeats": args.repeats or (2 if smoke else 1)}
+    t0 = time.perf_counter()
+    done, skipped, dropped, failed = [], [], [], []
+    for group in groups:
+        existing = cashed(group, backend, table_path, ledger_ids)
+        if existing is not None:
+            print(f"{group['name']}: cashed "
+                  f"(choice={existing['choice']}, "
+                  f"ledger:{existing['ledger']}) — skip", flush=True)
+            skipped.append(group["name"])
+            continue
+        spent = time.perf_counter() - t0
+        if spent > budget:
+            # no silent caps: name every rung the budget dropped
+            dropped.append(group["name"])
+            continue
+        print(f"{group['name']}: measuring "
+              f"({len(group['variants'])} candidates, "
+              f"budget {budget - spent:.0f}s left)", flush=True)
+        results = {}
+        for vname, venv in group["variants"].items():
+            r = _measure(group, vname, venv, ctx)
+            if r is None:
+                print(f"  {group['name']}.{vname}: no measurement",
+                      flush=True)
+                continue
+            results[vname] = r
+            print(f"  {group['name']}.{vname}: {r['value']:.4g} "
+                  f"{r['unit']} (ledger:{r['ledger']})", flush=True)
+        if not results:
+            failed.append(group["name"])
+            continue
+        unit = next(iter(results.values()))["unit"]
+        pick = (min if unit == "ms" else max)(
+            results, key=lambda k: results[k]["value"])
+        # hysteresis: the FIRST variant of every group is the built-in
+        # default — a challenger must beat it by FLIP_MARGIN or the
+        # entry records the default (with the full A/B in "measured")
+        default_v = next(iter(group["variants"]))
+        if pick != default_v and default_v in results:
+            basev = results[default_v]["value"]
+            winv = results[pick]["value"]
+            gain = ((basev - winv) / basev if unit == "ms"
+                    else (winv - basev) / basev)
+            if gain < FLIP_MARGIN:
+                print(f"  {group['name']}: {pick} ahead by only "
+                      f"{gain * 100:.1f}% (< {FLIP_MARGIN * 100:.0f}% "
+                      f"flip margin) — keeping default "
+                      f"{default_v}", flush=True)
+                pick = default_v
+        best = results[pick]
+        dims = group["dims"]
+        if dims is None:  # lamb: bucket on the record's parameter count
+            n = best.get("n_params")
+            if not n:
+                failed.append(group["name"])
+                continue
+            dims = dict(n=n)
+        entry = dispatch.make_entry(
+            group["op"], dims, group["dtype"], backend, pick,
+            best["ledger"], pins=best["pins"],
+            measured={v: {"value": r["value"], "unit": r["unit"],
+                          "ledger": r["ledger"]}
+                      for v, r in results.items()},
+            rung=group["name"])
+        _upsert_entry(table_path, entry)
+        print(f"{group['name']}: WINNER {pick} -> table entry "
+              f"{entry['bucket']} ({backend})", flush=True)
+        done.append(group["name"])
+    summary = {"done": done, "skipped": skipped, "dropped": dropped,
+               "failed": failed, "table": table_path,
+               "wall_s": round(time.perf_counter() - t0, 1)}
+    if dropped:
+        print(f"BUDGET DROPPED (re-run to resume): {dropped}", flush=True)
+    print("autotune: " + json.dumps(summary), flush=True)
+    return 1 if (failed or dropped) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

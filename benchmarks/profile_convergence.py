@@ -270,19 +270,22 @@ def gpt_curves():
     # impl-parity leg — compare.py's ACTUAL assertion: the same O2 run
     # under the alternate kernel dispatch (rows attention + Pallas LN +
     # fused LM head) must produce the same trace
-    from apex_tpu.normalization import fused_layer_norm as _fln
+    # the real module's setter — a package-level `import ... as _fln`
+    # resolves to the re-exported FUNCTION and `_fln.USE_PALLAS = True`
+    # silently never flips the dispatch (tests/test_dispatch.py)
+    from apex_tpu.normalization.fused_layer_norm import set_use_pallas
     from apex_tpu.ops import attention as _attn
     model_alt = GPTModel(TransformerConfig(
         bf16=True, fused_lm_head=True,
         fused_lm_head_interpret=not ON_TPU, **common))
-    _fln.USE_PALLAS = True
+    set_use_pallas(True)
     _attn.set_default_impl("rows")
     try:
         ia, fa = make(model_alt)
         l2_alt, _, _ = train_curve(ia, fa, tx, "O2")
     finally:
-        _fln.USE_PALLAS = False
-        _attn.set_default_impl("flash")
+        set_use_pallas(None)
+        _attn.reset_default_impl()
     rel = np.abs(l2_alt - l2) / np.maximum(np.abs(l2), 1e-8)
     # the strict per-step gate ALWAYS covers the pre-decorrelation
     # prefix — a grossly wrong kernel (10%-off loss from step 1) must

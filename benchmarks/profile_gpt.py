@@ -46,6 +46,10 @@ from benchmarks._knobs import (apply_dispatch_knobs, fused_head_requested,
 apply_dispatch_knobs()
 FUSED_HEAD = fused_head_requested()
 REMAT = remat_granularity()
+# Autotune rung mode (benchmarks/autotune_steps.py): measure ONLY the
+# FULL-train-step row — an A/B pass pays for one number per rung inside
+# a budgeted window, not the whole component table.
+ONLY_STEP = os.environ.get("APEX_GPT_ONLY_STEP") == "1"
 
 B, S = (2, 128) if SMOKE else (8, 1024)
 K = 2 if SMOKE else 32  # scan length
@@ -58,7 +62,8 @@ cfg = TransformerConfig(
     vocab_size=512 if SMOKE else 50304,
     max_position_embeddings=S,
     hidden_dropout=0.0, attention_dropout=0.0, bf16=True,
-    fused_lm_head=FUSED_HEAD, fused_lm_head_interpret=FUSED_HEAD and SMOKE,
+    fused_lm_head=FUSED_HEAD,
+    fused_lm_head_interpret=bool(FUSED_HEAD) and SMOKE,
     recompute_granularity=REMAT)
 model = GPTModel(cfg)
 mesh = Mesh(np.asarray(jax.devices()[:1]), (TENSOR_AXIS,))
@@ -109,8 +114,9 @@ def make_fwd(eps, ids, pos, labels):
         return p, loss
     return body
 
-t_fwd = scan_time("fwd+loss", make_fwd, params, (ids, pos, labels),
-                  flops_per_iter=model_flops_fwd)
+if not ONLY_STEP:
+    scan_time("fwd+loss", make_fwd, params, (ids, pos, labels),
+              flops_per_iter=model_flops_fwd)
 
 # 2. fwd+bwd
 def make_fb(eps, ids, pos, labels):
@@ -123,8 +129,9 @@ def make_fb(eps, ids, pos, labels):
         return p, loss
     return body
 
-t_fb = scan_time("fwd+bwd", make_fb, params, (ids, pos, labels),
-                 flops_per_iter=model_flops_fb)
+if not ONLY_STEP:
+    scan_time("fwd+bwd", make_fb, params, (ids, pos, labels),
+              flops_per_iter=model_flops_fb)
 
 # 3. optimizer update alone
 tx = fused_adam(learning_rate=1e-4)
@@ -139,7 +146,8 @@ def make_opt(eps, g0):
         return (p, ns), ns.count.astype(jnp.float32)
     return body
 
-t_opt = scan_time("adam update", make_opt, (params, opt_state), (g0,))
+if not ONLY_STEP:
+    scan_time("adam update", make_opt, (params, opt_state), (g0,))
 
 # 4. scaler unscale+update alone
 scaler = LossScaler()
@@ -154,7 +162,8 @@ def make_sc(eps, g0):
         return ns, ns.loss_scale
     return body
 
-t_sc = scan_time("scaler unscale+update", make_sc, scaler.init(), (g0,))
+if not ONLY_STEP:
+    scan_time("scaler unscale+update", make_sc, scaler.init(), (g0,))
 
 # 5. FULL train step. One step body shared by the deterministic row and
 # the dropout A/B rows (row 10) so every row measures the SAME scaler/
@@ -194,6 +203,13 @@ t_step = scan_time("FULL train step", make_step,
                    flops_per_iter=model_flops_fb)
 if t_step:  # None under APEX_WARM_ONLY (compile-only, nothing timed)
     print(f"{'':28s} -> {B*S/t_step:.0f} tok/s")
+
+if ONLY_STEP:
+    # autotune rung: one number, one ledger record, out
+    TRACER.flush_ledger("profile_gpt", extra={
+        "shape": {"b": B, "s": S, "params_m": round(n_params / 1e6, 1)},
+        "only_step": True})
+    sys.exit(0)
 
 # 6. trunk-only fwd+bwd (no CE head / embedding)
 from apex_tpu.transformer.testing.standalone_transformer_lm import (

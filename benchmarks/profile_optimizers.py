@@ -9,6 +9,11 @@ achieved HBM bandwidth against the analytic floor:
   LAMB:  adds the per-tensor norm reductions (reads dominate the same way)
   SGD:   read g, p, buf; write p, buf    ->  5 fp32 passes
 
+Every run flushes one ledger record (spans per optimizer row incl. the
+"FusedLAMB 1pass" A/B rung plus ``n_params``), so
+``benchmarks/autotune_steps.py`` can cash the LAMB structure decision
+into a dispatch-table entry citing the record id.
+
 Results recorded in PERF.md §2/§6.
 Run:  PYTHONPATH=/root/repo:$PYTHONPATH python benchmarks/profile_optimizers.py
 """
@@ -28,9 +33,9 @@ from benchmarks._smoke import smoke_mode  # noqa: E402
 
 SMOKE = smoke_mode("APEX_BENCH_SMOKE")  # force-CPU tiny sanity mode
 
-from benchmarks._timing import (bench_k, measure_dispatch_overhead,  # noqa: E402
-                                sync)
+from benchmarks._timing import Span, Tracer, bench_k, sync  # noqa: E402
 
+from apex_tpu import compile_cache  # noqa: E402
 from apex_tpu.optimizers.fused_adam import fused_adam  # noqa: E402
 from apex_tpu.optimizers.fused_lamb import fused_lamb  # noqa: E402
 from apex_tpu.optimizers.fused_sgd import fused_sgd  # noqa: E402
@@ -48,9 +53,9 @@ SHAPES = ([(50304, 768), (1024, 768)]
 params = [jnp.asarray(rs.randn(*s) * 0.02, jnp.float32) for s in SHAPES]
 grads = [jnp.asarray(rs.randn(*s) * 1e-3, jnp.float32) for s in SHAPES]
 n = sum(p.size for p in params)
-OVERHEAD = measure_dispatch_overhead(K)
+TRACER = Tracer(K)
 print(f"{n/1e6:.1f}M params across {len(SHAPES)} tensors "
-      f"(K={K}, overhead {OVERHEAD*1e3:.1f} ms)")
+      f"(K={K}, overhead {TRACER.overhead_ms:.1f} ms)")
 
 
 def bench(name, tx, passes):
@@ -72,26 +77,48 @@ def bench(name, tx, passes):
         return params, state, out
 
     f = jax.jit(run, donate_argnums=(0, 1))
+    traffic = passes * 4 * n
+    floor = traffic / HBM
+    if compile_cache.warm_only():
+        # warm-start pass (APEX_WARM_ONLY=1): AOT-compile only
+        info, _ = compile_cache.warm(
+            f, (p0, state0, jnp.float32(0.0), grads))
+        span = Span(name, None, None, K, TRACER.overhead,
+                    extra={"warm_only": True, "warm": info})
+        TRACER.spans.append(span)
+        print(span.format_row(width=12))
+        return
     p1, s1, out = f(p0, state0, jnp.float32(0.0), grads)
     sync(out)
     t0 = time.perf_counter()
     _, _, out = f(p1, s1, jnp.float32(1e-30), grads)
     sync(out)
-    dt = (time.perf_counter() - t0 - OVERHEAD) / K
-    traffic = passes * 4 * n
-    floor = traffic / HBM
+    total = time.perf_counter() - t0
+    dt = (total - TRACER.overhead) / K
+    # the donated warm/timed pattern can't ride Tracer.time_call (the
+    # timed args ARE the warm call's outputs), so the span is built here
+    # with the same calibration metadata
+    span = Span(name, dt, total, K, TRACER.overhead,
+                extra={"passes": passes,
+                       "gbps": round(traffic / dt / 1e9, 1),
+                       "floor_pct": round(floor / dt * 100, 1)})
+    TRACER.spans.append(span)
     print(f"{name:12s} {dt*1e3:7.2f} ms/step  "
           f"{traffic/dt/1e9:6.0f} GB/s effective "
           f"({floor/dt*100:5.1f}% of the {floor*1e3:.1f} ms HBM floor)")
 
 
 bench("FusedAdam", fused_adam(1e-3), 7)
-bench("FusedLAMB", fused_lamb(1e-3), 7)
+bench("FusedLAMB", fused_lamb(1e-3, impl="two_pass"), 7)
 # one-pass flat-buffer A/B (PERF.md §2 queued row): LAMB is the worst
 # fused-optimizer row at 54.9% of its HBM floor (Adam 81.9%, §10b) and
 # the per-leaf loop's many small norm reductions are the suspect — the
 # one_pass impl does ONE segment_sum sweep instead. Same state layout,
-# so the row is directly comparable; default stays two_pass until this
-# lands on device (measured-dispatch rule).
+# so the row is directly comparable; both rows pin impl= per call so
+# the labels can't drift whatever the table/env says, and
+# autotune_steps.py turns the pair into the dispatch-table "lamb" entry.
 bench("FusedLAMB 1pass", fused_lamb(1e-3, impl="one_pass"), 7)
 bench("FusedSGD", fused_sgd(1e-2, momentum=0.9), 5)
+
+TRACER.flush_ledger("profile_optimizers", extra={
+    "n_params": int(n), "n_tensors": len(SHAPES)})

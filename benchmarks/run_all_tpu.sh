@@ -33,6 +33,13 @@ run bench_first      1900 env APEX_BENCH_ATTEMPTS=1 python bench.py
 # program — its full-step row is the §10b 102k tok/s evidence class —
 # runs while the warm is freshest, before the microbench queue.
 run gpt              1200 python benchmarks/profile_gpt.py
+# autotune THIRD: one budgeted pass over the queued step-level A/Bs
+# (gpt_rows, b=16, remat x2, LAMB one_pass, fused-head, ln-pallas) ->
+# dispatch-table entries citing ledger ids instead of prose. Resumable
+# (skips cashed rungs) and warm-cache-first (warm_cache.py AOT-warmed
+# the missing-rung program set on the first healthy probe), so a
+# re-entered pass only pays for what's still missing.
+run autotune         4500 python benchmarks/autotune_steps.py
 # Then the small-HBM harnesses: the relay's observed degraded mode
 # (PERF.md §6) selectively starves large-HBM programs while small ones
 # run at device speed, so a partially-healthy window is still best spent
@@ -50,9 +57,24 @@ run xent             1200 python benchmarks/profile_xent.py
 run xent_rb256        900 env APEX_XENT_ROW_BLOCK=256 python benchmarks/profile_xent.py
 # NEVER-measured BASELINE harnesses (configs 1-4) outrank the step A/Bs
 # (whose defaults already carry kernel-level measurements, PERF.md §10b)
-# — a short window must land the missing evidence class first
+# — a short window must land the missing evidence class first.
+# profile_resnet measures O1 AND O2 in one run (configs 1-2);
+# profile_pretrain is the calibrated-scan leg of configs 3-4; the two
+# examples/transformer/pretrain.py rows drive the SAME configs through
+# the Megatron-arg entry point end-to-end (VERDICT r5 item 3 — fill
+# BASELINE.md configs 1-4 on the next window), tp=1 on the one chip.
 run resnet           1200 python benchmarks/profile_resnet.py
 run pretrain         1800 python benchmarks/profile_pretrain.py
+run pretrain_bert    1500 env PYTHONPATH=. python examples/transformer/pretrain.py \
+    --model bert --num-layers 24 --hidden-size 1024 \
+    --num-attention-heads 16 --max-position-embeddings 512 \
+    --seq-length 512 --micro-batch-size 4 --optimizer lamb --lr 1e-4 \
+    --bf16 --train-iters 30 --log-interval 10
+run pretrain_gpt345  1500 env PYTHONPATH=. python examples/transformer/pretrain.py \
+    --model gpt --num-layers 24 --hidden-size 1024 \
+    --num-attention-heads 16 --max-position-embeddings 1024 \
+    --seq-length 1024 --micro-batch-size 2 --optimizer adam --lr 1e-4 \
+    --bf16 --train-iters 30 --log-interval 10
 # L1-analog convergence curves (GPT + RN50, O0 vs O2 + impl-parity leg):
 # 6 short training runs; the traces land in benchmarks/curves/
 run convergence      2400 python benchmarks/profile_convergence.py

@@ -25,6 +25,12 @@ timeoutable — a wedge on one must not starve the rest):
 * ``profile_gpt`` — the collection pass's second rung: under
   ``APEX_WARM_ONLY=1`` its Tracer AOT-compiles every row (the EXACT
   measured programs — zero drift between warm and measurement).
+* the **autotune A/B set** (``benchmarks/autotune_steps.py``) —
+  BOUNDED: only rungs whose dispatch-table entry is missing (or cites
+  an unresolvable ledger id) are warmed, with the same env the
+  autotune pass will measure under (``APEX_DISPATCH=off`` +
+  ``APEX_GPT_ONLY_STEP=1`` for the gpt rungs), so every budgeted rung
+  dispatches compile-free inside the window.
 
 Exit status: 0 when the scored program (bench b=8) warmed, else 1 —
 the other targets are upside, not the contract.
@@ -45,8 +51,16 @@ from bench import _last_json  # noqa: E402  (the ONE driver-line parser)
 def warm_target(name, cmd, extra_env, timeout):
     """Run one warm subprocess; returns ``(ok, rec)`` where ``rec`` is
     the target's JSON warm line (bench targets; None for Tracer
-    harnesses and crashes)."""
-    env = dict(os.environ, APEX_WARM_ONLY="1", **extra_env)
+    harnesses and crashes). A None value in ``extra_env`` UNSETS the
+    var (same semantics as autotune's measured subprocesses — a
+    leftover pin in the probe shell must not make the warmed program
+    differ from the measured one)."""
+    env = dict(os.environ, APEX_WARM_ONLY="1")
+    for k, v in extra_env.items():
+        if v is None:
+            env.pop(k, None)
+        else:
+            env[k] = v
     # warming REQUIRES the cache on (that is its entire job) — but the
     # escape hatch stays honored: an explicit APEX_COMPILE_CACHE=0 wins
     env.setdefault("APEX_COMPILE_CACHE", "1")
@@ -98,6 +112,42 @@ def main():
     warm_target("bench b=16", [sys.executable, bench],
                 {"APEX_BENCH_BATCH": "16"}, timeout)
     warm_target("profile_gpt", [sys.executable, gpt], {}, timeout)
+
+    # autotune A/B program set — BOUNDED: only rungs whose table entry
+    # is missing, warmed under the exact env the autotune pass measures
+    # with (APEX_DISPATCH=off: a table-resolved program would be a
+    # different cache key than the dispatch-blind A/B program)
+    try:
+        from benchmarks.autotune_steps import missing_rungs
+
+        missing = missing_rungs()
+    except Exception as e:
+        missing = []
+        print(f"warm_cache: autotune rung scan failed ({e})", flush=True)
+    opt = os.path.join(REPO, "benchmarks", "profile_optimizers.py")
+    seen = set()  # the shared gpt baseline is one program, warm it once
+    for g in missing:
+        if g["harness"] == "profile_optimizers":
+            warm_target("autotune lamb", [sys.executable, opt],
+                        {"APEX_DISPATCH": "off"}, timeout)
+            continue
+        for vname, venv in g["variants"].items():
+            # keep None values: warm_target UNSETS them, mirroring the
+            # env the autotune subprocess will actually measure under
+            env = dict(venv)
+            env["APEX_DISPATCH"] = "off"
+            if g["harness"] == "bench":
+                env.setdefault("APEX_BENCH_ATTEMPTS", "1")
+                cmd = [sys.executable, bench]
+            else:
+                env["APEX_GPT_ONLY_STEP"] = "1"
+                cmd = [sys.executable, gpt]
+            key = (g["harness"], tuple(sorted(
+                (k, v) for k, v in env.items() if v is not None)))
+            if key in seen:
+                continue
+            seen.add(key)
+            warm_target(f"autotune {g['name']}.{vname}", cmd, env, timeout)
 
     from apex_tpu import compile_cache
 

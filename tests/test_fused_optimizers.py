@@ -301,10 +301,15 @@ def test_fused_lamb_one_pass_matches_two_pass(kwargs):
 
 
 def test_fused_lamb_impl_knob_resolution(monkeypatch):
-    from apex_tpu.optimizers.fused_lamb import _resolve_impl
+    from apex_tpu.optimizers.fused_lamb import _resolve_impl, _table_impl
 
     monkeypatch.delenv("APEX_LAMB_IMPL", raising=False)
-    assert _resolve_impl(None) == "two_pass"  # measured-dispatch default
+    # unset = UNPINNED (None): resolved per parameter set at trace time
+    # — dispatch-table consult, whose miss is the measured two_pass seat
+    assert _resolve_impl(None) is None
+    monkeypatch.setenv("APEX_DISPATCH", "off")
+    assert _table_impl([jnp.zeros((4, 4))]) == "two_pass"
+    monkeypatch.delenv("APEX_DISPATCH", raising=False)
     monkeypatch.setenv("APEX_LAMB_IMPL", "one_pass")
     assert _resolve_impl(None) == "one_pass"  # process-wide preference
     assert _resolve_impl("two_pass") == "two_pass"  # explicit arg wins

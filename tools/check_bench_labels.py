@@ -25,13 +25,21 @@ Checks:
    within ±0.15 ms (captions round to 0.1), a range must bracket it.
    (At-least-one, not all: an A/B paragraph legitimately cites two
    records with two different overheads.)
+3. **Dispatch table** (``apex_tpu/dispatch/table.jsonl``) — every
+   entry parses and carries the required fields, its op/choice is in
+   the vocabulary, its ``ledger`` id resolves to a record, and every
+   knob in its ``pins`` matches the cited record's recorded ``knobs``
+   (a table entry claiming APEX_ATTN_IMPL=rows over a record measured
+   without the pin is the same label-drift class as a wrong caption —
+   runtime lookups skip a corrupt line and fall back, but here it is a
+   finding).
 
 New PERF.md table rows must cite their ledger record id in the caption
 (``ledger:<id>``) — uncited legacy paragraphs are not flagged, but they
 get no drift protection either.
 
 Usage: python tools/check_bench_labels.py [--perf PATH] [--ledger PATH]
-                                          [--verbose]
+                                          [--table PATH] [--verbose]
 Exit status: 0 when clean, 1 on any finding.
 """
 
@@ -43,6 +51,7 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from apex_tpu import dispatch as dispatch_mod  # noqa: E402
 from apex_tpu.telemetry import ledger as ledger_mod  # noqa: E402
 
 CITE_RE = re.compile(r"ledger:(lg-[0-9a-f]{10})")
@@ -132,11 +141,31 @@ def check_captions(perf_text, perf_path, records):
     return problems, cited
 
 
+def check_dispatch_table(path, records):
+    """Validate every dispatch-table entry against the ledger (check 3).
+    A missing table file is clean (the subsystem is additive); corrupt
+    lines — which runtime lookups skip with a silent fallback — are
+    findings here, so corruption can't persist in the committed table."""
+    if not os.path.exists(path):
+        return [], 0
+    by_id = {r.get("id"): r for r in records}
+    entries, problems = dispatch_mod.load_table(path)
+    problems = [f"dispatch table {p}" for p in problems]
+    for key, entry in sorted(entries.items(),
+                             key=lambda kv: tuple(map(str, kv[0]))):
+        tag = (f"{path}: entry {entry.get('op')}/{entry.get('bucket')}"
+               f"/{entry.get('dtype')}/{entry.get('backend')}")
+        for p in dispatch_mod.validate_entry(entry, by_id):
+            problems.append(f"{tag}: {p}")
+    return problems, len(entries)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--perf", default=os.path.join(REPO, "PERF.md"))
     ap.add_argument("--ledger",
                     default=os.path.join(REPO, "benchmarks", "ledger.jsonl"))
+    ap.add_argument("--table", default=dispatch_mod.default_path())
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
 
@@ -155,15 +184,19 @@ def main(argv=None):
     cap_problems, cited = check_captions(perf_text, args.perf, records)
     problems += cap_problems
 
+    table_problems, n_entries = check_dispatch_table(args.table, records)
+    problems += table_problems
+
     if args.verbose:
         print(f"{len(records)} ledger records; {cited} PERF.md citations "
-              f"checked")
+              f"checked; {n_entries} dispatch-table entries validated")
     if problems:
         for p in problems:
             print(f"DRIFT: {p}")
         print(f"FAIL: {len(problems)} problem(s)")
         return 1
-    print("OK: ledger schema valid, no caption drift")
+    print("OK: ledger schema valid, no caption drift, dispatch table "
+          "resolves")
     return 0
 
 
