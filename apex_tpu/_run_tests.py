@@ -39,9 +39,10 @@ SUITES = {
             "test_mosaic_block_rules.py"],
     "api_parity": ["test_api_parity_round3.py"],
     "harness": ["test_run_tests.py", "test_bench_contract.py",
-                "test_compile_cache.py"],
+                "test_compile_cache.py", "test_resilience.py"],
     "telemetry": ["test_telemetry.py", "test_bench_labels.py",
                   "test_dispatch.py"],
+    "api_audit": ["test_noop_knob_audit.py"],
     "checkpoint": ["test_checkpoint.py"],
     "data": ["test_data.py"],
     "examples": ["test_examples.py"],

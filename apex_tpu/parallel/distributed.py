@@ -92,15 +92,25 @@ def broadcast_params(params, axis_name="data", src_index=0):
     return jax.tree_util.tree_map(bcast, params)
 
 
+# The accepted-but-inert ctor knobs: eager-NCCL stream/bucketing
+# artifacts with no TPU counterpart (XLA's collective combiner and
+# async scheduler subsume them). This tuple is the CODE side of the
+# documented-no-op audit — docs/API.md's "Accepted-but-inert knobs"
+# table must list exactly these (tests/test_noop_knob_audit.py).
+NOOP_KNOBS = ("message_size", "delay_allreduce", "num_allreduce_streams",
+              "retain_allreduce_buffers", "allreduce_trigger_params",
+              "allreduce_communicators", "gradient_average_split_factor",
+              "prof")
+
+
 class DistributedDataParallel:
     """Stateless config object mirroring the reference ctor
     (apex/parallel/distributed.py:129-175); call ``average_gradients``
     inside your shard_map'd step.
 
-    ``message_size``/``num_allreduce_streams``/``delay_allreduce``/
-    ``allreduce_trigger_params``/``retain_allreduce_buffers`` are
-    eager-NCCL artifacts — accepted, warned once, ignored (XLA's collective
-    combiner and async scheduler subsume them).
+    The :data:`NOOP_KNOBS` ctor arguments are eager-NCCL artifacts —
+    accepted, warned once on a non-default value, ignored (XLA's
+    collective combiner and async scheduler subsume them).
     """
 
     def __init__(self, module=None, message_size=10000000,
@@ -123,6 +133,11 @@ class DistributedDataParallel:
             ("delay_allreduce", delay_allreduce, False),
             ("num_allreduce_streams", num_allreduce_streams, 1),
             ("retain_allreduce_buffers", retain_allreduce_buffers, False),
+            ("allreduce_trigger_params", allreduce_trigger_params, None),
+            ("allreduce_communicators", allreduce_communicators, None),
+            ("gradient_average_split_factor",
+             gradient_average_split_factor, None),
+            ("prof", prof, False),
         ):
             if val != default:
                 warnings.warn(

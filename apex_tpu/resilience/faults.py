@@ -1,0 +1,232 @@
+"""Deterministic relay fault injection (``APEX_FAULT_PLAN``) — TEST ONLY.
+
+Every recorded round-3/4/5 relay failure mode (PERF.md §6) can be
+replayed on CPU, deterministically, through the REAL drivers: the env
+var holds a JSON fault plan (or a path to one), inherited across the
+subprocess boundary (bench.py's ``_attempt_once``, autotune's rung
+subprocesses, warm_cache's targets), and the drivers call the hook
+points below at the places the live relay actually fails. The chaos
+suite (``tests/test_resilience.py``) is built on this.
+
+NEVER set ``APEX_FAULT_PLAN`` during scored collection:
+``benchmarks/run_all_tpu.sh`` and ``probe_and_collect.sh`` refuse to
+start under it, every ledger record written while a plan is active is
+stamped ``fault_plan: <hash>`` (inside the content-hashed id, so the
+stamp cannot be stripped after the fact), and
+``tools/check_bench_labels.py`` fails tier-1 if PERF.md or the dispatch
+table ever cites a stamped record — an injected run can never
+masquerade as a measurement.
+
+Plan format — a JSON object ``{"faults": [...]}`` (or bare list); each
+fault::
+
+    {"site":  "backend_init" | "mid_attempt" | "large_program" |
+              "compile" | "calibration_overhead" | "emit" | "verdict" |
+              "autotune_budget",
+     "kind":  "hang" | "raise" | "exit" | "fabricate" |
+              "sigterm_parent" | "inflate" | "truncate" | "degraded" |
+              "set_budget",
+     "match_env": {"VAR": "value" | null},   # null = must be unset
+     ... kind-specific fields ...}
+
+Failure-mode map (the §6 catalogue):
+
+=======================================  ================================
+recorded failure mode                     scripted as
+=======================================  ================================
+backend-init hang (round 3)               backend_init/hang
+relay-init crash (connection reset)       backend_init/raise or exit
+inflated per-dispatch overhead            calibration_overhead/inflate
+  (relay-degraded, calibration flap)        (→ bench's calibration-flap
+                                            error line)
+selective large-HBM starvation            large_program/hang with
+  (day-2/round-5 mode)                      min_batch
+remote-compile HTTP-500 (b=32 stall)      compile/raise
+mid-attempt SIGTERM (outer budget)        mid_attempt/sigterm_parent
+full-timeout wedge                        mid_attempt/hang
+truncated/corrupt JSON output             emit/truncate, or fabricate
+                                            with truncate_bytes
+relay-degraded / implausible verdict      verdict/degraded
+autotune budget starved                   autotune_budget/set_budget
+scripted window replay                    backend_init/fabricate
+                                            (prints a canned record,
+                                            stamped, and exits)
+=======================================  ================================
+
+Kind-specific fields: ``seconds`` (hang: sleep N then continue; absent
+= forever), ``message``/``rc`` (raise/exit), ``record``/``rc``/
+``truncate_bytes`` (fabricate), ``add_s`` (inflate), ``bytes``
+(truncate), ``degraded_kind`` (degraded: relay|implausible|large_hbm),
+``budget_s`` (set_budget), ``min_batch`` (large_program matcher).
+
+Stdlib-only, and every check is a no-op dict lookup when the env var is
+unset — the hooks cost nothing on the scored path.
+"""
+
+import hashlib
+import json
+import os
+import signal
+import sys
+import time
+
+ENV = "APEX_FAULT_PLAN"
+
+_cache = {"raw": None, "plan": None, "hash": None}
+
+
+def active():
+    return bool(os.environ.get(ENV))
+
+
+def plan():
+    """The parsed fault list (possibly empty). Raises ValueError on an
+    unparseable plan — a chaos test with a broken plan must fail, not
+    silently run healthy."""
+    raw = os.environ.get(ENV)
+    if not raw:
+        return []
+    if _cache["raw"] == raw:
+        return _cache["plan"]
+    text = raw
+    if not raw.lstrip().startswith(("{", "[")):
+        with open(raw) as f:
+            text = f.read()
+    parsed = json.loads(text)
+    faults = parsed.get("faults", []) if isinstance(parsed, dict) \
+        else parsed
+    if not isinstance(faults, list):
+        raise ValueError(f"{ENV}: fault plan must be a list of faults")
+    canon = json.dumps(faults, sort_keys=True)
+    _cache.update(
+        raw=raw, plan=faults,
+        hash="fp-" + hashlib.sha1(canon.encode()).hexdigest()[:10])
+    return faults
+
+
+def plan_hash():
+    """``fp-<sha1[:10]>`` of the canonical active plan, or None. Stamped
+    by the ledger into every record written under injection."""
+    if not active():
+        return None
+    plan()
+    return _cache["hash"]
+
+
+def _match(fault, ctx):
+    for k, want in (fault.get("match_env") or {}).items():
+        if os.environ.get(k) != want:
+            return False
+    if "min_batch" in fault and ctx.get("batch") is not None \
+            and ctx["batch"] < fault["min_batch"]:
+        return False
+    return True
+
+
+def _say(fault, extra=""):
+    print(f"# FAULT[{plan_hash()}] site={fault.get('site')} "
+          f"kind={fault.get('kind')}{extra}", file=sys.stderr, flush=True)
+
+
+def _hang(fault):
+    _say(fault, f" (sleep {fault.get('seconds', 'forever')})")
+    if "seconds" in fault:
+        time.sleep(float(fault["seconds"]))
+        return
+    while True:
+        time.sleep(60)
+
+
+def fire(site, **ctx):
+    """Execute any matching faults at *site*. May hang, raise, exit, or
+    print a fabricated record and exit — exactly what the live relay
+    does to the process at that point."""
+    if not active():
+        return
+    for fault in plan():
+        if fault.get("site") != site or not _match(fault, ctx):
+            continue
+        kind = fault.get("kind")
+        if kind == "hang":
+            _hang(fault)
+        elif kind == "raise":
+            _say(fault)
+            raise RuntimeError(fault.get(
+                "message", f"injected fault at {site}"))
+        elif kind == "exit":
+            _say(fault)
+            sys.exit(int(fault.get("rc", 3)))
+        elif kind == "sigterm_parent":
+            _say(fault, f" -> SIGTERM pid {os.getppid()}")
+            os.kill(os.getppid(), signal.SIGTERM)
+            # stay in-flight: the parent's handler decides our fate
+            # (bench's on_term SIGKILLs exactly the in-flight child)
+            _hang(dict(fault, kind="hang"))
+        elif kind == "fabricate":
+            # scripted window replay: print a canned driver record —
+            # STAMPED with the plan hash inside the line itself — and
+            # exit, without ever touching a backend
+            rec = dict(fault.get("record") or {})
+            rec.setdefault("fault_plan", plan_hash())
+            line = json.dumps(rec)
+            if "truncate_bytes" in fault:
+                line = line[:int(fault["truncate_bytes"])]
+            _say(fault)
+            print(line, flush=True)
+            sys.exit(int(fault.get("rc", 0)))
+
+
+def transform(site, value, **ctx):
+    """Value-transforming faults (e.g. ``calibration_overhead/inflate``:
+    the relay flap that inflates the measured per-dispatch overhead so
+    the subtraction straddles — bench's calibration-flap line)."""
+    if not active():
+        return value
+    for fault in plan():
+        if fault.get("site") != site or not _match(fault, ctx):
+            continue
+        if fault.get("kind") == "inflate":
+            _say(fault, f" (+{fault.get('add_s', 1e6)}s)")
+            value = value + float(fault.get("add_s", 1e6))
+    return value
+
+
+def transform_output(line):
+    """``emit``-site faults: corrupt/truncate the driver's one JSON line
+    the way a wedging relay teardown does."""
+    if not active():
+        return line
+    for fault in plan():
+        if fault.get("site") != "emit" or not _match(fault, {}):
+            continue
+        if fault.get("kind") == "truncate":
+            _say(fault)
+            line = line[:int(fault.get("bytes", 20))]
+    return line
+
+
+def injected_degraded():
+    """``verdict``-site degraded kind (``relay | implausible |
+    large_hbm``) or None — consulted by
+    :func:`apex_tpu.resilience.classify_measurement`."""
+    if not active():
+        return None
+    for fault in plan():
+        if fault.get("site") == "verdict" \
+                and fault.get("kind") == "degraded" and _match(fault, {}):
+            return fault.get("degraded_kind", "relay")
+    return None
+
+
+def override_budget(budget_s):
+    """``autotune_budget``-site faults: starve the autotune pass's
+    global budget so the LOUD-drop path is exercised."""
+    if not active():
+        return budget_s
+    for fault in plan():
+        if fault.get("site") == "autotune_budget" \
+                and fault.get("kind") == "set_budget" \
+                and _match(fault, {}):
+            _say(fault, f" (budget {budget_s} -> {fault.get('budget_s', 0)})")
+            budget_s = float(fault.get("budget_s", 0))
+    return budget_s

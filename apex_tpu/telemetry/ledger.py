@@ -91,6 +91,22 @@ def make_record(harness, platform, dispatch_overhead_ms, k, relay=None,
     }
     if extra:
         rec.update(extra)
+    if os.environ.get("APEX_FAULT_PLAN"):
+        # any record produced under fault injection (the test-only
+        # APEX_FAULT_PLAN — apex_tpu.resilience.faults) is stamped with
+        # the plan hash BEFORE the content id is computed, so the stamp
+        # is tamper-evident: an injected run can never masquerade as a
+        # measurement (tools/check_bench_labels.py refuses citations of
+        # stamped records in tier-1). An ACTIVE-but-unresolvable plan
+        # (bad path, malformed JSON) still stamps — a sentinel, never a
+        # silent omission that would let the record pass as clean.
+        try:
+            from apex_tpu.resilience import faults as _faults
+
+            fp = _faults.plan_hash() or "fp-unresolvable"
+        except Exception:
+            fp = "fp-unresolvable"
+        rec["fault_plan"] = fp
     rec["id"] = record_id(rec)
     return rec
 
@@ -117,8 +133,11 @@ def append_record(harness, platform, dispatch_overhead_ms, k, relay=None,
 
 def read_ledger(path=None):
     """Parse a ledger file into a list of records. Raises ValueError
-    (with the line number) on an unparseable line — a corrupt ledger is
-    a finding, not something to skip past silently."""
+    (with the line number) on an unparseable OR non-object line — a
+    corrupt/truncated ledger is a finding, not something to skip past
+    silently, and a line truncated down to a bare JSON scalar (``42``,
+    ``"harness"``) must fail here with its line number instead of
+    crashing a consumer with an AttributeError later."""
     path = path or ledger_path()
     records = []
     with open(path) as f:
@@ -127,10 +146,15 @@ def read_ledger(path=None):
             if not line:
                 continue
             try:
-                records.append(json.loads(line))
+                rec = json.loads(line)
             except ValueError as e:
                 raise ValueError(f"{path}:{lineno}: unparseable ledger "
                                  f"line ({e})") from None
+            if not isinstance(rec, dict):
+                raise ValueError(
+                    f"{path}:{lineno}: ledger line is not a JSON object "
+                    f"(truncated line? parsed as {type(rec).__name__})")
+            records.append(rec)
     return records
 
 

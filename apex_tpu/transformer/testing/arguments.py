@@ -38,6 +38,28 @@ class ArgsError(ValueError):
     """Raised when cross-validation fails (reference uses bare asserts)."""
 
 
+# The accepted-but-inert MegatronArgs fields: CUDA-runtime knobs kept
+# for reference parity that drive NOTHING in the TPU tree — recorded on
+# the args object, never consumed by any model/optimizer/example code
+# path. This tuple is the CODE side of the documented-no-op audit:
+# docs/API.md's "Accepted-but-inert knobs" table must list exactly
+# these, and tests/test_noop_knob_audit.py mechanically verifies both
+# the doc match and the inertness (no field below may be read outside
+# this module — note `masked_softmax_fusion` is NOT here: it flows into
+# TransformerConfig and gates the FusedScaleMaskSoftmax fused path, so
+# documenting it as a no-op was label drift, fixed with this audit).
+INERT_CUDA_KNOBS = (
+    "persist_layer_norm",              # persistent-kernel CUDA LN variant
+    "bias_gelu_fusion",                # CUDA fused-kernel toggle; XLA fuses
+    "bias_dropout_fusion",             # CUDA fused-kernel toggle; XLA fuses
+    "gradient_accumulation_fusion",    # CUDA fused wgrad-accum; XLA fuses
+    "cpu_offload",                     # CUDA unified-memory offload
+    "use_contiguous_buffers_in_local_ddp",  # NCCL coalescing buffers
+    "use_cpu_initialization",          # dodge CUDA OOM at model build
+    "empty_unused_memory_level",       # torch.cuda.empty_cache cadence
+)
+
+
 @dataclasses.dataclass
 class MegatronArgs:
     # --- network size (reference :350-394) ---
@@ -88,6 +110,10 @@ class MegatronArgs:
     dataloader_type: Optional[str] = None  # single|cyclic
     async_tensor_model_parallel_allreduce: bool = True
     cpu_offload: bool = False
+    # accepted-but-inert (INERT_CUDA_KNOBS): the reference's persistent-
+    # kernel CUDA LayerNorm selector; the TPU LN dispatch is the
+    # measured jnp/Pallas choice (PERF.md §4), not a residency flag
+    persist_layer_norm: bool = False
 
     # --- initialization (reference :585-598) ---
     seed: int = 1234

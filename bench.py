@@ -168,6 +168,15 @@ def _warm_bench_programs(programs, platform=None):
 
 
 def main():
+    # fault hooks FIRST (apex_tpu.resilience.faults — no-ops unless the
+    # test-only APEX_FAULT_PLAN is set): the backend-init hang and the
+    # relay-init crash are failures that strike before any backend
+    # import, so their injection points sit there too
+    from apex_tpu import resilience
+    from apex_tpu.resilience import faults
+    faults.fire("backend_init")
+    faults.fire("mid_attempt")
+
     # smoke_mode BEFORE any backend-touching import (_smoke.py contract);
     # it also activates the persistent compile cache (default ON for
     # real runs, OFF for smoke; APEX_COMPILE_CACHE=1/0 overrides)
@@ -231,6 +240,11 @@ def main():
         # A/B (autotune_steps --smoke) can exercise the ladder locally
         b, s, iters = _default_batch(cfg, 2, s=128), 128, 3
         peak_flops = None
+
+    # §6 selective-starvation injection point: the relay's observed
+    # degraded mode starves programs by working-set size, so the fault
+    # matcher keys on the batch the attempt is about to build
+    faults.fire("large_program", batch=b)
 
     model = GPTModel(cfg)
     mesh = Mesh(np.asarray(jax.devices()[:1]), (TENSOR_AXIS,))
@@ -323,6 +337,15 @@ def main():
         }, platform=platform))
 
     overhead = measure_dispatch_overhead(iters)
+    # calibration-flap injection point: a relay flap straddling the
+    # calibration inflates the measured overhead relative to the timed
+    # scan — the recorded round-4 "non-positive step time" mode
+    overhead = faults.transform("calibration_overhead", overhead)
+
+    # remote-compile failure injection point: the relay's remote-compile
+    # helper returns HTTP 500 on oversized configs and is the component
+    # that wedges first (PERF.md §6/§10b)
+    faults.fire("compile", batch=b)
 
     # compile + warm + drain (donated inputs: rebind the carried state)
     print(f"# compiling {iters}-step scan at b={b} s={s} ...",
@@ -358,7 +381,7 @@ def main():
         # the dispatch-overhead calibration ran in a slower relay regime
         # than the timed scan (the relay flaps) — the subtraction went
         # negative and no throughput can be derived from this run
-        print(json.dumps({
+        flap = {
             "metric": f"gpt2s_train_tokens_per_sec ({platform})",
             "value": 0, "unit": "tokens/s", "vs_baseline": 0, "mfu": None,
             "dispatch_overhead_ms": round(overhead * 1e3, 1),
@@ -367,7 +390,10 @@ def main():
             "ledger_id": ledger_record(True, "calibration-flap", value=0),
             "error": "non-positive step time after overhead subtraction "
                      "(relay flap straddled the calibration); "
-                     "measurement unusable"}), flush=True)
+                     "measurement unusable"}
+        if faults.plan_hash():
+            flap["fault_plan"] = faults.plan_hash()
+        print(faults.transform_output(json.dumps(flap)), flush=True)
         return
 
     tokens_per_sec = b * s / dt
@@ -376,21 +402,21 @@ def main():
     if peak_flops:
         mfu = round(6.0 * n_params * b * s / dt / peak_flops, 4)
 
-    # The same program measured 37.6% MFU device-side (PERF.md §1); an MFU
-    # below 5% on TPU means the relay — not the chip — dominated the
-    # measurement (observed during the round-3 outage: ~34 s/dispatch).
-    # Only meaningful at MXU-feeding batch sizes (the threshold was
-    # calibrated at b=8/16) — tiny APEX_BENCH_BATCH overrides are exempt.
-    degraded = on_tpu and mfu is not None and mfu < 0.05 and b >= 8
-    # the opposite flap order inflates the number instead: an MFU beyond
-    # any physically plausible value means the overhead calibration ran
-    # in a slower regime than the timed scan — flag it like a degraded
-    # run (kept out of the baseline and the healthy gate)
-    implausible = on_tpu and mfu is not None and mfu > 0.6
-    degraded = degraded or implausible
+    # The MFU-envelope degradation verdict (thresholds and their
+    # PERF.md §1/§6 calibration live in apex_tpu.resilience — the one
+    # classifier the watchdog, the probe CLI and autotune share): <5%
+    # MFU on TPU at MXU-feeding batches = relay-dominated; >60% =
+    # implausible calibration straddle. A fault plan can inject the
+    # verdict deterministically (the record is fault-stamped below).
+    degraded_kind = resilience.classify_measurement(
+        on_tpu=on_tpu, mfu=mfu, batch=b)
+    implausible = degraded_kind == "implausible"
+    degraded = degraded_kind is not None
 
-    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                 "BENCH_BASELINE.json")
+    # APEX_BENCH_BASELINE redirects the baseline store (chaos tests
+    # exercise the seeding gate without touching the committed series)
+    baseline_path = os.environ.get("APEX_BENCH_BASELINE") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_BASELINE.json")
     # the unqualified key is the DEFAULT-batch series; a non-default TPU
     # batch (the ladder's b=16 upside, APEX_BENCH_BATCH overrides) gets
     # its own _b{N}-suffixed series — cross-batch ratios would measure
@@ -431,8 +457,6 @@ def main():
         # the default-off path is jaxpr-identical to uninstrumented
         "telemetry": bool(telemetry.enabled()),
     }
-    degraded_kind = (("implausible" if implausible else "relay")
-                     if degraded else None)
     ledger_id = ledger_record(
         bool(degraded), degraded_kind, value=round(tokens_per_sec, 1),
         unit="tokens/s", mfu=mfu, config=config)
@@ -457,6 +481,11 @@ def main():
         # of the pin-the-label rule
         "dispatch": _dispatch_snapshot(),
     }
+    if faults.plan_hash():
+        # a run under fault injection is stamped in the line itself (the
+        # ledger record carries the stamp inside its content-hashed id):
+        # an injected run can never masquerade as a measurement
+        result["fault_plan"] = faults.plan_hash()
     if telemetry.enabled():
         # flush the in-step scalars (stacked by the timed scan) + the
         # host-derived throughput to the metrics sink — AFTER the timed
@@ -481,41 +510,36 @@ def main():
             "TPU relay degraded during this run (per-step time far outside "
             "the device envelope measured in PERF.md §1: 82.5 ms/step, "
             "37.6% MFU at b=8); value reflects tunnel latency, not the chip")
-    print(json.dumps(result), flush=True)
+    # emit-site faults model the wedging-teardown truncation of the one
+    # JSON line (no-op without APEX_FAULT_PLAN)
+    print(faults.transform_output(json.dumps(result)), flush=True)
 
 
 def _last_json(text):
-    """(line, record) of the last PARSEABLE JSON line in *text*, skipping
-    brace-delimited non-JSON noise (e.g. a repr dict printed during relay
-    teardown); (None, None) when there is none. The one scanner behind
-    the watchdog, the timeout path, and the collection gate."""
-    for line in reversed((text or "").splitlines()):
-        if line.startswith("{") and line.rstrip().endswith("}"):
-            try:
-                return line, json.loads(line)
-            except ValueError:
-                continue
-    return None, None
+    """(line, record) of the last PARSEABLE JSON line in *text* —
+    delegates to apex_tpu.resilience.last_json, the one scanner behind
+    the watchdog, the timeout path, the collection gate and the probe
+    CLI."""
+    from apex_tpu import resilience
+
+    return resilience.last_json(text)
 
 
 def _requested_backend(rec, smoke=False):
-    """True when *rec* was measured on the requested backend: the TPU,
-    unless *smoke* (where CPU is the requested backend). The load-bearing
-    guard keeping silent-CPU-fallback numbers out of the headline — used
-    by the watchdog's best-selection, its exit code, and the collection
-    gate alike."""
-    return "(tpu)" in rec.get("metric", "") or smoke
+    """Delegates to apex_tpu.resilience.requested_backend — the guard
+    keeping silent-CPU-fallback numbers out of the headline."""
+    from apex_tpu import resilience
+
+    return resilience.requested_backend(rec, smoke)
 
 
 def _healthy_record(rec, smoke=False):
-    """True when *rec* (a parsed result line) is a healthy measurement on
-    the requested backend: no degraded 'note', no 'error', a positive
-    value, and `_requested_backend`. Single source of truth for the
-    watchdog's stop condition and benchmarks/probe_and_collect.sh's
-    collection gate."""
-    return ("error" not in rec and "note" not in rec
-            and (rec.get("value") or 0) > 0
-            and _requested_backend(rec, smoke))
+    """Delegates to apex_tpu.resilience.healthy — the single health
+    classifier behind the watchdog's stop condition, the probe CLI, and
+    benchmarks/probe_and_collect.sh's collection gate."""
+    from apex_tpu import resilience
+
+    return resilience.healthy(rec, smoke=smoke)
 
 
 def _healthy_json_line(text, smoke=False):
@@ -546,7 +570,7 @@ def _config_ladder(attempts, smoke):
     return [{}, {"APEX_BENCH_BATCH": "16"}] + [{}] * (attempts - 2)
 
 
-def _attempt_once(state, extra_env=None, timeout_cap=None):
+def _attempt_once(state, extra_env=None, timeout_cap=None, attempt=0):
     """One watchdogged run of main() in a subprocess.
 
     Returns ``(line, record, returncode_or_None)`` — line and record are
@@ -562,13 +586,20 @@ def _attempt_once(state, extra_env=None, timeout_cap=None):
     live Popen handle is parked in ``state["child"]`` so the SIGTERM
     handler can take down exactly the in-flight attempt (not the whole
     process group, which may be shared with a supervising driver).
+
+    This is the subprocess boundary the fault-injection layer is
+    honored across: ``APEX_FAULT_PLAN`` rides the inherited env into
+    the child (where main()'s hook points fire), and the attempt index
+    is exported as ``APEX_BENCH_ATTEMPT`` so a fault plan can script a
+    per-attempt window timeline (``match_env``).
     """
     import subprocess
 
-    env = dict(os.environ, APEX_BENCH_INNER="1", **(extra_env or {}))
-    timeout = int(os.environ.get("APEX_BENCH_TIMEOUT", "1800"))
-    if timeout_cap is not None:
-        timeout = min(timeout, timeout_cap)
+    from apex_tpu import resilience
+
+    env = dict(os.environ, APEX_BENCH_INNER="1",
+               APEX_BENCH_ATTEMPT=str(attempt), **(extra_env or {}))
+    timeout = resilience.attempt_timeout(timeout_cap)
     label = ("cpu" if os.environ.get("APEX_BENCH_SMOKE") == "1"
              else "tpu")
 
@@ -593,21 +624,11 @@ def _attempt_once(state, extra_env=None, timeout_cap=None):
         line, rec = _last_json(out)
         if rec is not None:
             return line, rec, None
-        rec = {
-            "metric": f"gpt2s_train_tokens_per_sec ({label})",
-            "value": 0,
-            "unit": "tokens/s",
-            "vs_baseline": 0,
-            "mfu": None,
-            # structured wedge marker: the lazy-cap arming keys on THIS,
-            # never on the error wording — a real error record forwarded
-            # after a teardown wedge must not arm the cap
-            "timed_out": True,
-            "relay_degraded": True,
-            "error": f"bench timed out after {timeout}s (TPU relay "
-                     "unresponsive — see PERF.md §6; device-side numbers "
-                     "for this tree are in PERF.md §1)",
-        }
+        # structured wedge marker (resilience.timeout_record stamps
+        # "timed_out": the lazy-cap arming keys on THIS, never on the
+        # error wording — a real error record forwarded after a
+        # teardown wedge must not arm the cap)
+        rec = resilience.timeout_record(label, timeout)
         return json.dumps(rec), rec, None
     finally:
         state["child"] = None
@@ -632,15 +653,25 @@ def _watchdog():
     Exactly ONE JSON line goes to stdout. If an outer timeout kills us
     mid-retry (run_all_tpu.sh budgets bench generously, but the driver's
     budget is unknown), the SIGTERM handler flushes the best line seen so
-    far instead of dying silently and discarding every measurement.
-    Returns 0 when a real measurement (healthy or degraded) was
-    produced on the requested backend; the child's exit code when every
-    attempt crashed; 1 otherwise.
+    far — plus a ``bench_watchdog`` ledger record, so a terminated
+    window leaves evidence — instead of dying silently and discarding
+    every measurement. Returns 0 when a real measurement (healthy or
+    degraded) was produced on the requested backend; the child's exit
+    code when every attempt crashed; 1 otherwise.
+
+    Classification (healthy / degraded / implausible tiers), the retry
+    pacing and the lazy wedge cap are apex_tpu.resilience — the single
+    implementation shared with the probe CLI and autotune.
     """
     import signal
 
-    attempts = max(1, int(os.environ.get("APEX_BENCH_ATTEMPTS", "3")))
-    retry_wait = int(os.environ.get("APEX_BENCH_RETRY_WAIT", "120"))
+    from apex_tpu import resilience
+    # imported HERE, not inside the signal handler: the import machinery
+    # must never run under a mid-import SIGTERM
+    from apex_tpu.telemetry import ledger as _tledger
+
+    policy = resilience.RetryPolicy()
+    attempts = policy.attempts
     smoke = os.environ.get("APEX_BENCH_SMOKE") == "1"
     # "best"/"fallback" hold (line, record) pairs; best_rank orders
     # candidates as (healthy?, value) so a healthy measurement always
@@ -673,6 +704,16 @@ def _watchdog():
 
     def on_term(signum, frame):
         flush_best()
+        # a terminated window is evidence too: record what was flushed
+        # (never raises; smoke runs skip unless APEX_TELEMETRY_LEDGER
+        # is set — the ledger's own rule)
+        pair = state["best"] or state["fallback"]
+        _tledger.append_record(
+            harness="bench_watchdog",
+            platform="cpu" if smoke else "tpu",
+            dispatch_overhead_ms=None, k=None,
+            extra={"terminated": "SIGTERM",
+                   "flushed": pair[1] if pair is not None else None})
         child = state["child"]
         if child is not None:
             # SIGKILL, not SIGTERM: the observed wedge is a child stuck
@@ -691,22 +732,17 @@ def _watchdog():
     ladder = _config_ladder(attempts, smoke)
     distinct = {json.dumps(c, sort_keys=True) for c in ladder}
     healthy_configs = set()
-    next_wait = retry_wait
     last_outcome = "relay-bound"
-    # Lazy wedge cap: the first attempt always gets the full
-    # APEX_BENCH_TIMEOUT (a degraded-but-live run that needs it keeps
-    # it, and a healthy run costs nothing extra). Once an attempt TIMES
-    # OUT — this relay needed more than the full budget, the §6
-    # wedge/starvation signature — the remaining attempts run under a
-    # 900s cap. A healthy retry finishes well under it; 900s (vs the
-    # 600s this started as) covers the observed degraded-attempt
-    # envelope (round-5 window attempts ran ~4 min, with slow-compile
-    # headroom), so a degraded-but-COMPLETE retry still lands as a real
-    # rc-0 measurement instead of being converted into a fabricated
-    # timeout. What the cap trades away is only the hours a wedged
-    # relay would otherwise burn (observed: init-hung children ride
-    # their entire timeout).
-    timeout_cap = None
+    # Lazy wedge cap (resilience.RetryPolicy): the first attempt always
+    # gets the full APEX_BENCH_TIMEOUT (a degraded-but-live run that
+    # needs it keeps it, and a healthy run costs nothing extra). Once an
+    # attempt TIMES OUT — this relay needed more than the full budget,
+    # the §6 wedge/starvation signature — the remaining attempts run
+    # under the WEDGE_CAP_S (900s) cap: a healthy retry finishes well
+    # under it, a degraded-but-COMPLETE retry still lands as a real
+    # rc-0 measurement (the cap covers the observed degraded-attempt
+    # envelope), and only the hours a wedged relay would burn are
+    # traded away.
     for i in range(attempts):
         cfg_key = json.dumps(ladder[i], sort_keys=True)
         # a config whose measurement is already in hand needn't re-run;
@@ -728,15 +764,18 @@ def _watchdog():
                 # is up; jump straight to the next config
                 print(f"# attempt {i} healthy; next config "
                       f"({i + 1}/{attempts})", file=sys.stderr, flush=True)
+                policy.pop_wait()
             else:
+                wait = policy.pop_wait()
                 print(f"# attempt {i} was {last_outcome}; retrying in "
-                      f"{next_wait}s ({i + 1}/{attempts})",
+                      f"{wait}s ({i + 1}/{attempts})",
                       file=sys.stderr, flush=True)
-                time.sleep(next_wait)
-            next_wait = retry_wait
+                time.sleep(wait)
         line, rec, rc = _attempt_once(state, ladder[i],
-                                      timeout_cap=timeout_cap)
-        if rc is None and rec is not None and rec.get("timed_out"):
+                                      timeout_cap=policy.timeout_cap,
+                                      attempt=i)
+        armed = policy.note_attempt(rec, rc)
+        if armed:
             # rc None + the fabricated timed_out record = the attempt
             # rode its ENTIRE budget without producing a JSON line
             # (wedge signature) — cap the remaining attempts. Keyed on
@@ -744,8 +783,20 @@ def _watchdog():
             # error: a teardown-wedge after printing a real error
             # record (e.g. the calibration-flap line) forwards that
             # record with rc None too, and a completed attempt must
-            # never arm the cap (ADVICE r5).
-            timeout_cap = 900
+            # never arm the cap (ADVICE r5; the arming rule lives in
+            # resilience.RetryPolicy.note_attempt).
+            print(f"# wedge signature (timed_out, no JSON inside the "
+                  f"budget) — capping remaining attempts at {armed}s",
+                  file=sys.stderr, flush=True)
+        if rec is not None and rec.get("timed_out") and healthy_configs:
+            # window context: a small-working-set config already ran at
+            # device speed in these same minutes — this timeout is the
+            # §6 SELECTIVE LARGE-HBM STARVATION mode, not a full wedge
+            print("# large-HBM starvation signature: small-HBM config "
+                  "healthy while this config rode its whole budget "
+                  f"(verdict: "
+                  f"{resilience.classify(rec, smoke, small_hbm_ok=True)})",
+                  file=sys.stderr, flush=True)
         if rec is None:
             # only a crash lands here (the timeout path always
             # fabricates an error record): the child exited with no
@@ -760,7 +811,7 @@ def _watchdog():
                   flush=True)
             state["crash_rc"] = rc
             last_outcome = "a crash"
-            next_wait = min(retry_wait, 15)
+            policy.note_crash()
             continue
         value = rec.get("value") or 0
         # a real measurement is one from the requested backend: when a
@@ -784,16 +835,11 @@ def _watchdog():
             # a fused-head "A/B" on the wrong backend
             distinct = {cfg_key}
         last_outcome = "relay-bound"
-        # tier 2: healthy; tier 1: degraded (real, tunnel-bound); tier
-        # 0: implausible calibration artifact — its inflated value must
-        # never outrank an honest measurement
-        if _healthy_record(rec, smoke):
-            tier = 2
-        elif rec.get("degraded_kind") == "implausible":
-            tier = 0
-        else:
-            tier = 1
-        rank = (tier, value)
+        # best-line ranking (resilience.rank): healthy > degraded
+        # (real, tunnel-bound) > implausible calibration artifact —
+        # an implausible line's inflated value must never outrank an
+        # honest measurement
+        rank = resilience.rank(rec, smoke)
         if "error" not in rec and requested_backend and \
                 rank > state["best_rank"]:
             state["best"], state["best_rank"] = (line, rec), rank

@@ -53,7 +53,9 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from apex_tpu import dispatch  # noqa: E402  (stdlib-only import)
+from apex_tpu import dispatch  # noqa: E402
+from apex_tpu import resilience  # noqa: E402
+from apex_tpu.resilience import faults  # noqa: E402
 from apex_tpu.telemetry import ledger as ledger_mod  # noqa: E402
 
 
@@ -257,20 +259,14 @@ def _measure(group, vname, venv, ctx):
                                   ctx["log_dir"], tag)
         result = None
         if harness == "bench":
-            rec = None
-            for line in reversed(out.splitlines()):
-                if line.startswith("{") and line.rstrip().endswith("}"):
-                    try:
-                        rec = json.loads(line)
-                        break
-                    except ValueError:
-                        continue
-            if rec and not rec.get("error") \
-                    and not rec.get("relay_degraded") \
-                    and (rec.get("value") or 0) > 0 \
+            _, rec = resilience.last_json(out)
+            if rec is not None \
+                    and resilience.healthy(rec, smoke=ctx["smoke"]) \
                     and rec.get("ledger_id"):
-                # a relay-degraded line must never become a table entry
-                # — it measures the tunnel, not the chip (PERF.md §0)
+                # the ONE health classifier (apex_tpu.resilience): a
+                # relay-degraded/wedged/implausible line must never
+                # become a table entry — it measures the tunnel, not
+                # the chip (PERF.md §0)
                 result = {"value": rec["value"], "unit": "tokens/s",
                           "ledger": rec["ledger_id"], "pins": pins}
         else:  # profile_gpt
@@ -362,10 +358,11 @@ def main(argv=None, runner=run_rung):
     ap.add_argument("--table", default=None)
     ap.add_argument("--ledger", default=None)
     ap.add_argument("--budget-s", type=float, default=None,
-                    help="stop launching rungs once spent "
-                         "(default 3600, smoke 600)")
+                    help="stop launching rungs once spent (default "
+                         "resilience.AUTOTUNE_BUDGET_S: 3600, smoke 600)")
     ap.add_argument("--rung-timeout", type=int, default=None,
-                    help="per-subprocess cap (default 900, smoke 180)")
+                    help="per-subprocess cap (default "
+                         "resilience.RUNG_TIMEOUT_S: 900, smoke 180)")
     ap.add_argument("--only", default=None,
                     help="comma-separated group names")
     ap.add_argument("--repeats", type=int, default=None,
@@ -377,10 +374,28 @@ def main(argv=None, runner=run_rung):
     smoke = args.smoke
     table_path = args.table or dispatch.default_path()
     ledger_path = args.ledger or ledger_mod.default_path()
+    # the §6 timeout envelope has ONE home (apex_tpu.resilience): the
+    # per-rung subprocess cap and the pass budget are read from there
     budget = args.budget_s if args.budget_s is not None \
-        else (600 if smoke else 3600)
+        else (resilience.AUTOTUNE_BUDGET_SMOKE_S if smoke
+              else resilience.AUTOTUNE_BUDGET_S)
     timeout = args.rung_timeout if args.rung_timeout is not None \
-        else (180 if smoke else 900)
+        else (resilience.RUNG_TIMEOUT_SMOKE_S if smoke
+              else resilience.RUNG_TIMEOUT_S)
+    # fault injection (test-only): a plan can starve the budget to
+    # exercise the LOUD-drop path; flag the pass so its artifacts
+    # self-describe (table writes to the COMMITTED table are refused
+    # below — an injected pass must never poison the measured table)
+    budget = faults.override_budget(budget)
+    if faults.active():
+        print(f"autotune: FAULT PLAN ACTIVE ({faults.plan_hash()}) — "
+              "test-only pass; entries citing fault-stamped records "
+              "fail tools/check_bench_labels.py", flush=True)
+        if args.table is None:
+            raise SystemExit(
+                "autotune: refusing to write the committed dispatch "
+                "table under APEX_FAULT_PLAN — pass --table to a "
+                "scratch path for chaos runs")
     backend = "cpu" if smoke else "tpu"
     log_dir = args.out
     if log_dir:
@@ -474,6 +489,8 @@ def main(argv=None, runner=run_rung):
     summary = {"done": done, "skipped": skipped, "dropped": dropped,
                "failed": failed, "table": table_path,
                "wall_s": round(time.perf_counter() - t0, 1)}
+    if faults.plan_hash():
+        summary["fault_plan"] = faults.plan_hash()
     if dropped:
         print(f"BUDGET DROPPED (re-run to resume): {dropped}", flush=True)
     print("autotune: " + json.dumps(summary), flush=True)

@@ -22,12 +22,45 @@
 set -u
 cd "$(dirname "$0")/.."
 
-PIDFILE=/tmp/apex_tpu_probe.pid
-DISARM_MARKER=/tmp/apex_tpu_probe_DISARMED
-STATE=/tmp/apex_tpu_probe_state
+# a fault plan is a chaos-test artifact (apex_tpu/resilience/faults.py):
+# scored collection must NEVER run under injection — refuse outright
+if [ -n "${APEX_FAULT_PLAN:-}" ]; then
+    echo "REFUSING TO START: APEX_FAULT_PLAN is set (fault injection is" >&2
+    echo "test-only; a scored collection pass must never run injected)." >&2
+    exit 2
+fi
+
+# paths are env-overridable so the tier-1 chaos tests can exercise the
+# arm guard without touching a live loop's markers
+PIDFILE="${APEX_PROBE_PIDFILE:-/tmp/apex_tpu_probe.pid}"
+DISARM_MARKER="${APEX_PROBE_DISARM:-/tmp/apex_tpu_probe_DISARMED}"
+STATE="${APEX_PROBE_STATE:-/tmp/apex_tpu_probe_state}"
+
+# the classifier CLI (one health implementation for the whole pipeline:
+# apex_tpu/resilience/). Always invoked relay-proof: a wedged relay
+# hangs even CPU interpreter start via the sitecustomize axon
+# registration (CLAUDE.md), so the empty pool var + timeout bound it.
+verdict_cli() {  # verdict_cli <timeout_s> <subcommand args...>
+    local t="$1"; shift
+    timeout "$t" env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+        APEX_PROBE_STATE="$STATE" python -m apex_tpu.resilience.probe "$@"
+}
 
 loop_alive() {
     [ -f "$PIDFILE" ] && kill -0 "$(cat "$PIDFILE" 2>/dev/null)" 2>/dev/null
+}
+
+latest_pass_dir() {  # latest_pass_dir <outdir> — highest passN, NUMERIC
+    # (a lexicographic glob walks pass10 before pass2..pass9 and would
+    # report an hours-old pass as the current one)
+    local best=0 d n out=""
+    for d in "$1"/pass*; do
+        [ -d "$d" ] || continue
+        n="${d##*pass}"
+        case "$n" in (*[!0-9]*|'') continue ;; esac
+        if [ "$n" -ge "$best" ]; then best=$n; out="$d"; fi
+    done
+    printf '%s' "$out"
 }
 
 case "${1:-}" in
@@ -45,10 +78,27 @@ case "${1:-}" in
             echo "NOT ARMED: no probe loop running"
             rc=1
         fi
-        [ -f "$STATE" ] && echo "last probe: $(cat "$STATE")"
+        # classifier verdict of the LAST probe (healthy/degraded/wedged
+        # + age) — the resilience classifier's reading, not the raw
+        # state file; cross-classified against the latest pass's bench
+        # log so the §6 selective large-HBM starvation mode is named
+        last="$(latest_pass_dir "$SOUT")"
+        if [ -f "$STATE" ]; then
+            SBENCH=""
+            if [ -n "$last" ]; then
+                # prefer the end-of-queue full-ladder bench over the
+                # opening rung, both from the LATEST pass only
+                [ -f "$last/bench_first.log" ] && SBENCH="$last/bench_first.log"
+                [ -f "$last/bench.log" ] && SBENCH="$last/bench.log"
+            fi
+            verdict_cli 60 status --state "$STATE" \
+                ${SBENCH:+--bench "$SBENCH"} \
+                || [ $? -le 1 ] \
+                || echo "last probe (raw): $(cat "$STATE")"
+        else
+            echo "no probe has run yet"
+        fi
         if [ -d "$SOUT" ]; then
-            last=""
-            for d in "$SOUT"/pass*; do [ -d "$d" ] && last="$d"; done
             if [ -n "$last" ]; then
                 echo "latest pass: $last"
             else
@@ -92,6 +142,12 @@ if loop_alive; then
     echo "already armed: probe loop running (pid $(cat "$PIDFILE")) —" \
          "a second loop would put two TPU clients in contention" >&2
     exit 3
+fi
+# chaos-test hook: validate the arm path (guards passed) without
+# starting a live probe loop against the relay
+if [ -n "${APEX_PROBE_DRYRUN:-}" ]; then
+    echo "ARM OK (dryrun): guards passed; not starting the loop"
+    exit 0
 fi
 # become a process-group leader so `disarm` can take down the whole
 # tree (loop + in-flight collection pass) with one group kill
@@ -205,19 +261,10 @@ except Exception as e:
 EOF
 }
 
-bench_healthy() {  # bench_healthy <bench.log> — bench.py's own health gate
-    # same relay-proofing as cache_stats: log parsing must not be able
-    # to hang the loop when the relay wedges mid-window
-    timeout 120 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python - "$1" <<'EOF'
-import sys
-sys.path.insert(0, ".")   # cwd is the repo root (cd at script top)
-import bench
-try:
-    text = open(sys.argv[1]).read()
-except OSError:
-    sys.exit(1)
-sys.exit(0 if bench._healthy_json_line(text) else 1)
-EOF
+bench_healthy() {  # bench_healthy <bench.log> — the collection gate,
+    # via the resilience classifier CLI (the same health implementation
+    # bench.py's watchdog ranks with); relay-proof like cache_stats
+    verdict_cli 120 log "$1" >/dev/null 2>&1
 }
 
 # resume the pass numbering across invocations: a rerun into the same
@@ -257,12 +304,18 @@ autotune_stats() {  # autotune_stats <pass_dir> — per-pass table delta
 WARMED=0
 while true; do
     echo "[$(date +%H:%M:%S)] probing relay..."
-    probe > /tmp/apex_tpu_probe_last 2>&1
+    probe > "$STATE.last" 2>&1
     PRC=$?
-    cat /tmp/apex_tpu_probe_last
-    printf '%s %s: %s\n' "$(date '+%F %T')" \
-        "$([ "$PRC" -eq 0 ] && echo HEALTHY || echo degraded/unreachable)" \
-        "$(tail -1 /tmp/apex_tpu_probe_last)" > "$STATE"
+    cat "$STATE.last"
+    # classify + stamp the structured probe state (the verdict --status
+    # reports); the printf fallback keeps a state file even if the
+    # classifier CLI itself is starved
+    verdict_cli 60 stamp --rc "$PRC" \
+        --detail "$(tail -1 "$STATE.last")" --out "$STATE" \
+        || [ $? -le 1 ] \
+        || printf '%s %s: %s\n' "$(date '+%F %T')" \
+            "$([ "$PRC" -eq 0 ] && echo HEALTHY || echo degraded/unreachable)" \
+            "$(tail -1 "$STATE.last")" > "$STATE"
     if [ "$PRC" -eq 0 ]; then
         # FIRST healthy probe: warm the persistent compile cache BEFORE
         # any collection pass — AOT-compiles of the scored bench program

@@ -4,6 +4,13 @@
 # Each harness gets its own timeout so one wedged run cannot sink the rest.
 set -u
 cd "$(dirname "$0")/.."
+# fault injection (apex_tpu/resilience/faults.py) is test-only: a
+# scored collection pass must never run under APEX_FAULT_PLAN — every
+# record it produced would be fault-stamped and refused anyway
+if [ -n "${APEX_FAULT_PLAN:-}" ]; then
+    echo "REFUSING TO COLLECT: APEX_FAULT_PLAN is set (test-only)" >&2
+    exit 2
+fi
 OUT="${1:-/tmp/apex_tpu_bench_$(date +%Y%m%d_%H%M)}"
 mkdir -p "$OUT"
 echo "collecting into $OUT"

@@ -45,6 +45,7 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from apex_tpu import resilience  # noqa: E402
 from bench import _last_json  # noqa: E402  (the ONE driver-line parser)
 
 
@@ -54,7 +55,9 @@ def warm_target(name, cmd, extra_env, timeout):
     harnesses and crashes). A None value in ``extra_env`` UNSETS the
     var (same semantics as autotune's measured subprocesses — a
     leftover pin in the probe shell must not make the warmed program
-    differ from the measured one)."""
+    differ from the measured one). ``APEX_FAULT_PLAN`` (test-only)
+    rides the inherited env — this is one of the subprocess boundaries
+    the fault-injection layer is honored across."""
     env = dict(os.environ, APEX_WARM_ONLY="1")
     for k, v in extra_env.items():
         if v is None:
@@ -65,6 +68,7 @@ def warm_target(name, cmd, extra_env, timeout):
     # escape hatch stays honored: an explicit APEX_COMPILE_CACHE=0 wins
     env.setdefault("APEX_COMPILE_CACHE", "1")
     t0 = time.perf_counter()
+    timed_out = False
     try:
         proc = subprocess.run(cmd, env=env, cwd=REPO, text=True,
                               capture_output=True, timeout=timeout)
@@ -72,6 +76,11 @@ def warm_target(name, cmd, extra_env, timeout):
         note = f"rc={proc.returncode}"
     except subprocess.TimeoutExpired:
         ok, proc, note = False, None, f"timed out after {timeout}s"
+        timed_out = True
+    # the shared health classifier's subprocess verdict: a timed-out
+    # warm is the §6 wedge signature, a non-zero exit is relay-bound
+    verdict = resilience.classify_subprocess(
+        proc.returncode if proc is not None else None, timed_out)
     dt = time.perf_counter() - t0
     detail, rec = "", None
     if proc is not None:
@@ -87,8 +96,8 @@ def warm_target(name, cmd, extra_env, timeout):
             detail = f" {n} rows warmed"
         if not ok:
             sys.stderr.write((proc.stderr or "")[-2000:])
-    print(f"warm {name}: {'ok' if ok else 'FAILED'} ({note}, "
-          f"{dt:.0f}s){detail}", flush=True)
+    print(f"warm {name}: {'ok' if ok else 'FAILED'} "
+          f"(verdict={verdict}, {note}, {dt:.0f}s){detail}", flush=True)
     return ok, rec
 
 
@@ -97,7 +106,8 @@ def main():
         print("warm_cache: APEX_COMPILE_CACHE=0 — nothing to warm",
               flush=True)
         return 0
-    timeout = int(os.environ.get("APEX_WARM_TIMEOUT", "1500"))
+    timeout = int(os.environ.get("APEX_WARM_TIMEOUT",
+                                 str(resilience.WARM_TIMEOUT_S)))
     bench = os.path.join(REPO, "bench.py")
     gpt = os.path.join(REPO, "benchmarks", "profile_gpt.py")
     ok_b8, rec = warm_target("bench b=8", [sys.executable, bench], {},

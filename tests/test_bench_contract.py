@@ -280,7 +280,7 @@ def test_watchdog_lazy_cap_after_timeout(monkeypatch, capsys):
 
     caps = []
 
-    def fake_timeout_attempt(state, extra_env=None, timeout_cap=None):
+    def fake_timeout_attempt(state, extra_env=None, timeout_cap=None, **kw):
         caps.append(timeout_cap)
         rec = {"metric": "gpt2s_train_tokens_per_sec (tpu)", "value": 0,
                "unit": "tokens/s", "vs_baseline": 0, "mfu": None,
@@ -303,7 +303,7 @@ def test_watchdog_lazy_cap_after_timeout(monkeypatch, capsys):
     # a COMPLETED degraded attempt (rc 0) must not arm the cap
     caps.clear()
 
-    def fake_degraded_attempt(state, extra_env=None, timeout_cap=None):
+    def fake_degraded_attempt(state, extra_env=None, timeout_cap=None, **kw):
         caps.append(timeout_cap)
         rec = dict(_fake_rec(5.0, False), note="relay degraded",
                    degraded_kind="relay")
@@ -327,7 +327,7 @@ def test_watchdog_real_error_record_does_not_arm_cap(monkeypatch, capsys):
 
     caps = []
 
-    def fake_teardown_wedge(state, extra_env=None, timeout_cap=None):
+    def fake_teardown_wedge(state, extra_env=None, timeout_cap=None, **kw):
         caps.append(timeout_cap)
         rec = {"metric": "gpt2s_train_tokens_per_sec (tpu)", "value": 0,
                "unit": "tokens/s", "vs_baseline": 0, "mfu": None,

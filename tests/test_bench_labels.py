@@ -129,3 +129,57 @@ def test_corrupt_ledger_fails(tmp_path):
     out = _run("--perf", str(perf), "--ledger", str(lpath))
     assert out.returncode == 1
     assert "unparseable" in out.stdout
+
+
+def test_truncated_ledger_line_fails_with_line_number(tmp_path):
+    """A line truncated mid-record (a SIGTERM/flap landing mid-append)
+    must FAIL the tier-1 check naming file:lineno — never crash the
+    checker with a raw JSONDecodeError traceback."""
+    rec, _ = _seed(tmp_path)
+    good = json.dumps(rec, sort_keys=True)
+    lpath = tmp_path / "ledger.jsonl"
+    lpath.write_text(good + "\n" + good[:37] + "\n")
+    perf = tmp_path / "PERF.md"
+    perf.write_text("# fixture\n")
+    out = _run("--perf", str(perf), "--ledger", str(lpath))
+    assert out.returncode == 1, out.stdout
+    assert f"{lpath}:2:" in out.stdout, out.stdout
+    assert "Traceback" not in out.stderr and "Traceback" not in out.stdout
+
+
+def test_scalar_truncated_ledger_line_fails_not_crashes(tmp_path):
+    """The nastier truncation: a line cut down to a bare JSON scalar
+    still PARSES (`42`), and used to reach the validators as a non-dict
+    and crash with an AttributeError — it must be a line-numbered
+    finding instead."""
+    rec, _ = _seed(tmp_path)
+    lpath = tmp_path / "ledger.jsonl"
+    lpath.write_text(json.dumps(rec, sort_keys=True) + "\n42\n")
+    perf = tmp_path / "PERF.md"
+    perf.write_text("# fixture\n")
+    out = _run("--perf", str(perf), "--ledger", str(lpath))
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert f"{lpath}:2:" in out.stdout
+    assert "not a JSON object" in out.stdout
+    assert "Traceback" not in out.stderr and "Traceback" not in out.stdout
+
+
+def test_fault_stamped_record_citation_is_drift(tmp_path, monkeypatch):
+    """A PERF.md caption citing a record produced under APEX_FAULT_PLAN
+    (chaos injection) is label drift: injected runs are not
+    measurements."""
+    monkeypatch.setenv(
+        "APEX_FAULT_PLAN",
+        json.dumps([{"site": "verdict", "kind": "degraded"}]))
+    rec = ledger.make_record(
+        harness="bench", platform="tpu", dispatch_overhead_ms=80.0,
+        k=16, knobs={}, git="abc", ts=1000.0)
+    monkeypatch.delenv("APEX_FAULT_PLAN")
+    assert rec["fault_plan"].startswith("fp-")
+    lpath = tmp_path / "ledger.jsonl"
+    lpath.write_text(json.dumps(rec, sort_keys=True) + "\n")
+    perf = tmp_path / "PERF.md"
+    perf.write_text(f"# fixture\n\nrows (ledger:{rec['id']}):\n")
+    out = _run("--perf", str(perf), "--ledger", str(lpath))
+    assert out.returncode == 1, out.stdout
+    assert "FAULT-INJECTED" in out.stdout

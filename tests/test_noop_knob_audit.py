@@ -1,0 +1,196 @@
+"""The documented-no-op knob audit (VERDICT item 9, finished).
+
+docs/API.md's "Accepted-but-inert knobs (no-op on TPU)" table and the
+code must agree EXACTLY — both directions:
+
+* every knob the table documents as inert exists in the code's
+  registries (`parallel.distributed.NOOP_KNOBS`,
+  `testing.arguments.INERT_CUDA_KNOBS`, amp's ``cast_model_outputs``)
+  and is mechanically UNREAD outside its defining module, and
+* every registered inert knob is documented.
+
+The original spot-check found "most are, not all": the old table listed
+``masked_softmax_fusion`` as a no-op while the field actually flows
+into ``TransformerConfig`` and gates the ``FusedScaleMaskSoftmax``
+fused path — this suite asserts that class of drift can't come back
+(a registry entry that is consumed anywhere fails the inertness scan;
+a consumed knob snuck into the doc table fails the exact-match).
+"""
+
+import dataclasses
+import inspect
+import os
+import re
+import sys
+import warnings
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from apex_tpu.parallel.distributed import (  # noqa: E402
+    NOOP_KNOBS,
+    DistributedDataParallel,
+)
+from apex_tpu.transformer.testing.arguments import (  # noqa: E402
+    INERT_CUDA_KNOBS,
+    MegatronArgs,
+    parse_args,
+)
+
+API_MD = os.path.join(REPO, "docs", "API.md")
+AMP_INERT = ("cast_model_outputs",)
+
+
+def documented_noop_knobs():
+    """Knob names from the FIRST cell of each row of API.md's
+    'Accepted-but-inert knobs' table."""
+    with open(API_MD) as f:
+        text = f.read()
+    start = text.index("### Accepted-but-inert knobs")
+    section = text[start:]
+    end = section.find("\n## ")
+    if end != -1:
+        section = section[:end]
+    names = set()
+    for line in section.splitlines():
+        if not line.startswith("|") or line.startswith("|---"):
+            continue
+        first_cell = line.split("|")[1]
+        if first_cell.strip() == "Knob":
+            continue
+        for token in re.findall(r"`([^`]+)`", first_cell):
+            idents = re.findall(r"[A-Za-z_][A-Za-z0-9_]*", token)
+            if idents:
+                names.add(idents[-1])
+    return names
+
+
+def _py_files(*roots):
+    for root in roots:
+        for dirpath, _dirs, files in os.walk(os.path.join(REPO, root)):
+            if "__pycache__" in dirpath:
+                continue
+            for fn in files:
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def _attribute_reads(field, exclude_suffixes, roots=("apex_tpu",
+                                                     "examples")):
+    """Files (outside *exclude_suffixes*) containing an attribute access
+    of *field* — the mechanical inertness probe: an inert knob may be
+    stored, but nothing may READ it off an object."""
+    pat = re.compile(r"\." + re.escape(field) + r"\b")
+    hits = []
+    for path in _py_files(*roots):
+        if any(path.endswith(sfx) for sfx in exclude_suffixes):
+            continue
+        with open(path) as f:
+            if pat.search(f.read()):
+                hits.append(os.path.relpath(path, REPO))
+    return hits
+
+
+def test_doc_table_matches_code_registries_exactly():
+    code = set(NOOP_KNOBS) | set(INERT_CUDA_KNOBS) | set(AMP_INERT)
+    doc = documented_noop_knobs()
+    assert doc == code, (
+        f"docs/API.md no-op table drifted from the code registries: "
+        f"documented-but-unregistered={sorted(doc - code)}, "
+        f"registered-but-undocumented={sorted(code - doc)}")
+
+
+def test_registered_knobs_are_accepted_by_their_surfaces():
+    fields = {f.name for f in dataclasses.fields(MegatronArgs)}
+    missing = set(INERT_CUDA_KNOBS) - fields
+    assert not missing, (
+        f"INERT_CUDA_KNOBS not accepted by MegatronArgs: {missing} — "
+        "a documented no-op must at least be ACCEPTED (reference parity)")
+    params = set(inspect.signature(
+        DistributedDataParallel.__init__).parameters)
+    missing = set(NOOP_KNOBS) - params
+    assert not missing, f"NOOP_KNOBS not DDP ctor params: {missing}"
+    from apex_tpu.amp.frontend import initialize
+
+    assert set(AMP_INERT) <= set(inspect.signature(initialize).parameters)
+
+
+def test_registered_megatron_knobs_are_mechanically_inert():
+    """No file outside testing/arguments.py may read any INERT field
+    off an object — `masked_softmax_fusion` (a REAL knob the old table
+    misdocumented) fails exactly this probe, which is why it is not in
+    the registry."""
+    for field in INERT_CUDA_KNOBS:
+        hits = _attribute_reads(field, ("testing/arguments.py",))
+        assert not hits, (
+            f"MegatronArgs.{field} is registered inert but read in "
+            f"{hits} — either drop it from INERT_CUDA_KNOBS (+ the "
+            f"API.md table) or remove the consumer")
+    # the converse control: the knob the audit evicted IS consumed
+    assert _attribute_reads("masked_softmax_fusion",
+                            ("testing/arguments.py",)), (
+        "masked_softmax_fusion no longer consumed anywhere — it may "
+        "belong back in the inert table")
+    # ...and nothing bridged into TransformerConfig can be inert
+    from apex_tpu.transformer.testing import arguments as args_mod
+
+    bridge_src = inspect.getsource(MegatronArgs.to_transformer_config)
+    for field in INERT_CUDA_KNOBS:
+        assert f"self.{field}" not in bridge_src, (
+            f"{field} is bridged to TransformerConfig — not inert")
+    assert "self.masked_softmax_fusion" in bridge_src
+    del args_mod
+
+
+def test_registered_ddp_knobs_are_mechanically_inert():
+    # scoped to the package: the DDP knobs are ctor arguments, and an
+    # example's own argparse namespace reusing a name (imagenet's
+    # `--prof` step cap) is not a read of the DDP knob
+    for field in NOOP_KNOBS:
+        hits = _attribute_reads(field, ("parallel/distributed.py",),
+                                roots=("apex_tpu",))
+        assert not hits, (f"DDP `{field}` is registered inert but read "
+                          f"in {hits}")
+
+
+def test_amp_cast_model_outputs_recorded_not_consumed():
+    hits = _attribute_reads("cast_model_outputs", ("amp/frontend.py",
+                                                   "amp/_amp_state.py"))
+    assert not hits, f"cast_model_outputs consumed in {hits}"
+
+
+def test_ddp_warns_on_every_nondefault_noop_knob():
+    nondefault = {
+        "message_size": 1, "delay_allreduce": True,
+        "num_allreduce_streams": 2, "retain_allreduce_buffers": True,
+        "allreduce_trigger_params": ["w"], "allreduce_communicators": "c",
+        "gradient_average_split_factor": 2.0, "prof": True,
+    }
+    assert set(nondefault) == set(NOOP_KNOBS)
+    for name, value in nondefault.items():
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            DistributedDataParallel(**{name: value})
+        assert any(name in str(w.message) for w in caught), (
+            f"non-default `{name}` did not warn")
+    # defaults stay silent
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        DistributedDataParallel()
+    assert not caught
+
+
+def test_persist_layer_norm_is_accepted_cli_to_dataclass():
+    """The audit found the doc promising `MegatronArgs.persist_layer_norm`
+    while the dataclass lacked the field — it now exists end-to-end
+    (accepted, recorded, inert)."""
+    args = MegatronArgs(num_layers=2, hidden_size=64,
+                        num_attention_heads=4,
+                        max_position_embeddings=32, micro_batch_size=1,
+                        persist_layer_norm=True).finalize()
+    assert args.persist_layer_norm is True
+    args = parse_args(["--num-layers", "2", "--hidden-size", "64",
+                       "--num-attention-heads", "4",
+                       "--max-position-embeddings", "32",
+                       "--micro-batch-size", "1", "--persist-layer-norm"])
+    assert args.persist_layer_norm is True

@@ -431,3 +431,38 @@ def test_minimal_gpt_trajectory_and_grad_norm_parity():
         assert abs(l - rl) <= 0.05, (losses, ref_losses)
     for g, rg in zip(gnorms, ref_gnorms):
         assert abs(g - rg) <= 0.05 * max(rg, 1e-6), (gnorms, ref_gnorms)
+
+
+def test_dryrun_multichip_topology_plan_includes_16_way():
+    """__graft_entry__.dryrun_multichip(16) (VERDICT #6 remainder) must
+    drive the capped factorization (2, 4, 2) AND the deeper explicit
+    pp=4/dp=2/tp=2 mesh — asserted on the topology plan here (fast);
+    the full 16-way parity run is the slow twin below."""
+    import __graft_entry__
+    from apex_tpu.transformer.testing.minimal import factorize_mesh
+
+    assert factorize_mesh(16) == (2, 4, 2)
+    assert __graft_entry__.dryrun_topologies(16) == [(2, 4, 2), (4, 2, 2)]
+    # every plan factorizes its device count exactly
+    for n in (1, 2, 4, 8, 16):
+        for pp, dp, tp in __graft_entry__.dryrun_topologies(n):
+            assert pp * dp * tp == n, (n, pp, dp, tp)
+
+
+@pytest.mark.slow  # pytest twin of the driver's dryrun_multichip(16):
+# own subprocess because it needs 16 virtual devices (conftest pins 8)
+def test_dryrun_multichip_16_parity_subprocess():
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__; __graft_entry__.dryrun_multichip(16)"],
+        capture_output=True, text=True, timeout=1800, env=env, cwd=repo)
+    assert out.returncode == 0, out.stderr[-3000:]
+    assert "trajectory + grad-norm parity ok across 2 topologies" \
+        in out.stdout
+    assert "pp=4/dp=2/tp=2" in out.stdout

@@ -1,0 +1,578 @@
+"""Chaos suite for the resilience subsystem (`apex_tpu/resilience/`).
+
+Every recorded round-3/4/5 relay failure mode (PERF.md §6) is replayed
+through the REAL drivers on CPU via scripted ``APEX_FAULT_PLAN`` plans
+(apex_tpu.resilience.faults), asserting the committed behaviors:
+
+* the watchdog ladder picks the healthy b=8 line over a starved b=16,
+* the lazy wedge cap arms only on the structured ``timed_out`` stamp,
+* an injected degraded run is stamped ``degraded_kind: relay`` and
+  REFUSED by the BENCH_BASELINE seeding gate,
+* autotune drops rungs LOUDLY when the budget is injected away,
+* SIGTERM still flushes a well-formed JSON line + a ledger record,
+* the probe arm-guard refuses a silent start after a disarm,
+* an inflated dispatch-overhead calibration yields the honest
+  calibration-flap error line,
+* a remote-compile HTTP-500 crashes the attempt and the watchdog
+  crash-retries,
+* a truncated JSON line is treated as no measurement (crash-retry).
+
+Fast-keeping rule: fault plans that hang/crash/fabricate fire BEFORE
+any backend work (a few seconds per inner process); only the faults
+that live deep in the measured path (calibration inflation, the
+degraded verdict, the compile-site 500) pay a real CPU smoke run, and
+those share one persistent compile-cache dir.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from apex_tpu import resilience  # noqa: E402
+from apex_tpu.resilience import faults, probe as probe_cli  # noqa: E402
+from apex_tpu.telemetry import ledger as tledger  # noqa: E402
+
+BENCH = os.path.join(REPO, "bench.py")
+PROBE_SH = os.path.join(REPO, "benchmarks", "probe_and_collect.sh")
+RUN_ALL_SH = os.path.join(REPO, "benchmarks", "run_all_tpu.sh")
+
+HEALTHY_TPU_REC = {
+    "metric": "gpt2s_train_tokens_per_sec (tpu)", "value": 100.0,
+    "unit": "tokens/s", "vs_baseline": 1.0, "mfu": 0.4,
+    "config": {"batch": 8},
+}
+
+
+# --------------------------------------------------------------- unit layer
+
+def test_classify_recorded_failure_shapes():
+    """The §6 catalogue of record shapes maps to the five verdicts."""
+    c = resilience.classify
+    assert c(None) == resilience.WEDGED  # no output at all (init hang)
+    # fabricated full-timeout record (wedge signature)
+    assert c({"timed_out": True, "relay_degraded": True,
+              "error": "bench timed out"}) == resilience.WEDGED
+    # ...the same record next to healthy small-HBM evidence = §6
+    # selective starvation
+    assert c({"timed_out": True}, small_hbm_ok=True) \
+        == resilience.DEGRADED_LARGE_HBM
+    # round-5 degraded line (5.5k tok/s, honest note)
+    assert c({"metric": "x (tpu)", "value": 5568, "note": "relay",
+              "degraded_kind": "relay",
+              "relay_degraded": True}) == resilience.DEGRADED_RELAY
+    # calibration-straddle artifact
+    assert c({"metric": "x (tpu)", "value": 9e9, "note": "implausible",
+              "degraded_kind": "implausible",
+              "relay_degraded": True}) == resilience.IMPLAUSIBLE
+    # calibration-flap error line (non-positive step time)
+    assert c({"metric": "x (tpu)", "value": 0, "relay_degraded": True,
+              "error": "non-positive step time"}) \
+        == resilience.DEGRADED_RELAY
+    # silent CPU fallback on a TPU request vs an honest CPU smoke
+    assert c({"metric": "x (cpu)", "value": 200.0}) \
+        == resilience.DEGRADED_RELAY
+    assert c({"metric": "x (cpu)", "value": 200.0}, smoke=True) \
+        == resilience.HEALTHY
+    assert c(HEALTHY_TPU_REC) == resilience.HEALTHY
+
+
+def test_rank_healthy_beats_degraded_beats_implausible():
+    healthy = dict(HEALTHY_TPU_REC)
+    degraded = {"metric": "x (tpu)", "value": 5e3, "note": "n",
+                "degraded_kind": "relay"}
+    implausible = {"metric": "x (tpu)", "value": 9e9, "note": "n",
+                   "degraded_kind": "implausible"}
+    assert resilience.rank(healthy) > resilience.rank(degraded) \
+        > resilience.rank(implausible)
+    # within a tier, higher throughput wins
+    assert resilience.rank(dict(healthy, value=200.0)) \
+        > resilience.rank(healthy)
+
+
+def test_classify_measurement_envelope():
+    cm = resilience.classify_measurement
+    assert cm(True, 0.376, 8) is None            # the §1 device envelope
+    assert cm(True, 0.02, 8) == "relay"          # tunnel-dominated
+    assert cm(True, 0.02, 16) == "relay"
+    assert cm(True, 0.02, 2) is None             # tiny-batch exemption
+    assert cm(True, 0.7, 8) == "implausible"     # calibration straddle
+    assert cm(False, None, 2) is None            # no CPU detector
+    assert cm(False, 0.0, 2) is None
+
+
+def test_retry_policy_lazy_cap_state_machine():
+    p = resilience.RetryPolicy(attempts=3, retry_wait_s=100)
+    assert p.timeout_cap is None
+    # a completed degraded attempt (rc 0) never arms the cap
+    assert p.note_attempt({"note": "relay degraded"}, 0) is None
+    # a REAL error record forwarded with rc None (teardown wedge after
+    # printing) never arms it either — only the structured stamp does
+    assert p.note_attempt({"error": "calibration flap"}, None) is None
+    assert p.timeout_cap is None
+    assert p.note_attempt({"timed_out": True}, None) \
+        == resilience.WEDGE_CAP_S
+    assert p.timeout_cap == resilience.WEDGE_CAP_S
+    # arming is one-shot
+    assert p.note_attempt({"timed_out": True}, None) is None
+    # crash retries take the short wait once, then the full backoff
+    p.note_crash()
+    assert p.pop_wait() == resilience.CRASH_RETRY_WAIT_S
+    assert p.pop_wait() == 100
+
+
+def test_fault_plan_parsing_hash_and_matchers(monkeypatch, tmp_path):
+    monkeypatch.delenv("APEX_FAULT_PLAN", raising=False)
+    assert not faults.active() and faults.plan_hash() is None
+    plan = [{"site": "verdict", "kind": "degraded",
+             "degraded_kind": "relay",
+             "match_env": {"APEX_CHAOS_MARK": "1"}}]
+    monkeypatch.setenv("APEX_FAULT_PLAN", json.dumps(plan))
+    h = faults.plan_hash()
+    assert h and h.startswith("fp-")
+    # env matcher gates the fault
+    monkeypatch.delenv("APEX_CHAOS_MARK", raising=False)
+    assert faults.injected_degraded() is None
+    monkeypatch.setenv("APEX_CHAOS_MARK", "1")
+    assert faults.injected_degraded() == "relay"
+    # a path-valued plan parses to the same hash as the inline text
+    p = tmp_path / "plan.json"
+    p.write_text(json.dumps({"faults": plan}))
+    monkeypatch.setenv("APEX_FAULT_PLAN", str(p))
+    assert faults.plan_hash() == h
+    # transform faults
+    monkeypatch.setenv("APEX_FAULT_PLAN", json.dumps(
+        [{"site": "calibration_overhead", "kind": "inflate", "add_s": 5},
+         {"site": "emit", "kind": "truncate", "bytes": 7}]))
+    assert faults.transform("calibration_overhead", 1.0) == 6.0
+    assert faults.transform_output('{"value": 1234567}') == '{"value'
+
+
+def test_ledger_stamps_sentinel_for_unresolvable_plan(monkeypatch,
+                                                      tmp_path):
+    """An ACTIVE-but-unresolvable APEX_FAULT_PLAN (deleted plan file,
+    malformed JSON) must still stamp the record — a sentinel, never a
+    silent omission that would let a record written under injection
+    masquerade as clean."""
+    monkeypatch.setenv("APEX_FAULT_PLAN", str(tmp_path / "gone.json"))
+    rec = tledger.make_record("bench", "cpu", 1.0, 3, git="abc", ts=1.0)
+    assert rec["fault_plan"] == "fp-unresolvable"
+    monkeypatch.setenv("APEX_FAULT_PLAN", "{not json")
+    rec = tledger.make_record("bench", "cpu", 1.0, 3, git="abc", ts=1.0)
+    assert rec["fault_plan"] == "fp-unresolvable"
+
+
+def test_ledger_stamps_fault_plan_inside_content_id(monkeypatch):
+    """The stamp is computed BEFORE the content hash: stripping it (or
+    adding it after the fact) breaks the record's own id — the checker
+    flags exactly that as tampering."""
+    monkeypatch.setenv("APEX_FAULT_PLAN",
+                       json.dumps([{"site": "verdict", "kind": "degraded"}]))
+    rec = tledger.make_record("bench", "cpu", 1.0, 3, git="abc", ts=1.0)
+    assert rec["fault_plan"] == faults.plan_hash()
+    assert tledger.validate_record(rec) == []
+    stripped = {k: v for k, v in rec.items() if k != "fault_plan"}
+    assert any("does not match record content" in p
+               for p in tledger.validate_record(stripped))
+
+
+# -------------------------------------------------- watchdog chaos (fast:
+# every inner attempt hangs/fabricates before any backend work)
+
+def _watchdog_env(tmp_path, plan, attempts, timeout, wait=1):
+    env = dict(os.environ)
+    for k in ("APEX_BENCH_SMOKE", "APEX_BENCH_INNER", "APEX_WARM_ONLY",
+              "APEX_FUSED_LM_HEAD", "APEX_ATTN_IMPL", "APEX_LN_PALLAS",
+              "APEX_REMAT", "APEX_BENCH_BATCH"):
+        env.pop(k, None)
+    env.update(
+        PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
+        APEX_FAULT_PLAN=json.dumps(plan),
+        APEX_BENCH_ATTEMPTS=str(attempts),
+        APEX_BENCH_TIMEOUT=str(timeout),
+        APEX_BENCH_RETRY_WAIT=str(wait),
+        APEX_TELEMETRY_LEDGER=str(tmp_path / "ledger.jsonl"),
+        APEX_BENCH_BASELINE=str(tmp_path / "baseline.json"))
+    return env
+
+
+def _run_watchdog(tmp_path, plan, attempts=2, timeout=10, wait=1):
+    return subprocess.run(
+        [sys.executable, BENCH], capture_output=True, text=True,
+        timeout=300, env=_watchdog_env(tmp_path, plan, attempts, timeout,
+                                       wait))
+
+
+def _stdout_json_lines(out):
+    return [json.loads(l) for l in out.stdout.splitlines()
+            if l.startswith("{")]
+
+
+def test_chaos_ladder_picks_b8_over_starved_b16(tmp_path):
+    """§6 selective large-HBM starvation: the default-config (b=8)
+    attempt measures healthy while the b=16 ladder rung rides its whole
+    budget — the best line is the healthy b=8 one, the starvation
+    signature is named, and the fabricated window's stamp rides the
+    printed line."""
+    plan = [
+        {"site": "backend_init", "kind": "fabricate",
+         "match_env": {"APEX_BENCH_BATCH": None},
+         "record": HEALTHY_TPU_REC},
+        {"site": "backend_init", "kind": "hang",
+         "match_env": {"APEX_BENCH_BATCH": "16"}},
+    ]
+    out = _run_watchdog(tmp_path, plan, attempts=2, timeout=8)
+    lines = _stdout_json_lines(out)
+    assert out.returncode == 0, (out.stdout, out.stderr[-2000:])
+    assert len(lines) == 1  # the one-JSON-line contract survives chaos
+    rec = lines[0]
+    assert rec["value"] == 100.0 and rec["config"]["batch"] == 8
+    assert rec["fault_plan"].startswith("fp-")
+    assert "large-HBM starvation signature" in out.stderr
+    assert "degraded_large_hbm" in out.stderr
+
+
+def test_chaos_full_timeout_wedge_arms_lazy_cap(tmp_path):
+    """Backend-init hang on every attempt: each rides its entire budget,
+    the first arms the 900s wedge cap (visible in the liveness log),
+    and the flushed line is the honest fabricated timeout record."""
+    plan = [{"site": "backend_init", "kind": "hang"}]
+    out = _run_watchdog(tmp_path, plan, attempts=2, timeout=4)
+    lines = _stdout_json_lines(out)
+    assert out.returncode == 1  # error line only: no real measurement
+    assert len(lines) == 1
+    rec = lines[0]
+    assert rec["timed_out"] is True and rec["relay_degraded"] is True
+    assert "timed out" in rec["error"]
+    assert rec["fault_plan"].startswith("fp-")  # injected wedge is stamped
+    assert out.stderr.count(
+        f"capping remaining attempts at {resilience.WEDGE_CAP_S}s") == 1
+    assert resilience.classify(rec) == resilience.WEDGED
+
+
+def test_chaos_sigterm_flushes_best_line_and_ledger_record(tmp_path):
+    """Mid-attempt SIGTERM (the outer driver's budget firing): the
+    watchdog flushes the best line seen so far — well-formed JSON — and
+    appends a bench_watchdog ledger record naming the termination."""
+    plan = [
+        {"site": "backend_init", "kind": "fabricate",
+         "match_env": {"APEX_BENCH_ATTEMPT": "0"},
+         "record": HEALTHY_TPU_REC},
+        {"site": "backend_init", "kind": "sigterm_parent",
+         "match_env": {"APEX_BENCH_ATTEMPT": "1"}},
+    ]
+    out = _run_watchdog(tmp_path, plan, attempts=2, timeout=60)
+    lines = _stdout_json_lines(out)
+    assert out.returncode == 0, (out.stdout, out.stderr[-2000:])
+    assert len(lines) == 1 and lines[0]["value"] == 100.0
+    records = tledger.read_ledger(str(tmp_path / "ledger.jsonl"))
+    wd = [r for r in records if r.get("harness") == "bench_watchdog"]
+    assert len(wd) == 1
+    assert wd[0]["terminated"] == "SIGTERM"
+    assert wd[0]["flushed"]["value"] == 100.0
+    assert wd[0]["fault_plan"].startswith("fp-")
+    assert tledger.validate_record(wd[0]) == []
+
+
+def test_chaos_truncated_json_is_no_measurement_then_retried(tmp_path):
+    """A truncated/corrupt JSON line (wedging-teardown class) parses to
+    NO measurement: the watchdog crash-retries and the healthy retry
+    becomes the headline."""
+    plan = [
+        {"site": "backend_init", "kind": "fabricate",
+         "match_env": {"APEX_BENCH_ATTEMPT": "0"},
+         "record": HEALTHY_TPU_REC, "truncate_bytes": 25},
+        {"site": "backend_init", "kind": "fabricate",
+         "match_env": {"APEX_BENCH_ATTEMPT": "1"},
+         "record": HEALTHY_TPU_REC},
+    ]
+    out = _run_watchdog(tmp_path, plan, attempts=2, timeout=60)
+    lines = _stdout_json_lines(out)
+    assert out.returncode == 0, (out.stdout, out.stderr[-2000:])
+    assert len(lines) == 1 and lines[0]["value"] == 100.0
+    assert "inner bench process crashed" in out.stderr
+
+
+@pytest.mark.slow  # crash-retry is already tier-1-covered by the
+# truncated-JSON chaos test (same no-measurement crash path); this twin
+# only varies the exit style, so it rides the slow tier (CLAUDE.md
+# fast-tier budget)
+def test_chaos_relay_init_crash_is_retried_with_short_wait(tmp_path):
+    """A relay-init crash (connection reset instead of a hang — the
+    watchdog docstring's round-3 mode): non-zero exit, no JSON, short
+    crash wait, healthy retry wins."""
+    plan = [
+        {"site": "backend_init", "kind": "exit", "rc": 7,
+         "match_env": {"APEX_BENCH_ATTEMPT": "0"}},
+        {"site": "backend_init", "kind": "fabricate",
+         "match_env": {"APEX_BENCH_ATTEMPT": "1"},
+         "record": HEALTHY_TPU_REC},
+    ]
+    out = _run_watchdog(tmp_path, plan, attempts=2, timeout=60)
+    lines = _stdout_json_lines(out)
+    assert out.returncode == 0
+    assert len(lines) == 1 and lines[0]["value"] == 100.0
+    assert "crashed (rc=7)" in out.stderr
+
+
+# ------------------------------------------ real-driver chaos (one CPU
+# smoke run each; they share a persistent compile cache to stay fast)
+
+@pytest.fixture(scope="module")
+def chaos_cache_dir(tmp_path_factory):
+    return str(tmp_path_factory.mktemp("chaos_compile_cache"))
+
+
+def _run_inner_smoke(tmp_path, plan, chaos_cache_dir, extra_env=None):
+    env = dict(os.environ)
+    env.pop("APEX_WARM_ONLY", None)
+    env.pop("APEX_FAULT_PLAN", None)  # plan=None = uninjected control
+    env.update(
+        PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
+        APEX_BENCH_SMOKE="1", APEX_BENCH_INNER="1",
+        APEX_COMPILE_CACHE="1", APEX_COMPILE_CACHE_DIR=chaos_cache_dir,
+        APEX_TELEMETRY_LEDGER=str(tmp_path / "ledger.jsonl"),
+        APEX_BENCH_BASELINE=str(tmp_path / "baseline.json"),
+        **(extra_env or {}))
+    if plan is not None:
+        env["APEX_FAULT_PLAN"] = json.dumps(plan)
+    return subprocess.run([sys.executable, BENCH], capture_output=True,
+                          text=True, timeout=300, env=env)
+
+
+def test_chaos_inflated_overhead_yields_calibration_flap_line(
+        tmp_path, chaos_cache_dir):
+    """Relay-degraded dispatch overhead: the injected inflation makes
+    the overhead subtraction go non-positive — bench prints the honest
+    calibration-flap error line (relay_degraded, value 0), classified
+    degraded_relay, fault-stamped in both the line and the ledger."""
+    plan = [{"site": "calibration_overhead", "kind": "inflate",
+             "add_s": 1e6}]
+    out = _run_inner_smoke(tmp_path, plan, chaos_cache_dir)
+    assert out.returncode == 0, out.stderr[-2000:]
+    _, rec = resilience.last_json(out.stdout)
+    assert rec is not None
+    assert "non-positive step time" in rec["error"]
+    assert rec["relay_degraded"] is True and rec["value"] == 0
+    assert rec["fault_plan"].startswith("fp-")
+    assert resilience.classify(rec, smoke=True) \
+        == resilience.DEGRADED_RELAY
+    records = tledger.read_ledger(str(tmp_path / "ledger.jsonl"))
+    assert records[-1]["fault_plan"] == rec["fault_plan"]
+    assert records[-1]["relay"] == {"degraded": True,
+                                    "kind": "calibration-flap"}
+
+
+def test_chaos_degraded_stamp_refused_by_baseline_seeding_gate(
+        tmp_path, chaos_cache_dir):
+    """An injected relay-degraded verdict: the record carries
+    ``degraded_kind: relay`` + the honest note, and the BENCH_BASELINE
+    seeding gate REFUSES to seed a series from it (vs_baseline falls to
+    the 0 sentinel); the same run without the fault seeds normally."""
+    plan = [{"site": "verdict", "kind": "degraded",
+             "degraded_kind": "relay"}]
+    out = _run_inner_smoke(tmp_path, plan, chaos_cache_dir)
+    assert out.returncode == 0, out.stderr[-2000:]
+    _, rec = resilience.last_json(out.stdout)
+    assert rec["degraded_kind"] == "relay"
+    assert rec["relay_degraded"] is True and "note" in rec
+    assert rec["fault_plan"].startswith("fp-")
+    assert resilience.classify(rec, smoke=True) \
+        == resilience.DEGRADED_RELAY
+    assert not os.path.exists(tmp_path / "baseline.json"), \
+        "a degraded run must never seed a baseline series"
+    # ...and with no series seeded, vs_baseline falls to the honest
+    # "not comparable" 0 sentinel (the healthy-run seeding path itself
+    # is long-standing behavior — the committed BENCH_BASELINE.json's
+    # cpu series — and the slow-tier bench contract smoke covers it)
+    assert rec["vs_baseline"] == 0.0
+
+
+def test_chaos_remote_compile_http500_crashes_attempt(
+        tmp_path, chaos_cache_dir):
+    """The remote-compile helper's HTTP-500 mode (the round-3 b=32
+    stall class): the attempt dies with the error on stderr and NO JSON
+    line — exactly the no-measurement crash the watchdog retries."""
+    plan = [{"site": "compile", "kind": "raise",
+             "message": "remote compile failed: HTTP 500"}]
+    out = _run_inner_smoke(tmp_path, plan, chaos_cache_dir)
+    assert out.returncode != 0
+    assert "HTTP 500" in out.stderr
+    _, rec = resilience.last_json(out.stdout)
+    assert rec is None  # no parseable measurement line
+
+
+# ------------------------------------------------------- autotune chaos
+
+def test_chaos_autotune_budget_injected_away_drops_loudly(
+        tmp_path, monkeypatch, capsys):
+    """Budget starved to zero by the fault plan: every rung is dropped
+    BY NAME (no silent caps), the pass exits non-zero, and the summary
+    carries the fault stamp."""
+    from benchmarks import autotune_steps
+
+    monkeypatch.setenv("APEX_FAULT_PLAN", json.dumps(
+        [{"site": "autotune_budget", "kind": "set_budget",
+          "budget_s": 0}]))
+
+    def boom(*a, **k):  # the budget gate must stop every launch
+        raise AssertionError("no rung subprocess may launch at budget 0")
+
+    rc = autotune_steps.main(
+        ["--smoke", "--table", str(tmp_path / "table.jsonl"),
+         "--ledger", str(tmp_path / "ledger.jsonl")], runner=boom)
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "BUDGET DROPPED" in out
+    for g in autotune_steps.rung_groups(True):
+        assert g["name"] in out, f"dropped rung {g['name']} not named"
+    summary = json.loads(out.splitlines()[-1].split("autotune: ", 1)[1])
+    assert summary["fault_plan"] == faults.plan_hash()
+    assert sorted(summary["dropped"]) == sorted(
+        g["name"] for g in autotune_steps.rung_groups(True))
+
+
+def test_autotune_refuses_committed_table_under_fault_plan(monkeypatch):
+    from benchmarks import autotune_steps
+
+    monkeypatch.setenv("APEX_FAULT_PLAN", json.dumps(
+        [{"site": "autotune_budget", "kind": "set_budget", "budget_s": 0}]))
+    with pytest.raises(SystemExit, match="refusing to write the committed"):
+        autotune_steps.main(["--smoke"])
+
+
+# ----------------------------------------------------- probe CLI verdicts
+
+def test_probe_cli_log_gate(tmp_path, capsys):
+    healthy = tmp_path / "bench.log"
+    healthy.write_text("# noise\n" + json.dumps(HEALTHY_TPU_REC) + "\n")
+    assert probe_cli.main(["log", str(healthy)]) == 0
+    assert "healthy" in capsys.readouterr().out
+    wedged = tmp_path / "wedged.log"
+    wedged.write_text(json.dumps({
+        "metric": "gpt2s_train_tokens_per_sec (tpu)", "value": 0,
+        "timed_out": True, "relay_degraded": True, "error": "timed out"}))
+    assert probe_cli.main(["log", str(wedged)]) == 1
+    assert "wedged" in capsys.readouterr().out
+    assert probe_cli.main(["log", str(tmp_path / "missing.log")]) == 1
+    capsys.readouterr()
+
+
+def test_probe_cli_stamp_and_status_verdicts(tmp_path, capsys):
+    state = str(tmp_path / "state.json")
+    # healthy probe
+    assert probe_cli.main(["stamp", "--rc", "0", "--detail",
+                           "probe: marginal 186.2 TF/s", "--out",
+                           state]) == 0
+    capsys.readouterr()
+    assert probe_cli.main(["status", "--state", state]) == 0
+    out = capsys.readouterr().out
+    assert "last probe: healthy" in out and "age" in out
+    # out-of-band marginal = degraded relay; timeout kill = wedged
+    assert probe_cli.main(["stamp", "--rc", "1", "--detail",
+                           "probe: ... -> marginal 42.0 TF/s", "--out",
+                           state]) == 1
+    capsys.readouterr()
+    assert probe_cli.main(["status", "--state", state]) == 1
+    assert "last probe: degraded_relay" in capsys.readouterr().out
+    assert probe_cli.main(["stamp", "--rc", "124", "--out", state]) == 1
+    capsys.readouterr()
+    assert probe_cli.main(["status", "--state", state]) == 1
+    assert "last probe: wedged" in capsys.readouterr().out
+
+
+def test_probe_cli_status_names_large_hbm_starvation(tmp_path, capsys):
+    """Healthy probe + starved bench log = the §6 selective-starvation
+    verdict, named in --status output."""
+    state = str(tmp_path / "state.json")
+    probe_cli.main(["stamp", "--rc", "0", "--detail",
+                    "probe: marginal 186.2 TF/s", "--out", state])
+    bench_log = tmp_path / "bench.log"
+    bench_log.write_text(json.dumps({
+        "metric": "gpt2s_train_tokens_per_sec (tpu)", "value": 0,
+        "timed_out": True, "relay_degraded": True, "error": "timed out"}))
+    capsys.readouterr()
+    assert probe_cli.main(["status", "--state", state,
+                           "--bench", str(bench_log)]) == 0
+    out = capsys.readouterr().out
+    assert "last probe: healthy" in out
+    assert resilience.DEGRADED_LARGE_HBM in out
+    assert "selective starvation" in out
+
+
+# ------------------------------------------------------- shell arm guard
+
+def _sh(args, env_extra, timeout=60):
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
+               **env_extra)
+    return subprocess.run(["bash", *args], capture_output=True, text=True,
+                          timeout=timeout, env=env, cwd=REPO)
+
+
+@pytest.fixture
+def guard_env(tmp_path):
+    return {
+        "APEX_PROBE_PIDFILE": str(tmp_path / "probe.pid"),
+        "APEX_PROBE_DISARM": str(tmp_path / "DISARMED"),
+        "APEX_PROBE_STATE": str(tmp_path / "probe_state"),
+        "APEX_PROBE_DRYRUN": "1",
+    }
+
+
+def test_chaos_arm_guard_refuses_silent_start_after_disarm(tmp_path,
+                                                           guard_env):
+    """The round-5 failure mode: a window opening against a loop left
+    disarmed. After `disarm` the sticky marker makes a plain start
+    REFUSE loudly; only an explicit --rearm clears it."""
+    out = _sh([PROBE_SH, "disarm"], guard_env)
+    assert out.returncode == 0, out.stderr
+    assert os.path.exists(guard_env["APEX_PROBE_DISARM"])
+    # plain start refuses — a round cannot silently begin disarmed
+    out = _sh([PROBE_SH], guard_env)
+    assert out.returncode == 2
+    assert "REFUSING TO START" in out.stderr
+    assert "--rearm" in out.stderr
+    # --status reports the disarmed state and exits non-zero
+    out = _sh([PROBE_SH, "--status", str(tmp_path / "noout")], guard_env,
+              timeout=120)
+    assert out.returncode == 1
+    assert "DISARMED" in out.stdout
+    # explicit re-arm clears the marker and passes the guards
+    out = _sh([PROBE_SH, "--rearm"], guard_env)
+    assert out.returncode == 0
+    assert "ARM OK (dryrun)" in out.stdout
+    assert not os.path.exists(guard_env["APEX_PROBE_DISARM"])
+
+
+def test_status_picks_latest_pass_numerically(tmp_path, guard_env):
+    """pass10 must beat pass2..pass9 in --status (lexicographic globbing
+    would report an hours-old pass as the current window)."""
+    sout = tmp_path / "collect"
+    for n in (2, 9, 10):
+        (sout / f"pass{n}").mkdir(parents=True)
+    out = _sh([PROBE_SH, "--status", str(sout)], guard_env, timeout=120)
+    assert f"latest pass: {sout}/pass10" in out.stdout, out.stdout
+
+
+def test_collection_shells_refuse_fault_plans(tmp_path, guard_env):
+    """Scored collection must never run injected: both shell drivers
+    refuse outright when APEX_FAULT_PLAN is set."""
+    env = dict(guard_env, APEX_FAULT_PLAN="[]")
+    out = _sh([PROBE_SH], env)
+    assert out.returncode == 2 and "APEX_FAULT_PLAN" in out.stderr
+    out = _sh([RUN_ALL_SH, str(tmp_path / "out")], env)
+    assert out.returncode == 2 and "APEX_FAULT_PLAN" in out.stderr
+
+
+def test_shell_drivers_pass_bash_syntax_gate():
+    """`bash -n` over the collection shells: a broken quoting edit must
+    fail tier-1, not brick the next unattended window."""
+    for script in (PROBE_SH, RUN_ALL_SH):
+        out = subprocess.run(["bash", "-n", script], capture_output=True,
+                             text=True, timeout=60)
+        assert out.returncode == 0, f"{script}: {out.stderr}"

@@ -113,7 +113,17 @@ def check_captions(perf_text, perf_path, records):
                 problems.append(
                     f"{perf_path}:{lineno}: citation ledger:{rid} has no "
                     f"ledger record")
-            elif rec.get("dispatch_overhead_ms") is not None:
+                continue
+            if rec.get("fault_plan"):
+                # fault-injected records (APEX_FAULT_PLAN chaos runs —
+                # apex_tpu.resilience.faults) are test artifacts: a
+                # PERF.md caption must never cite one as a measurement
+                problems.append(
+                    f"{perf_path}:{lineno}: citation ledger:{rid} is a "
+                    f"FAULT-INJECTED record (fault_plan="
+                    f"{rec['fault_plan']}) — injected runs are not "
+                    f"measurements")
+            if rec.get("dispatch_overhead_ms") is not None:
                 overheads[rid] = rec["dispatch_overhead_ms"]
         if not overheads:
             continue
@@ -157,6 +167,22 @@ def check_dispatch_table(path, records):
                f"/{entry.get('dtype')}/{entry.get('backend')}")
         for p in dispatch_mod.validate_entry(entry, by_id):
             problems.append(f"{tag}: {p}")
+        # a dispatch default must never be decided by an injected run:
+        # neither the entry itself nor any record it cites may carry
+        # the APEX_FAULT_PLAN stamp
+        if entry.get("fault_plan"):
+            problems.append(f"{tag}: entry carries a fault_plan stamp "
+                            f"({entry['fault_plan']}) — produced under "
+                            f"injection")
+        cited = [entry.get("ledger")] + [
+            m.get("ledger") for m in (entry.get("measured") or {}).values()
+            if isinstance(m, dict)]
+        for rid in cited:
+            rec = by_id.get(rid)
+            if rec is not None and rec.get("fault_plan"):
+                problems.append(
+                    f"{tag}: cites FAULT-INJECTED record {rid} "
+                    f"(fault_plan={rec['fault_plan']})")
     return problems, len(entries)
 
 
@@ -175,6 +201,8 @@ def main(argv=None):
         print(f"FAIL: ledger {args.ledger} does not exist")
         return 1
     except ValueError as e:
+        # read_ledger names the offending file:lineno for corrupt,
+        # truncated and non-object lines — the finding, not a traceback
         print(f"FAIL: {e}")
         return 1
     problems = check_ledger(records)
@@ -201,4 +229,12 @@ def main(argv=None):
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        sys.exit(main())
+    except SystemExit:
+        raise
+    except Exception as e:  # a checker that crashes is a checker that
+        # silently stops gating: any unexpected error becomes a FAIL
+        # finding (tier-1 sees exit 1 + a message, never a traceback)
+        print(f"FAIL: checker error: {type(e).__name__}: {e}")
+        sys.exit(1)
