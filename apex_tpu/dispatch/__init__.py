@@ -36,6 +36,23 @@ File format — one JSON object per line::
      "ledger": "lg-1da2bfbbb0", "pins": {"APEX_ATTN_IMPL": "rows"},
      "measured": {...}, "rung": "gpt_rows"}
 
+Entries may additionally carry a ``params`` payload — the per-shape
+TILE geometry measured for the chosen kernel (``benchmarks/
+autotune_tiles.py``), its own citation riding inside::
+
+    "params": {"value": {"block_q": 256}, "ledger": "lg-...",
+               "pins": {"APEX_ATTN_BLOCK_Q": "256"},
+               "measured": {"256": {...}, "512": {...}}}
+
+``lookup_params`` resolves it at trace time (strictly below per-call
+tile knobs and the kernels' process-wide tile setters); legality under
+the shared tile model (:mod:`apex_tpu.dispatch.tiles`) is re-checked by
+the consuming kernel against the REAL call dims, so a payload measured
+at the bucket shape degrades to the built-in heuristic — never a
+Mosaic rejection — on a shape it can't tile. A malformed payload is
+skip-and-fallback at runtime and a check-4 finding in
+``tools/check_bench_labels.py``.
+
 Shape bucketing: every dimension is rounded UP to the next power of
 two (:func:`bucket`), so a measurement at b=8/s=1024 serves b=7/s=1000
 but never a 2x-different working set. Dims are name-sorted in the key
@@ -57,6 +74,8 @@ imports it without touching a jax backend); jax is imported lazily in
 
 import json
 import os
+
+from apex_tpu.dispatch import tiles
 
 # allowed choices per op — the consuming call site's knob vocabulary.
 # "attention" is ops.attention.fused_attention's impl; "attention_bwd"
@@ -199,8 +218,19 @@ def lookup(op, dtype, backend=None, path=None, **dims):
     must degrade to the built-in default, not crash a trace. Every
     lookup (hit or miss) lands in the process consult log
     (:func:`snapshot`)."""
+    return lookup_params(op, dtype, backend=backend, path=path,
+                         **dims)[0]
+
+
+def lookup_params(op, dtype, backend=None, path=None, **dims):
+    """``(choice, tile_params)`` for this key — the params form of
+    :func:`lookup`. ``tile_params`` is the entry's ``params.value``
+    dict when present and well-formed (``tiles.runtime_value``), else
+    None: a malformed payload degrades to the heuristic tile
+    (skip-and-fallback) while check 4 flags the committed line. The
+    consult log records the resolved params next to the choice."""
     e = lookup_entry(op, dtype, backend=backend, path=path, **dims)
-    choice = None
+    choice, params = None, None
     if e is not None:
         choice = e.get("choice")
         allowed = OP_CHOICES.get(op)
@@ -208,20 +238,29 @@ def lookup(op, dtype, backend=None, path=None, **dims):
             choice = None
         elif op == "bench_batch" and not str(choice).isdigit():
             choice = None
+        if "params" in e:
+            params = tiles.runtime_value(op, e["params"])
     if dispatch_enabled():
         _consults[(op, bucket(**dims), normalize_dtype(dtype),
-                   backend or current_backend())] = choice
-    return choice
+                   backend or current_backend())] = (choice, params)
+    return choice, params
 
 
 def consulted():
     """The consult log: one row per distinct key looked up in this
     process, with the choice that resolved (None = table miss, i.e. the
-    built-in default applied)."""
-    return [{"op": k[0], "bucket": k[1], "dtype": k[2], "backend": k[3],
-             "choice": v}
-            for k, v in sorted(_consults.items(),
-                               key=lambda kv: tuple(map(str, kv[0])))]
+    built-in default applied) and, when a tile payload resolved too,
+    the ``params`` the consult handed the kernel."""
+    out = []
+    for k, v in sorted(_consults.items(),
+                       key=lambda kv: tuple(map(str, kv[0]))):
+        choice, params = v
+        row = {"op": k[0], "bucket": k[1], "dtype": k[2], "backend": k[3],
+               "choice": choice}
+        if params is not None:
+            row["params"] = params
+        out.append(row)
+    return out
 
 
 def snapshot():
@@ -234,10 +273,13 @@ def snapshot():
 
 
 def make_entry(op, dims, dtype, backend, choice, ledger_id, pins=None,
-               measured=None, rung=None):
+               measured=None, rung=None, params=None):
     """Build one table entry. ``pins`` are the APEX_* env knobs that
     produced the winning measurement — the checker asserts each one
-    matches the cited ledger record's recorded knobs."""
+    matches the cited ledger record's recorded knobs. ``params`` is the
+    optional tile payload (``{"value": {...}, "ledger": ..., "pins":
+    ..., "measured": ...}`` — see the module docstring), validated by
+    check 4."""
     e = {"op": op, "bucket": bucket(**dims),
          "dtype": normalize_dtype(dtype), "backend": backend,
          "choice": choice, "ledger": ledger_id,
@@ -246,6 +288,8 @@ def make_entry(op, dims, dtype, backend, choice, ledger_id, pins=None,
         e["measured"] = measured
     if rung:
         e["rung"] = rung
+    if params:
+        e["params"] = params
     return e
 
 
@@ -288,17 +332,61 @@ def validate_entry(entry, ledger_by_id):
     if rec is None:
         problems.append(f"citation ledger:{rid} has no ledger record")
         return problems
-    knobs = rec.get("knobs") or {}
+    problems += _pin_problems(pins, rec.get("knobs") or {}, rid)
+    return problems
+
+
+def _pin_problems(pins, knobs, rid, prefix="pin"):
+    """Pin-agreement findings: every pinned knob must equal the cited
+    record's recorded value; a None pin asserts the knob was UNSET.
+    Shared by the entry-level and params-payload validators so the two
+    checks cannot drift."""
+    problems = []
     for k, v in sorted(pins.items()):
         if v is None:
             if k in knobs:
                 problems.append(
-                    f"pin {k}=unset but cited record {rid} pinned "
+                    f"{prefix} {k}=unset but cited record {rid} pinned "
                     f"{k}={knobs[k]!r}")
         elif knobs.get(k) != v:
             problems.append(
-                f"pin {k}={v!r} does not match cited record {rid} "
+                f"{prefix} {k}={v!r} does not match cited record {rid} "
                 f"(measured with {k}={knobs.get(k)!r})")
+    return problems
+
+
+def validate_params(entry, ledger_by_id):
+    """Problems for one entry's tile ``params`` payload (check 4 of
+    ``tools/check_bench_labels.py``; empty when the entry has none).
+    Three gates: legality under the shared tile model at the entry's
+    bucket dims (a committed tile must lower), citation resolution
+    (``params.ledger`` must name a real — and un-injected — record),
+    and pin agreement (every ``params.pins`` knob must equal the cited
+    record's recorded value). Runtime lookups skip a payload that
+    fails ``tiles.runtime_value`` and fall back to the heuristic; here
+    the same payload is a finding."""
+    payload = entry.get("params")
+    if payload is None:
+        return []
+    problems = tiles.validate_payload(
+        entry.get("op"), entry.get("bucket"), entry.get("dtype"), payload)
+    if not isinstance(payload, dict):
+        return problems
+    rid = payload.get("ledger")
+    if isinstance(rid, str):
+        rec = ledger_by_id.get(rid)
+        if rec is None:
+            problems.append(
+                f"params citation ledger:{rid} has no ledger record")
+        else:
+            if rec.get("fault_plan"):
+                problems.append(
+                    f"params cites FAULT-INJECTED record {rid} "
+                    f"(fault_plan={rec['fault_plan']})")
+            pins = payload.get("pins")
+            if isinstance(pins, dict):
+                problems += _pin_problems(pins, rec.get("knobs") or {},
+                                          rid, prefix="params pin")
     return problems
 
 

@@ -65,7 +65,8 @@ def _normalized_axes(x, normalized_shape):
 
 
 def _resolve_pallas(x_shape, n_norm_axes, use_pallas, dtype=None):
-    """``(use, interpret)`` for one call — THE dispatch decision.
+    """``(use, interpret, block_rows_pref)`` for one call — THE
+    dispatch decision.
 
     Resolution: per-call ``use_pallas`` > module ``USE_PALLAS`` >
     dispatch-table "layer_norm" entry for this (rows, hidden) bucket >
@@ -74,14 +75,18 @@ def _resolve_pallas(x_shape, n_norm_axes, use_pallas, dtype=None):
     A table entry is backend-keyed, so a CPU-measured "pallas" row was
     measured in interpret mode — it runs the same way (``interpret``
     True off-TPU); explicit True still requires a real TPU, unchanged.
+    ``block_rows_pref`` is the table entry's tile payload (the kernel
+    validates it per shape and falls back to its heuristic — strictly
+    below its per-call ``block_rows`` and ``set_block_rows``).
     """
     if n_norm_axes != 1:
-        return False, False
+        return False, False, None
     hidden = x_shape[-1]
     rows = 1
     for d in x_shape[:-1]:
         rows *= d
     from_table = False
+    tile_pref = None
     if use_pallas is None:
         use_pallas = USE_PALLAS
     if use_pallas is None:
@@ -90,31 +95,33 @@ def _resolve_pallas(x_shape, n_norm_axes, use_pallas, dtype=None):
         # under a guessed dtype that could diverge from the real call's
         # (fused_layer_norm always passes x.dtype)
         if dtype is None:
-            return False, False
+            return False, False, None
         from apex_tpu import dispatch
 
-        use_pallas = dispatch.lookup(
-            "layer_norm", dtype=dtype, rows=rows,
-            hidden=hidden) == "pallas"
+        choice, params = dispatch.lookup_params(
+            "layer_norm", dtype=dtype, rows=rows, hidden=hidden)
+        use_pallas = choice == "pallas"
         from_table = use_pallas
+        if params:
+            tile_pref = params.get("block_rows")
     if not use_pallas:
-        return False, False
+        return False, False, None
     # imports below the early return: the pure-jnp default path must not
     # require jax.experimental.pallas to be importable
     from apex_tpu.ops.attention import _tpu_available
     from apex_tpu.ops import layer_norm_pallas as lnp
 
     if not lnp.supported(rows, hidden):
-        return False, False
+        return False, False, None
     on_tpu = _tpu_available()
     if from_table:
-        return True, not on_tpu
+        return True, not on_tpu, tile_pref
     if not on_tpu and os.environ.get("APEX_PALLAS_INTERPRET") == "1":
         # the CPU leg of a pinned pallas A/B (autotune_steps --smoke):
         # run the kernel in interpret mode instead of silently falling
         # back to jnp — a "pallas" label over a jnp run is label drift
-        return True, True
-    return on_tpu, False
+        return True, True, tile_pref
+    return on_tpu, False, tile_pref
 
 
 def would_use_pallas(x_shape, n_norm_axes=1, use_pallas=None, dtype=None):
@@ -131,16 +138,21 @@ def would_use_pallas(x_shape, n_norm_axes=1, use_pallas=None, dtype=None):
 
 
 def fused_layer_norm(x, normalized_shape, weight=None, bias=None, eps=1e-5,
-                     memory_efficient=False, use_pallas=None):
+                     memory_efficient=False, use_pallas=None,
+                     block_rows=None):
     """Functional layer norm, fp32 statistics (reference autograd fns:
     fused_layer_norm.py:32,59,84,103). ``use_pallas`` overrides the
-    module-level ``USE_PALLAS`` dispatch to the Pallas row kernel."""
+    module-level ``USE_PALLAS`` dispatch to the Pallas row kernel;
+    ``block_rows`` is the per-call tile demand forwarded to the kernel
+    (raises on an illegal tile — apex_tpu.dispatch.tiles; the kernel's
+    ``set_block_rows``/``APEX_LN_BLOCK_ROWS``/table-params tiles apply
+    only when it is None)."""
     del memory_efficient  # remat is a jax.checkpoint policy decision here
     axes, _ = _normalized_axes(x, normalized_shape)
     orig_dtype = x.dtype
 
-    use, interpret = _resolve_pallas(x.shape, len(axes), use_pallas,
-                                     x.dtype)
+    use, interpret, block_rows_pref = _resolve_pallas(
+        x.shape, len(axes), use_pallas, x.dtype)
     if use:
         from apex_tpu.ops import layer_norm_pallas as lnp
 
@@ -150,7 +162,7 @@ def fused_layer_norm(x, normalized_shape, weight=None, bias=None, eps=1e-5,
             x.reshape(rows, hidden),
             None if weight is None else weight.astype(jnp.float32),
             None if bias is None else bias.astype(jnp.float32), eps,
-            interpret)
+            interpret, block_rows, block_rows_pref)
         return y2d.reshape(x.shape)
 
     xf = x.astype(jnp.float32)
@@ -214,6 +226,7 @@ class FusedLayerNorm(nn.Module):
     memory_efficient: bool = False
     param_dtype: jnp.dtype = jnp.float32
     use_pallas: bool = None
+    block_rows: int = None  # per-call tile demand (raises when illegal)
 
     @nn.compact
     def __call__(self, x):
@@ -230,7 +243,8 @@ class FusedLayerNorm(nn.Module):
                 "bias", nn.initializers.zeros, shape, self.param_dtype)
         return fused_layer_norm(x, shape, weight, bias, self.eps,
                                 self.memory_efficient,
-                                use_pallas=self.use_pallas)
+                                use_pallas=self.use_pallas,
+                                block_rows=self.block_rows)
 
 
 class FusedRMSNorm(nn.Module):

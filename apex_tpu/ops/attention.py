@@ -108,26 +108,36 @@ def reset_default_impl():
     _IMPL_PINNED = False
 
 
-def _effective_impl(impl, q, k):
-    """``(impl, from_table)`` for one call: per-call ``impl`` >
-    ``set_default_impl`` > dispatch-table entry for this shape bucket >
-    built-in. Table entries are preferences (measured on this backend,
-    keyed by shape bucket); unsupported shapes still fall through
-    rows → flash → dense downstream. ``from_table`` lets the rows
-    branch run a CPU-measured table choice in interpret mode — the way
-    it was measured."""
+def _effective_impl_params(impl, q, k):
+    """``(impl, from_table, tile_params)`` for one call: per-call
+    ``impl`` > ``set_default_impl`` > dispatch-table entry for this
+    shape bucket > built-in. Table entries are preferences (measured on
+    this backend, keyed by shape bucket); unsupported shapes still fall
+    through rows → flash → dense downstream. ``from_table`` lets the
+    rows branch run a CPU-measured table choice in interpret mode — the
+    way it was measured. ``tile_params`` is the entry's tile payload
+    (block_q/...), handed to the rows kernel as a PREFERENCE — illegal
+    tiles for the real shape fall back to the kernel heuristic there."""
     if impl is not None:
-        return impl, False
+        return impl, False, None
     if _IMPL_PINNED:
-        return _DEFAULT_IMPL, False
+        return _DEFAULT_IMPL, False, None
     from apex_tpu import dispatch
 
-    choice = dispatch.lookup(
+    choice, params = dispatch.lookup_params(
         "attention", dtype=q.dtype, b=q.shape[0], h=q.shape[1],
         sq=q.shape[2], sk=k.shape[2], d=q.shape[3])
     if choice:
-        return choice, True
-    return _DEFAULT_IMPL, False
+        return choice, True, params
+    # a params-only entry (tile measured for the shipped default impl)
+    # still feeds the kernel's tile preference
+    return _DEFAULT_IMPL, False, params
+
+
+def _effective_impl(impl, q, k):
+    """``(impl, from_table)`` — the choice half of
+    :func:`_effective_impl_params` (kept for its callers/tests)."""
+    return _effective_impl_params(impl, q, k)[:2]
 
 
 def fused_attention(q, k, v, *, causal=False, sm_scale=None,
@@ -156,8 +166,9 @@ def fused_attention(q, k, v, *, causal=False, sm_scale=None,
     # force_dense never consults the table: a consult the caller ignores
     # would still land in the dispatch.snapshot() consult log and
     # mislabel what a dense-baseline row actually ran
-    eff_impl, from_table = (("flash", False) if force_dense
-                            else _effective_impl(impl, q, k))
+    eff_impl, from_table, tile_params = (
+        ("flash", False, None) if force_dense
+        else _effective_impl_params(impl, q, k))
     if eff_impl == "rows" and not force_dense:
         import os
 
@@ -178,9 +189,14 @@ def fused_attention(q, k, v, *, causal=False, sm_scale=None,
                        or os.environ.get("APEX_PALLAS_INTERPRET") == "1"))
         if ((_tpu_available() or interp) and seq_ok
                 and ap.supported(sq, sk, q.shape[-1])):
+            # table tile params ride as a PREFERENCE tuple (hashable —
+            # custom_vjp nondiff arg); the kernel validates per shape
+            # and falls back to its heuristic on an illegal tile
+            pref = tuple(sorted(tile_params.items())) if tile_params \
+                else None
             return ap.fused_attention_rows(q, k, v, causal,
                                            float(sm_scale), segment_ids,
-                                           interp)
+                                           interp, tile_pref=pref)
     use_flash = flash_supported(sq, sk) and not force_dense
     if not use_flash:
         return _dense_attention(q, k, v, causal, sm_scale, segment_ids)

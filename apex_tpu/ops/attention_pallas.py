@@ -52,23 +52,50 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from apex_tpu.dispatch import tiles
 
-_VMEM_BUDGET = 10 * 1024 * 1024  # fp32 [bq, sk] working-set bytes
-_BWD_ARRAYS = 4  # S/P, dP, dS live + headroom (bwd is the tight pass)
+# budget/working-set constants live in the shared tile model
+# (apex_tpu/dispatch/tiles.py) — the sweeper, the label checker and
+# this lowering judge tiles with the same arithmetic
+_VMEM_BUDGET = tiles.ATTN_VMEM_BUDGET
+_BWD_ARRAYS = tiles.ATTN_BWD_ARRAYS
 # dropout keeps two extra [bq, sk] fp32 arrays live in the backward (the
 # keep-scale tile and the dropped probs), so its q block is sized for a
 # 6-array working set
-_DROP_BWD_ARRAYS = 6
+_DROP_BWD_ARRAYS = tiles.ATTN_DROP_BWD_ARRAYS
 
 
 def _q_block(sq, sk, n_arrays=_BWD_ARRAYS):
     """Largest power-of-two q block dividing sq whose bwd working set
     ([bq, sk] fp32 x n_arrays) fits the budget (0 → unsupported)."""
-    from apex_tpu.ops.attention import _block
+    return tiles.attn_q_block(sq, sk, n_arrays, budget=_VMEM_BUDGET)
 
-    cap = max(1, _VMEM_BUDGET // (4 * sk * n_arrays))
-    b = _block(sq, cap)
-    return b if b >= 8 else 0
+
+# Process-wide q-block preference (tri-state; falls back per shape —
+# only per-call tile knobs raise on an illegal tile)
+_BLOCK_Q = None
+
+
+def set_block_q(value):
+    """Pin the process-wide q-block preference (int), or un-pin with
+    None (table params / the heuristic apply again). Shapes the pinned
+    tile can't block fall back to the heuristic silently."""
+    global _BLOCK_Q
+    tiles.check_setter_value(value, "block_q")
+    _BLOCK_Q = value
+
+
+def _env_block_q():
+    return tiles.env_int("APEX_ATTN_BLOCK_Q")
+
+
+def _pref_get(tile_pref, name):
+    """Read one key out of a ``tile_pref`` tuple (the hashable
+    ``((name, value), ...)`` form table params travel in — custom_vjp
+    nondiff args must hash)."""
+    if not tile_pref:
+        return None
+    return dict(tile_pref).get(name)
 
 
 def supported(sq, sk, d, dropout=False):
@@ -601,15 +628,25 @@ def _chunked(causal, bq, sq, sk):
     return causal and bq % 128 == 0 and sk % bq == 0 and sq >= 2 * bq
 
 
-def _pick_bq(sq, sk, block_q, n_arrays=_BWD_ARRAYS):
-    bq = _q_block(sq, sk, n_arrays)
+def _pick_bq(sq, sk, block_q, n_arrays=_BWD_ARRAYS, tile_pref=None,
+             pref_keys=("block_q",)):
+    """The effective q block: per-call ``block_q`` (raises on an
+    illegal tile — the shared model's verdict) > ``set_block_q`` /
+    ``APEX_ATTN_BLOCK_Q`` (fall back per shape) > ``tile_pref`` (table
+    params, first legal of ``pref_keys``) > the heuristic."""
     if block_q is not None:
-        if sq % block_q or block_q > bq:
-            raise ValueError(
-                f"block_q={block_q} must divide sq={sq} and fit the VMEM "
-                f"budget (max {bq})")
-        bq = block_q
-    return bq
+        problems = tiles.attn_q_problems("block_q", block_q, sq, sk,
+                                         n_arrays, budget=_VMEM_BUDGET)
+        if problems:
+            raise ValueError("attention_pallas: " + "; ".join(problems))
+        return block_q
+    prefs = [_BLOCK_Q, _env_block_q()]
+    prefs += [_pref_get(tile_pref, k) for k in pref_keys]
+    for p in prefs:
+        if p is not None and not tiles.attn_q_problems(
+                "block_q", p, sq, sk, n_arrays, budget=_VMEM_BUDGET):
+            return p
+    return _q_block(sq, sk, n_arrays)
 
 
 # Backward structure: "monolithic" = one q-major kernel accumulating
@@ -646,6 +683,20 @@ def reset_bwd_impl():
     _BWD_PINNED = False
 
 
+def _bwd_table_consult(q, k):
+    """``(choice_or_None, tile_pref_tuple_or_None)`` from the
+    dispatch-table "attention_bwd" entry for this bucket — the params
+    half feeds the backward's tile resolution even when the impl itself
+    is pinned (the impl pin and the tile axis are independent knobs)."""
+    from apex_tpu import dispatch
+
+    choice, params = dispatch.lookup_params(
+        "attention_bwd", dtype=q.dtype, b=q.shape[0], h=q.shape[1],
+        sq=q.shape[2], sk=k.shape[2], d=q.shape[3])
+    pref = tuple(sorted(params.items())) if params else None
+    return choice, pref
+
+
 def _effective_bwd_impl(q, k):
     """Table-aware resolution for an unpinned backward: set_bwd_impl >
     dispatch-table "attention_bwd" entry for this bucket > built-in.
@@ -653,18 +704,15 @@ def _effective_bwd_impl(q, k):
     fall back to monolithic in _bwd_rule."""
     if _BWD_PINNED:
         return BWD_IMPL
-    from apex_tpu import dispatch
-
-    choice = dispatch.lookup(
-        "attention_bwd", dtype=q.dtype, b=q.shape[0], h=q.shape[1],
-        sq=q.shape[2], sk=k.shape[2], d=q.shape[3])
-    return choice or BWD_IMPL
+    return _bwd_table_consult(q, k)[0] or BWD_IMPL
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 6, 7, 8, 9, 11, 12, 13))
 def fused_attention_rows(q, k, v, causal, sm_scale, segment_ids=None,
                          interpret=False, block_q=None, bwd_impl=None,
-                         dropout_p=0.0, dropout_seed=None):
+                         dropout_p=0.0, dropout_seed=None,
+                         bwd_block_q=None, block_k=None, tile_pref=None):
     """VMEM-row fused attention. q: [b, h, sq, d]; k, v: [b, h, sk, d];
     segment_ids: None or (seg_q [b, sq], seg_kv [b, sk]). Check
     ``supported(sq, sk, d)`` first. ``interpret=True`` for CPU tests.
@@ -675,15 +723,28 @@ def fused_attention_rows(q, k, v, causal, sm_scale, segment_ids=None,
     INSIDE the kernel (counter-hash mask, replayed in backward — no
     [sq, sk] mask in HBM); requires a traced int32 ``dropout_seed``
     of shape (1, 1). Dropout forces the monolithic backward (an
-    explicit ``bwd_impl="split"`` request raises)."""
+    explicit ``bwd_impl="split"`` request raises).
+
+    Tile knobs (all judged by ``apex_tpu.dispatch.tiles``; per-call
+    values raise on an illegal tile): ``block_q`` sizes the fwd AND
+    (absent ``bwd_block_q``) backward q blocks; ``bwd_block_q``
+    overrides the backward only; ``block_k`` sizes the split backward's
+    k-major dk/dv block (requires the split structure to stay
+    eligible). ``tile_pref`` is the preference form — a hashable
+    ``((name, value), ...)`` tuple the dispatch-table consumer passes;
+    illegal entries fall back per shape, and ``set_block_q`` /
+    ``APEX_ATTN_BLOCK_Q`` resolve above it."""
     if bwd_impl is not None and bwd_impl not in ("monolithic", "split"):
         raise ValueError(f"unknown rows bwd impl {bwd_impl!r}")
     if not 0.0 <= dropout_p < 1.0:
         raise ValueError(f"dropout_p={dropout_p} outside [0, 1)")
     if dropout_p > 0.0 and bwd_impl == "split":
         raise ValueError("dropout requires the monolithic backward")
+    if block_k is not None and bwd_impl == "monolithic":
+        raise ValueError("block_k tiles the split backward; it cannot "
+                         "be honored with bwd_impl='monolithic'")
     return _fwd(q, k, v, causal, sm_scale, segment_ids, interpret,
-                block_q, dropout_p, dropout_seed)[0]
+                block_q, dropout_p, dropout_seed, tile_pref)[0]
 
 
 def _drop_ops(dropout_p, dropout_seed):
@@ -702,14 +763,14 @@ def _drop_spec(dropout_p):
 
 
 def _fwd(q, k, v, causal, sm_scale, segment_ids, interpret, block_q=None,
-         dropout_p=0.0, dropout_seed=None):
+         dropout_p=0.0, dropout_seed=None, tile_pref=None):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     if not supported(sq, sk, d, dropout=dropout_p > 0.0):
         raise ValueError(f"attention_pallas: unsupported {q.shape}x{k.shape}"
                          + (" with dropout" if dropout_p > 0.0 else ""))
     n_arrays = _DROP_BWD_ARRAYS if dropout_p > 0.0 else _BWD_ARRAYS
-    bq = _pick_bq(sq, sk, block_q, n_arrays)
+    bq = _pick_bq(sq, sk, block_q, n_arrays, tile_pref)
     has_seg = segment_ids is not None
     ins, qspec, _ = _specs(b, h, bq, sq, sk, d, has_seg)
     kern = functools.partial(_fwd_kernel, dropout_p=dropout_p, n_heads=h)
@@ -733,18 +794,35 @@ def _fwd(q, k, v, causal, sm_scale, segment_ids, interpret, block_q=None,
 
 def _fwd_rule(q, k, v, causal, sm_scale, segment_ids, interpret,
               block_q=None, bwd_impl=None, dropout_p=0.0,
-              dropout_seed=None):
+              dropout_seed=None, bwd_block_q=None, block_k=None,
+              tile_pref=None):
     return _fwd(q, k, v, causal, sm_scale, segment_ids, interpret, block_q,
-                dropout_p, dropout_seed)
+                dropout_p, dropout_seed, tile_pref)
+
+
+def _pick_bwd_bq(sq, sk, block_q, bwd_block_q, n_arrays=_BWD_ARRAYS,
+                 tile_pref=None):
+    """Backward q block: per-call ``bwd_block_q`` (raise) > per-call
+    ``block_q`` (raise — shared with fwd) > setter/env > table
+    ``bwd_block_q`` then ``block_q`` prefs > heuristic."""
+    if bwd_block_q is not None:
+        problems = tiles.attn_q_problems("bwd_block_q", bwd_block_q, sq,
+                                         sk, n_arrays,
+                                         budget=_VMEM_BUDGET)
+        if problems:
+            raise ValueError("attention_pallas: " + "; ".join(problems))
+        return bwd_block_q
+    return _pick_bq(sq, sk, block_q, n_arrays, tile_pref,
+                    pref_keys=("bwd_block_q", "block_q"))
 
 
 def _bwd_monolithic(causal, sm_scale, interpret, block_q, res, g,
-                    dropout_p=0.0):
+                    dropout_p=0.0, bwd_block_q=None, tile_pref=None):
     q, k, v, segment_ids, dropout_seed = res
     b, h, sq, d = q.shape
     sk = k.shape[2]
     n_arrays = _DROP_BWD_ARRAYS if dropout_p > 0.0 else _BWD_ARRAYS
-    bq = _pick_bq(sq, sk, block_q, n_arrays)
+    bq = _pick_bwd_bq(sq, sk, block_q, bwd_block_q, n_arrays, tile_pref)
     has_seg = segment_ids is not None
     ins, qspec, kvspec = _specs(b, h, bq, sq, sk, d, has_seg)
     kern = functools.partial(_bwd_kernel, dropout_p=dropout_p, n_heads=h)
@@ -769,11 +847,13 @@ def _bwd_monolithic(causal, sm_scale, interpret, block_q, res, g,
     return (dq, dk.astype(k.dtype), dv.astype(v.dtype), None, None)
 
 
-def _bwd_split(causal, sm_scale, interpret, block_q, res, g):
+def _bwd_split(causal, sm_scale, interpret, block_q, res, g,
+               bwd_block_q=None, block_k=None, tile_pref=None):
     q, k, v, segment_ids, _ = res  # no dropout on the split path
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    bq = _pick_bq(sq, sk, block_q)
+    bq = _pick_bwd_bq(sq, sk, block_q, bwd_block_q,
+                      tile_pref=tile_pref)
     has_seg = segment_ids is not None
     ins, qspec, kvspec = _specs(b, h, bq, sq, sk, d, has_seg)
     # stats carry a trailing 1 (block last dim == array dim) so the
@@ -798,7 +878,10 @@ def _bwd_split(causal, sm_scale, interpret, block_q, res, g):
         interpret=interpret,
     )(q, k, v, *_seg_ops(segment_ids), g)
 
-    bk = bq  # k-blocks reuse the VMEM-validated row block size
+    # k blocks default to the VMEM-validated row block; block_k decouples
+    # them (per-call raises via _bwd_rule's eligibility gate, a table
+    # pref falls back there)
+    bk = block_k if block_k is not None else bq
     fullq = pl.BlockSpec((1, 1, sq, d), lambda ib, ih, ik: (ib, ih, 0, 0))
     kvblk = pl.BlockSpec((1, 1, bk, d), lambda ib, ih, ik: (ib, ih, ik, 0))
     fullvec = pl.BlockSpec((1, 1, sq, 1), lambda ib, ih, ik: (ib, ih, 0, 0))
@@ -828,50 +911,88 @@ def _bwd_split(causal, sm_scale, interpret, block_q, res, g):
     return (dq, dk.astype(k.dtype), dv.astype(v.dtype), None, None)
 
 
-def _split_ok(sq, sk, d, bq, itemsize):
+def _split_ok(sq, sk, d, bq, itemsize, bk=None):
     """VMEM eligibility of the split k-major pass: it keeps the full
     [sq, d] q and dO resident per grid step (the monolithic backward
-    streams q instead), holds 3 [bq, bq] fp32 chunk arrays + 2 [bq, d]
-    accumulators + 3 [sq] stat vectors, and unrolls sq/bq chunks."""
-    # bq % 128: the k-major pass tiles k/v (and seg_kv) into (.., bk)
-    # LANE-dim blocks with bk = bq, and every in-kernel
-    # [:, c*bq:(c+1)*bq] chunk slice cuts the lane axis — both need
-    # 128-alignment under Mosaic. (The stat vectors themselves are
-    # [.., bq, 1] sublane-major and only need bq % 8.)
-    if sk % bq or bq % 128 or sq // bq > 32:
-        return False
-    resident = (2 * sq * d * itemsize      # q, dO
-                + 3 * bq * bq * 4          # s/p, dp, ds
-                + 2 * bq * d * 4           # dk/dv accumulators
-                + 3 * sq * 4)              # m, l, D
-    return resident <= _VMEM_BUDGET
+    streams q instead), holds 3 [bq, bk] fp32 chunk arrays + 2 [bk, d]
+    accumulators + 3 [sq] stat vectors, and unrolls sq/bq chunks.
+    The model lives in the shared tile module (``tiles.split_ok``);
+    bq % 128: the k-major pass tiles seg_kv into (.., bk) LANE-dim
+    blocks (bk = bq by default) and every in-kernel
+    [:, c*bq:(c+1)*bq] chunk slice in the q-major dq pass cuts the
+    lane axis — both need 128-alignment under Mosaic."""
+    return tiles.split_ok(sq, sk, d, bq, itemsize, bk,
+                          budget=_VMEM_BUDGET)
 
 
 def _bwd_rule(causal, sm_scale, interpret, block_q, bwd_impl, dropout_p,
-              res, g):
+              bwd_block_q, block_k, tile_pref, res, g):
     if bwd_impl is not None and bwd_impl not in ("monolithic", "split"):
         raise ValueError(f"unknown rows bwd impl {bwd_impl!r}")
+    q, k, v, _, _ = res
     if dropout_p > 0.0:
         # the split structure has no dropout replay wired through its two
         # passes; the per-call demand raises (fused_attention_rows already
-        # pre-checks this), the process-wide preference falls back
+        # pre-checks this), the process-wide preference falls back.
+        # BEFORE any table consult: dropout forces monolithic, and a
+        # consult whose choice could never be honored would still land
+        # in dispatch.snapshot()'s consult log — mislabeling what the
+        # measured backward actually ran
         if bwd_impl == "split":
             raise ValueError("dropout requires the monolithic backward")
+        if block_k is not None:
+            raise ValueError("block_k tiles the split backward; it "
+                             "cannot be honored with dropout")
         return _bwd_monolithic(causal, sm_scale, interpret, block_q, res,
-                               g, dropout_p)
-    q, k, v, _, _ = res
-    impl = bwd_impl or _effective_bwd_impl(q, k)
+                               g, dropout_p, bwd_block_q, tile_pref)
+    if not _BWD_PINNED and bwd_impl is None:
+        # the attention_bwd table entry's params feed the backward tile
+        # resolution (below per-call knobs and setter/env), merged over
+        # any call-level pref: bwd-specific keys win
+        table_choice, table_pref = _bwd_table_consult(q, k)
+        if table_pref:
+            merged = dict(tile_pref or ())
+            merged.update(dict(table_pref))
+            tile_pref = tuple(sorted(merged.items()))
+    else:
+        table_choice = None
+    impl = bwd_impl or (BWD_IMPL if _BWD_PINNED
+                        else table_choice or BWD_IMPL)
     sq, sk = q.shape[2], k.shape[2]
-    bq = _pick_bq(sq, sk, block_q)
-    ok = _split_ok(sq, sk, q.shape[3], bq, q.dtype.itemsize)
+    bq = _pick_bwd_bq(sq, sk, block_q, bwd_block_q, tile_pref=tile_pref)
+    if block_k is not None:
+        # an explicit k block is a demand on the split structure
+        problems = []
+        if not isinstance(block_k, int) or block_k % 128 or block_k < 128:
+            problems.append(f"block_k={block_k!r} must be a multiple "
+                            f"of 128")
+        elif sk % block_k:
+            problems.append(f"block_k={block_k} does not divide sk={sk}")
+        elif not _split_ok(sq, sk, q.shape[3], bq, q.dtype.itemsize,
+                           block_k):
+            problems.append(
+                f"block_k={block_k}: split bwd ineligible for "
+                f"{q.shape}x{k.shape} (bq={bq})")
+        if problems:
+            raise ValueError("attention_pallas: " + "; ".join(problems))
+        if bwd_impl is None and impl != "split":
+            impl = "split"  # an explicit block_k selects the structure
+    eff_bk = block_k if block_k is not None \
+        else _pref_get(tile_pref, "block_k")
+    if eff_bk is not None and block_k is None and not _split_ok(
+            sq, sk, q.shape[3], bq, q.dtype.itemsize, eff_bk):
+        eff_bk = None  # table pref falls back per shape
+    ok = _split_ok(sq, sk, q.shape[3], bq, q.dtype.itemsize, eff_bk)
     if bwd_impl == "split" and not ok:
         # an explicit request must be honored or error — silently running
         # monolithic would mislabel A/B benchmark rows
         raise ValueError(
             f"split bwd ineligible for {q.shape}x{k.shape} (bq={bq})")
     if impl == "split" and ok:
-        return _bwd_split(causal, sm_scale, interpret, block_q, res, g)
-    return _bwd_monolithic(causal, sm_scale, interpret, block_q, res, g)
+        return _bwd_split(causal, sm_scale, interpret, block_q, res, g,
+                          bwd_block_q, eff_bk, tile_pref)
+    return _bwd_monolithic(causal, sm_scale, interpret, block_q, res, g,
+                           0.0, bwd_block_q, tile_pref)
 
 
 fused_attention_rows.defvjp(_fwd_rule, _bwd_rule)

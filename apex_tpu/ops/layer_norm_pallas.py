@@ -19,6 +19,20 @@ with an actual kernel.
 
 Tested against the jnp reference in Pallas interpret mode on CPU
 (tests/test_layer_norm_pallas.py); block sizes sized to VMEM.
+
+Tile geometry is a dispatch axis (the measured-dispatch rule one level
+below impl choice): the row block ``br`` resolves per call as
+
+    per-call ``block_rows``  (raises on an illegal tile)
+  > ``set_block_rows`` / ``APEX_LN_BLOCK_ROWS``  (preference — an
+    illegal tile for this shape falls back per shape)
+  > table ``block_rows_pref``  (the dispatch-table ``params`` payload
+    the consumer passes down; same fallback semantics)
+  > the VMEM-model heuristic (``tiles.ln_row_block`` — UNCHANGED)
+
+with legality judged by the shared model in
+``apex_tpu.dispatch.tiles`` (the same model ``check_bench_labels``
+check 4 holds committed payloads to).
 """
 
 import functools
@@ -27,25 +41,62 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from apex_tpu.dispatch import tiles
 
-_VMEM_BUDGET = 12 * 1024 * 1024  # total fp32 block bytes (of ~16MB VMEM)
-# resident fp32 [br, hidden] arrays per kernel: fwd holds x, xc, y; bwd
-# holds x, dy, dx, xhat, wg plus headroom — the bwd count sizes smaller
-# blocks, and supported() gates on the bwd (binding) constraint
-_FWD_ARRAYS = 3
-_BWD_ARRAYS = 6
+# the VMEM budget and working-set counts live in the shared tile model
+# (apex_tpu/dispatch/tiles.py) so the sweeper, the checker and this
+# lowering can never disagree; these names remain for their users
+_VMEM_BUDGET = tiles.LN_VMEM_BUDGET
+_FWD_ARRAYS = tiles.LN_FWD_ARRAYS
+_BWD_ARRAYS = tiles.LN_BWD_ARRAYS
 
 
 def _row_block(rows, hidden, n_arrays):
-    """Largest power-of-two row block such that ``n_arrays`` fp32
-    [block, hidden] arrays fit the VMEM budget and the block divides
-    ``rows`` (0 → no valid blocking; caller falls back)."""
+    """The heuristic row block (shared model; 0 → no valid blocking)."""
     cap = max(1, _VMEM_BUDGET // (4 * hidden * n_arrays))
-    b = 1
-    while b * 2 <= cap and rows % (b * 2) == 0:
-        b *= 2
-    # at least 8 rows per block keeps the (8, 128) fp32 tile shape happy
+    b = tiles.chain_block(rows, cap)
     return b if b >= 8 else 0
+
+
+# Process-wide row-block *preference* (tri-state: None = unpinned).
+# Like every process-wide setter it falls back per shape; only the
+# per-call ``block_rows=`` raises on an un-honorable tile.
+_BLOCK_ROWS = None
+
+
+def set_block_rows(value):
+    """Pin the process-wide row-block preference (int), or un-pin with
+    None (table params / the heuristic apply again). Shapes the pinned
+    tile can't legally block fall back to the heuristic silently."""
+    global _BLOCK_ROWS
+    tiles.check_setter_value(value, "block_rows")
+    _BLOCK_ROWS = value
+
+
+def _env_block_rows():
+    """Trace-time APEX_LN_BLOCK_ROWS (shared parser: tiles.env_int —
+    an env knob is a preference, not a per-call raise)."""
+    return tiles.env_int("APEX_LN_BLOCK_ROWS")
+
+
+def _resolve_br(rows, hidden, block_rows, block_rows_pref):
+    """The resolved row block for one call, or None when no knob
+    resolves — the fwd and bwd heuristics then apply UNCHANGED (they
+    size to different working sets; a resolved tile is used by both
+    passes and its legality is gated on the bwd — binding — model)."""
+    dims = {"rows": rows, "hidden": hidden}
+    if block_rows is not None:
+        problems = tiles.legal("layer_norm", dims, None,
+                               {"block_rows": block_rows})
+        if problems:
+            raise ValueError("layer_norm_pallas: illegal block_rows: "
+                             + "; ".join(problems))
+        return block_rows
+    for pref in (_BLOCK_ROWS, _env_block_rows(), block_rows_pref):
+        if pref is not None and not tiles.legal(
+                "layer_norm", dims, None, {"block_rows": pref}):
+            return pref
+    return None
 
 
 def _fwd_kernel(x_ref, w_ref, b_ref, y_ref, mean_ref, rstd_ref, *, eps,
@@ -95,24 +146,36 @@ def supported(rows, hidden):
     return hidden % 128 == 0 and _row_block(rows, hidden, _BWD_ARRAYS) != 0
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def layer_norm(x2d, weight, bias, eps=1e-5, interpret=False):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def layer_norm(x2d, weight, bias, eps=1e-5, interpret=False,
+               block_rows=None, block_rows_pref=None):
     """Row layer-norm over the last dim of ``x2d`` [rows, hidden].
 
     ``weight``/``bias`` may be None (plain normalization). Statistics and
     affine math in fp32; output in ``x2d.dtype``. Use ``supported`` first;
     unsupported shapes raise. ``interpret=True`` runs the kernel in Pallas
     interpret mode (CPU tests).
+
+    ``block_rows``: per-call row-block demand — raises when the tile is
+    illegal for this shape (divisibility / VMEM model, see
+    ``apex_tpu.dispatch.tiles``). ``block_rows_pref``: preference form
+    (the dispatch-table params consumer passes it) — an illegal tile
+    falls back silently; ``set_block_rows``/``APEX_LN_BLOCK_ROWS``
+    resolve above it, the built-in heuristic below it.
     """
-    y, _ = _fwd(x2d, weight, bias, eps, interpret)
+    y, _ = _fwd(x2d, weight, bias, eps, interpret, block_rows,
+                block_rows_pref)
     return y
 
 
-def _fwd(x2d, weight, bias, eps, interpret):
+def _fwd(x2d, weight, bias, eps, interpret, block_rows=None,
+         block_rows_pref=None):
     rows, hidden = x2d.shape
     if not supported(rows, hidden):
         raise ValueError(f"layer_norm_pallas: unsupported shape {x2d.shape}")
-    br = _row_block(rows, hidden, _FWD_ARRAYS)
+    br = _resolve_br(rows, hidden, block_rows, block_rows_pref)
+    if br is None:
+        br = _row_block(rows, hidden, _FWD_ARRAYS)
     has_w = weight is not None
     has_b = bias is not None
     w_in = weight if has_w else jnp.zeros((hidden,), jnp.float32)
@@ -142,15 +205,19 @@ def _fwd(x2d, weight, bias, eps, interpret):
     return y, (x2d, w_in, mean, rstd, has_w, has_b)
 
 
-def _fwd_rule(x2d, weight, bias, eps, interpret):
-    y, res = _fwd(x2d, weight, bias, eps, interpret)
+def _fwd_rule(x2d, weight, bias, eps, interpret, block_rows=None,
+              block_rows_pref=None):
+    y, res = _fwd(x2d, weight, bias, eps, interpret, block_rows,
+                  block_rows_pref)
     return y, res
 
 
-def _bwd_rule(eps, interpret, res, dy):
+def _bwd_rule(eps, interpret, block_rows, block_rows_pref, res, dy):
     x2d, w_in, mean, rstd, has_w, has_b = res
     rows, hidden = x2d.shape
-    br = _row_block(rows, hidden, _BWD_ARRAYS)
+    br = _resolve_br(rows, hidden, block_rows, block_rows_pref)
+    if br is None:
+        br = _row_block(rows, hidden, _BWD_ARRAYS)
     grid = (rows // br,)
     dx, dw_part, db_part = pl.pallas_call(
         functools.partial(_bwd_kernel, has_w=has_w),

@@ -34,20 +34,57 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from apex_tpu.dispatch import tiles
 
-_VMEM_BUDGET = 12 * 1024 * 1024  # total fp32 block bytes (of ~16MB VMEM)
-_FWD_ARRAYS = 3  # x, exp/y, mask/scratch resident per fwd block
-_BWD_ARRAYS = 4  # y, g, dx + headroom per bwd block
+# budget/working-set constants live in the shared tile model
+# (apex_tpu/dispatch/tiles.py) — sweeper, checker and lowering agree
+_VMEM_BUDGET = tiles.SM_VMEM_BUDGET
+_FWD_ARRAYS = tiles.SM_FWD_ARRAYS
+_BWD_ARRAYS = tiles.SM_BWD_ARRAYS
 
 
 def _sq_block(sq, sk, n_arrays):
-    """Largest power-of-two sq block with ``n_arrays`` fp32 [block, sk]
-    arrays inside the VMEM budget, dividing ``sq`` (0 → unsupported)."""
+    """The heuristic sq block (shared model; 0 → unsupported)."""
     cap = max(1, _VMEM_BUDGET // (4 * sk * n_arrays))
-    b = 1
-    while b * 2 <= cap and sq % (b * 2) == 0:
-        b *= 2
+    b = tiles.chain_block(sq, cap)
     return b if b >= 8 else 0
+
+
+# Process-wide row-block preference (tri-state; falls back per shape —
+# only the per-call ``block_rows=`` raises on an illegal tile)
+_BLOCK_ROWS = None
+
+
+def set_block_rows(value):
+    """Pin the process-wide sq-block preference (int), or un-pin with
+    None. Shapes the pinned tile can't block fall back silently."""
+    global _BLOCK_ROWS
+    tiles.check_setter_value(value, "block_rows")
+    _BLOCK_ROWS = value
+
+
+def _env_block_rows():
+    return tiles.env_int("APEX_SOFTMAX_BLOCK_ROWS")
+
+
+def _resolve_bsq(sq, sk, block_rows, block_rows_pref):
+    """Resolved sq block, or None (heuristics apply unchanged):
+    per-call (raise) > setter/env (fall back) > table pref (fall back).
+    Legality via the shared model, gated on the bwd working set; a
+    resolved tile is used by BOTH passes."""
+    dims = {"b": 1, "h": 1, "sq": sq, "sk": sk}
+    if block_rows is not None:
+        problems = tiles.legal("softmax", dims, None,
+                               {"block_rows": block_rows})
+        if problems:
+            raise ValueError("softmax_pallas: illegal block_rows: "
+                             + "; ".join(problems))
+        return block_rows
+    for pref in (_BLOCK_ROWS, _env_block_rows(), block_rows_pref):
+        if pref is not None and not tiles.legal(
+                "softmax", dims, None, {"block_rows": pref}):
+            return pref
+    return None
 
 
 def supported(sq, sk):
@@ -97,8 +134,9 @@ def _bwd_kernel(y_ref, g_ref, dx_ref, *, scale):
     dx_ref[...] = (jnp.float32(scale) * y * (g - dot)).astype(dx_ref.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
-def scaled_masked_softmax(x, mask, scale=1.0, causal=False, interpret=False):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
+def scaled_masked_softmax(x, mask, scale=1.0, causal=False, interpret=False,
+                          block_rows=None, block_rows_pref=None):
     """``softmax(scale * x [+ causal/explicit mask])`` over the last dim.
 
     ``x``: [b, np, sq, sk]. ``mask``: None or a boolean/int array of shape
@@ -106,16 +144,26 @@ def scaled_masked_softmax(x, mask, scale=1.0, causal=False, interpret=False):
     triangle is generated in-register when ``causal``. Use ``supported``
     first; unsupported shapes raise. ``interpret=True`` runs in Pallas
     interpret mode (CPU tests).
+
+    ``block_rows``: per-call sq-block demand (raises on an illegal tile
+    — divisibility/VMEM model, ``apex_tpu.dispatch.tiles``).
+    ``block_rows_pref``: preference form (table params) — falls back
+    silently; ``set_block_rows``/``APEX_SOFTMAX_BLOCK_ROWS`` resolve
+    above it, the heuristic below it.
     """
-    y, _ = _fwd(x, mask, scale, causal, interpret)
+    y, _ = _fwd(x, mask, scale, causal, interpret, block_rows,
+                block_rows_pref)
     return y
 
 
-def _fwd(x, mask, scale, causal, interpret):
+def _fwd(x, mask, scale, causal, interpret, block_rows=None,
+         block_rows_pref=None):
     b, np_, sq, sk = x.shape
     if not supported(sq, sk):
         raise ValueError(f"softmax_pallas: unsupported shape {x.shape}")
-    bsq = _sq_block(sq, sk, _FWD_ARRAYS)
+    bsq = _resolve_bsq(sq, sk, block_rows, block_rows_pref)
+    if bsq is None:
+        bsq = _sq_block(sq, sk, _FWD_ARRAYS)
     has_mask = mask is not None
     grid = (b, np_, sq // bsq)
     blk = (1, 1, bsq, sk)
@@ -146,14 +194,19 @@ def _fwd(x, mask, scale, causal, interpret):
     return y, y
 
 
-def _fwd_rule(x, mask, scale, causal, interpret):
-    y, res = _fwd(x, mask, scale, causal, interpret)
+def _fwd_rule(x, mask, scale, causal, interpret, block_rows=None,
+              block_rows_pref=None):
+    y, res = _fwd(x, mask, scale, causal, interpret, block_rows,
+                  block_rows_pref)
     return y, res
 
 
-def _bwd_rule(scale, causal, interpret, y, g):
+def _bwd_rule(scale, causal, interpret, block_rows, block_rows_pref, y,
+              g):
     b, np_, sq, sk = y.shape
-    bsq = _sq_block(sq, sk, _BWD_ARRAYS)
+    bsq = _resolve_bsq(sq, sk, block_rows, block_rows_pref)
+    if bsq is None:
+        bsq = _sq_block(sq, sk, _BWD_ARRAYS)
     blk = (1, 1, bsq, sk)
     spec = pl.BlockSpec(blk, lambda ib, ih, js: (ib, ih, js, 0))
     dx = pl.pallas_call(

@@ -27,7 +27,6 @@ and contrib references in interpret mode (tests/test_xent_pallas.py).
 """
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
@@ -35,49 +34,102 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from apex_tpu.dispatch import tiles
+
 
 # Row block sizes the number of full passes over E (n/br passes of
 # V*h*2 bytes each in fwd and again in dx): bigger blocks cut that
 # traffic linearly, so the cap is VMEM-derived per (h, bv) rather than a
 # constant — at GPT-2 shapes (h=768, bv=384) it resolves to 512, ~5 MB
 # in the worst kernel (dx: x + dx out + fp32 acc + logits + p tiles).
-# APEX_XENT_ROW_BLOCK overrides the cap (escape hatch if Mosaic's
-# double-buffering pushes the modeled 6.5 MB over real VMEM on device).
+# The model (and the 8 MB budget / 512 caps) lives in the shared tile
+# module (apex_tpu/dispatch/tiles.py) so sweeps and the label checker
+# judge exactly what this file lowers. APEX_XENT_ROW_BLOCK overrides
+# the CAP (escape hatch if Mosaic's double-buffering pushes the modeled
+# 6.5 MB over real VMEM on device) — read at TRACE time, never import
+# time, so autotune subprocesses and tests vary it without re-import.
 # The vocab chunk is the largest lane-aligned divisor of V <= 512
 # (GPT-2's 50304 = 2^7*3*131 gives 384).
-_ROW_BLOCK = int(os.environ.get("APEX_XENT_ROW_BLOCK", "512"))
-_MAX_VCHUNK = 512
-_VMEM_BUDGET = 8 * 1024 * 1024
+_MAX_VCHUNK = tiles.XENT_MAX_VCHUNK
+_VMEM_BUDGET = tiles.XENT_VMEM_BUDGET
+
+
+def _env_row_cap():
+    """Trace-time APEX_XENT_ROW_BLOCK (the heuristic's cap; shared
+    parser tiles.env_int — a preference, not a raise)."""
+    return tiles.env_int("APEX_XENT_ROW_BLOCK")
 
 
 def _v_chunk(V):
     """Largest multiple-of-128 divisor of V that is <= _MAX_VCHUNK
     (0 → unsupported)."""
-    for bv in range(_MAX_VCHUNK, 0, -128):
-        if V % bv == 0:
-            return bv
-    return 0
+    return tiles.xent_v_chunk(V)
 
 
 def _row_block(n, h, bv):
-    """Largest power-of-two row block dividing ``n``, capped at
-    _ROW_BLOCK and by the backward kernels' VMEM model: the dE kernel
-    carries br-independent (bv, h) tiles (e bf16 + fp32 dE output block
-    = 6*bv*h bytes), and the worst per-block-row cost is
-    max(dx: x + dx out + fp32 acc + logits/p = 8h + 8bv,
-        dE: x + fp32 wx + logits/p/coeff = 6h + 10bv)."""
-    fixed = 6 * bv * h
-    if fixed >= _VMEM_BUDGET:
-        return 0
-    per_row = max(8 * h + 8 * bv, 6 * h + 10 * bv)
-    cap = min(_ROW_BLOCK, (_VMEM_BUDGET - fixed) // per_row)
-    b = 8
-    best = 0
-    while b <= cap:
-        if n % b == 0:
-            best = b
-        b *= 2
-    return best
+    """The heuristic row block (shared VMEM model, capped by the
+    trace-time APEX_XENT_ROW_BLOCK escape hatch; 0 → unsupported)."""
+    return tiles.xent_row_block(n, h, bv,
+                                cap=_env_row_cap() or tiles.XENT_ROW_CAP)
+
+
+# Process-wide exact-row-block preference (tri-state; falls back per
+# shape — only the per-call ``row_block=`` raises on an illegal tile)
+_ROW_BLOCK_PREF = None
+
+
+def set_row_block(value):
+    """Pin the process-wide row-block preference (exact block, int), or
+    un-pin with None. Illegal for a shape → heuristic, silently."""
+    global _ROW_BLOCK_PREF
+    tiles.check_setter_value(value, "row_block")
+    _ROW_BLOCK_PREF = value
+
+
+def _resolve_br(n, V, h, bv, row_block, vmem_budget, row_block_pref):
+    """The effective row block: per-call ``row_block`` (raises on an
+    illegal tile, judged under ``vmem_budget`` when given) >
+    ``set_row_block`` > table pref > the heuristic (env-capped, sized
+    under ``vmem_budget`` when given). Returns 0 when even the
+    heuristic finds no block (caller raises unsupported)."""
+    dims = {"n": n, "v": V, "h": h}
+    if vmem_budget is not None:
+        problems = tiles.legal("lm_head", dims, None,
+                               {"vmem_budget": vmem_budget})
+        if problems:
+            raise ValueError("xent_pallas: illegal vmem_budget: "
+                             + "; ".join(problems))
+    if row_block is not None:
+        params = {"row_block": row_block}
+        if vmem_budget is not None:
+            params["vmem_budget"] = vmem_budget
+        problems = tiles.legal("lm_head", dims, None, params)
+        if problems:
+            raise ValueError("xent_pallas: illegal row_block: "
+                             + "; ".join(problems))
+        return row_block
+    budget = vmem_budget or _VMEM_BUDGET
+    for pref in (_ROW_BLOCK_PREF, row_block_pref):
+        if pref is None:
+            continue
+        params = {"row_block": pref}
+        if vmem_budget is not None:
+            params["vmem_budget"] = vmem_budget
+        if not tiles.legal("lm_head", dims, None, params):
+            return pref
+    br = tiles.xent_row_block(
+        n, h, bv, cap=_env_row_cap() or tiles.XENT_ROW_CAP,
+        budget=budget)
+    if not br:
+        # only reachable through an explicit vmem_budget (a no-knob
+        # call already passed supported(), which sizes under the
+        # default budget): an in-range budget this shape cannot tile
+        # under must raise cleanly, not ZeroDivisionError mid-trace
+        raise ValueError(
+            f"xent_pallas: no legal row block for [{n},{h}]x[{V},{h}] "
+            f"under vmem_budget={budget} (fixed [bv={bv}, h] tiles "
+            f"alone need {6 * bv * h} B)")
+    return br
 
 
 def supported(n, V, h):
@@ -223,10 +275,11 @@ def _common_specs(br, bv, h):
     return xspec, espec, lspec
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def linear_cross_entropy_sharded(x, embedding_shard, labels, axis_name,
                                  interpret=False, smoothing=0.0,
-                                 reduce_dx=True):
+                                 reduce_dx=True, row_block=None,
+                                 vmem_budget=None, row_block_pref=None):
     """Vocab-parallel fused linear+CE: the tensor-parallel form of
     ``linear_cross_entropy`` (reference analog:
     tensor_parallel/cross_entropy.py over materialized logit shards —
@@ -254,21 +307,28 @@ def linear_cross_entropy_sharded(x, embedding_shard, labels, axis_name,
     itself (e.g. a sequence-parallel gather whose backward
     reduce-scatters): the vjp then returns this rank's PARTIAL dX,
     halving collective traffic on the model's hottest bwd tensor.
+
+    Tile knobs (``row_block``/``vmem_budget`` raise, ``row_block_pref``
+    falls back) match :func:`linear_cross_entropy`; legality is judged
+    on the SHARD dims, like ``supported``.
     """
     del reduce_dx  # backward-only knob
     return _fwd_sharded(x, embedding_shard, labels, axis_name,
-                        interpret, smoothing)[0]
+                        interpret, smoothing, row_block, vmem_budget,
+                        row_block_pref)[0]
 
 
 def _fwd_sharded(x, embedding_shard, labels, axis_name, interpret,
-                 smoothing=0.0):
+                 smoothing=0.0, row_block=None, vmem_budget=None,
+                 row_block_pref=None):
     n, h = x.shape
     Vs = embedding_shard.shape[0]
     if not supported(n, Vs, h):
         raise ValueError(
             f"xent_pallas sharded: unsupported [{n},{h}]x[{Vs},{h}]")
     bv = _v_chunk(Vs)
-    br = _row_block(n, h, bv)
+    br = _resolve_br(n, Vs, h, bv, row_block, vmem_budget,
+                     row_block_pref)
     nb, nv = n // br, Vs // bv
     # shift labels into SHARD-local ids: out-of-shard rows match no
     # column in any chunk, so their hit (and target partial) is zero
@@ -303,16 +363,21 @@ def _fwd_sharded(x, embedding_shard, labels, axis_name, interpret,
 
 
 def _fwd_sharded_rule(x, embedding_shard, labels, axis_name, interpret,
-                      smoothing, reduce_dx=True):
+                      smoothing, reduce_dx=True, row_block=None,
+                      vmem_budget=None, row_block_pref=None):
     return _fwd_sharded(x, embedding_shard, labels, axis_name, interpret,
-                        smoothing)
+                        smoothing, row_block, vmem_budget,
+                        row_block_pref)
 
 
-def _bwd_sharded_rule(axis_name, interpret, smoothing, reduce_dx, res, g):
+def _bwd_sharded_rule(axis_name, interpret, smoothing, reduce_dx,
+                      row_block, vmem_budget, row_block_pref, res, g):
     x, embedding_shard, labs, lse = res
     v_total = embedding_shard.shape[0] * lax.axis_size(axis_name)
     dx_local, de, _ = _bwd_kernels(x, embedding_shard, labs, lse, g,
-                                   interpret, smoothing, v_total)
+                                   interpret, smoothing, v_total,
+                                   row_block, vmem_budget,
+                                   row_block_pref)
     # dX sums every shard's p_shard @ E_shard contribution; dE is local.
     # With reduce_dx=False the caller's downstream mapping (e.g. an sp
     # gather's reduce-scatter bwd) performs the sum instead.
@@ -323,9 +388,10 @@ def _bwd_sharded_rule(axis_name, interpret, smoothing, reduce_dx, res, g):
 linear_cross_entropy_sharded.defvjp(_fwd_sharded_rule, _bwd_sharded_rule)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def linear_cross_entropy(x, embedding, labels, interpret=False,
-                         smoothing=0.0):
+                         smoothing=0.0, row_block=None, vmem_budget=None,
+                         row_block_pref=None):
     """Fused ``-log_softmax(x @ embedding^T)[i, labels[i]]`` -> [n] fp32.
 
     x: [n, h]; embedding: [V, h]; labels: [n] int32. The [n, V] logits
@@ -336,17 +402,26 @@ def linear_cross_entropy(x, embedding, labels, interpret=False,
     costs one extra row-vector accumulator riding the same vocab-chunk
     pass; at the default 0.0 the kernels are bit-identical to the
     pre-smoothing ones (the accumulator is not even allocated).
+
+    Tile knobs: ``row_block`` demands an exact row block and
+    ``vmem_budget`` the model budget it is judged under — both raise on
+    illegal values (``apex_tpu.dispatch.tiles``). ``row_block_pref`` is
+    the preference form (table params; falls back), with
+    ``set_row_block`` above it and the heuristic (whose cap stays the
+    trace-time ``APEX_XENT_ROW_BLOCK`` escape hatch) below.
     """
-    return _fwd(x, embedding, labels, interpret, smoothing)[0]
+    return _fwd(x, embedding, labels, interpret, smoothing, row_block,
+                vmem_budget, row_block_pref)[0]
 
 
-def _fwd(x, embedding, labels, interpret, smoothing=0.0):
+def _fwd(x, embedding, labels, interpret, smoothing=0.0, row_block=None,
+         vmem_budget=None, row_block_pref=None):
     n, h = x.shape
     V = embedding.shape[0]
     if not supported(n, V, h):
         raise ValueError(f"xent_pallas: unsupported [{n},{h}]x[{V},{h}]")
     bv = _v_chunk(V)
-    br = _row_block(n, h, bv)
+    br = _resolve_br(n, V, h, bv, row_block, vmem_budget, row_block_pref)
     nb, nv = n // br, V // bv
     labs = labels.astype(jnp.int32).reshape(n, 1)
     xspec, espec, lspec = _common_specs(br, bv, h)
@@ -365,12 +440,15 @@ def _fwd(x, embedding, labels, interpret, smoothing=0.0):
     return loss[:, 0], (x, embedding, labs, lse)
 
 
-def _fwd_rule(x, embedding, labels, interpret, smoothing):
-    return _fwd(x, embedding, labels, interpret, smoothing)
+def _fwd_rule(x, embedding, labels, interpret, smoothing, row_block=None,
+              vmem_budget=None, row_block_pref=None):
+    return _fwd(x, embedding, labels, interpret, smoothing, row_block,
+                vmem_budget, row_block_pref)
 
 
 def _bwd_kernels(x, embedding, labs, lse, g, interpret, smoothing=0.0,
-                 v_total=None):
+                 v_total=None, row_block=None, vmem_budget=None,
+                 row_block_pref=None):
     """The two backward pallas calls, shared by the single-slab and the
     vocab-sharded vjp rules (``embedding`` is the full table or one
     shard — the kernels only see its leading dim; ``v_total`` is the
@@ -381,7 +459,7 @@ def _bwd_kernels(x, embedding, labs, lse, g, interpret, smoothing=0.0,
     if v_total is None:
         v_total = V
     bv = _v_chunk(V)
-    br = _row_block(n, h, bv)
+    br = _resolve_br(n, V, h, bv, row_block, vmem_budget, row_block_pref)
     nb, nv = n // br, V // bv
     xspec, espec, lspec = _common_specs(br, bv, h)
     dl = g.astype(jnp.float32).reshape(n, 1)
@@ -413,9 +491,12 @@ def _bwd_kernels(x, embedding, labs, lse, g, interpret, smoothing=0.0,
     return dx, de.astype(embedding.dtype), None
 
 
-def _bwd_rule(interpret, smoothing, res, g):
+def _bwd_rule(interpret, smoothing, row_block, vmem_budget,
+              row_block_pref, res, g):
     x, embedding, labs, lse = res
-    return _bwd_kernels(x, embedding, labs, lse, g, interpret, smoothing)
+    return _bwd_kernels(x, embedding, labs, lse, g, interpret, smoothing,
+                        row_block=row_block, vmem_budget=vmem_budget,
+                        row_block_pref=row_block_pref)
 
 
 linear_cross_entropy.defvjp(_fwd_rule, _bwd_rule)

@@ -112,7 +112,8 @@ class FusedScaleMaskSoftmax:
 
     def __init__(self, input_in_fp16, input_in_bf16, attn_mask_type,
                  scaled_masked_softmax_fusion, mask_func, softmax_in_fp32,
-                 scale, use_pallas=None, _pallas_interpret=False):
+                 scale, use_pallas=None, _pallas_interpret=False,
+                 block_rows=None):
         self.input_in_fp16 = input_in_fp16
         self.input_in_bf16 = input_in_bf16
         assert not (input_in_fp16 and input_in_bf16), \
@@ -131,6 +132,10 @@ class FusedScaleMaskSoftmax:
         # default (jnp won every measured shape)
         self.use_pallas = use_pallas
         self._pallas_interpret = _pallas_interpret
+        # per-call tile demand handed to the kernel — raises on an
+        # illegal tile (apex_tpu.dispatch.tiles); None defers to the
+        # kernel's setter/env, then the table's params payload
+        self.block_rows = block_rows
         assert self.scale is None or softmax_in_fp32, \
             "softmax should be in fp32 when scaled"
 
@@ -161,22 +166,29 @@ class FusedScaleMaskSoftmax:
         return False
 
     def _resolve_pallas(self, input):
-        """``(use, interpret)`` for one call: instance ``use_pallas`` >
-        module ``USE_PALLAS`` (set_use_pallas) > dispatch-table
-        "softmax" entry for this shape bucket > False. A table entry is
-        backend-keyed: a CPU-measured "pallas" row was measured in
-        interpret mode and runs the same way."""
+        """``(use, interpret, block_rows_pref)`` for one call: instance
+        ``use_pallas`` > module ``USE_PALLAS`` (set_use_pallas) >
+        dispatch-table "softmax" entry for this shape bucket > False. A
+        table entry is backend-keyed: a CPU-measured "pallas" row was
+        measured in interpret mode and runs the same way.
+        ``block_rows_pref`` is the entry's tile payload — the kernel
+        validates it per shape (strictly below its per-call knob and
+        ``set_block_rows``) and falls back to its heuristic."""
         use = self.use_pallas
         if use is None:
             use = USE_PALLAS
         from_table = False
+        tile_pref = None
         if use is None:
             from apex_tpu import dispatch
 
             b, np_, sq, sk = input.shape
-            use = dispatch.lookup("softmax", dtype=input.dtype, b=b,
-                                  h=np_, sq=sq, sk=sk) == "pallas"
+            choice, params = dispatch.lookup_params(
+                "softmax", dtype=input.dtype, b=b, h=np_, sq=sq, sk=sk)
+            use = choice == "pallas"
             from_table = use
+            if params:
+                tile_pref = params.get("block_rows")
         interpret = self._pallas_interpret
         if use and not interpret:
             from apex_tpu.ops.attention import _tpu_available
@@ -187,7 +199,7 @@ class FusedScaleMaskSoftmax:
                 # CPU leg of a pinned pallas A/B (autotune --smoke):
                 # interpret mode instead of a silent jnp fallback
                 interpret = not _tpu_available()
-        return bool(use), interpret
+        return bool(use), interpret, tile_pref
 
     def forward_fused_softmax(self, input, mask):
         """Reference: fused_softmax.py:202-223."""
@@ -196,7 +208,8 @@ class FusedScaleMaskSoftmax:
         if causal:
             assert input.shape[-2] == input.shape[-1], \
                 "causal mask is only for self attention"
-        use_pallas, p_interpret = self._resolve_pallas(input)
+        use_pallas, p_interpret, block_rows_pref = \
+            self._resolve_pallas(input)
         if use_pallas:
             from apex_tpu.ops import softmax_pallas
             from apex_tpu.ops.attention import _tpu_available
@@ -211,7 +224,8 @@ class FusedScaleMaskSoftmax:
                          or softmax_pallas.mask_supported(m, input.shape))):
                 return softmax_pallas.scaled_masked_softmax(
                     input, m, scale, causal=causal,
-                    interpret=p_interpret)
+                    interpret=p_interpret, block_rows=self.block_rows,
+                    block_rows_pref=block_rows_pref)
         if causal:
             b, np_, sq, sk = input.shape
             out = scaled_upper_triang_masked_softmax(
@@ -263,11 +277,12 @@ class GenericFusedScaleMaskSoftmax(FusedScaleMaskSoftmax):
 
     def __init__(self, input_in_fp16, input_in_bf16, mask_func,
                  softmax_in_fp32, scale, use_pallas=None,
-                 _pallas_interpret=False):
+                 _pallas_interpret=False, block_rows=None):
         super().__init__(input_in_fp16, input_in_bf16, AttnMaskType.padding,
                          True, mask_func, softmax_in_fp32, scale,
                          use_pallas=use_pallas,
-                         _pallas_interpret=_pallas_interpret)
+                         _pallas_interpret=_pallas_interpret,
+                         block_rows=block_rows)
 
     def is_kernel_available(self, mask, b, np_, sq, sk):
         return self.scaled_masked_softmax_fusion and self.input_in_float16

@@ -765,20 +765,22 @@ class GPTModel(nn.Module):
     # composites build on.
 
     def _fused_head_applies(self, hidden):
-        """``(applies, interpret)``: whether the Pallas fused LM head
-        replaces logits+CE for this call, and whether it runs in
-        interpret mode. ``cfg.fused_lm_head`` True/False pins; None
-        consults the dispatch table (op "lm_head", keyed on the GLOBAL
-        (n, vocab, h) shape) — a backend-keyed table "fused" measured
-        on CPU runs in interpret mode, same as it was measured. A
-        pinned True still requires a real TPU (or the explicit
-        ``fused_lm_head_interpret`` test knob), and supported SHARD
-        shapes either way. tp > 1 runs the vocab-parallel kernel
+        """``(applies, interpret, row_block_pref)``: whether the Pallas
+        fused LM head replaces logits+CE for this call, and whether it
+        runs in interpret mode. ``cfg.fused_lm_head`` True/False pins;
+        None consults the dispatch table (op "lm_head", keyed on the
+        GLOBAL (n, vocab, h) shape) — a backend-keyed table "fused"
+        measured on CPU runs in interpret mode, same as it was
+        measured. A pinned True still requires a real TPU (or the
+        explicit ``fused_lm_head_interpret`` test knob), and supported
+        SHARD shapes either way. tp > 1 runs the vocab-parallel kernel
         (``linear_cross_entropy_sharded`` — per-shard online stats +
         pmax/psum combine); under sequence parallelism the standard
         pre-matmul seq gather runs first (with split-bwd, since the
         sharded head's dX is already cross-rank reduced). All static —
-        the choice is baked at trace time."""
+        the choice is baked at trace time. ``row_block_pref`` is the
+        entry's tile payload, handed to the kernel as a preference
+        (below its per-call ``row_block`` and ``set_row_block``)."""
         cfg = self.cfg
         tp = lax.axis_size(self.axis_name)
         s, b, h = hidden.shape
@@ -787,24 +789,28 @@ class GPTModel(nn.Module):
         fused = cfg.fused_lm_head
         interpret = cfg.fused_lm_head_interpret
         from_table = False
+        row_block_pref = None
         if fused is None:
             from apex_tpu import dispatch
 
-            fused = dispatch.lookup(
+            choice, params = dispatch.lookup_params(
                 "lm_head", dtype=hidden.dtype, n=b * s,
-                v=cfg.vocab_size, h=h) == "fused"
+                v=cfg.vocab_size, h=h)
+            fused = choice == "fused"
             from_table = fused
+            if params:
+                row_block_pref = params.get("row_block")
         if not fused:
-            return False, interpret
+            return False, interpret, None
         from apex_tpu.ops import xent_pallas
         from apex_tpu.ops.attention import _tpu_available
 
         if from_table and not interpret:
             interpret = not _tpu_available()
         if not (interpret or _tpu_available()):
-            return False, interpret
+            return False, interpret, None
         return (xent_pallas.supported(b * s, cfg.vocab_size // tp, h),
-                interpret)
+                interpret, row_block_pref)
 
     @nn.compact
     def __call__(self, input_ids, position_ids, attention_mask, labels=None,
@@ -837,7 +843,8 @@ class GPTModel(nn.Module):
         if not self.post_process:
             return hidden
 
-        fused_head, head_interpret = self._fused_head_applies(hidden)
+        fused_head, head_interpret, head_row_block = \
+            self._fused_head_applies(hidden)
         if labels is not None and fused_head:
             from apex_tpu.ops import xent_pallas
 
@@ -861,13 +868,15 @@ class GPTModel(nn.Module):
                 loss = xent_pallas.linear_cross_entropy(
                     x2d, word_embeddings.astype(x2d.dtype),
                     labels.reshape(-1),
-                    head_interpret)
+                    head_interpret, 0.0,
+                    row_block_pref=head_row_block)
             else:
                 loss = xent_pallas.linear_cross_entropy_sharded(
                     x2d, word_embeddings.astype(x2d.dtype),
                     labels.reshape(-1), self.axis_name,
                     head_interpret, 0.0,
-                    not sp_gathered)
+                    not sp_gathered,
+                    row_block_pref=head_row_block)
             return loss.reshape(b, s)
 
         logits = parallel_lm_logits(

@@ -47,6 +47,13 @@ run gpt              1200 python benchmarks/profile_gpt.py
 # the missing-rung program set on the first healthy probe), so a
 # re-entered pass only pays for what's still missing.
 run autotune         4500 python benchmarks/autotune_steps.py
+# tile autotuner FOURTH: per-shape Pallas tile sweeps (block_q / row
+# blocks / xent row block) — kernel-level candidates measure in seconds
+# each, so this rung converts leftover window minutes into committed
+# params payloads even when the step-level rungs hit the wedge.
+# Resumable (skips groups whose params payload is cashed) and
+# warm-cache-first like the step pass.
+run autotune_tiles   2400 python benchmarks/autotune_tiles.py
 # Then the small-HBM harnesses: the relay's observed degraded mode
 # (PERF.md §6) selectively starves large-HBM programs while small ones
 # run at device speed, so a partially-healthy window is still best spent

@@ -159,6 +159,31 @@ def main():
             seen.add(key)
             warm_target(f"autotune {g['name']}.{vname}", cmd, env, timeout)
 
+    # tile-sweep candidate set (benchmarks/autotune_tiles.py) — BOUNDED
+    # the same way: only groups whose params payload is missing, every
+    # legal candidate AOT-compiled under the exact child env
+    # (APEX_DISPATCH=off + the per-call tile), so a window's tile sweep
+    # dispatches cached executables
+    try:
+        from apex_tpu.dispatch import tiles as tile_model
+        from benchmarks.autotune_tiles import missing_rungs as tile_rungs
+
+        missing_tiles = tile_rungs()
+    except Exception as e:
+        missing_tiles = []
+        print(f"warm_cache: tile rung scan failed ({e})", flush=True)
+    tiles_py = os.path.join(REPO, "benchmarks", "autotune_tiles.py")
+    for g in missing_tiles:
+        cands = tile_model.candidates(g["op"], g["dims"], g["dtype"], 6)
+        for params in cands:
+            spec = json.dumps(dict(op=g["op"], dims=g["dims"],
+                                   dtype=g["dtype"], params=params,
+                                   smoke=False))
+            ptag = "-".join(f"{k}{v}" for k, v in sorted(params.items()))
+            warm_target(f"tiles {g['op']}.{ptag}",
+                        [sys.executable, tiles_py, "--child", spec],
+                        {"APEX_DISPATCH": "off"}, timeout)
+
     from apex_tpu import compile_cache
 
     print(f"warm_cache: cache dir {compile_cache.cache_dir()}", flush=True)

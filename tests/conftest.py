@@ -36,3 +36,18 @@ _compat.install()
 assert len(jax.devices()) == 8, (
     f"expected 8 virtual CPU devices, got {jax.devices()}"
 )
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def shared_smoke_cache_dir(tmp_path_factory):
+    """ONE persistent compile cache for every subprocess smoke-bench
+    deep path in the suite (test_compile_cache's scored-line test seeds
+    it; test_resilience's chaos deep-path tests reuse it) — the smoke
+    bench program is identical across them, so each re-compile after
+    the first was pure fast-tier wall time (CLAUDE.md ~5 min budget).
+    Tests that assert cold-vs-warm cache SEMANTICS keep their own
+    fresh dirs."""
+    return str(tmp_path_factory.mktemp("shared_smoke_compile_cache"))

@@ -1,0 +1,427 @@
+"""Tile-parameterized kernels (ISSUE 5): interpret-mode parity across
+swept tile geometries, per-call/setter/env precedence, and the raising
+vs falling-back asymmetry — for all four Pallas op families.
+
+The kernel-test rule (CLAUDE.md): every swept geometry must match the
+jnp/dense reference in interpret mode, including the minimum legal
+tile, non-divisible edge shapes (which must RAISE per-call and FALL
+BACK as preferences), and every backward structure and dtype.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.dispatch import tiles
+from apex_tpu.ops import attention_pallas as ap
+from apex_tpu.ops import layer_norm_pallas as lnp
+from apex_tpu.ops import softmax_pallas as smp
+from apex_tpu.ops import xent_pallas as xp
+from apex_tpu.ops.attention import _dense_attention
+
+
+@pytest.fixture(autouse=True)
+def _clean_tile_state(monkeypatch):
+    """Unpin every tile setter/env knob around each test."""
+    for k in ("APEX_LN_BLOCK_ROWS", "APEX_SOFTMAX_BLOCK_ROWS",
+              "APEX_ATTN_BLOCK_Q", "APEX_XENT_ROW_BLOCK",
+              "APEX_DISPATCH", "APEX_DISPATCH_TABLE"):
+        monkeypatch.delenv(k, raising=False)
+
+    def reset():
+        lnp.set_block_rows(None)
+        smp.set_block_rows(None)
+        ap.set_block_q(None)
+        xp.set_row_block(None)
+
+    reset()
+    yield
+    reset()
+
+
+def _jx(fn, *args):
+    """Comparable jaxpr string: pallas_call params embed kernel
+    function reprs whose 0x addresses differ per trace — strip them so
+    equality means equal lowered structure."""
+    import re
+
+    return re.sub(r"0x[0-9a-f]+", "0x",
+                  str(jax.make_jaxpr(lambda *a: fn(*a))(*args)))
+
+
+# ------------------------------------------------------------ layer norm
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("br", [8, 16, 64])  # 8 = the minimum legal tile
+def test_layer_norm_tile_parity(dtype, br):
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(64, 256), dtype)
+    w = jnp.asarray(rs.randn(256), jnp.float32)
+    b = jnp.asarray(rs.randn(256), jnp.float32)
+
+    def ref(x, w, b):
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=1, keepdims=True)
+        var = jnp.mean((xf - mean) ** 2, axis=1, keepdims=True)
+        y = (xf - mean) * jax.lax.rsqrt(var + 1e-5) * w + b
+        return y.astype(x.dtype)
+
+    got = lnp.layer_norm(x, w, b, 1e-5, True, br)
+    tol = 2e-2 if dtype == jnp.bfloat16 else 1e-5
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(ref(x, w, b), np.float32),
+                               atol=tol)
+    # backward structure at this tile (dx + affine-grad partials)
+    g = jax.grad(lambda x, w, b: jnp.sum(
+        lnp.layer_norm(x, w, b, 1e-5, True, br).astype(jnp.float32) ** 2),
+        argnums=(0, 1, 2))(x, w, b)
+    r = jax.grad(lambda x, w, b: jnp.sum(
+        ref(x, w, b).astype(jnp.float32) ** 2), argnums=(0, 1, 2))(x, w, b)
+    for gi, ri in zip(g, r):
+        np.testing.assert_allclose(np.asarray(gi, np.float32),
+                                   np.asarray(ri, np.float32),
+                                   atol=3e-1 if dtype == jnp.bfloat16
+                                   else 1e-3, rtol=2e-2)
+
+
+def test_layer_norm_per_call_raises_pref_falls_back():
+    x = jnp.ones((64, 256), jnp.float32)
+    # non-divisible edge: 48 does not divide 64
+    with pytest.raises(ValueError, match="does not divide"):
+        lnp.layer_norm(x, None, None, 1e-5, True, 48)
+    # sub-minimum tile
+    with pytest.raises(ValueError, match="multiple of 8"):
+        lnp.layer_norm(x, None, None, 1e-5, True, 4)
+    # the same tiles as PREFERENCES fall back to the heuristic silently
+    want = np.asarray(lnp.layer_norm(x, None, None, 1e-5, True))
+    for pref in (48, 4, 10 ** 9):
+        got = lnp.layer_norm(x, None, None, 1e-5, True, None, pref)
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-6)
+
+
+def test_layer_norm_precedence_per_call_over_setter_over_env(monkeypatch):
+    x = jnp.ones((64, 256), jnp.float32)
+
+    def grid_of(fn):
+        jx = _jx(fn, x)
+        assert "pallas_call" in jx
+        return jx
+
+    j8 = grid_of(lambda x: lnp.layer_norm(x, None, None, 1e-5, True, 8))
+    j16 = grid_of(lambda x: lnp.layer_norm(x, None, None, 1e-5, True, 16))
+    assert j8 != j16  # the tile genuinely changes the lowered program
+    # env resolves when nothing else is set — read at TRACE time
+    monkeypatch.setenv("APEX_LN_BLOCK_ROWS", "16")
+    assert grid_of(lambda x: lnp.layer_norm(
+        x, None, None, 1e-5, True)) == j16
+    # setter beats env
+    lnp.set_block_rows(8)
+    assert grid_of(lambda x: lnp.layer_norm(
+        x, None, None, 1e-5, True)) == j8
+    # per-call beats setter
+    lnp.set_block_rows(16)
+    assert grid_of(lambda x: lnp.layer_norm(
+        x, None, None, 1e-5, True, 8)) == j8
+    with pytest.raises(ValueError):
+        lnp.set_block_rows("big")
+
+
+# --------------------------------------------------------------- softmax
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("bsq", [8, 32, 128])
+def test_softmax_tile_parity(causal, bsq):
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.randn(2, 2, 128, 128), jnp.float32)
+
+    def ref(x):
+        xf = x * 0.5
+        if causal:
+            m = jnp.arange(128)[None, :] > jnp.arange(128)[:, None]
+            xf = jnp.where(m, jnp.finfo(jnp.float32).min, xf)
+        e = jnp.exp(xf - jnp.max(xf, axis=-1, keepdims=True))
+        if causal:
+            e = jnp.where(m, 0.0, e)
+        return e / jnp.sum(e, axis=-1, keepdims=True)
+
+    got = smp.scaled_masked_softmax(x, None, 0.5, causal, True, bsq)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref(x)),
+                               atol=1e-6)
+    gg = jax.grad(lambda x: jnp.sum(smp.scaled_masked_softmax(
+        x, None, 0.5, causal, True, bsq) ** 2))(x)
+    rg = jax.grad(lambda x: jnp.sum(ref(x) ** 2))(x)
+    np.testing.assert_allclose(np.asarray(gg), np.asarray(rg), atol=1e-5)
+
+
+def test_softmax_per_call_raises_pref_falls_back(monkeypatch):
+    x = jnp.ones((1, 1, 128, 128), jnp.bfloat16)
+    with pytest.raises(ValueError, match="does not divide"):
+        smp.scaled_masked_softmax(x, None, 1.0, False, True, 48)
+    want = np.asarray(smp.scaled_masked_softmax(x, None, 1.0, False, True),
+                      np.float32)
+    got = smp.scaled_masked_softmax(x, None, 1.0, False, True, None, 48)
+    np.testing.assert_allclose(np.asarray(got, np.float32), want)
+    # setter preference engages per shape; jaxpr proves the tile took
+    j32 = _jx(lambda x: smp.scaled_masked_softmax(
+        x, None, 1.0, False, True, 32), x)
+    smp.set_block_rows(32)
+    assert _jx(lambda x: smp.scaled_masked_softmax(
+        x, None, 1.0, False, True), x) == j32
+    smp.set_block_rows(None)
+    monkeypatch.setenv("APEX_SOFTMAX_BLOCK_ROWS", "32")
+    assert _jx(lambda x: smp.scaled_masked_softmax(
+        x, None, 1.0, False, True), x) == j32
+
+
+# ------------------------------------------------------------- attention
+
+@pytest.mark.parametrize("bwd_impl", ["monolithic", "split"])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_attention_tile_parity_both_backwards(dtype, bwd_impl):
+    b, h, s, d = 1, 2, 256, 32
+    rs = np.random.RandomState(2)
+    q = jnp.asarray(rs.randn(b, h, s, d), dtype)
+    k = jnp.asarray(rs.randn(b, h, s, d), dtype)
+    v = jnp.asarray(rs.randn(b, h, s, d), dtype)
+    scale = 1.0 / np.sqrt(d)
+    kw = dict(block_q=128) if bwd_impl == "monolithic" \
+        else dict(block_q=128, block_k=128)
+
+    def f(q, k, v):
+        y = ap.fused_attention_rows(q, k, v, True, scale, None, True,
+                                    kw.get("block_q"), bwd_impl, 0.0,
+                                    None, None, kw.get("block_k"))
+        return jnp.sum(y.astype(jnp.float32) ** 2)
+
+    def r(q, k, v):
+        y = _dense_attention(q, k, v, True, scale, None)
+        return jnp.sum(y.astype(jnp.float32) ** 2)
+
+    tol = 2e-2 if dtype == jnp.bfloat16 else 2e-4
+    g = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    ref = jax.grad(r, argnums=(0, 1, 2))(q, k, v)
+    for gi, ri in zip(g, ref):
+        np.testing.assert_allclose(np.asarray(gi, np.float32),
+                                   np.asarray(ri, np.float32), atol=tol,
+                                   rtol=1e-2)
+
+
+def test_attention_bwd_block_q_decoupled_from_fwd():
+    """bwd_block_q re-tiles ONLY the backward; fwd keeps the heuristic
+    block — and the grads stay reference-exact (the dk/dv accumulation
+    across a different number of q blocks)."""
+    b, h, s, d = 1, 1, 256, 32
+    rs = np.random.RandomState(3)
+    q = jnp.asarray(rs.randn(b, h, s, d), jnp.float32)
+
+    def loss(q, **kw):
+        return jnp.sum(ap.fused_attention_rows(
+            q, q, q, False, 0.2, None, True, **kw) ** 2)
+
+    g0 = jax.grad(loss)(q)
+    g1 = jax.grad(lambda x: loss(x, bwd_block_q=32))(q)
+    np.testing.assert_allclose(np.asarray(g0), np.asarray(g1), atol=2e-4)
+    # fwd jaxpr identical (bwd_block_q is backward-only)...
+    assert _jx(lambda x: ap.fused_attention_rows(
+        x, x, x, False, 0.2, None, True), q) \
+        == _jx(lambda x: ap.fused_attention_rows(
+            x, x, x, False, 0.2, None, True, None, None, 0.0, None, 32),
+            q)
+    # ...while the backward jaxpr differs
+    assert _jx(lambda x: jax.grad(loss)(x), q) \
+        != _jx(lambda x: jax.grad(
+            lambda y: loss(y, bwd_block_q=32))(x), q)
+
+
+def test_attention_block_k_demands_split_and_validates():
+    q = jnp.ones((1, 1, 256, 32), jnp.float32)
+
+    def loss(q, **kw):
+        return jnp.sum(ap.fused_attention_rows(
+            q, q, q, False, 0.2, None, True, **kw) ** 2)
+
+    # block_k without bwd_impl selects the split structure implicitly
+    g = jax.grad(lambda x: loss(x, block_k=128))(q)
+    r = jax.grad(lambda x: jnp.sum(
+        _dense_attention(x, x, x, False, 0.2, None) ** 2))(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=2e-4)
+    # illegal block_k raises (not lane-aligned / non-dividing)
+    with pytest.raises(ValueError, match="multiple of 128"):
+        jax.grad(lambda x: loss(x, block_k=64))(q)
+    with pytest.raises(ValueError, match="monolithic"):
+        loss(q, block_k=128, bwd_impl="monolithic")
+
+
+def test_attention_setter_env_and_pref(monkeypatch):
+    q = jnp.ones((1, 1, 256, 32), jnp.float32)
+
+    def fwd(x):
+        return ap.fused_attention_rows(x, x, x, False, 0.2, None, True)
+
+    j64 = _jx(lambda x: ap.fused_attention_rows(
+        x, x, x, False, 0.2, None, True, 64), q)
+    monkeypatch.setenv("APEX_ATTN_BLOCK_Q", "64")
+    assert _jx(fwd, q) == j64
+    monkeypatch.delenv("APEX_ATTN_BLOCK_Q")
+    ap.set_block_q(64)
+    assert _jx(fwd, q) == j64
+    ap.set_block_q(None)
+    # tile_pref (the table-consumer channel) resolves below setter/env
+    assert _jx(lambda x: ap.fused_attention_rows(
+        x, x, x, False, 0.2, None, True,
+        tile_pref=(("block_q", 64),)), q) == j64
+    # ...and an illegal pref falls back to the heuristic
+    assert _jx(lambda x: ap.fused_attention_rows(
+        x, x, x, False, 0.2, None, True,
+        tile_pref=(("block_q", 100),)), q) == _jx(fwd, q)
+
+
+# ------------------------------------------------------------- lm head
+
+@pytest.mark.parametrize("smoothing", [0.0, 0.1])
+@pytest.mark.parametrize("br", [8, 64])  # 8 = minimum legal tile
+def test_xent_tile_parity(smoothing, br):
+    rs = np.random.RandomState(4)
+    n, V, hd = 64, 512, 128
+    x = jnp.asarray(rs.randn(n, hd), jnp.float32)
+    e = jnp.asarray(rs.randn(V, hd), jnp.float32)
+    lab = jnp.asarray(rs.randint(0, V, (n,)), jnp.int32)
+
+    def ref(x, e):
+        logits = (x @ e.T).astype(jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=1)
+        nll = lse - logits[jnp.arange(n), lab]
+        if smoothing:
+            nll = ((1 - smoothing) * (lse - logits[jnp.arange(n), lab])
+                   + smoothing * (lse - jnp.mean(logits, axis=1)))
+        return nll
+
+    got = xp.linear_cross_entropy(x, e, lab, True, smoothing, br)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref(x, e)),
+                               rtol=1e-5, atol=1e-5)
+    g = jax.grad(lambda x, e: jnp.sum(xp.linear_cross_entropy(
+        x, e, lab, True, smoothing, br)), argnums=(0, 1))(x, e)
+    r = jax.grad(lambda x, e: jnp.sum(ref(x, e)), argnums=(0, 1))(x, e)
+    for gi, ri in zip(g, r):
+        np.testing.assert_allclose(np.asarray(gi), np.asarray(ri),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_xent_knobs_and_trace_time_env(monkeypatch):
+    rs = np.random.RandomState(5)
+    # n=512 so the heuristic row block (512) sits ABOVE the 1 MB-budget
+    # model cap — the vmem_budget knob then visibly re-tiles the trace
+    x = jnp.asarray(rs.randn(512, 128), jnp.float32)
+    e = jnp.asarray(rs.randn(512, 128), jnp.float32)
+    lab = jnp.asarray(rs.randint(0, 512, (512,)), jnp.int32)
+
+    def f(x, **kw):
+        return xp.linear_cross_entropy(x, e, lab, True, 0.0, **kw)
+
+    # per-call demands raise on illegal values
+    with pytest.raises(ValueError, match="does not divide"):
+        f(x, row_block=48)
+    with pytest.raises(ValueError, match="vmem_budget"):
+        f(x, vmem_budget=17 * 1024 * 1024)
+    # vmem_budget re-sizes the heuristic cap — traced program changes
+    j_default = _jx(f, x)
+    j_small = _jx(lambda x: f(x, vmem_budget=1024 * 1024), x)
+    assert j_default != j_small
+    # APEX_XENT_ROW_BLOCK is read at TRACE time (no re-import): the
+    # import-time module constant is gone
+    monkeypatch.setenv("APEX_XENT_ROW_BLOCK", "16")
+    j_env = _jx(f, x)
+    assert j_env != j_default
+    monkeypatch.delenv("APEX_XENT_ROW_BLOCK")
+    assert _jx(f, x) == j_default
+    # setter (exact block) beats the env cap; per-call beats both
+    monkeypatch.setenv("APEX_XENT_ROW_BLOCK", "16")
+    xp.set_row_block(64)
+    j_set = _jx(f, x)
+    assert j_set != j_env
+    assert _jx(lambda x: f(x, row_block=16), x) == j_env
+    xp.set_row_block(None)
+    # pref falls back when illegal
+    want = np.asarray(f(x))
+    np.testing.assert_allclose(
+        np.asarray(f(x, row_block_pref=48)), want, rtol=1e-6)
+
+
+def test_xent_infeasible_vmem_budget_raises_cleanly():
+    """An in-range vmem_budget the shape cannot tile under must raise a
+    ValueError naming the budget — not ZeroDivisionError mid-trace
+    (h=512, bv=512: the fixed [bv, h] tiles alone exceed 1 MB)."""
+    rs = np.random.RandomState(7)
+    x = jnp.asarray(rs.randn(64, 512), jnp.float32)
+    e = jnp.asarray(rs.randn(1024, 512), jnp.float32)
+    lab = jnp.asarray(rs.randint(0, 1024, (64,)), jnp.int32)
+    with pytest.raises(ValueError, match="no legal row block"):
+        xp.linear_cross_entropy(x, e, lab, True, 0.0, None,
+                                1024 * 1024)
+
+
+def test_xent_sharded_accepts_tile_knobs():
+    """The vocab-parallel form takes the same knobs (judged on SHARD
+    dims) — single-rank shard_map sanity."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    rs = np.random.RandomState(6)
+    x = jnp.asarray(rs.randn(64, 128), jnp.float32)
+    e = jnp.asarray(rs.randn(512, 128), jnp.float32)
+    lab = jnp.asarray(rs.randint(0, 512, (64,)), jnp.int32)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+
+    from jax import shard_map
+
+    def run(x, e, lab, **kw):
+        return shard_map(
+            lambda x, e, lab: xp.linear_cross_entropy_sharded(
+                x, e, lab, "tp", True, 0.0, True, **kw),
+            mesh=mesh, in_specs=(P(), P("tp"), P()), out_specs=P(),
+            check_vma=False)(x, e, lab)
+
+    base = np.asarray(run(x, e, lab))
+    got = np.asarray(run(x, e, lab, row_block=16))
+    np.testing.assert_allclose(got, base, rtol=1e-5, atol=1e-5)
+
+
+# ----------------------------------------------- shared model coherence
+
+def test_kernel_heuristics_match_shared_model():
+    """The kernels' heuristic tiles ARE the shared model's
+    default_params — the acceptance bar that extracting the model
+    changed no default."""
+    assert lnp._row_block(8192, 768, lnp._BWD_ARRAYS) \
+        == tiles.default_params("layer_norm",
+                                {"rows": 8192, "hidden": 768},
+                                "bfloat16")["block_rows"]
+    assert smp._sq_block(1024, 1024, smp._BWD_ARRAYS) \
+        == tiles.default_params("softmax",
+                                {"b": 8, "h": 12, "sq": 1024, "sk": 1024},
+                                "bfloat16")["block_rows"]
+    assert ap._q_block(1024, 1024) \
+        == tiles.default_params(
+            "attention",
+            {"b": 8, "h": 12, "sq": 1024, "sk": 1024, "d": 64},
+            "bfloat16")["block_q"]
+    bv = xp._v_chunk(50304)
+    assert xp._row_block(8192, 768, bv) \
+        == tiles.default_params("lm_head",
+                                {"n": 8192, "v": 50304, "h": 768},
+                                "bfloat16")["row_block"]
+
+
+def test_candidates_are_all_legal_and_incumbent_first():
+    for op, dims in (
+            ("layer_norm", {"rows": 8192, "hidden": 768}),
+            ("softmax", {"b": 8, "h": 12, "sq": 1024, "sk": 1024}),
+            ("attention", {"b": 8, "h": 12, "sq": 1024, "sk": 1024,
+                           "d": 64}),
+            ("lm_head", {"n": 8192, "v": 50304, "h": 768})):
+        cands = tiles.candidates(op, dims, "bfloat16")
+        assert cands, op
+        assert cands[0] == tiles.default_params(op, dims, "bfloat16")
+        for c in cands:
+            assert tiles.legal(op, dims, "bfloat16", c) == [], (op, c)

@@ -33,6 +33,16 @@ Checks:
    without the pin is the same label-drift class as a wrong caption —
    runtime lookups skip a corrupt line and fall back, but here it is a
    finding).
+4. **Tile params payloads** — every entry carrying a ``params``
+   payload (the per-shape tile geometry from
+   ``benchmarks/autotune_tiles.py``) must be LEGAL under the shared
+   tile model (``apex_tpu.dispatch.tiles``: VMEM working set +
+   (8, 128)-divisibility at the entry's bucket dims — a committed tile
+   must lower), cite a resolving, un-injected ``params.ledger``
+   record, and carry ``params.pins`` matching that record's knobs.
+   Runtime consults skip a malformed payload and fall back to the
+   kernel heuristic; here it is a finding, so corruption cannot
+   persist in the committed table.
 
 New PERF.md table rows must cite their ledger record id in the caption
 (``ledger:<id>``) — uncited legacy paragraphs are not flagged, but they
@@ -167,6 +177,10 @@ def check_dispatch_table(path, records):
                f"/{entry.get('dtype')}/{entry.get('backend')}")
         for p in dispatch_mod.validate_entry(entry, by_id):
             problems.append(f"{tag}: {p}")
+        # check 4: tile params payloads — legality under the shared
+        # tile model + citation + pin agreement
+        for p in dispatch_mod.validate_params(entry, by_id):
+            problems.append(f"{tag}: {p}")
         # a dispatch default must never be decided by an injected run:
         # neither the entry itself nor any record it cites may carry
         # the APEX_FAULT_PLAN stamp
@@ -174,8 +188,13 @@ def check_dispatch_table(path, records):
             problems.append(f"{tag}: entry carries a fault_plan stamp "
                             f"({entry['fault_plan']}) — produced under "
                             f"injection")
+        params_payload = entry.get("params") \
+            if isinstance(entry.get("params"), dict) else {}
         cited = [entry.get("ledger")] + [
             m.get("ledger") for m in (entry.get("measured") or {}).values()
+            if isinstance(m, dict)] + [
+            m.get("ledger")
+            for m in (params_payload.get("measured") or {}).values()
             if isinstance(m, dict)]
         for rid in cited:
             rec = by_id.get(rid)
