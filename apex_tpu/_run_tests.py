@@ -43,7 +43,8 @@ SUITES = {
     "telemetry": ["test_telemetry.py", "test_bench_labels.py",
                   "test_dispatch.py", "test_dispatch_tiles.py"],
     "api_audit": ["test_noop_knob_audit.py"],
-    "checkpoint": ["test_checkpoint.py"],
+    "checkpoint": ["test_checkpoint.py", "test_checkpoint_durable.py",
+                   "test_checkpoint_chaos.py", "test_resume_parity.py"],
     "data": ["test_data.py"],
     "examples": ["test_examples.py"],
 }

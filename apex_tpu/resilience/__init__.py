@@ -78,6 +78,30 @@ AUTOTUNE_BUDGET_SMOKE_S = 600
 WARM_TIMEOUT_S = 1500      # warm_cache per-target subprocess cap
 PROBE_TIMEOUT_S = 300      # marginal-rate matmul probe cap
 
+# Exit statuses that mean "the budget killed it" (the wedge signature):
+# timeout(1)'s 124/137, shell-reported SIGTERM (143 = 128+15), and the
+# raw negative signal codes Popen returns. The ONE set shared by the
+# probe CLI and the collection manifest — a SIGTERM'd row must classify
+# the same everywhere.
+TIMEOUT_RCS = (124, 137, 143, -9, -15)
+
+
+def atomic_write(path, text):
+    """Durable tmp+fsync+rename text write — the ONE commit dance for
+    every small state file a SIGTERM/timeout must not tear (probe
+    state, collection manifest, autotune table). os.replace is atomic
+    on POSIX; the fsync makes the rename land on bytes, not cache."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def atomic_write_json(path, obj, **dump_kw):
+    atomic_write(path, json.dumps(obj, **dump_kw))
+
 
 def last_json(text):
     """(line, record) of the last PARSEABLE JSON line in *text*, skipping

@@ -22,11 +22,14 @@ fault::
 
     {"site":  "backend_init" | "mid_attempt" | "large_program" |
               "compile" | "calibration_overhead" | "emit" | "verdict" |
-              "autotune_budget",
+              "autotune_budget" | "ckpt_commit" | "ckpt_manifest" |
+              "ckpt_data" | "final_save",
      "kind":  "hang" | "raise" | "exit" | "fabricate" |
-              "sigterm_parent" | "inflate" | "truncate" | "degraded" |
-              "set_budget",
+              "sigterm_parent" | "sigkill" | "inflate" | "truncate" |
+              "degraded" | "set_budget" | "set_field" |
+              "truncate_file" | "corrupt_file",
      "match_env": {"VAR": "value" | null},   # null = must be unset
+     "match_ctx": {"step": 2, "phase": "data_visible"},  # hook kwargs
      ... kind-specific fields ...}
 
 Failure-mode map (the §6 catalogue):
@@ -51,13 +54,23 @@ autotune budget starved                   autotune_budget/set_budget
 scripted window replay                    backend_init/fabricate
                                             (prints a canned record,
                                             stamped, and exits)
+SIGKILL mid-checkpoint-commit             ckpt_commit/sigkill with
+  (wedge teardown during save)              match_ctx phase
+slow-disk commit stall                    ckpt_commit/hang (seconds)
+truncated/corrupt checkpoint file         ckpt_data/truncate_file or
+  (disk rot, torn write)                    corrupt_file
+stale-step restore (tampered manifest)    ckpt_manifest/set_field
+SIGTERM during the final save             final_save/hang + outer kill
 =======================================  ================================
 
 Kind-specific fields: ``seconds`` (hang: sleep N then continue; absent
 = forever), ``message``/``rc`` (raise/exit), ``record``/``rc``/
 ``truncate_bytes`` (fabricate), ``add_s`` (inflate), ``bytes``
 (truncate), ``degraded_kind`` (degraded: relay|implausible|large_hbm),
-``budget_s`` (set_budget), ``min_batch`` (large_program matcher).
+``budget_s`` (set_budget), ``min_batch`` (large_program matcher),
+``field``/``value`` (set_field: tamper one JSON field pre-write),
+``keep_bytes`` (truncate_file), ``offset`` (corrupt_file: XOR one
+byte).
 
 Stdlib-only, and every check is a no-op dict lookup when the env var is
 unset — the hooks cost nothing on the scored path.
@@ -120,6 +133,12 @@ def _match(fault, ctx):
     if "min_batch" in fault and ctx.get("batch") is not None \
             and ctx["batch"] < fault["min_batch"]:
         return False
+    for k, want in (fault.get("match_ctx") or {}).items():
+        # hook-kwarg matcher (e.g. the checkpoint commit's step/phase):
+        # a plan can target exactly "step 2's commit, after the data
+        # rename" — determinism is the whole point of scripted chaos
+        if ctx.get(k) != want:
+            return False
     return True
 
 
@@ -162,6 +181,12 @@ def fire(site, **ctx):
             # stay in-flight: the parent's handler decides our fate
             # (bench's on_term SIGKILLs exactly the in-flight child)
             _hang(dict(fault, kind="hang"))
+        elif kind == "sigkill":
+            # the un-catchable death (wedge teardown, OOM-killer): no
+            # Python cleanup runs — exactly what the checkpoint commit
+            # protocol's atomicity invariants are tested against
+            _say(fault, " -> SIGKILL self")
+            os.kill(os.getpid(), signal.SIGKILL)
         elif kind == "fabricate":
             # scripted window replay: print a canned driver record —
             # STAMPED with the plan hash inside the line itself — and
@@ -203,6 +228,49 @@ def transform_output(line):
             _say(fault)
             line = line[:int(fault.get("bytes", 20))]
     return line
+
+
+def transform_json(site, obj, **ctx):
+    """``set_field``-kind faults: tamper one field of a JSON-bound dict
+    before it is written (e.g. the checkpoint manifest's ``step`` — the
+    stale-step restore mode). Returns a (possibly modified) copy; the
+    original is never mutated."""
+    if not active():
+        return obj
+    for fault in plan():
+        if fault.get("site") != site or not _match(fault, ctx):
+            continue
+        if fault.get("kind") == "set_field" and "field" in fault:
+            _say(fault, f" ({fault['field']} -> {fault.get('value')!r})")
+            obj = dict(obj, **{fault["field"]: fault.get("value")})
+    return obj
+
+
+def damage_file(site, path, **ctx):
+    """File-damage faults fired AFTER a commit: ``truncate_file``
+    (keep the first ``keep_bytes`` bytes — a torn write the rename
+    protocol could not see) and ``corrupt_file`` (XOR the byte at
+    ``offset`` — silent disk rot). The durability invariant under test:
+    a file that no longer hashes to its manifest is never restored."""
+    if not active():
+        return
+    for fault in plan():
+        if fault.get("site") != site or not _match(fault, ctx):
+            continue
+        kind = fault.get("kind")
+        if kind == "truncate_file":
+            keep = int(fault.get("keep_bytes", 16))
+            _say(fault, f" (truncate {path} to {keep}B)")
+            with open(path, "r+b") as f:
+                f.truncate(keep)
+        elif kind == "corrupt_file":
+            off = int(fault.get("offset", 0))
+            _say(fault, f" (flip byte {off} of {path})")
+            with open(path, "r+b") as f:
+                f.seek(off)
+                b = f.read(1)
+                f.seek(off)
+                f.write(bytes([b[0] ^ 0xFF]) if b else b"\xff")
 
 
 def injected_degraded():

@@ -1,0 +1,240 @@
+"""Durable collection manifest: which rows of a round are cashed vs owed.
+
+The autotuners already had the right window economics — skip-if-cashed
+resume, so a flap mid-pass costs only what is not yet banked. This
+module generalizes that to the WHOLE ``benchmarks/run_all_tpu.sh`` pass
+list: every row records its verdict (via the one resilience classifier)
+into a per-round manifest, ``run()`` consults it before launching, and
+the *next* healthy window therefore continues the round instead of
+restarting it — three straight rounds lost everything outside one
+~50-minute window because each pass started from zero (ISSUE 6;
+PERF.md §6 window economics).
+
+A row is **cashed** when its verdict is ``healthy`` (the same
+acceptance gate bench's watchdog and autotune use); anything else —
+degraded, wedged, crashed — leaves it **owed**, and the next pass
+re-runs exactly the owed rows. The manifest lives at the ROUND level
+(``$OUT/manifest.json`` next to the ``passN`` dirs;
+``APEX_COLLECT_MANIFEST`` overrides), so it spans passes and windows.
+
+CLI (invoked relay-proof by the shell drivers, like the probe CLI)::
+
+    python -m apex_tpu.resilience.manifest check  ROW --manifest PATH
+    python -m apex_tpu.resilience.manifest record ROW --manifest PATH \\
+        --log FILE --rc N [--pass DIR] [--smoke]
+    python -m apex_tpu.resilience.manifest status --manifest PATH
+
+``check`` exits 0 iff the row is cashed (the skip gate); ``record``
+classifies the row's log/exit status and updates the manifest
+atomically (tmp + rename — a SIGTERM mid-record must not tear the
+round's ledger of what is banked); ``status`` prints cashed/owed
+counts + the owed list (``probe_and_collect.sh --status`` surfaces it).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from apex_tpu import resilience
+
+# The run_all_tpu.sh pass list, in collection order — the denominator
+# for "rows owed this round". tests/test_resilience.py asserts this
+# stays in sync with the `run <name> ...` lines of the shell script, so
+# a row added to one cannot silently vanish from the other's account.
+PASS_ROWS = (
+    "bench_first", "gpt", "autotune", "autotune_tiles",
+    "attention", "layernorm", "softmax", "optimizers",
+    "multihead_attn", "dcgan", "xent", "xent_rb256",
+    "resnet", "pretrain", "pretrain_bert", "pretrain_gpt345",
+    "convergence", "gpt_rows", "gpt_fused_head", "gpt_ln_pallas",
+    "gpt_remat_sel", "attn_seq4096", "bench", "bench_b32",
+    "bench_b32_remat",
+)
+
+
+
+def classify_row(log_text, rc, smoke=False, probe_state=None):
+    """One verdict for a collection row: the log's last JSON line when
+    it is a driver measurement line (bench-style, carries ``metric``),
+    else the subprocess-level verdict from the exit status (profile
+    harnesses print tables, not JSON; autotune's summary line carries
+    its own pass/fail in the rc).
+
+    ``probe_state`` (path to the structured probe-state JSON the
+    resilience CLI stamps) guards the rc-only rows: a relay-degraded
+    window can run a profile harness ~40x slow and still exit 0 — the
+    exit status alone cannot tell a device-speed table from a
+    tunnel-bound one. When the LAST stamped probe verdict is
+    unhealthy, an rc-0 row with no measurement line is banked with
+    the probe's verdict (stays owed) instead of healthy. Measurement
+    lines (bench-style JSON) are never overridden — their classifier
+    is measurement-grade."""
+    _, rec = resilience.last_json(log_text or "")
+    if rec is not None and "metric" in rec:
+        return resilience.classify(rec, smoke=smoke)
+    verdict = resilience.classify_subprocess(
+        rc, timed_out=rc in resilience.TIMEOUT_RCS)
+    if verdict == resilience.HEALTHY and probe_state:
+        pv = _probe_verdict(probe_state)
+        if pv and pv != resilience.HEALTHY:
+            return pv
+    return verdict
+
+
+def _probe_verdict(path):
+    """Verdict of the stamped probe state
+    (``python -m apex_tpu.resilience.probe stamp``), or None when the
+    file is absent/unreadable/legacy-format — absence never blocks a
+    standalone run from banking rows."""
+    try:
+        with open(path) as f:
+            state = json.load(f)
+        v = state.get("verdict") if isinstance(state, dict) else None
+        return v if v in resilience.VERDICTS else None
+    except (OSError, ValueError):
+        return None
+
+
+def load(path):
+    """The manifest dict ``{"rows": {...}}`` (empty when absent or
+    unreadable — a corrupt manifest degrades to re-running rows, never
+    to skipping un-banked ones)."""
+    try:
+        with open(path) as f:
+            m = json.load(f)
+        if isinstance(m, dict) and isinstance(m.get("rows"), dict):
+            return m
+    except (OSError, ValueError):
+        pass
+    return {"rows": {}}
+
+
+def _write(path, manifest):
+    # atomic: a SIGTERM landing mid-record (the wedge-teardown case the
+    # whole subsystem exists for) must not tear the round's account
+    resilience.atomic_write_json(path, manifest, sort_keys=True, indent=1)
+
+
+def record(path, row, verdict, rc=None, pass_dir=None, log=None):
+    """Upsert one row's verdict. A later non-healthy run never
+    DOWNGRADES a cashed row: the banked measurement exists regardless
+    of what a worse window did afterwards."""
+    manifest = load(path)
+    prev = manifest["rows"].get(row)
+    if prev and prev.get("verdict") == resilience.HEALTHY \
+            and verdict != resilience.HEALTHY:
+        return prev
+    entry = {"verdict": verdict, "ts": round(time.time(), 3)}
+    if rc is not None:
+        entry["rc"] = rc
+    if pass_dir:
+        entry["pass"] = os.path.basename(os.path.normpath(pass_dir))
+    if log:
+        entry["log"] = log
+    manifest["rows"][row] = entry
+    _write(path, manifest)
+    return entry
+
+
+def cashed_rows(path):
+    """The set of rows banked as healthy."""
+    return {row for row, e in load(path)["rows"].items()
+            if e.get("verdict") == resilience.HEALTHY}
+
+
+def is_cashed(path, row):
+    return row in cashed_rows(path)
+
+
+def status_lines(path, rows=PASS_ROWS):
+    """Human-readable round account: cashed/owed counts + per-row
+    verdicts for everything not yet banked."""
+    manifest = load(path)["rows"]
+    cashed = [r for r in rows
+              if manifest.get(r, {}).get("verdict") == resilience.HEALTHY]
+    owed = [r for r in rows if r not in cashed]
+    lines = [f"collection manifest: {len(cashed)}/{len(rows)} rows "
+             f"cashed, {len(owed)} owed"]
+    if owed:
+        detail = []
+        for r in owed:
+            v = manifest.get(r, {}).get("verdict")
+            detail.append(f"{r}({v})" if v else r)
+        lines.append("owed: " + " ".join(detail))
+    extras = sorted(set(manifest) - set(rows))
+    if extras:
+        lines.append("extra rows recorded: " + " ".join(extras))
+    return lines, len(owed)
+
+
+# ------------------------------------------------------------------ CLI
+
+def cmd_check(args):
+    if is_cashed(args.manifest, args.row):
+        print(f"{args.row}: cashed")
+        return 0
+    print(f"{args.row}: owed")
+    return 1
+
+
+def cmd_record(args):
+    text = ""
+    if args.log:
+        try:
+            with open(args.log, errors="replace") as f:
+                text = f.read()
+        except OSError:
+            pass
+    verdict = classify_row(text, args.rc, smoke=args.smoke,
+                           probe_state=args.probe_state)
+    entry = record(args.manifest, args.row, verdict, rc=args.rc,
+                   pass_dir=getattr(args, "pass_dir", None), log=args.log)
+    print(f"{args.row}: {entry.get('verdict')}"
+          + (" (kept earlier healthy record)"
+             if entry.get("verdict") != verdict else ""))
+    return 0 if entry.get("verdict") == resilience.HEALTHY else 1
+
+
+def cmd_status(args):
+    lines, owed = status_lines(args.manifest)
+    for line in lines:
+        print(line)
+    return 0 if owed == 0 else 1
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m apex_tpu.resilience.manifest",
+        description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("check", help="exit 0 iff the row is cashed")
+    p.add_argument("row")
+    p.add_argument("--manifest", required=True)
+    p.set_defaults(fn=cmd_check)
+
+    p = sub.add_parser("record", help="classify + bank one row's outcome")
+    p.add_argument("row")
+    p.add_argument("--manifest", required=True)
+    p.add_argument("--log", default=None)
+    p.add_argument("--rc", type=int, default=None)
+    p.add_argument("--pass", dest="pass_dir", default=None)
+    p.add_argument("--smoke", action="store_true")
+    p.add_argument("--probe-state",
+                   default=os.environ.get("APEX_PROBE_STATE"),
+                   help="structured probe-state JSON; an unhealthy "
+                        "last probe keeps rc-only rows owed")
+    p.set_defaults(fn=cmd_record)
+
+    p = sub.add_parser("status", help="cashed/owed account of the round")
+    p.add_argument("--manifest", required=True)
+    p.set_defaults(fn=cmd_status)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,7 +52,7 @@ def classify_probe(rc, detail=""):
     early) = wedged."""
     if rc == 0:
         return resilience.HEALTHY
-    if rc in (124, 137, -9, -15):  # timeout(1) / SIGKILL / SIGTERM
+    if rc in resilience.TIMEOUT_RCS:
         return resilience.WEDGED
     return (resilience.DEGRADED_RELAY
             if "marginal" in (detail or "") else resilience.WEDGED)
@@ -82,10 +82,7 @@ def cmd_stamp(args):
     state = {"ts": round(time.time(), 3), "verdict": verdict,
              "rc": args.rc, "detail": (args.detail or "")[:500]}
     if args.out:
-        tmp = args.out + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(state, f)
-        os.replace(tmp, args.out)
+        resilience.atomic_write_json(args.out, state)
     print(verdict)
     return 0 if verdict == resilience.HEALTHY else 1
 

@@ -49,6 +49,46 @@ def knob_pins(env=None):
     return {k: env[k] for k in sorted(env) if k.startswith("APEX_")}
 
 
+# Harness-infrastructure knobs that legitimately differ between the run
+# that SAVED a checkpoint and the run that RESUMES it (paths, attempt
+# counters, retry budgets) — everything else an APEX_* pin names shapes
+# the measured program, and a resumed timing row whose pins drifted
+# from the checkpoint's is mixing two configs under one label. Shared
+# by bench.py's resume provenance and check_bench_labels check 5 so the
+# two can never disagree about what counts as drift.
+INFRA_KNOB_PREFIXES = (
+    "APEX_CKPT_", "APEX_BENCH_ATTEMPT", "APEX_BENCH_TIMEOUT",
+    "APEX_BENCH_RETRY_WAIT", "APEX_BENCH_INNER", "APEX_BENCH_BASELINE",
+    "APEX_TELEMETRY_LEDGER", "APEX_TELEMETRY_PATH",
+    "APEX_COMPILE_CACHE", "APEX_WARM_ONLY", "APEX_WARM_TIMEOUT",
+    "APEX_PROBE_", "APEX_FAULT_PLAN", "APEX_COLLECT_MANIFEST",
+)
+
+
+def measurement_pins(knobs=None):
+    """The subset of ``knobs`` (default: the live environment) that
+    shapes the measured program — infra knobs stripped. This is what a
+    checkpoint saves and what resume-provenance pin-matching compares."""
+    knobs = knob_pins() if knobs is None else knobs
+    return {k: v for k, v in knobs.items()
+            if not any(k.startswith(p) for p in INFRA_KNOB_PREFIXES)}
+
+
+def pin_drift(saved, now):
+    """Measurement-pin drift between a checkpoint's saved pins and a
+    run's knobs: ``{knob: [saved, now]}`` for every measurement knob
+    that differs, BOTH sides filtered through
+    :func:`measurement_pins`. The ONE implementation shared by the
+    provenance producer (``checkpoint.resume_provenance``) and the
+    citation checker (``check_bench_labels`` check 5) — two copies of
+    this comparison could disagree about what counts as drift."""
+    saved = measurement_pins(saved or {})
+    now = measurement_pins(now or {})
+    return {k: [saved.get(k), now.get(k)]
+            for k in sorted(set(saved) | set(now))
+            if saved.get(k) != now.get(k)}
+
+
 def git_sha():
     """HEAD commit of the repo (None when git is unavailable)."""
     import subprocess
@@ -199,6 +239,44 @@ def validate_record(rec):
                                         and age >= 0):
                 problems.append(
                     "compile_cache.warm_age_s is not a non-negative number")
+    ck = rec.get("checkpoint")
+    if ck is not None:
+        # the durability telemetry block (apex_tpu.checkpoint
+        # DurableCheckpointer.snapshot): a malformed one could silently
+        # claim a window's state was banked when it was not
+        if not isinstance(ck, dict):
+            problems.append("checkpoint is not a dict")
+        else:
+            for field in ("saves", "queue_depth"):
+                v = ck.get(field)
+                if not (isinstance(v, int) and not isinstance(v, bool)
+                        and v >= 0):
+                    problems.append(
+                        f"checkpoint.{field} is not a non-negative int")
+            if ck.get("commit_ms") is not None and not isinstance(
+                    ck["commit_ms"], (int, float)):
+                problems.append("checkpoint.commit_ms is not numeric")
+            if ck.get("last_step") is not None and not (
+                    isinstance(ck["last_step"], int)
+                    and not isinstance(ck["last_step"], bool)):
+                problems.append("checkpoint.last_step is not an int")
+    rf = rec.get("resumed_from")
+    if rf is not None:
+        # resume provenance (bench.py --resume / profile_gpt): rides
+        # INSIDE the content-hashed id; check_bench_labels check 5
+        # pin-matches citations of resumed records
+        if not isinstance(rf, dict):
+            problems.append("resumed_from is not a dict")
+        else:
+            if not (isinstance(rf.get("ckpt"), str)
+                    and rf["ckpt"].startswith("ck-")):
+                problems.append(
+                    "resumed_from.ckpt is not a checkpoint id (ck-...)")
+            if not (isinstance(rf.get("step"), int)
+                    and not isinstance(rf.get("step"), bool)):
+                problems.append("resumed_from.step is not an int")
+            if not isinstance(rf.get("pins"), dict):
+                problems.append("resumed_from.pins is not a dict")
     if "id" in rec and all(f in rec for f in REQUIRED_FIELDS):
         want = record_id(rec)
         if rec["id"] != want:

@@ -44,6 +44,55 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 # cannot update one and orphan the other.
 DEFAULT_TPU_BATCH = 8
 
+# Emergency-save staging (durability layer, ISSUE 6): after each scan
+# boundary the inner run parks a HOST copy of the newest training state
+# here — host copies, because the jit donates the device buffers into
+# the next dispatch and a SIGTERM handler cannot fetch a donated array.
+# The SIGTERM handler commits this through the DurableCheckpointer so a
+# wedge-capped/terminated window still leaves a resumable checkpoint
+# next to its best JSON line.
+_EMERGENCY = {"writer": None, "state": None, "step": None, "meta": None,
+              "platform": None}
+
+
+def _stage_emergency(writer, step, state, meta, platform):
+    """Fetch ``state`` to host and stage it for the SIGTERM flush. The
+    fetch is the scan-boundary device→host transfer — it happens
+    OUTSIDE the timed region (before timing starts / after it ends),
+    so checkpoint cost can never leak into step cost."""
+    import jax
+
+    _EMERGENCY.update(state=jax.device_get(state), step=int(step),
+                      meta=dict(meta), writer=writer, platform=platform)
+
+
+def _emergency_sigterm(signum, frame):
+    """Inner-run SIGTERM: commit the staged checkpoint and append a
+    ``bench_emergency_save`` ledger record, then exit. Both terminate
+    paths grant a 15 s grace window before SIGKILL (the watchdog's
+    timeout path and its on_term handler) — enough for a host-side
+    commit; a child wedged in native relay code never runs this, and
+    the scan-boundary commit already banked the pre-wedge state (the
+    commit protocol's atomicity keeps it the newest valid one).
+    ``commit_now`` bypasses the async queue: a signal handler must not
+    block on queue internals its interrupted frame may hold."""
+    es = _EMERGENCY
+    try:
+        if es["writer"] is not None and es["state"] is not None:
+            es["writer"].commit_now(es["step"], es["state"],
+                                    meta=es["meta"])
+            from apex_tpu.telemetry import ledger as _ledger
+
+            _ledger.append_record(
+                harness="bench_emergency_save", platform=es["platform"],
+                dispatch_overhead_ms=None, k=None,
+                extra={"terminated": "SIGTERM", "ckpt_step": es["step"],
+                       "checkpoint": es["writer"].snapshot()})
+            print(f"# emergency checkpoint committed at step "
+                  f"{es['step']}", file=sys.stderr, flush=True)
+    finally:
+        os._exit(143)
+
 
 def _default_batch(cfg, builtin, s):
     """The bench batch: APEX_BENCH_BATCH pins; else a dispatch-table
@@ -336,6 +385,51 @@ def main():
             "step_scan_timed_rebind": timed_rebind,
         }, platform=platform))
 
+    # ------------------------------------------------- durability layer
+    # (opt-in: APEX_CKPT_DIR; ISSUE 6). Restore happens HERE — before
+    # the overhead calibration and the warm scan — so restore cost can
+    # never mix into step cost; the provenance stamped below makes that
+    # mechanically checkable (check_bench_labels check 5).
+    from apex_tpu.telemetry import ledger as tledger
+
+    ckpt_writer, resumed_from, step0 = None, None, 0
+    rng = jax.random.PRNGKey(0)
+    if os.environ.get("APEX_CKPT_DIR"):
+        import signal
+
+        from apex_tpu import checkpoint as ckpt_mod
+
+        ckpt_writer = ckpt_mod.DurableCheckpointer(
+            os.environ["APEX_CKPT_DIR"])
+        if os.environ.get("APEX_CKPT_RESUME") == "1":
+            tmpl = {"params": params, "opt": opt_state,
+                    "scaler": scaler_state, "rng": rng}
+            # the batch/seq guard matters because the state TREE is
+            # batch-independent — only the saved meta can refuse a
+            # cross-config resume (checkpoint.resume_provenance is the
+            # one implementation, shared with profile_gpt)
+            restored, step0, resumed_from = ckpt_mod.resume_provenance(
+                ckpt_writer, tmpl, expect_meta={"batch": b, "s": s})
+            if restored is not None:
+                params, opt_state = restored["params"], restored["opt"]
+                scaler_state, rng = restored["scaler"], restored["rng"]
+            else:
+                print("# resume requested but no usable checkpoint in "
+                      f"{ckpt_writer.directory}; cold start",
+                      file=sys.stderr, flush=True)
+
+        def ckpt_meta(step):
+            return {"step": int(step), "harness": "bench", "batch": b,
+                    "s": s, "knob_pins": tledger.measurement_pins()}
+
+        # stage the post-init/restore state and arm the SIGTERM flush:
+        # from here on, a terminated attempt leaves a checkpoint
+        _stage_emergency(ckpt_writer, step0,
+                         {"params": params, "opt": opt_state,
+                          "scaler": scaler_state, "rng": rng},
+                         ckpt_meta(step0), platform)
+        signal.signal(signal.SIGTERM, _emergency_sigterm)
+
     overhead = measure_dispatch_overhead(iters)
     # calibration-flap injection point: a relay flap straddling the
     # calibration inflates the measured overhead relative to the timed
@@ -353,12 +447,40 @@ def main():
     params, opt_state, scaler_state, losses, _ = step(
         params, opt_state, scaler_state, jnp.float32(0.0), ids, pos, labels)
     sync(losses)
+    if ckpt_writer is not None:
+        # scan boundary 1: host-stage AND COMMIT the warm scan's output
+        # (the device buffers are about to be donated into the timed
+        # dispatch). The commit is host-side and strictly before t0,
+        # so no checkpoint cost can leak into the timed region — and a
+        # child hard-wedged in the timed dispatch (the mode that never
+        # runs its SIGTERM handler) still leaves this state banked.
+        _stage_emergency(ckpt_writer, step0 + iters,
+                         {"params": params, "opt": opt_state,
+                          "scaler": scaler_state, "rng": rng},
+                         ckpt_meta(step0 + iters), platform)
+        ckpt_writer.save(step0 + iters, _EMERGENCY["state"],
+                         meta=_EMERGENCY["meta"])
+        ckpt_writer.flush()
     print("# compiled; timing", file=sys.stderr, flush=True)
     t0 = time.perf_counter()
     out = step(params, opt_state, scaler_state, jnp.float32(1e-30), ids, pos,
                labels)
     sync(out[3])
     dt = (time.perf_counter() - t0 - overhead) / iters
+
+    final_step = step0 + 2 * iters
+    if ckpt_writer is not None:
+        # scan boundary 2 (timing closed): commit the final TrainState.
+        # The final_save fault site models a wedge striking exactly
+        # here — the emergency SIGTERM path must still flush.
+        faults.fire("final_save")
+        _stage_emergency(ckpt_writer, final_step,
+                         {"params": out[0], "opt": out[1],
+                          "scaler": out[2], "rng": rng},
+                         ckpt_meta(final_step), platform)
+        ckpt_writer.save(final_step, _EMERGENCY["state"],
+                         meta=_EMERGENCY["meta"])
+        ckpt_writer.flush()
 
     from apex_tpu import telemetry
 
@@ -368,14 +490,22 @@ def main():
         # compile_cache block proves whether the number was compile-free.
         from apex_tpu import dispatch as dispatch_table
 
+        base = {"metric": f"gpt2s_train_tokens_per_sec ({platform})",
+                "compile_cache": compile_cache.snapshot(),
+                "dispatch": dispatch_table.snapshot()}
+        if ckpt_writer is not None:
+            base["checkpoint"] = ckpt_writer.snapshot()
+        if resumed_from is not None:
+            # resume provenance INSIDE the content-hashed record id:
+            # a timing row that restored state self-describes its
+            # lineage tamper-evidently (check_bench_labels check 5
+            # pin-matches citations of resumed records)
+            base["resumed_from"] = resumed_from
         return telemetry.ledger.append_record(
             harness="bench", platform=platform,
             dispatch_overhead_ms=round(overhead * 1e3, 1), k=iters,
             relay={"degraded": degraded, "kind": kind},
-            extra=dict({"metric": f"gpt2s_train_tokens_per_sec ({platform})",
-                        "compile_cache": compile_cache.snapshot(),
-                        "dispatch": dispatch_table.snapshot()},
-                       **extra))
+            extra=dict(base, **extra))
 
     if dt <= 0:
         # the dispatch-overhead calibration ran in a slower relay regime
@@ -481,6 +611,14 @@ def main():
         # of the pin-the-label rule
         "dispatch": _dispatch_snapshot(),
     }
+    if ckpt_writer is not None:
+        # the durability telemetry block: {saves, queue_depth,
+        # commit_ms, last_step} (+async/errors) — a window's driver log
+        # proves whether its checkpoints committed
+        result["checkpoint"] = ckpt_writer.snapshot()
+        ckpt_writer.close()
+    if resumed_from is not None:
+        result["resumed_from"] = resumed_from
     if faults.plan_hash():
         # a run under fault injection is stamped in the line itself (the
         # ledger record carries the stamp inside its content-hashed id):
@@ -567,7 +705,14 @@ def _config_ladder(attempts, smoke):
                            "APEX_BENCH_BATCH"))
     if smoke or pinned or attempts < 2:
         return [{}] * attempts
-    return [{}, {"APEX_BENCH_BATCH": "16"}] + [{}] * (attempts - 2)
+    # the b=16 upside attempt opts OUT of the durability layer (None =
+    # unset in _attempt_once): resuming a default-config checkpoint
+    # under a different batch pin would stamp pin_drift provenance and
+    # make the A/B line uncitable (check 5), and its final save would
+    # park a b=16-trajectory state where the default config resumes —
+    # only the default config banks durable state
+    return [{}, {"APEX_BENCH_BATCH": "16", "APEX_CKPT_DIR": None,
+                 "APEX_CKPT_RESUME": None}] + [{}] * (attempts - 2)
 
 
 def _attempt_once(state, extra_env=None, timeout_cap=None, attempt=0):
@@ -598,7 +743,14 @@ def _attempt_once(state, extra_env=None, timeout_cap=None, attempt=0):
     from apex_tpu import resilience
 
     env = dict(os.environ, APEX_BENCH_INNER="1",
-               APEX_BENCH_ATTEMPT=str(attempt), **(extra_env or {}))
+               APEX_BENCH_ATTEMPT=str(attempt))
+    for k, v in (extra_env or {}).items():
+        # None UNSETS the var (the ladder's durability opt-out) — the
+        # same semantics autotune/warm_cache subprocess envs use
+        if v is None:
+            env.pop(k, None)
+        else:
+            env[k] = v
     timeout = resilience.attempt_timeout(timeout_cap)
     label = ("cpu" if os.environ.get("APEX_BENCH_SMOKE") == "1"
              else "tpu")
@@ -669,6 +821,9 @@ def _watchdog():
     # imported HERE, not inside the signal handler: the import machinery
     # must never run under a mid-import SIGTERM
     from apex_tpu.telemetry import ledger as _tledger
+    _ckpt_mod = None
+    if os.environ.get("APEX_CKPT_DIR"):
+        from apex_tpu import checkpoint as _ckpt_mod
 
     policy = resilience.RetryPolicy()
     attempts = policy.attempts
@@ -705,16 +860,42 @@ def _watchdog():
     def on_term(signum, frame):
         flush_best()
         # a terminated window is evidence too: record what was flushed
-        # (never raises; smoke runs skip unless APEX_TELEMETRY_LEDGER
-        # is set — the ledger's own rule)
+        # — and, when the durability layer is armed, the newest
+        # committed checkpoint on disk, so the next window knows what
+        # `--resume` will pick up (never raises; smoke runs skip the
+        # write unless APEX_TELEMETRY_LEDGER is set — the ledger's rule)
         pair = state["best"] or state["fallback"]
+        extra = {"terminated": "SIGTERM",
+                 "flushed": pair[1] if pair is not None else None}
+        child = state["child"]
+        if os.environ.get("APEX_CKPT_DIR") and child is not None:
+            # give a LIVE child its emergency-save grace: SIGTERM, then
+            # a bounded wait (15 s — the same grace the timeout path
+            # grants, sized for a host-side commit of the full
+            # TrainState). A child wedged in native relay code ignores
+            # it and eats the SIGKILL below, exactly as before.
+            try:
+                child.terminate()
+                child.wait(timeout=15)
+            except Exception:
+                pass
+        if _ckpt_mod is not None:
+            # the on-disk peek (NOT the writer's telemetry block —
+            # that schema belongs to the inner run): what --resume
+            # will pick up next window
+            try:
+                m = _ckpt_mod.latest_durable_manifest(
+                    os.environ["APEX_CKPT_DIR"])
+                extra["ckpt_on_disk"] = (
+                    {"last_step": m["step"], "id": m.get("id")}
+                    if m else None)
+            except Exception:
+                extra["ckpt_on_disk"] = None
         _tledger.append_record(
             harness="bench_watchdog",
             platform="cpu" if smoke else "tpu",
             dispatch_overhead_ms=None, k=None,
-            extra={"terminated": "SIGTERM",
-                   "flushed": pair[1] if pair is not None else None})
-        child = state["child"]
+            extra=extra)
         if child is not None:
             # SIGKILL, not SIGTERM: the observed wedge is a child stuck
             # in native relay code that never runs Python signal
@@ -870,6 +1051,16 @@ if __name__ == "__main__":
         # CLI alias for APEX_BENCH_SMOKE=1 (inherited by the watchdog's
         # inner attempts via the environment)
         os.environ["APEX_BENCH_SMOKE"] = "1"
+    if "--resume" in sys.argv[1:]:
+        # CLI alias for APEX_CKPT_RESUME=1 (inherited the same way):
+        # restore the full TrainState from APEX_CKPT_DIR's newest valid
+        # checkpoint and continue — the cross-window resume path
+        # (PERF.md §6). Requires APEX_CKPT_DIR.
+        if not os.environ.get("APEX_CKPT_DIR"):
+            print("bench.py --resume requires APEX_CKPT_DIR",
+                  file=sys.stderr)
+            sys.exit(2)
+        os.environ["APEX_CKPT_RESUME"] = "1"
     if os.environ.get("APEX_WARM_ONLY") == "1":
         # warm-start pass (benchmarks/warm_cache.py): compile-only, no
         # measurement — the retrying watchdog has nothing to rank

@@ -311,10 +311,7 @@ def _upsert_entry(table_path, entry):
     # atomic replace: a SIGTERM/timeout landing mid-write must not
     # truncate the committed table (that would destroy every cashed
     # rung and break the resume property)
-    tmp = table_path + ".tmp"
-    with open(tmp, "w") as f:
-        f.write("\n".join(lines) + "\n")
-    os.replace(tmp, table_path)
+    resilience.atomic_write(table_path, "\n".join(lines) + "\n")
     dispatch._reset_for_tests()  # drop the mtime cache
 
 

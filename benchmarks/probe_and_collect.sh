@@ -63,6 +63,11 @@ latest_pass_dir() {  # latest_pass_dir <outdir> — highest passN, NUMERIC
     printf '%s' "$out"
 }
 
+manifest_cli() {  # relay-proof, like verdict_cli
+    timeout 120 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+        python -m apex_tpu.resilience.manifest "$@"
+}
+
 case "${1:-}" in
     --status)
         SOUT="${2:-/tmp/apex_tpu_collect}"
@@ -106,6 +111,15 @@ case "${1:-}" in
             fi
             [ -f "$SOUT/warm_cache.log" ] \
                 && echo "warm log: $(tail -1 "$SOUT/warm_cache.log")"
+        fi
+        # the durable collection manifest: rows cashed vs owed this
+        # round — a glance shows what the next window must still
+        # produce (ISSUE 6)
+        if [ -f "$SOUT/manifest.json" ]; then
+            manifest_cli status --manifest "$SOUT/manifest.json" \
+                | sed 's/^/  /' || true
+        else
+            echo "  no collection manifest yet ($SOUT/manifest.json)"
         fi
         exit "$rc"
         ;;
@@ -162,6 +176,16 @@ INTERVAL="${1:-600}"
 OUT="${2:-/tmp/apex_tpu_collect}"
 MAX_PASSES="${3:-8}"
 mkdir -p "$OUT"
+# the round's durable collection manifest rides at the round root —
+# shared by every passN, so a pass launched after a wedge re-runs only
+# the rows the earlier passes did not bank (run_all_tpu.sh consults it
+# before every row; warm_cache skips targets whose row is cashed).
+# The probe-state path is exported too: manifest `record` refuses to
+# bank an rc-only (table-printing) row as healthy while the last
+# stamped probe was degraded/wedged — exit status alone cannot tell a
+# device-speed table from a 40x tunnel-bound one.
+export APEX_COLLECT_MANIFEST="$OUT/manifest.json"
+export APEX_PROBE_STATE="$STATE"
 
 probe() {
     # Healthy == the MARGINAL bf16 matmul rate between a K=8 and a K=64
@@ -278,9 +302,15 @@ for d in "$OUT"/pass*; do
 done
 [ "$PASS" -gt 0 ] && echo "resuming after existing pass$PASS in $OUT"
 # a healthy headline can come from the opening bench_first rung OR the
-# end-of-queue full-ladder bench (run_all_tpu.sh) — gate on either
+# end-of-queue full-ladder bench (run_all_tpu.sh) — gate on either the
+# pass's own logs or the round manifest (a headline banked by an
+# EARLIER pass is not re-run, so the latest pass dir may not hold it)
 pass_has_headline() {  # pass_has_headline <pass_dir>
-    bench_healthy "$1/bench_first.log" || bench_healthy "$1/bench.log"
+    bench_healthy "$1/bench_first.log" || bench_healthy "$1/bench.log" \
+        || manifest_cli check bench_first \
+            --manifest "$APEX_COLLECT_MANIFEST" >/dev/null 2>&1 \
+        || manifest_cli check bench \
+            --manifest "$APEX_COLLECT_MANIFEST" >/dev/null 2>&1
 }
 if [ "$PASS" -gt 0 ] && pass_has_headline "$OUT/pass$PASS"; then
     echo "pass$PASS already holds a device-speed bench; nothing to do"
@@ -349,6 +379,9 @@ while true; do
         cache_stats "$PASS_OUT"
         echo "[$(date +%H:%M:%S)] pass $PASS autotune stats:"
         autotune_stats "$PASS_OUT"
+        echo "[$(date +%H:%M:%S)] pass $PASS round account:"
+        manifest_cli status --manifest "$APEX_COLLECT_MANIFEST" \
+            | sed 's/^/    /' || true
         # the relay flaps: a healthy probe does not guarantee a healthy
         # collection. Keep looping until the headline bench ran at
         # device speed (bench.py stamps relay-degraded runs with a

@@ -15,8 +15,25 @@ OUT="${1:-/tmp/apex_tpu_bench_$(date +%Y%m%d_%H%M)}"
 mkdir -p "$OUT"
 echo "collecting into $OUT"
 
+# Durable collection manifest (apex_tpu/resilience/manifest.py): every
+# row's verdict is banked per ROUND, and a row already cashed (healthy)
+# in an earlier pass/window is skipped — the next healthy window
+# continues the round instead of restarting it. probe_and_collect.sh
+# exports APEX_COLLECT_MANIFEST at the round outdir; a standalone run
+# defaults to a manifest next to its own logs (reruns into the same
+# outdir resume the same way).
+MANIFEST="${APEX_COLLECT_MANIFEST:-$OUT/manifest.json}"
+manifest_cli() {  # relay-proof, like the probe CLI (CLAUDE.md)
+    timeout 120 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+        python -m apex_tpu.resilience.manifest "$@"
+}
+
 run() {  # run <name> <timeout_s> <cmd...>
     local name="$1" t="$2"; shift 2
+    if manifest_cli check "$name" --manifest "$MANIFEST" >/dev/null 2>&1; then
+        echo "=== $name: cashed in $MANIFEST — skip (row already banked)"
+        return 0
+    fi
     echo "=== $name (timeout ${t}s)"
     # --preserve-status: bench.py's SIGTERM handler flushes its best
     # measurement and exits with a meaningful status — don't mask it as 124
@@ -24,6 +41,9 @@ run() {  # run <name> <timeout_s> <cmd...>
     local rc=$?
     tail -3 "$OUT/$name.log" | sed 's/^/    /'
     [ $rc -ne 0 ] && echo "    rc=$rc (see $OUT/$name.log)"
+    manifest_cli record "$name" --manifest "$MANIFEST" \
+        --log "$OUT/$name.log" --rc "$rc" --pass "$OUT" 2>/dev/null \
+        | sed 's/^/    manifest: /'
 }
 
 # bench.py FIRST (round-5 lesson, PERF.md §10b): the scored headline
@@ -100,15 +120,30 @@ run gpt_remat_sel     900 env APEX_REMAT=selective python benchmarks/profile_gpt
 # long-sequence crossover behind the rows-vs-flash dispatch rule
 run attn_seq4096      900 env APEX_ATTN_SEQ=4096 python benchmarks/profile_attention.py
 # full-ladder bench retry: if bench_first already landed healthy this is
-# one cached-compile re-measurement plus the b=16 upside attempt
-run bench            5900 python bench.py
+# one cached-compile re-measurement plus the b=16 upside attempt.
+# The END-of-queue bench rows run with the DURABILITY layer armed
+# (apex_tpu.checkpoint: emergency save on SIGTERM/wedge-cap, resume of
+# a previous window's banked TrainState — provenance stamped in the
+# record, check_bench_labels check 5 polices citations). NOT the
+# opening headline rows: the scan-boundary device→host fetch of the
+# full TrainState is unmeasured transfer time + wedge surface the
+# window's opening minutes must not pay (APEX_CKPT_ASYNC A/B queued,
+# PERF.md §6). Per-config checkpoint dirs: the GPT TrainState's SHAPES
+# are batch-independent, so the restore walk alone cannot tell a b=32
+# trajectory from a b=8 one — the dirs keep them apart, and the saved
+# meta's batch/seq guard (checkpoint.resume_provenance) refuses a
+# cross-config resume even if the dirs are ever consolidated.
+CKPT_ROOT="$(dirname "$MANIFEST")/ckpt"
+run bench            5900 env APEX_CKPT_DIR="$CKPT_ROOT/bench" APEX_CKPT_RESUME=1 python bench.py
 # b=32 amortization probe LAST: its compile stalled the tunneled
 # remote-compile helper once (PERF.md) and a wedged client can poison
 # subsequent backend inits — nothing after it left to lose. Single
 # attempt: the retry ladder would re-wedge.
-run bench_b32        1500 env APEX_BENCH_BATCH=32 APEX_BENCH_ATTEMPTS=1 python bench.py
+run bench_b32        1500 env APEX_CKPT_DIR="$CKPT_ROOT/bench_b32" APEX_CKPT_RESUME=1 APEX_BENCH_BATCH=32 APEX_BENCH_ATTEMPTS=1 python bench.py
 # ...and with selective remat: the smaller backward working set may be
 # what the b=32 compile needs (round-3 stall was an oversized config)
-run bench_b32_remat  1500 env APEX_BENCH_BATCH=32 APEX_REMAT=selective APEX_BENCH_ATTEMPTS=1 python bench.py
+run bench_b32_remat  1500 env APEX_CKPT_DIR="$CKPT_ROOT/bench_b32_remat" APEX_CKPT_RESUME=1 APEX_BENCH_BATCH=32 APEX_REMAT=selective APEX_BENCH_ATTEMPTS=1 python bench.py
 
 echo "=== done; feed the logs into PERF.md"
+# the round's account: what this pass banked, what the next window owes
+manifest_cli status --manifest "$MANIFEST" || true

@@ -110,18 +110,41 @@ def main():
                                  str(resilience.WARM_TIMEOUT_S)))
     bench = os.path.join(REPO, "bench.py")
     gpt = os.path.join(REPO, "benchmarks", "profile_gpt.py")
-    ok_b8, rec = warm_target("bench b=8", [sys.executable, bench], {},
-                             timeout)
-    # the contract is the SCORED program: exit 0 iff bench's step_scan
-    # warmed. A flap that fails only an upside key (timed-rebind,
-    # calibration) exits the bench warm non-zero but must not make the
-    # probe loop re-run the whole warm ahead of every later pass.
-    if rec and "warm" in rec:
-        sw = rec["warm"].get("step_scan") or {}
-        ok_b8 = bool(sw) and "error" not in sw
-    warm_target("bench b=16", [sys.executable, bench],
-                {"APEX_BENCH_BATCH": "16"}, timeout)
-    warm_target("profile_gpt", [sys.executable, gpt], {}, timeout)
+    # the durable collection manifest (apex_tpu.resilience.manifest):
+    # a headline row an earlier window already banked as healthy will
+    # be SKIPPED by run_all_tpu.sh — don't spend this window's opening
+    # minutes warming a program nobody will run
+    cashed = set()
+    mpath = os.environ.get("APEX_COLLECT_MANIFEST")
+    if mpath:
+        try:
+            from apex_tpu.resilience import manifest as manifest_mod
+
+            cashed = manifest_mod.cashed_rows(mpath)
+        except Exception as e:
+            print(f"warm_cache: manifest unreadable ({e})", flush=True)
+    ok_b8, rec = True, None
+    if "bench_first" in cashed and "bench" in cashed:
+        print("warm bench b=8: skipped (headline rows cashed in the "
+              "round manifest)", flush=True)
+    else:
+        ok_b8, rec = warm_target("bench b=8", [sys.executable, bench], {},
+                                 timeout)
+        # the contract is the SCORED program: exit 0 iff bench's
+        # step_scan warmed. A flap that fails only an upside key
+        # (timed-rebind, calibration) exits the bench warm non-zero but
+        # must not make the probe loop re-run the whole warm ahead of
+        # every later pass.
+        if rec and "warm" in rec:
+            sw = rec["warm"].get("step_scan") or {}
+            ok_b8 = bool(sw) and "error" not in sw
+        warm_target("bench b=16", [sys.executable, bench],
+                    {"APEX_BENCH_BATCH": "16"}, timeout)
+    if "gpt" in cashed:
+        print("warm profile_gpt: skipped (row cashed in the round "
+              "manifest)", flush=True)
+    else:
+        warm_target("profile_gpt", [sys.executable, gpt], {}, timeout)
 
     # autotune A/B program set — BOUNDED: only rungs whose table entry
     # is missing, warmed under the exact env the autotune pass measures

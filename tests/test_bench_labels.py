@@ -183,3 +183,90 @@ def test_fault_stamped_record_citation_is_drift(tmp_path, monkeypatch):
     out = _run("--perf", str(perf), "--ledger", str(lpath))
     assert out.returncode == 1, out.stdout
     assert "FAULT-INJECTED" in out.stdout
+
+
+# ------------------------------------------------ check 5: resume provenance
+
+def _resumed_record(knobs, saved_pins, **extra):
+    return ledger.make_record(
+        harness="bench", platform="tpu", dispatch_overhead_ms=80.0,
+        k=16, knobs=knobs, git="abc", ts=1000.0,
+        extra=dict({"resumed_from": {"ckpt": "ck-0123456789ab"[:13],
+                                     "step": 32, "pins": saved_pins}},
+                   **extra))
+
+
+def test_resumed_record_with_matching_pins_passes(tmp_path):
+    """A resumed run whose measurement pins equal its checkpoint's is
+    citable — resume provenance alone is not drift."""
+    rec = _resumed_record({"APEX_REMAT": "selective"},
+                          {"APEX_REMAT": "selective"})
+    lpath = tmp_path / "ledger.jsonl"
+    lpath.write_text(json.dumps(rec, sort_keys=True) + "\n")
+    perf = tmp_path / "PERF.md"
+    perf.write_text(f"# fixture\n\nresumed row (ledger:{rec['id']}):\n")
+    out = _run("--perf", str(perf), "--ledger", str(lpath))
+    assert out.returncode == 0, out.stdout
+
+
+def test_resumed_record_with_pin_drift_is_refused(tmp_path):
+    """check 5: the restored run's knobs differ from the checkpoint's
+    saved pins — the timing row mixes two configs under one label."""
+    rec = _resumed_record({"APEX_REMAT": "none"},
+                          {"APEX_REMAT": "selective"})
+    lpath = tmp_path / "ledger.jsonl"
+    lpath.write_text(json.dumps(rec, sort_keys=True) + "\n")
+    perf = tmp_path / "PERF.md"
+    perf.write_text(f"# fixture\n\nresumed row (ledger:{rec['id']}):\n")
+    out = _run("--perf", str(perf), "--ledger", str(lpath))
+    assert out.returncode == 1, out.stdout
+    assert "DIFFERENT measurement pins" in out.stdout
+    assert "APEX_REMAT" in out.stdout
+
+
+def test_infra_knob_difference_is_not_pin_drift(tmp_path):
+    """Paths/attempt counters (ledger.INFRA_KNOB_PREFIXES) legitimately
+    differ between the saving and the resuming run — not drift."""
+    rec = _resumed_record(
+        {"APEX_CKPT_RESUME": "1", "APEX_BENCH_ATTEMPT": "2"},
+        {"APEX_BENCH_TIMEOUT": "900"})
+    lpath = tmp_path / "ledger.jsonl"
+    lpath.write_text(json.dumps(rec, sort_keys=True) + "\n")
+    perf = tmp_path / "PERF.md"
+    perf.write_text(f"# fixture\n\nresumed row (ledger:{rec['id']}):\n")
+    out = _run("--perf", str(perf), "--ledger", str(lpath))
+    assert out.returncode == 0, out.stdout
+
+
+def test_cold_start_claim_refuses_resumed_record(tmp_path):
+    """check 5: a paragraph claiming a cold start must not cite a
+    record that restored checkpointed state, whatever its
+    compile-cache counters say."""
+    rec = _resumed_record({}, {})
+    lpath = tmp_path / "ledger.jsonl"
+    lpath.write_text(json.dumps(rec, sort_keys=True) + "\n")
+    perf = tmp_path / "PERF.md"
+    perf.write_text(
+        f"# fixture\n\nCold-start compile tax row "
+        f"(ledger:{rec['id']}):\n")
+    out = _run("--perf", str(perf), "--ledger", str(lpath))
+    assert out.returncode == 1, out.stdout
+    assert "not a cold start" in out.stdout
+    # ...and the same citation in a non-cold paragraph is fine
+    perf.write_text(f"# fixture\n\nresumed row (ledger:{rec['id']}):\n")
+    out = _run("--perf", str(perf), "--ledger", str(lpath))
+    assert out.returncode == 0, out.stdout
+
+
+def test_malformed_resume_provenance_is_a_finding(tmp_path):
+    rec = ledger.make_record(
+        harness="bench", platform="tpu", dispatch_overhead_ms=80.0,
+        k=16, knobs={}, git="abc", ts=1000.0,
+        extra={"resumed_from": {"ckpt": "ck-0123456789", "step": 32,
+                                "pins": "not-a-dict"}})
+    lpath = tmp_path / "ledger.jsonl"
+    lpath.write_text(json.dumps(rec, sort_keys=True) + "\n")
+    perf = tmp_path / "PERF.md"
+    perf.write_text(f"# fixture\n\nrow (ledger:{rec['id']}):\n")
+    out = _run("--perf", str(perf), "--ledger", str(lpath))
+    assert out.returncode == 1, out.stdout

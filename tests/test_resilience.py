@@ -587,3 +587,144 @@ def test_shell_drivers_pass_bash_syntax_gate():
         out = subprocess.run(["bash", "-n", script], capture_output=True,
                              text=True, timeout=60)
         assert out.returncode == 0, f"{script}: {out.stderr}"
+
+
+# ------------------------------------------- durable collection manifest
+
+from apex_tpu.resilience import manifest as manifest_mod  # noqa: E402
+
+
+def test_manifest_pass_rows_match_run_all_tpu_sh():
+    """The manifest's canonical row list must equal the `run <name>`
+    lines of run_all_tpu.sh, in order — a row added to one cannot
+    silently vanish from the other's cashed/owed account."""
+    import re
+
+    with open(RUN_ALL_SH) as f:
+        rows = re.findall(r"^run\s+(\S+)\s", f.read(), re.MULTILINE)
+    assert tuple(rows) == manifest_mod.PASS_ROWS
+
+
+def test_manifest_classify_row_shapes(tmp_path):
+    """Bench-style logs classify by their JSON line; table-printing
+    harnesses by exit status; timeout statuses are the wedge."""
+    healthy = json.dumps(HEALTHY_TPU_REC)
+    degraded = json.dumps({"metric": "x (tpu)", "value": 5,
+                           "note": "relay", "degraded_kind": "relay",
+                           "relay_degraded": True})
+    assert manifest_mod.classify_row(healthy, 0) == resilience.HEALTHY
+    assert manifest_mod.classify_row(degraded, 0) \
+        == resilience.DEGRADED_RELAY
+    assert manifest_mod.classify_row("table output\n", 0) \
+        == resilience.HEALTHY
+    assert manifest_mod.classify_row("", 1) == resilience.DEGRADED_RELAY
+    for rc in (124, 137, 143):
+        assert manifest_mod.classify_row("", rc) == resilience.WEDGED
+    # autotune's summary line is JSON but not a measurement line — the
+    # rc carries its pass/fail
+    summary = json.dumps({"done": [], "dropped": ["gpt_rows"]})
+    assert manifest_mod.classify_row(summary, 1) \
+        == resilience.DEGRADED_RELAY
+
+
+def test_manifest_record_check_status_roundtrip(tmp_path, capsys):
+    """The CLI surface run_all_tpu.sh consults: record banks a healthy
+    row, check gates on it, a later degraded run never downgrades it,
+    and status reports the cashed/owed account."""
+    p = str(tmp_path / "manifest.json")
+    log = tmp_path / "bench_first.log"
+    log.write_text(json.dumps(HEALTHY_TPU_REC) + "\n")
+    assert manifest_mod.main(["record", "bench_first", "--manifest", p,
+                              "--log", str(log), "--rc", "0",
+                              "--pass", str(tmp_path / "pass1")]) == 0
+    assert manifest_mod.main(["check", "bench_first",
+                              "--manifest", p]) == 0
+    assert manifest_mod.main(["check", "gpt", "--manifest", p]) == 1
+    # a degraded re-run must not downgrade the banked row
+    log.write_text(json.dumps({"metric": "x (tpu)", "value": 5,
+                               "note": "relay",
+                               "relay_degraded": True}) + "\n")
+    manifest_mod.main(["record", "bench_first", "--manifest", p,
+                       "--log", str(log), "--rc", "0"])
+    assert manifest_mod.is_cashed(p, "bench_first")
+    # a wedged row stays owed with its verdict named
+    manifest_mod.main(["record", "xent", "--manifest", p, "--rc", "124"])
+    capsys.readouterr()
+    assert manifest_mod.main(["status", "--manifest", p]) == 1
+    out = capsys.readouterr().out
+    assert "1/25 rows cashed" in out and "xent(wedged)" in out
+    entry = manifest_mod.load(p)["rows"]["bench_first"]
+    assert entry["pass"] == "pass1"
+
+
+def test_manifest_corrupt_file_degrades_to_rerun(tmp_path):
+    """A torn/corrupt manifest must degrade to re-running rows (empty
+    account), never to skipping un-banked ones or crashing."""
+    p = tmp_path / "manifest.json"
+    p.write_text('{"rows": {"bench_first"')
+    assert manifest_mod.cashed_rows(str(p)) == set()
+    assert manifest_mod.main(["check", "bench_first",
+                              "--manifest", str(p)]) == 1
+
+
+def test_run_all_tpu_skips_cashed_rows_and_records_new_ones(tmp_path):
+    """run_all_tpu.sh end-to-end on a stubbed run() queue is too heavy
+    for the fast tier, but the shell's manifest contract is two CLI
+    calls — exercise exactly those through a fake row the way run()
+    issues them, against one manifest across two 'passes' (the
+    continue-the-round property)."""
+    p = str(tmp_path / "manifest.json")
+    log = tmp_path / "gpt.log"
+    # pass 1: the row wedges (timeout rc) -> owed
+    log.write_text("no json\n")
+    assert manifest_mod.main(["record", "gpt", "--manifest", p,
+                              "--log", str(log), "--rc", "124",
+                              "--pass", str(tmp_path / "pass1")]) == 1
+    assert manifest_mod.main(["check", "gpt", "--manifest", p]) == 1
+    # pass 2 (next window): the row lands healthy -> cashed, and a
+    # third pass's check now skips it
+    log.write_text("fine table output\n")
+    assert manifest_mod.main(["record", "gpt", "--manifest", p,
+                              "--log", str(log), "--rc", "0",
+                              "--pass", str(tmp_path / "pass2")]) == 0
+    assert manifest_mod.main(["check", "gpt", "--manifest", p]) == 0
+    entry = manifest_mod.load(p)["rows"]["gpt"]
+    assert entry["verdict"] == resilience.HEALTHY
+    assert entry["pass"] == "pass2"
+
+
+def test_manifest_probe_state_gates_rc_only_rows(tmp_path):
+    """A table-printing harness (no measurement line) that exits 0
+    inside a window whose LAST stamped probe was unhealthy must NOT be
+    banked as healthy — exit status alone cannot tell a device-speed
+    table from a ~40x tunnel-bound one. Measurement-line rows keep
+    their own classifier verdict regardless of the probe."""
+    degraded_probe = tmp_path / "probe_state"
+    degraded_probe.write_text(json.dumps(
+        {"ts": 1.0, "verdict": resilience.DEGRADED_RELAY, "rc": 1}))
+    healthy_probe = tmp_path / "probe_state_ok"
+    healthy_probe.write_text(json.dumps(
+        {"ts": 1.0, "verdict": resilience.HEALTHY, "rc": 0}))
+    # rc-only row: downgraded to the probe's verdict / banked when ok
+    assert manifest_mod.classify_row(
+        "table\n", 0, probe_state=str(degraded_probe)) \
+        == resilience.DEGRADED_RELAY
+    assert manifest_mod.classify_row(
+        "table\n", 0, probe_state=str(healthy_probe)) \
+        == resilience.HEALTHY
+    # absent/corrupt probe state never blocks a standalone run
+    assert manifest_mod.classify_row(
+        "table\n", 0, probe_state=str(tmp_path / "missing")) \
+        == resilience.HEALTHY
+    # a bench-style measurement line is never overridden by the probe
+    assert manifest_mod.classify_row(
+        json.dumps(HEALTHY_TPU_REC) + "\n", 0,
+        probe_state=str(degraded_probe)) == resilience.HEALTHY
+    # ...and the CLI wires --probe-state through
+    p = str(tmp_path / "manifest.json")
+    log = tmp_path / "gpt.log"
+    log.write_text("table output\n")
+    assert manifest_mod.main(
+        ["record", "gpt", "--manifest", p, "--log", str(log),
+         "--rc", "0", "--probe-state", str(degraded_probe)]) == 1
+    assert not manifest_mod.is_cashed(p, "gpt")

@@ -43,6 +43,19 @@ Checks:
    Runtime consults skip a malformed payload and fall back to the
    kernel heuristic; here it is a finding, so corruption cannot
    persist in the committed table.
+5. **Resume provenance** — a cited record carrying ``resumed_from``
+   (bench.py ``--resume`` / profile_gpt: the run restored a
+   checkpointed TrainState and continued) must pin-match: the
+   measurement pins saved in the checkpoint
+   (``resumed_from.pins``, filtered by
+   ``ledger.measurement_pins``) must equal the restored run's own
+   recorded ``knobs`` — a resumed timing row whose pins drifted is
+   mixing two configs under one label. And any paragraph making a
+   COLD-start claim ("cold start", "cold compile", "cold cache")
+   must not cite a resumed record at all: a run that restored state
+   is not a cold start, whatever its compile-cache counters say.
+   The same pin-match applies to dispatch-table entries citing
+   resumed records.
 
 New PERF.md table rows must cite their ledger record id in the caption
 (``ledger:<id>``) — uncited legacy paragraphs are not flagged, but they
@@ -71,6 +84,35 @@ OVERHEAD_RE = re.compile(
     r"dispatch overhead\s+([0-9]+(?:\.[0-9]+)?)"
     r"(?:\s*[–-]\s*([0-9]+(?:\.[0-9]+)?))?\s*ms")
 TOL_MS = 0.15  # captions round to 0.1 ms
+# check 5: a paragraph claiming a cold start must not cite a record
+# that restored checkpointed state (both hyphen and space spellings)
+COLD_RE = re.compile(r"\bcold[- ](?:start|compile|cache)", re.IGNORECASE)
+
+
+def resume_problems(rec, rid):
+    """Check-5 pin-match for one cited record carrying resume
+    provenance; [] when clean or not resumed. The comparison is the
+    SAME filter the provenance was stamped with
+    (``ledger.measurement_pins``), so infra knobs (paths, attempt
+    counters) can never count as drift while measurement knobs always
+    do."""
+    rf = rec.get("resumed_from")
+    if rf is None:
+        return []
+    if not isinstance(rf, dict) or not isinstance(rf.get("pins"), dict):
+        return [f"record {rid} carries malformed resume provenance"]
+    problems = []
+    # the ONE drift comparison (ledger.pin_drift, shared with the
+    # provenance producer) — both sides measurement-filtered
+    drift = ledger_mod.pin_drift(rf["pins"], rec.get("knobs"))
+    if drift:
+        detail = ", ".join(f"{k}: ckpt={s!r} run={n!r}"
+                           for k, (s, n) in sorted(drift.items()))
+        problems.append(
+            f"record {rid} resumed from checkpoint {rf.get('ckpt')} "
+            f"under DIFFERENT measurement pins ({detail}) — the row "
+            f"mixes two configs under one label")
+    return problems
 
 
 def _paragraphs(text):
@@ -133,6 +175,17 @@ def check_captions(perf_text, perf_path, records):
                     f"FAULT-INJECTED record (fault_plan="
                     f"{rec['fault_plan']}) — injected runs are not "
                     f"measurements")
+            # check 5: resume provenance — pin-match + cold-start gate
+            for p in resume_problems(rec, rid):
+                problems.append(f"{perf_path}:{lineno}: {p}")
+            if rec.get("resumed_from") is not None \
+                    and COLD_RE.search(para):
+                problems.append(
+                    f"{perf_path}:{lineno}: paragraph makes a cold-"
+                    f"start claim but cites ledger:{rid}, which "
+                    f"RESUMED from checkpoint "
+                    f"{rec['resumed_from'].get('ckpt') if isinstance(rec['resumed_from'], dict) else '?'}"
+                    f" — a restored run is not a cold start")
             if rec.get("dispatch_overhead_ms") is not None:
                 overheads[rid] = rec["dispatch_overhead_ms"]
         if not overheads:
@@ -202,6 +255,11 @@ def check_dispatch_table(path, records):
                 problems.append(
                     f"{tag}: cites FAULT-INJECTED record {rid} "
                     f"(fault_plan={rec['fault_plan']})")
+            if rec is not None:
+                # check 5 on the table side: a dispatch default decided
+                # by a resumed run must pin-match its checkpoint
+                for p in resume_problems(rec, rid):
+                    problems.append(f"{tag}: {p}")
     return problems, len(entries)
 
 
