@@ -9,6 +9,19 @@ mesh — fails with ``AttributeError: module 'jax' has no attribute
 'shard_map'`` before running anything. :func:`install` bridges exactly
 that gap and is a no-op wherever ``jax.shard_map`` already exists (the
 shim never shadows a real implementation).
+
+It is also the home of the **XLA analysis normalizers** the cost
+accounting layer (``apex_tpu.telemetry.costs``) consults: the
+``cost_analysis`` / ``memory_analysis`` surfaces differ by jax version
+AND backend — on jax 0.4.37 ``Lowered.cost_analysis()`` returns a flat
+dict, ``Compiled.cost_analysis()`` a LIST of per-computation dicts, and
+``Compiled.memory_analysis()`` a ``CompiledMemoryStats`` extension
+object (attributes, not keys); other versions/backends return None, a
+dict, or omit the method entirely. :func:`cost_analysis_dict` and
+:func:`memory_analysis_dict` fold every observed variant into one
+plain-dict shape (or None — "the backend can't report" is a value here,
+never an exception), so the cost block's producers degrade gracefully
+instead of version-forking at every call site.
 """
 
 import functools
@@ -64,3 +77,87 @@ def _install_axis_size():
         return env.axis_size(axis_name)
 
     lax.axis_size = axis_size
+
+
+# --------------------------------------------------------------------------
+# XLA cost/memory analysis normalizers (telemetry.costs feature detection)
+
+# CompiledMemoryStats attribute names → the one key set the cost block
+# speaks. Every field is device-side; the host_* twins are ignored.
+_MEMORY_FIELDS = (
+    "argument_size_in_bytes",
+    "output_size_in_bytes",
+    "temp_size_in_bytes",
+    "alias_size_in_bytes",
+    "generated_code_size_in_bytes",
+)
+
+
+def cost_analysis_dict(stage):
+    """One flat ``{metric: float}`` dict from a ``Lowered`` or
+    ``Compiled`` stage's ``cost_analysis()``, or None when the backend
+    can't report.
+
+    Observed variants, all folded here (jax 0.4.37 calibration):
+
+    * method absent (old stages, custom wrappers) → None
+    * returns None / raises (unimplemented backend) → None
+    * ``Lowered.cost_analysis()`` → a flat dict → passed through
+    * ``Compiled.cost_analysis()`` → a LIST of per-computation dicts
+      (one per partition/computation) → key-wise SUM across the list
+      (a multi-computation executable's flops are the total it runs)
+    * empty list / list of non-dicts → None
+    """
+    fn = getattr(stage, "cost_analysis", None)
+    if fn is None:
+        return None
+    try:
+        raw = fn()
+    except Exception:
+        return None
+    if isinstance(raw, dict):
+        return dict(raw) or None
+    if isinstance(raw, (list, tuple)):
+        dicts = [d for d in raw if isinstance(d, dict)]
+        if not dicts:
+            return None
+        out = {}
+        for d in dicts:
+            for k, v in d.items():
+                if isinstance(v, (int, float)):
+                    out[k] = out.get(k, 0) + v
+        return out or None
+    return None
+
+
+def memory_analysis_dict(compiled):
+    """One plain dict (``argument/output/temp/alias/generated_code
+    _size_in_bytes`` ints) from ``Compiled.memory_analysis()``, or None.
+
+    Folds: method absent → None; returns None / raises → None; a
+    ``CompiledMemoryStats`` extension object → attribute read; an
+    already-plain dict (some backends) → key filter. Missing individual
+    fields degrade to 0 (the stats object always carries the full set
+    on backends that report at all)."""
+    fn = getattr(compiled, "memory_analysis", None)
+    if fn is None:
+        return None
+    try:
+        raw = fn()
+    except Exception:
+        return None
+    if raw is None:
+        return None
+    out = {}
+    for field in _MEMORY_FIELDS:
+        v = raw.get(field) if isinstance(raw, dict) \
+            else getattr(raw, field, None)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[field] = int(v)
+        else:
+            out[field] = 0
+    if not any(out.values()):
+        # a stats object with every field 0 carries no information
+        # (e.g. a backend that stubs the surface) — report "can't"
+        return None
+    return out

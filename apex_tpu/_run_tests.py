@@ -41,7 +41,8 @@ SUITES = {
     "harness": ["test_run_tests.py", "test_bench_contract.py",
                 "test_compile_cache.py", "test_resilience.py"],
     "telemetry": ["test_telemetry.py", "test_bench_labels.py",
-                  "test_dispatch.py", "test_dispatch_tiles.py"],
+                  "test_dispatch.py", "test_dispatch_tiles.py",
+                  "test_costs.py", "test_window_report.py"],
     "api_audit": ["test_noop_knob_audit.py"],
     "checkpoint": ["test_checkpoint.py", "test_checkpoint_durable.py",
                    "test_checkpoint_chaos.py", "test_resume_parity.py"],

@@ -365,6 +365,70 @@ def legal(op, dims, dtype, params):
     return problems + _LEGAL[op](dims, dtype, known)
 
 
+def model_vmem_bytes(op, dims, dtype, params=None):
+    """The model's predicted VMEM working set (bytes) for a tile — the
+    same arithmetic :func:`legal` budgets against, exposed as a number
+    so it can be VALIDATED against XLA's accounting instead of only
+    asserted. ``params`` defaults to the heuristic tile. None when the
+    op/shape is unsupported or the dims are incomplete."""
+    params = params or default_params(op, dims, dtype)
+    if params is None or any(k not in dims for k in DIM_KEYS.get(op, ("_",))):
+        return None
+    if op in ("attention", "attention_bwd"):
+        sq, sk, d = dims["sq"], dims["sk"], dims["d"]
+        bq = params.get("bwd_block_q") or params.get("block_q") \
+            or attn_q_block(sq, sk)
+        if not bq:
+            return None
+        bk = params.get("block_k")
+        if bk:  # split k-major backward resident set (split_ok's model)
+            return (2 * sq * d * itemsize(dtype) + 3 * bq * bk * 4
+                    + 2 * bk * d * 4 + 3 * sq * 4)
+        return 4 * sk * ATTN_BWD_ARRAYS * bq
+    if op == "layer_norm":
+        br = params.get("block_rows") \
+            or ln_row_block(dims["rows"], dims["hidden"])
+        return 4 * dims["hidden"] * LN_BWD_ARRAYS * br if br else None
+    if op == "softmax":
+        br = params.get("block_rows") or sm_row_block(dims["sq"],
+                                                      dims["sk"])
+        return 4 * dims["sk"] * SM_BWD_ARRAYS * br if br else None
+    if op == "lm_head":
+        bv = xent_v_chunk(dims["v"])
+        budget = params.get("vmem_budget") or XENT_VMEM_BUDGET
+        br = params.get("row_block") \
+            or xent_row_block(dims["n"], dims["h"], bv, budget=budget)
+        if not bv or not br:
+            return None
+        h = dims["h"]
+        return 6 * bv * h + br * max(8 * h + 8 * bv, 6 * h + 10 * bv)
+    return None
+
+
+def compare_vmem(op, dims, dtype, params, xla_bytes):
+    """Validation hook: the model's predicted working set vs XLA's
+    measured number for the same kernel program (e.g. the ``cost``
+    block's ``memory.temp_size_in_bytes`` captured by
+    ``apex_tpu.telemetry.costs`` off an AOT-compiled kernel scan).
+
+    Returns ``{"model_bytes", "xla_bytes", "ratio", "within"}`` or None
+    when either side can't report. ``within`` is a coarse 4x band in
+    either direction — XLA's temp accounting includes pipeline
+    double-buffering, layout padding and fusion scratch the model
+    deliberately ignores, so the hook catches ORDER-OF-MAGNITUDE model
+    drift (the failure mode that would let a "legal" tile spill), not
+    byte equality. A committed tighter band needs a device measurement
+    first (measured dispatch, not asserted dispatch)."""
+    model = model_vmem_bytes(op, dims, dtype, params)
+    if model is None or not isinstance(xla_bytes, (int, float)) \
+            or xla_bytes <= 0:
+        return None
+    ratio = float(xla_bytes) / float(model)
+    return {"model_bytes": int(model), "xla_bytes": int(xla_bytes),
+            "ratio": round(ratio, 3),
+            "within": 0.25 <= ratio <= 4.0}
+
+
 def default_params(op, dims, dtype):
     """The shipped heuristic's tile for these dims — what the kernel
     picks with no knob set (the sweep's incumbent). None when the

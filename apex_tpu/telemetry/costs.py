@@ -1,0 +1,376 @@
+"""Static cost/memory/communication accounting — the attribution layer.
+
+The repo can measure (PR 1), dispatch (PR 3/5) and survive the relay
+(PR 4), but a number like "38.7% MFU at b=8" carries no attribution:
+is the gap to the 0.45 goal compute-bound, HBM-bound, or tunnel-bound,
+and which slice owns it? This module derives, for every AOT-lowered
+bench/harness program, a validated **cost block** from XLA's own
+analyses — no measurement, no device time, no change to the measured
+program (the analyses read the lowered/compiled artifact; PR-1's
+disabled-is-free invariant holds trivially: the traced jaxpr is
+byte-identical whether or not anyone asks XLA to count its flops).
+
+The block (:func:`build`; schema policed by :func:`validate`, wired
+into ``ledger.validate_record``)::
+
+    {"source": "compiled"|"lowered"|None,   # what XLA surface reported
+     "steps": K,                            # scan length (metadata —
+                                            # XLA counts the body ONCE)
+     "xla_flops_per_step":   ...,  # XLA-counted flops (real HLO work)
+     "model_flops_per_step": ...,  # the 6·N·tokens an MFU claim uses
+     "hbm_bytes_per_step":   ...,  # bytes moved ("bytes accessed")
+     "peak_hbm_bytes":       ...,  # arg+out+temp+code − alias
+     "memory": {...},              # the raw memory_analysis fields
+     "comm_bytes_per_axis": {...}, # collective payload per mesh axis
+     "peak_flops": ..., "hbm_bytes_per_s": ...,   # roofline constants
+     "compute_floor_ms": ..., "bandwidth_floor_ms": ...,
+     "step_floor_ms": ...,         # max(compute, bandwidth) floor
+     "mfu_bound": ...}             # model flops at the floor ÷ peak
+
+Every field degrades to None where the backend can't report (the
+``_compat`` normalizers fold the per-version/backend shape differences:
+absent method, None return, flat dict, list-of-dicts, extension
+object) — a cost block is *always* stampable, never a crash.
+
+Comm accounting (:func:`comm_from_jaxpr`) counts collective payload
+bytes per mesh axis from the jaxpr — psum/pmean/all_gather/
+reduce_scatter/ppermute/all_to_all operand bytes, scan bodies
+multiplied by their trip count. "Payload" = per-participant operand
+bytes, NOT wire bytes (a ring all-reduce moves ~2(n−1)/n× payload);
+the number is the telemetry prerequisite for quantized-collective
+work (ROADMAP item 3), where payload shrinkage is exactly the claim.
+
+Predicted peak HBM drives the §6 starvation economics BEFORE a row
+burns window time: :func:`starvation` flags a program whose predicted
+peak exceeds the chip (hard infeasible) or the operator-set
+``APEX_STARVE_HBM_BYTES`` threshold (the relay's observed large-HBM
+starvation mode sits between the b=8 and b=16 working sets; the
+threshold is a knob, not an asserted constant, until a window measures
+it — measured dispatch, not asserted dispatch).
+
+Stdlib-only at import (like ``ledger``): jax and ``_compat`` load
+lazily inside the capture functions, so the ledger's validators and
+``tools/window_report.py`` never touch a backend.
+"""
+
+import os
+
+# ------------------------------------------------- chip roofline envelope
+# The ONE home of the v5e constants the harnesses previously inlined
+# (bench.py / profile_*.py `peak_flops = 197e12`): an MFU claim and its
+# cost block must divide by the same peak.
+V5E_PEAK_BF16_FLOPS = 197e12
+V5E_HBM_BYTES_PER_S = 819e9       # v5e HBM bandwidth
+V5E_HBM_CAPACITY_BYTES = 16 * 2 ** 30
+
+_NUMERIC_FIELDS = (
+    "xla_flops_per_step", "model_flops_per_step", "hbm_bytes_per_step",
+    "peak_hbm_bytes", "peak_flops", "hbm_bytes_per_s",
+    "compute_floor_ms", "bandwidth_floor_ms", "step_floor_ms",
+    "mfu_bound",
+)
+FIELDS = ("source", "steps", "memory", "comm_bytes_per_axis") \
+    + _NUMERIC_FIELDS
+
+_MEMORY_KEYS = ("argument_size_in_bytes", "output_size_in_bytes",
+                "temp_size_in_bytes", "alias_size_in_bytes",
+                "generated_code_size_in_bytes")
+
+# collective primitives counted by comm_from_jaxpr; pmean/pmax/pmin
+# lower to (or are) reductions over the same axes as psum
+_COLLECTIVES = ("psum", "pmean", "pmax", "pmin", "all_gather",
+                "all_to_all", "ppermute", "reduce_scatter",
+                "psum_scatter")
+
+
+def peak_flops_for(platform):
+    """The bf16 roofline peak an MFU on this platform divides by (None
+    when the repo has no committed envelope — CPU smoke numbers carry
+    no MFU, same rule as bench.py)."""
+    return V5E_PEAK_BF16_FLOPS if platform == "tpu" else None
+
+
+def hbm_bw_for(platform):
+    return V5E_HBM_BYTES_PER_S if platform == "tpu" else None
+
+
+def hbm_capacity_for(platform):
+    return V5E_HBM_CAPACITY_BYTES if platform == "tpu" else None
+
+
+def requested():
+    """Tri-state ``APEX_COST_ANALYSIS``: True ("1"), False ("0"), or
+    None (unset — the caller's default applies). A process-wide
+    preference, never a raise (CLAUDE.md knob asymmetry; same parsing
+    as ``compile_cache.requested``)."""
+    v = os.environ.get("APEX_COST_ANALYSIS")
+    if v == "1":
+        return True
+    if v == "0":
+        return False
+    return None
+
+
+def enabled(default=True):
+    """Whether to run the XLA captures. Real runs default ON; smoke
+    callers pass ``default=False`` (a CPU sanity run should not pay
+    extra host traces for numbers nobody cites — mirroring the
+    ledger's and compile cache's smoke rule). Disabled still stamps
+    the all-None block: degradation, never omission."""
+    r = requested()
+    return bool(default) if r is None else r
+
+
+def null_block():
+    """The all-None degradation: the backend (or the escape hatch)
+    reported nothing, and the record says so explicitly instead of
+    omitting the block."""
+    block = {k: None for k in FIELDS}
+    return block
+
+
+def build(xla_flops=None, hbm_bytes=None, memory=None, comm=None,
+          steps=None, model_flops_per_step=None, platform=None,
+          source=None):
+    """Assemble a validated cost block from XLA's reported numbers.
+
+    ``xla_flops`` / ``hbm_bytes`` are the analyses' reported counts,
+    which are PER-STEP already for a K-step ``lax.scan`` program: XLA
+    counts a loop body ONCE, not × trip count (calibrated on this
+    container's jax 0.4.37, Lowered and Compiled both — a 16-step scan
+    of a 2·64³-flop matmul reports 524,290 flops, one body plus loop
+    overhead; asserted by tests/test_costs.py so a jax that changes the
+    counting fails loudly instead of silently re-breaking attribution).
+    ``steps`` is metadata — the scan length of the analyzed program,
+    NOT a divisor. ``memory`` is the normalized memory_analysis dict;
+    ``comm`` the per-axis payload dict (per step — the caller divides
+    its whole-program jaxpr walk by the scan length, since
+    ``comm_from_jaxpr`` DOES multiply bodies by trip count). Floors and
+    the MFU bound are derived where the inputs allow, None elsewhere."""
+    block = null_block()
+    block["source"] = source
+    steps = int(steps) if steps else None
+    block["steps"] = steps
+    if xla_flops is not None:
+        block["xla_flops_per_step"] = float(xla_flops)
+    if hbm_bytes is not None:
+        block["hbm_bytes_per_step"] = float(hbm_bytes)
+    if model_flops_per_step is not None:
+        block["model_flops_per_step"] = float(model_flops_per_step)
+    if isinstance(memory, dict):
+        block["memory"] = {k: memory.get(k) for k in _MEMORY_KEYS}
+        block["peak_hbm_bytes"] = max(0, (
+            (memory.get("argument_size_in_bytes") or 0)
+            + (memory.get("output_size_in_bytes") or 0)
+            + (memory.get("temp_size_in_bytes") or 0)
+            + (memory.get("generated_code_size_in_bytes") or 0)
+            - (memory.get("alias_size_in_bytes") or 0)))
+    if isinstance(comm, dict):
+        block["comm_bytes_per_axis"] = {str(k): float(v)
+                                        for k, v in sorted(comm.items())}
+    peak = peak_flops_for(platform)
+    bw = hbm_bw_for(platform)
+    block["peak_flops"] = peak
+    block["hbm_bytes_per_s"] = bw
+    if peak and block["xla_flops_per_step"] is not None:
+        block["compute_floor_ms"] = round(
+            block["xla_flops_per_step"] / peak * 1e3, 6)
+    if bw and block["hbm_bytes_per_step"] is not None:
+        block["bandwidth_floor_ms"] = round(
+            block["hbm_bytes_per_step"] / bw * 1e3, 6)
+    floors = [f for f in (block["compute_floor_ms"],
+                          block["bandwidth_floor_ms"]) if f is not None]
+    if floors:
+        block["step_floor_ms"] = max(floors)
+        mf = block["model_flops_per_step"] or block["xla_flops_per_step"]
+        if mf and peak and block["step_floor_ms"] > 0:
+            block["mfu_bound"] = round(
+                mf / (block["step_floor_ms"] / 1e3) / peak, 4)
+    return block
+
+
+def capture(lowered=None, compiled=None, steps=1, comm=None,
+            model_flops_per_step=None, platform=None):
+    """The capture path: feature-detected ``cost_analysis`` /
+    ``memory_analysis`` off an AOT stage pair, folded into one block.
+
+    ``compiled`` is preferred (its analyses see the optimized
+    executable, and only it carries memory_analysis); ``lowered``
+    degrades to flops/bytes only. Never raises; with the escape hatch
+    thrown (or no stage at all) returns the all-None block."""
+    if not enabled() or (lowered is None and compiled is None):
+        return build(comm=comm, steps=steps,
+                     model_flops_per_step=model_flops_per_step,
+                     platform=platform, source=None)
+    try:
+        from apex_tpu import _compat
+    except Exception:
+        return null_block()
+    ca = ma = None
+    source = None
+    if compiled is not None:
+        ca = _compat.cost_analysis_dict(compiled)
+        ma = _compat.memory_analysis_dict(compiled)
+        if ca is not None or ma is not None:
+            source = "compiled"
+    if ca is None and lowered is not None:
+        ca = _compat.cost_analysis_dict(lowered)
+        if ca is not None and source is None:
+            source = "lowered"
+    return build(
+        xla_flops=ca.get("flops") if ca else None,
+        hbm_bytes=ca.get("bytes accessed") if ca else None,
+        memory=ma, comm=comm, steps=steps,
+        model_flops_per_step=model_flops_per_step, platform=platform,
+        source=source)
+
+
+# --------------------------------------------------------- comm accounting
+
+def _aval_bytes(var):
+    aval = getattr(var, "aval", None)
+    size = getattr(aval, "size", None)
+    dtype = getattr(aval, "dtype", None)
+    if size is None or dtype is None:
+        return 0
+    return int(size) * int(getattr(dtype, "itemsize", 0) or 0)
+
+
+def _eqn_axes(params):
+    axes = params.get("axes", params.get("axis_name"))
+    if axes is None:
+        return ()
+    if isinstance(axes, (tuple, list)):
+        return tuple(a for a in axes if isinstance(a, (str, int)))
+    return (axes,)
+
+
+def comm_from_jaxpr(jaxpr):
+    """Per-mesh-axis collective payload bytes in a (Closed)Jaxpr.
+
+    Walks every equation, recursing into sub-jaxprs (pjit/shard_map
+    bodies, cond branches) and multiplying scan/while bodies by their
+    static trip count where known (a microbatch loop's collectives
+    happen once per microbatch per step). Payload = summed operand
+    array bytes, attributed to EACH named axis of the eqn (a
+    two-axis psum moves the payload on both meshes). Returns
+    ``{axis_name: bytes}`` — empty dict = traced, no collectives;
+    never raises (a jaxpr shape this walker doesn't know contributes
+    nothing rather than crashing a harness)."""
+    totals = {}
+
+    def visit(jxp, mult):
+        eqns = getattr(jxp, "eqns", None)
+        if eqns is None:  # ClosedJaxpr
+            inner = getattr(jxp, "jaxpr", None)
+            if inner is None:
+                return
+            return visit(inner, mult)
+        for eqn in eqns:
+            name = getattr(eqn.primitive, "name", "")
+            if name in _COLLECTIVES:
+                nbytes = sum(_aval_bytes(v) for v in eqn.invars) * mult
+                for ax in _eqn_axes(eqn.params):
+                    ax = str(ax)
+                    totals[ax] = totals.get(ax, 0) + nbytes
+            # trip-count multiplier for loop bodies
+            inner_mult = mult
+            if name == "scan":
+                length = eqn.params.get("length")
+                if isinstance(length, int) and length > 0:
+                    inner_mult = mult * length
+            for p in eqn.params.values():
+                for sub in _sub_jaxprs(p):
+                    visit(sub, inner_mult)
+
+    def _sub_jaxprs(p):
+        if hasattr(p, "eqns") or hasattr(p, "jaxpr"):
+            yield p
+        elif isinstance(p, (tuple, list)):
+            for item in p:
+                if hasattr(item, "eqns") or hasattr(item, "jaxpr"):
+                    yield item
+
+    try:
+        visit(jaxpr, 1)
+    except Exception:
+        return {}
+    return {k: int(v) for k, v in totals.items()}
+
+
+# --------------------------------------------------- starvation economics
+
+def starve_threshold():
+    """Operator-set predicted-peak-HBM starvation threshold in bytes
+    (``APEX_STARVE_HBM_BYTES``; None = no committed threshold yet —
+    the §6 mode's boundary is unmeasured, so nothing is flagged by
+    default: measured dispatch, not asserted dispatch)."""
+    v = os.environ.get("APEX_STARVE_HBM_BYTES")
+    if v and v.isdigit() and int(v) > 0:
+        return int(v)
+    return None
+
+
+def starvation(peak_hbm_bytes, platform=None):
+    """Pre-flight verdict for a program's predicted peak HBM:
+    ``"exceeds-hbm"`` (hard infeasible on the chip),
+    ``"starvation-risk"`` (above the operator-set §6 threshold), or
+    None (no flag / nothing to judge)."""
+    if not isinstance(peak_hbm_bytes, (int, float)) or peak_hbm_bytes <= 0:
+        return None
+    cap = hbm_capacity_for(platform)
+    if cap and peak_hbm_bytes > cap:
+        return "exceeds-hbm"
+    thresh = starve_threshold()
+    if thresh and peak_hbm_bytes > thresh:
+        return "starvation-risk"
+    return None
+
+
+# -------------------------------------------------------------- validation
+
+def validate(block):
+    """Schema problems for one cost block (empty list = clean). Fed by
+    ``ledger.validate_record`` for every record carrying ``cost`` —
+    a malformed block could silently mis-attribute a headline gap."""
+    problems = []
+    if not isinstance(block, dict):
+        return ["cost is not a dict"]
+    for field in FIELDS:
+        if field not in block:
+            problems.append(f"missing field {field!r}")
+    for field in _NUMERIC_FIELDS:
+        v = block.get(field)
+        if v is not None and (not isinstance(v, (int, float))
+                              or isinstance(v, bool) or v < 0):
+            problems.append(f"{field} is not a non-negative number")
+    src = block.get("source")
+    if src is not None and src not in ("compiled", "lowered"):
+        problems.append(f"source {src!r} not in ('compiled', 'lowered')")
+    steps = block.get("steps")
+    if steps is not None and (not isinstance(steps, int)
+                              or isinstance(steps, bool) or steps <= 0):
+        problems.append("steps is not a positive int")
+    mem = block.get("memory")
+    if mem is not None:
+        if not isinstance(mem, dict):
+            problems.append("memory is not a dict")
+        else:
+            for k in _MEMORY_KEYS:
+                v = mem.get(k)
+                if v is not None and (not isinstance(v, int)
+                                      or isinstance(v, bool) or v < 0):
+                    problems.append(
+                        f"memory.{k} is not a non-negative int")
+    comm = block.get("comm_bytes_per_axis")
+    if comm is not None:
+        if not isinstance(comm, dict):
+            problems.append("comm_bytes_per_axis is not a dict")
+        else:
+            for k, v in comm.items():
+                if not isinstance(k, str) or not isinstance(
+                        v, (int, float)) or isinstance(v, bool) or v < 0:
+                    problems.append(
+                        f"comm_bytes_per_axis[{k!r}] is not a "
+                        f"non-negative number")
+    return problems

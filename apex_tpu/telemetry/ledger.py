@@ -62,6 +62,7 @@ INFRA_KNOB_PREFIXES = (
     "APEX_TELEMETRY_LEDGER", "APEX_TELEMETRY_PATH",
     "APEX_COMPILE_CACHE", "APEX_WARM_ONLY", "APEX_WARM_TIMEOUT",
     "APEX_PROBE_", "APEX_FAULT_PLAN", "APEX_COLLECT_MANIFEST",
+    "APEX_PROFILE_", "APEX_COST_ANALYSIS",
 )
 
 
@@ -260,6 +261,22 @@ def validate_record(rec):
                     isinstance(ck["last_step"], int)
                     and not isinstance(ck["last_step"], bool)):
                 problems.append("checkpoint.last_step is not an int")
+    prof = rec.get("profile")
+    if prof is not None:
+        # the profiler artifact stamp (telemetry.profiling): a capture
+        # whose hash/extent fields are malformed could pass off an
+        # edited trace as the one the record captured
+        from apex_tpu.telemetry import profiling as _profiling
+
+        problems += _profiling.validate_block(prof)
+    cost = rec.get("cost")
+    if cost is not None:
+        # the attribution block (apex_tpu.telemetry.costs): a malformed
+        # one could silently mis-attribute a headline gap (wrong floor,
+        # wrong MFU bound) — same teeth as the compile_cache block
+        from apex_tpu.telemetry import costs as _costs
+
+        problems += [f"cost: {p}" for p in _costs.validate(cost)]
     rf = rec.get("resumed_from")
     if rf is not None:
         # resume provenance (bench.py --resume / profile_gpt): rides
@@ -284,3 +301,106 @@ def validate_record(rec):
                 f"id {rec['id']!r} does not match record content "
                 f"(expected {want!r}) — record edited after the fact?")
     return problems
+
+
+# ------------------------------------------------------- inspection CLI
+# ``python -m apex_tpu.telemetry.ledger status|tail|show <id>`` — until
+# now the only ledger reader was the checker; a window operator (or the
+# window-economics report) should not need a JSON one-liner to ask
+# "what did this round record". Read-only; never writes the ledger.
+
+
+def _summary_line(rec):
+    """One human line per record: id, harness, platform, ts, verdict-ish
+    marks (relay stamp / fault stamp / value / span count)."""
+    import datetime
+
+    ts = rec.get("ts")
+    when = "?"
+    if isinstance(ts, (int, float)):
+        when = datetime.datetime.fromtimestamp(ts).strftime(
+            "%Y-%m-%d %H:%M:%S")
+    marks = []
+    relay = rec.get("relay") or {}
+    if isinstance(relay, dict) and relay.get("degraded"):
+        marks.append(f"degraded:{relay.get('kind')}")
+    if rec.get("fault_plan"):
+        marks.append(f"INJECTED:{rec['fault_plan']}")
+    if rec.get("value") is not None:
+        marks.append(f"value={rec['value']}")
+    if rec.get("mfu") is not None:
+        marks.append(f"mfu={rec['mfu']}")
+    spans = rec.get("spans")
+    if isinstance(spans, list):
+        marks.append(f"{len(spans)} span(s)")
+    cost = rec.get("cost")
+    if isinstance(cost, dict) and cost.get("peak_hbm_bytes"):
+        marks.append(f"peak_hbm={cost['peak_hbm_bytes'] / 2 ** 20:.0f}MiB")
+    return (f"{rec.get('id', '?'):14s} {when}  "
+            f"{str(rec.get('harness', '?')):22s} "
+            f"{str(rec.get('platform', '?')):4s} "
+            f"{' '.join(marks)}").rstrip()
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m apex_tpu.telemetry.ledger",
+        description="Inspect the run ledger (read-only).")
+    ap.add_argument("--ledger", default=None,
+                    help="ledger path (default: APEX_TELEMETRY_LEDGER "
+                         "or benchmarks/ledger.jsonl)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("status", help="record counts + schema findings")
+    tail = sub.add_parser("tail", help="last N record summaries")
+    tail.add_argument("n", nargs="?", type=int, default=10)
+    show = sub.add_parser("show", help="pretty-print one record")
+    show.add_argument("id", help="record id (lg-...)")
+    args = ap.parse_args(argv)
+
+    path = args.ledger or ledger_path()
+    try:
+        records = read_ledger(path)
+    except FileNotFoundError:
+        print(f"no ledger at {path}")
+        return 1
+    except ValueError as e:
+        print(f"CORRUPT: {e}")
+        return 1
+
+    if args.cmd == "status":
+        by_harness, problems, injected = {}, 0, 0
+        for rec in records:
+            h = rec.get("harness", "?")
+            by_harness[h] = by_harness.get(h, 0) + 1
+            if validate_record(rec):
+                problems += 1
+            if rec.get("fault_plan"):
+                injected += 1
+        print(f"{path}: {len(records)} record(s)")
+        for h in sorted(by_harness):
+            print(f"  {h:24s} {by_harness[h]}")
+        print(f"  schema findings: {problems}; fault-injected: {injected}")
+        return 1 if problems else 0
+    if args.cmd == "tail":
+        # n<=0 prints nothing (records[-0:] would be the WHOLE ledger)
+        for rec in records[-args.n:] if args.n > 0 else []:
+            print(_summary_line(rec))
+        return 0
+    # show <id>
+    for rec in records:
+        if rec.get("id") == args.id:
+            print(json.dumps(rec, indent=2, sort_keys=True))
+            problems = validate_record(rec)
+            for p in problems:
+                print(f"FINDING: {p}")
+            return 1 if problems else 0
+    print(f"no record {args.id!r} in {path}")
+    return 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

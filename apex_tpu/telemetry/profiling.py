@@ -1,0 +1,183 @@
+"""Budgeted on-device profiler capture (``APEX_PROFILE_CAPTURE=1``).
+
+Static cost accounting (``telemetry.costs``) says what a program
+*should* cost; only a device trace says where its time actually went.
+But a profiler trace perturbs the traced run and the relay can wedge
+mid-capture — so a capture must NEVER ride the scored attempt. The
+contract, enforced by bench.py's watchdog:
+
+* the watchdog runs the capture as a SEPARATE subprocess
+  (``APEX_PROFILE_INNER=1``) after the scored attempts complete, under
+  the resilience timeout envelope (:func:`timeout_s` — a wedged
+  capture costs a bounded slice of the window, never the window);
+* the capture child re-runs the measured program's warm scan, then
+  traces K' post-warmup steps (one more scan dispatch) inside
+  ``jax.profiler.trace`` — nothing it produces is a measurement, and
+  its ledger record says so (harness ``bench_profile``, no ``value``);
+* the artifact directory + a content hash are stamped into the ledger
+  (:func:`artifact_block`), so a PERF.md attribution claim can name
+  the exact trace it read — tamper-evidently, like every other stamp;
+* a capture is REFUSED outright under ``APEX_FAULT_PLAN`` (like the
+  collection shells and the scored artifacts: an injected run's trace
+  must not land next to real ones).
+
+Feature detection: ``jax.profiler.trace`` is absent or non-functional
+on some backends — :func:`trace` degrades to a no-op context and the
+artifact block reports zero files (a "can't report" value, never a
+crash). Knobs: ``APEX_PROFILE_CAPTURE=1`` arms the watchdog hook;
+``APEX_PROFILE_DIR`` overrides the artifact root (default
+``benchmarks/profiles/``, git-ignored); ``APEX_PROFILE_TIMEOUT``
+overrides the subprocess budget.
+"""
+
+import contextlib
+import hashlib
+import os
+import time
+
+from apex_tpu.telemetry.ledger import repo_root
+
+DEFAULT_TIMEOUT_S = 900  # matches the resilience wedge cap: a capture
+#                          is upside, never worth more than a capped
+#                          attempt's budget
+
+
+def requested():
+    """True when the operator armed the watchdog's capture hook."""
+    return os.environ.get("APEX_PROFILE_CAPTURE") == "1"
+
+
+def capture_active():
+    """True inside the capture CHILD (``APEX_PROFILE_INNER=1`` — set
+    only by the watchdog hook; the scored inner attempts never see
+    it)."""
+    return os.environ.get("APEX_PROFILE_INNER") == "1"
+
+
+def refusal():
+    """Reason string when a capture must be refused, else None. Mirrors
+    the collection shells' APEX_FAULT_PLAN gate: profiler artifacts are
+    refused under injection like every other scored artifact."""
+    try:
+        from apex_tpu.resilience import faults
+
+        if faults.active():
+            return ("APEX_FAULT_PLAN is set (fault injection is "
+                    "test-only; a profiler artifact must never be "
+                    "captured under injection)")
+    except Exception:
+        pass
+    return None
+
+
+def timeout_s():
+    """The capture subprocess budget (the resilience timeout envelope:
+    ``APEX_PROFILE_TIMEOUT`` override, :data:`DEFAULT_TIMEOUT_S`
+    default)."""
+    v = os.environ.get("APEX_PROFILE_TIMEOUT")
+    if v and v.isdigit() and int(v) > 0:
+        return int(v)
+    return DEFAULT_TIMEOUT_S
+
+
+def profile_root():
+    return os.environ.get("APEX_PROFILE_DIR") or os.path.join(
+        repo_root(), "benchmarks", "profiles")
+
+
+def new_capture_dir(label="capture"):
+    """A fresh artifact directory under the profile root; created
+    eagerly so the trace has somewhere to land."""
+    d = os.path.join(profile_root(),
+                     f"{label}-{time.strftime('%Y%m%d-%H%M%S')}-"
+                     f"{os.getpid()}")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+@contextlib.contextmanager
+def trace(outdir):
+    """``jax.profiler.trace`` with feature detection: yields True when
+    a real trace is active, False when the surface is absent/broken
+    (the body still runs — a capture child that can't trace still
+    exercises the program and reports an empty artifact block)."""
+    cm = None
+    try:
+        import jax.profiler
+
+        cm = jax.profiler.trace(outdir)
+        cm.__enter__()
+    except Exception:
+        cm = None
+    try:
+        yield cm is not None
+    finally:
+        if cm is not None:
+            try:
+                cm.__exit__(None, None, None)
+            except Exception:
+                pass
+
+
+def artifact_block(outdir):
+    """The ledger stamp for one capture: ``{dir, files, bytes,
+    sha256}``. The hash covers every file's relative path + content in
+    sorted order, so a trace edited (or truncated) after the fact no
+    longer matches its stamped record — same tamper-evidence rule as
+    the record ids themselves. Never raises; an unreadable dir reports
+    zero files."""
+    files, total = [], 0
+    h = hashlib.sha256()
+    try:
+        for root, _, names in sorted(os.walk(outdir)):
+            for name in sorted(names):
+                p = os.path.join(root, name)
+                rel = os.path.relpath(p, outdir)
+                # chunked read: device traces run to hundreds of MB and
+                # the 1-core collection host hashes them while the
+                # window is still open — never hold a whole artifact.
+                # Feed a COPY and commit on success, so a file whose
+                # read fails midway contributes nothing to the digest
+                # (same all-or-nothing rule as the whole-read it
+                # replaces).
+                trial = h.copy()
+                trial.update(rel.encode())
+                nbytes = 0
+                try:
+                    with open(p, "rb") as f:
+                        while True:
+                            chunk = f.read(1 << 20)
+                            if not chunk:
+                                break
+                            trial.update(chunk)
+                            nbytes += len(chunk)
+                except OSError:
+                    continue
+                h = trial
+                files.append(rel)
+                total += nbytes
+    except OSError:
+        pass
+    return {"dir": outdir, "files": len(files), "bytes": total,
+            "sha256": h.hexdigest() if files else None}
+
+
+def validate_block(block):
+    """Schema problems for a ``profile`` artifact block (ledger
+    teeth, like the compile_cache/cost blocks)."""
+    if not isinstance(block, dict):
+        return ["profile is not a dict"]
+    problems = []
+    if not isinstance(block.get("dir"), str):
+        problems.append("profile.dir is not a string")
+    for k in ("files", "bytes"):
+        v = block.get(k)
+        if not (isinstance(v, int) and not isinstance(v, bool)
+                and v >= 0):
+            problems.append(f"profile.{k} is not a non-negative int")
+    sha = block.get("sha256")
+    if sha is not None and not (isinstance(sha, str) and len(sha) == 64):
+        problems.append("profile.sha256 is not a sha256 hex digest")
+    if block.get("files") and sha is None:
+        problems.append("profile has files but no content hash")
+    return problems

@@ -172,14 +172,46 @@ class Tracer:
                 self.overhead = measure_dispatch_overhead(self.k)
         self.peak_flops = peak_flops
         self.spans = []
+        # the run-level attribution block (apex_tpu.telemetry.costs):
+        # set by the first capture_cost=True row (or set_cost); flushed
+        # with every ledger record — null-degraded when nothing captured
+        self.cost = None
 
     @property
     def overhead_ms(self):
         return self.overhead * 1e3
 
+    def _capture_cost(self, call, args, flops_per_iter, compiled=None):
+        """Attribution block for one measured program (cost_analysis /
+        memory_analysis via apex_tpu.telemetry.costs): ``compiled`` is
+        the free-harvest path (the warm mode already paid for the AOT
+        object); otherwise one extra host-side ``call.lower`` trace,
+        compiled only where that is a persistent-cache read — never a
+        second cold compile through the relay. Never raises; the first
+        captured block becomes the run-level ``self.cost``."""
+        from apex_tpu import compile_cache
+        from apex_tpu.telemetry import costs
+
+        platform = jax.devices()[0].platform
+        lowered = None
+        try:
+            if compiled is None and hasattr(call, "lower"):
+                lowered = call.lower(*args)
+                if compile_cache.enabled():
+                    compiled = lowered.compile()
+        except Exception:
+            pass
+        block = costs.capture(lowered=lowered, compiled=compiled,
+                              steps=self.k,
+                              model_flops_per_step=flops_per_iter,
+                              platform=platform)
+        if self.cost is None:
+            self.cost = block
+        return block
+
     def time_call(self, name, call, warm_args, timed_args,
                   flops_per_iter=None, extra=None, on_fail="raise",
-                  sync_out=sync):
+                  sync_out=sync, capture_cost=False):
         """Warm (compile + drain) with ``warm_args``, then time one
         dispatch of ``call(*timed_args)``; per-iteration time = (wall -
         overhead) / K. The two argument tuples must differ in a traced
@@ -198,15 +230,25 @@ class Tracer:
 
         if compile_cache.warm_only():
             try:
+                warm_cost = None
                 if hasattr(call, "lower"):
-                    info, _ = compile_cache.warm(call, warm_args)
+                    info, compiled = compile_cache.warm(call, warm_args)
+                    if capture_cost:
+                        # free harvest: the warm already paid for the
+                        # Compiled object (bench's warm path does the
+                        # same — predicted peak HBM before any dispatch)
+                        warm_cost = self._capture_cost(
+                            call, warm_args, flops_per_iter,
+                            compiled=compiled)
                 else:
                     sync_out(call(*warm_args))
                     info = {"executed": True}
                 span = Span(name, None, None, self.k, self.overhead,
                             flops_per_iter=flops_per_iter,
                             extra=dict(extra or {}, warm_only=True,
-                                       warm=info))
+                                       warm=info,
+                                       **({"cost": warm_cost}
+                                          if warm_cost else {})))
             except Exception as e:
                 if on_fail != "span":
                     raise
@@ -230,14 +272,21 @@ class Tracer:
         t0 = time.perf_counter()
         sync_out(call(*timed_args))
         total = time.perf_counter() - t0
+        span_extra = dict(extra or {})
+        if capture_cost:
+            # AFTER the timed region: the lower/compile are host work
+            # that must never straddle t0 (the calibration-flap class)
+            span_extra["cost"] = self._capture_cost(call, warm_args,
+                                                    flops_per_iter)
         span = Span(name, (total - self.overhead) / self.k, total, self.k,
                     self.overhead, flops_per_iter=flops_per_iter,
-                    extra=dict(extra or {}))
+                    extra=span_extra)
         self.spans.append(span)
         return span
 
     def scan_time(self, name, make_body, carry0, ops, wrap=None,
-                  flops_per_iter=None, extra=None, on_fail="raise"):
+                  flops_per_iter=None, extra=None, on_fail="raise",
+                  capture_cost=False):
         """The §0 protocol in one call. ``make_body(eps, *ops)`` returns
         ``body(carry, t) -> (carry, metric)``; ``ops`` (big arrays) are
         jit ARGUMENTS — closure-captured constants would be inlined into
@@ -253,7 +302,8 @@ class Tracer:
         return self.time_call(
             name, f, (carry0, jnp.float32(0.0)) + tuple(ops),
             (carry0, jnp.float32(1e-30)) + tuple(ops),
-            flops_per_iter=flops_per_iter, extra=extra, on_fail=on_fail)
+            flops_per_iter=flops_per_iter, extra=extra, on_fail=on_fail,
+            capture_cost=capture_cost)
 
     def flush_ledger(self, harness, platform=None, relay=None, extra=None,
                      path=None):
@@ -272,9 +322,16 @@ class Tracer:
             return None
         if platform is None:
             platform = jax.devices()[0].platform
+        from apex_tpu.telemetry import costs
+
         payload = {"spans": [s.as_record() for s in self.spans],
                    "compile_cache": compile_cache.snapshot(),
-                   "dispatch": dispatch.snapshot()}
+                   "dispatch": dispatch.snapshot(),
+                   # every Tracer record carries a validated cost block:
+                   # the first capture_cost=True row's, or the explicit
+                   # all-None degradation (never a silent omission)
+                   "cost": self.cost if self.cost is not None
+                   else costs.null_block()}
         payload.update(extra or {})
         return ledger.append_record(
             harness=harness, platform=platform,

@@ -398,6 +398,49 @@ def reference_training(cfg, pp, batch, num_steps, lr=1e-4, device=None):
             [float(x) for x in np.asarray(gnorms)])
 
 
+def training_comm_bytes(devices, cfg, topology, num_microbatches=4,
+                        micro_batch_size=2, seq_len=16):
+    """Per-mesh-axis collective payload bytes of ONE (pp, dp, tp)
+    training step — init + 1 full step traced to a jaxpr and counted by
+    ``apex_tpu.telemetry.costs.comm_from_jaxpr`` (psum/all_gather/
+    ppermute/all_to_all operand bytes; microbatch scan bodies
+    multiplied by their trip count). Pure host tracing: nothing is
+    compiled or executed, so the dryrun can print the counts for every
+    topology at jaxpr cost. Returns ``{axis: bytes}`` — the telemetry
+    seed for quantized-collective accounting (ROADMAP item 3)."""
+    pp, dp, tp = topology
+    assert pp * dp * tp == len(devices), (topology, len(devices))
+    mesh = Mesh(np.asarray(devices).reshape(pp, dp, tp),
+                (PIPELINE_AXIS, DATA_AXIS, TENSOR_AXIS))
+    _, init_params = make_gpt_fns(cfg, pp)
+    step, tx, scaler = gpt_train_step_fn(cfg, pp, num_microbatches)
+    global_mb = micro_batch_size * dp
+    batch = toy_batch(cfg.vocab_size, num_microbatches, global_mb,
+                      seq_len)
+
+    def one(batch):
+        params = init_params(jax.random.PRNGKey(0),
+                             {k: v[0] for k, v in batch.items()})
+        opt_state = tx.init(params)
+        scaler_state = scaler.init()
+        out = step(params, opt_state, scaler_state, batch)
+        return lax.pmean(out[3], DATA_AXIS)
+
+    f = jax.shard_map(
+        one, mesh=mesh,
+        in_specs=({"ids": P(None, DATA_AXIS),
+                   "labels": P(None, DATA_AXIS)},),
+        out_specs=P(), check_vma=False)
+    from apex_tpu.telemetry import costs
+
+    comm = costs.comm_from_jaxpr(jax.make_jaxpr(f)(batch))
+    # a size-1 axis's collectives are no-ops on the wire: the payload
+    # is traced (the jaxpr still carries the psum) but nothing moves —
+    # reporting it as comm would overstate every degenerate topology
+    sizes = {PIPELINE_AXIS: pp, DATA_AXIS: dp, TENSOR_AXIS: tp}
+    return {ax: v for ax, v in comm.items() if sizes.get(ax, 2) > 1}
+
+
 def run_minimal_gpt_training(n_devices=None, cfg=None, num_microbatches=4,
                              micro_batch_size=2, seq_len=16, num_steps=1,
                              devices=None, topology=None,

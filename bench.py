@@ -114,6 +114,57 @@ def _dispatch_snapshot():
     return dispatch.snapshot()
 
 
+def _capture_step_cost(step, run, step_args, iters, model_flops_per_step,
+                       platform, smoke=False):
+    """The attribution block for the measured K-step scan
+    (apex_tpu.telemetry.costs): XLA-counted flops / HBM bytes / peak
+    HBM + analytic floors, stamped into the JSON line and the ledger
+    record so a headline MFU self-describes its gap.
+
+    Pure host work off the timed path: ``step.lower`` and
+    ``jax.make_jaxpr`` trace without dispatching anything, and
+    ``memory_analysis`` (which needs a COMPILED executable) is taken
+    only where the compile is a persistent-cache read or a CPU compile
+    — never a second cold compile through the relay's remote-compile
+    helper. Every failure degrades to None fields (the block is always
+    stampable); ``APEX_COST_ANALYSIS=0`` skips the captures outright.
+    """
+    from apex_tpu import compile_cache
+    from apex_tpu.telemetry import costs
+
+    # smoke runs default the capture OFF (extra host traces for numbers
+    # nobody cites — the ledger's smoke rule); APEX_COST_ANALYSIS=1/0
+    # overrides either default
+    if not costs.enabled(default=not smoke):
+        return costs.null_block()
+    lowered = compiled = None
+    comm = None
+    try:
+        lowered = step.lower(*step_args)
+    except Exception:
+        pass
+    try:
+        if lowered is not None and (platform != "tpu"
+                                    or compile_cache.enabled()):
+            compiled = lowered.compile()
+    except Exception:
+        pass
+    try:
+        import jax
+
+        # per-step comm: the scan body's collectives count once per
+        # iteration, so divide the whole-program totals by the scan
+        # length (comm_from_jaxpr multiplies scan bodies by length)
+        total = costs.comm_from_jaxpr(jax.make_jaxpr(run)(*step_args))
+        comm = {k: v / iters for k, v in total.items()}
+    except Exception:
+        pass
+    return costs.capture(lowered=lowered, compiled=compiled, steps=iters,
+                         comm=comm,
+                         model_flops_per_step=model_flops_per_step,
+                         platform=platform)
+
+
 def make_one_step(model, scaler, tx):
     """The flagship amp-O2 training step: bf16 fwd/bwd, dynamic loss
     scaling, fused Adam, skip-step selects.
@@ -164,7 +215,7 @@ def make_one_step(model, scaler, tx):
     return one_step
 
 
-def _warm_bench_programs(programs, platform=None):
+def _warm_bench_programs(programs, platform=None, cost_ctx=None):
     """APEX_WARM_ONLY=1 path: AOT-compile (never run) every program of
     the scored bench attempt, populating the persistent compile cache
     (apex_tpu.compile_cache) so the NEXT invocation — the driver-scored
@@ -203,6 +254,26 @@ def _warm_bench_programs(programs, platform=None):
         except Exception as e:  # report, keep warming the rest
             results[name] = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
             failed = name
+            continue
+        # harvest the attribution block for free: the warm already paid
+        # for the Compiled object, so cost_analysis/memory_analysis are
+        # a host-side read — the PREDICTED peak HBM reaches the window
+        # driver before any measured dispatch, which is what lets §6
+        # small-HBM-first ordering flag a starvation-doomed program
+        # before it burns window minutes
+        from apex_tpu.telemetry import costs
+
+        ctx = cost_ctx or {}
+        if costs.enabled(default=not ctx.get("smoke")):
+            block = costs.capture(
+                compiled=compiled_by_name[name],
+                steps=ctx.get("steps") or 1,
+                model_flops_per_step=ctx.get("model_flops", {}).get(name),
+                platform=platform)
+            results[name]["cost"] = block
+            flag = costs.starvation(block.get("peak_hbm_bytes"), platform)
+            if flag:
+                results[name]["starvation"] = flag
     ledger_id = telemetry.ledger.append_record(
         harness="bench_warm", platform=platform, dispatch_overhead_ms=None,
         k=None, extra={"warm": results,
@@ -241,6 +312,7 @@ def main():
 
     from apex_tpu.amp.scaler import LossScaler
     from apex_tpu.optimizers.fused_adam import fused_adam
+    from apex_tpu.telemetry import costs
     from apex_tpu.transformer.parallel_state import TENSOR_AXIS
     from apex_tpu.transformer.testing import GPTModel, TransformerConfig
 
@@ -276,7 +348,10 @@ def main():
         # overrides the built-in measured default.
         b = _default_batch(cfg, DEFAULT_TPU_BATCH, s=1024)
         s, iters = 1024, 16
-        peak_flops = 197e12  # v5e bf16
+        # the ONE v5e roofline home (telemetry.costs): the measured MFU
+        # and its record's cost block must divide by the same peak, or
+        # check 6 flags arithmetic drift on every cited record
+        peak_flops = costs.peak_flops_for("tpu")
     else:
         cfg = TransformerConfig(
             hidden_size=128, num_layers=2, num_attention_heads=4,
@@ -288,7 +363,7 @@ def main():
         # the CPU smoke honors the same batch knob/table so the b-rung
         # A/B (autotune_steps --smoke) can exercise the ladder locally
         b, s, iters = _default_batch(cfg, 2, s=128), 128, 3
-        peak_flops = None
+        peak_flops = costs.peak_flops_for("cpu")  # None: no CPU envelope
 
     # §6 selective-starvation injection point: the relay's observed
     # degraded mode starves programs by working-set size, so the fault
@@ -379,11 +454,18 @@ def main():
             return step, (out_sds[0], out_sds[1], out_sds[2], zero,
                           ids, pos, labels)
 
+        n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+        step_flops = 6.0 * n_params * b * s
         sys.exit(_warm_bench_programs({
             "dispatch_overhead": (_overhead_program(iters), (zero, zero)),
             "step_scan": (step, step_args),
             "step_scan_timed_rebind": timed_rebind,
-        }, platform=platform))
+        }, platform=platform, cost_ctx={
+            "steps": iters,
+            "smoke": os.environ.get("APEX_BENCH_SMOKE") == "1",
+            "model_flops": {"step_scan": step_flops,
+                            "step_scan_timed_rebind": step_flops},
+        }))
 
     # ------------------------------------------------- durability layer
     # (opt-in: APEX_CKPT_DIR; ISSUE 6). Restore happens HERE — before
@@ -441,6 +523,19 @@ def main():
     # that wedges first (PERF.md §6/§10b)
     faults.fire("compile", batch=b)
 
+    # attribution capture BEFORE the warm dispatch: the jit donates the
+    # state buffers into the scan, so this is the last point the
+    # concrete args (whose shardings reproduce the warmed cache key)
+    # are alive — and strictly before t0, so nothing here can leak
+    # into the timed region
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    model_flops_per_step = 6.0 * n_params * b * s
+    cost_block = _capture_step_cost(
+        step, run, (params, opt_state, scaler_state, jnp.float32(0.0),
+                    ids, pos, labels),
+        iters, model_flops_per_step, platform,
+        smoke=os.environ.get("APEX_BENCH_SMOKE") == "1")
+
     # compile + warm + drain (donated inputs: rebind the carried state)
     print(f"# compiling {iters}-step scan at b={b} s={s} ...",
           file=sys.stderr, flush=True)
@@ -461,6 +556,42 @@ def main():
         ckpt_writer.save(step0 + iters, _EMERGENCY["state"],
                          meta=_EMERGENCY["meta"])
         ckpt_writer.flush()
+
+    from apex_tpu.telemetry import profiling
+
+    if profiling.capture_active():
+        # profiler-capture child (APEX_PROFILE_INNER=1 — spawned by the
+        # watchdog hook AFTER the scored attempts, never the scored
+        # attempt itself): trace K' post-warmup steps (the scan above
+        # was the warmup) and stamp the artifact + its content hash
+        # into the ledger. A traced run is perturbed by its own
+        # instrumentation, so no value/baseline/measurement comes out
+        # of this path — harness "bench_profile", one JSON status line.
+        from apex_tpu import telemetry
+
+        reason = profiling.refusal()
+        if reason is not None:
+            print(json.dumps({"profile_only": True, "profile": None,
+                              "error": f"profile capture refused: "
+                                       f"{reason}"}), flush=True)
+            return
+        outdir = profiling.new_capture_dir(f"bench-{platform}-b{b}")
+        with profiling.trace(outdir) as traced:
+            out = step(params, opt_state, scaler_state,
+                       jnp.float32(1e-30), ids, pos, labels)
+            sync(out[3])
+        art = profiling.artifact_block(outdir)
+        ledger_id = telemetry.ledger.append_record(
+            harness="bench_profile", platform=platform,
+            dispatch_overhead_ms=round(overhead * 1e3, 1), k=iters,
+            extra={"profile": art, "cost": cost_block,
+                   "compile_cache": compile_cache.snapshot(),
+                   "config": {"batch": b, "s": s}})
+        print(json.dumps({"profile_only": True, "traced": bool(traced),
+                          "k": iters, "profile": art,
+                          "ledger_id": ledger_id}), flush=True)
+        return
+
     print("# compiled; timing", file=sys.stderr, flush=True)
     t0 = time.perf_counter()
     out = step(params, opt_state, scaler_state, jnp.float32(1e-30), ids, pos,
@@ -492,7 +623,12 @@ def main():
 
         base = {"metric": f"gpt2s_train_tokens_per_sec ({platform})",
                 "compile_cache": compile_cache.snapshot(),
-                "dispatch": dispatch_table.snapshot()}
+                "dispatch": dispatch_table.snapshot(),
+                # the attribution block (apex_tpu.telemetry.costs):
+                # XLA-counted flops/bytes/peak-HBM + analytic floors —
+                # check_bench_labels check 6 polices MFU arithmetic
+                # against it on cited records
+                "cost": cost_block}
         if ckpt_writer is not None:
             base["checkpoint"] = ckpt_writer.snapshot()
         if resumed_from is not None:
@@ -517,6 +653,7 @@ def main():
             "dispatch_overhead_ms": round(overhead * 1e3, 1),
             "relay_degraded": True,
             "compile_cache": compile_cache.snapshot(),
+            "cost": cost_block,
             "ledger_id": ledger_record(True, "calibration-flap", value=0),
             "error": "non-positive step time after overhead subtraction "
                      "(relay flap straddled the calibration); "
@@ -527,10 +664,9 @@ def main():
         return
 
     tokens_per_sec = b * s / dt
-    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
     mfu = None
     if peak_flops:
-        mfu = round(6.0 * n_params * b * s / dt / peak_flops, 4)
+        mfu = round(model_flops_per_step / dt / peak_flops, 4)
 
     # The MFU-envelope degradation verdict (thresholds and their
     # PERF.md §1/§6 calibration live in apex_tpu.resilience — the one
@@ -572,6 +708,9 @@ def main():
 
     config = {
         "batch": b,
+        # sequence length rides the label so check_bench_labels check 6
+        # can recompute MFU from the cost block's flops (tokens = b*s)
+        "s": s,
         # knob PINS, tri-state: True/False (or a string value) = pinned,
         # None = unpinned — resolved by the dispatch table at trace
         # time; the resolved choices are in the JSON line's "dispatch"
@@ -610,6 +749,11 @@ def main():
         # choices (apex_tpu.dispatch consult log) — the data-driven half
         # of the pin-the-label rule
         "dispatch": _dispatch_snapshot(),
+        # the attribution block: what the step SHOULD cost (XLA flops /
+        # HBM bytes / peak HBM, analytic floors, MFU bound) next to
+        # what it measured — null-degraded where the backend (or the
+        # smoke default) reported nothing
+        "cost": cost_block,
     }
     if ckpt_writer is not None:
         # the durability telemetry block: {saves, queue_depth,
@@ -786,6 +930,80 @@ def _attempt_once(state, extra_env=None, timeout_cap=None, attempt=0):
         state["child"] = None
 
 
+def _maybe_profile_capture(state):
+    """The watchdog's APEX_PROFILE_CAPTURE=1 hook: after the scored
+    attempts (and after the one JSON line is flushed — stdout stays the
+    driver's), run ONE profiler-capture child under the resilience
+    timeout envelope. Refused under APEX_FAULT_PLAN; skipped when no
+    attempt completed a real measurement this window (a wedged relay
+    should not be handed another 900s program). All reporting goes to
+    stderr; the child's ledger record carries the artifact stamp."""
+    import subprocess
+
+    from apex_tpu.telemetry import profiling
+
+    if not profiling.requested():
+        return
+    reason = profiling.refusal()
+    if reason is not None:
+        print(f"# profile capture REFUSED: {reason}", file=sys.stderr,
+              flush=True)
+        return
+    pair = state["best"]
+    if pair is None or "error" in pair[1]:
+        print("# profile capture skipped: no completed measurement this "
+              "window", file=sys.stderr, flush=True)
+        return
+    timeout = profiling.timeout_s()
+    print(f"# profile capture: tracing post-warmup steps in a subprocess "
+          f"(timeout {timeout}s)", file=sys.stderr, flush=True)
+    env = dict(os.environ, APEX_BENCH_INNER="1", APEX_PROFILE_INNER="1")
+    # re-apply the WINNING attempt's ladder env (same None-unsets
+    # semantics as _attempt_once) so the trace profiles the program the
+    # headline line measured — e.g. when the b=16 upside attempt won,
+    # the capture must not quietly trace the default b=8 shape
+    for k, v in (state.get("best_env") or {}).items():
+        if v is None:
+            env.pop(k, None)
+        else:
+            env[k] = v
+    try:
+        # Popen + state["child"] (not subprocess.run): the watchdog's
+        # SIGTERM handler kills exactly state["child"] — a capture
+        # child blocked through the relay must be reaped by the slot
+        # timeout like any attempt, never orphaned holding the device
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            stdout=subprocess.PIPE, text=True)
+        state["child"] = proc
+        out, _ = proc.communicate(timeout=timeout)
+        _, rec = _last_json(out)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        print(f"# profile capture timed out after {timeout}s (wedge "
+              "signature) — artifact abandoned", file=sys.stderr,
+              flush=True)
+        return
+    except OSError as e:
+        print(f"# profile capture failed to launch: {e}", file=sys.stderr,
+              flush=True)
+        return
+    finally:
+        state["child"] = None
+    if rec and rec.get("profile"):
+        art = rec["profile"]
+        print(f"# profile capture: {art.get('files')} file(s), "
+              f"{art.get('bytes')} bytes in {art.get('dir')} "
+              f"(sha256 {str(art.get('sha256'))[:12]}..., "
+              f"ledger {rec.get('ledger_id')})", file=sys.stderr,
+              flush=True)
+    else:
+        print(f"# profile capture produced no artifact "
+              f"({(rec or {}).get('error', f'rc={proc.returncode}')})",
+              file=sys.stderr, flush=True)
+
+
 def _watchdog():
     """Retry through relay flaps, report the best attempt.
 
@@ -832,8 +1050,8 @@ def _watchdog():
     # candidates as (healthy?, value) so a healthy measurement always
     # beats a degraded/implausible one regardless of its (possibly
     # inflated) tokens/s value
-    state = {"best": None, "best_rank": (-1, -1.0), "fallback": None,
-             "printed": False, "child": None}
+    state = {"best": None, "best_rank": (-1, -1.0), "best_env": None,
+             "fallback": None, "printed": False, "child": None}
 
     def flush_best():
         if state["printed"]:
@@ -1024,6 +1242,10 @@ def _watchdog():
         if "error" not in rec and requested_backend and \
                 rank > state["best_rank"]:
             state["best"], state["best_rank"] = (line, rec), rank
+            # the winning attempt's ladder env rides along so the
+            # profiler capture child traces the PROGRAM the headline
+            # measured (e.g. the b=16 upside attempt), not the default
+            state["best_env"] = ladder[i]
         elif state["best"] is None:
             # last-resort slot: prefer a non-error (cpu-fallback) line
             # over an error line
@@ -1037,6 +1259,10 @@ def _watchdog():
             if healthy_configs >= distinct:
                 break  # every distinct config measured — done
     flush_best()
+    # budgeted profiler capture (APEX_PROFILE_CAPTURE=1): strictly after
+    # the scored attempts and the flushed line — never on the scored
+    # attempt, bounded by its own envelope, refused under a fault plan
+    _maybe_profile_capture(state)
     if state["best"] is None and state["fallback"] is None:
         # every attempt crashed or produced nothing: surface the child's
         # exit code as a small honest diagnostic (rc can be negative for

@@ -121,6 +121,16 @@ case "${1:-}" in
         else
             echo "  no collection manifest yet ($SOUT/manifest.json)"
         fi
+        # window economics of the latest pass (tools/window_report.py):
+        # per-log slot minutes, attempts, verdicts, cost attribution —
+        # jax-free aggregation, relay-proof like the other status CLIs
+        if [ -n "$last" ]; then
+            echo "window economics ($last):"
+            timeout 120 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+                python tools/window_report.py --logs "$last" \
+                --manifest "$SOUT/manifest.json" \
+                --probe-state "$STATE" | sed 's/^/  /' || true
+        fi
         exit "$rc"
         ;;
     disarm)

@@ -53,7 +53,9 @@ ONLY_STEP = os.environ.get("APEX_GPT_ONLY_STEP") == "1"
 
 B, S = (2, 128) if SMOKE else (8, 1024)
 K = 2 if SMOKE else 32  # scan length
-PEAK = 197e12  # v5e bf16 peak FLOP/s
+# the ONE v5e roofline home (telemetry.costs): an MFU row and its cost
+# block must divide by the same peak (check 6 polices cited records)
+from apex_tpu.telemetry.costs import V5E_PEAK_BF16_FLOPS as PEAK  # noqa: E402
 
 cfg = TransformerConfig(
     hidden_size=128 if SMOKE else 768,
@@ -87,7 +89,8 @@ print(f"params: {n_params/1e6:.1f}M   (method: {K}-step lax.scan, 1 dispatch,"
       f" dispatch overhead {TRACER.overhead_ms:.1f} ms subtracted)")
 
 
-def scan_time(name, make_body, carry0, ops, flops_per_iter=None):
+def scan_time(name, make_body, carry0, ops, flops_per_iter=None,
+              capture_cost=False):
     """make_body(eps, *ops) -> body(carry, _) -> (carry, metric); the §0
     protocol (K-scan, traced eps, overhead subtraction) via the shared
     Tracer — every row lands in the run's ledger record with its
@@ -96,7 +99,8 @@ def scan_time(name, make_body, carry0, ops, flops_per_iter=None):
     and overflow the remote-compile tunnel."""
     span = TRACER.scan_time(name, make_body, carry0, ops,
                             wrap=lambda run: shmap(run, 2 + len(ops)),
-                            flops_per_iter=flops_per_iter)
+                            flops_per_iter=flops_per_iter,
+                            capture_cost=capture_cost)
     print(span.format_row(PEAK))
     return span.seconds
 
@@ -232,9 +236,15 @@ if os.environ.get("APEX_CKPT_DIR") and not _cc.warm_only():
             _ckpt_rng = _restored["rng"]
             CKPT_EXTRA["resumed_from"] = _prov
 
+# the headline row captures its attribution block (flops/HBM/peak-HBM
+# floors — apex_tpu.telemetry.costs): one extra host trace after the
+# timed region, free in warm mode, smoke-off like the ledger
+from apex_tpu.telemetry import costs as _costs  # noqa: E402
+
 t_step = scan_time("FULL train step", make_step,
                    step_carry0, (ids, pos, labels),
-                   flops_per_iter=model_flops_fb)
+                   flops_per_iter=model_flops_fb,
+                   capture_cost=_costs.enabled(default=not SMOKE))
 if t_step:  # None under APEX_WARM_ONLY (compile-only, nothing timed)
     print(f"{'':28s} -> {B*S/t_step:.0f} tok/s")
 

@@ -86,10 +86,22 @@ def warm_target(name, cmd, extra_env, timeout):
     if proc is not None:
         _, rec = _last_json(proc.stdout)
         if rec and "warm" in rec:  # bench warm JSON line
-            per = {k: ("cached" if v.get("cached") else
-                       f"compiled {v.get('seconds', '?')}s"
-                       if "error" not in v else "FAILED")
-                   for k, v in rec["warm"].items()}
+            def _one(v):
+                if "error" in v:
+                    return "FAILED"
+                s = ("cached" if v.get("cached")
+                     else f"compiled {v.get('seconds', '?')}s")
+                # the free attribution harvest (telemetry.costs): the
+                # PREDICTED peak HBM, so the window driver sees a
+                # starvation-doomed program before it burns minutes
+                peak = (v.get("cost") or {}).get("peak_hbm_bytes")
+                if peak:
+                    s += f" peak_hbm={peak / 2 ** 20:.0f}MiB"
+                if v.get("starvation"):
+                    s += f" !{v['starvation']}"
+                return s
+
+            per = {k: _one(v) for k, v in rec["warm"].items()}
             detail = " " + json.dumps(per)
         elif proc.stdout:  # Tracer harness: count its warmed rows
             n = sum(" warmed " in ln for ln in proc.stdout.splitlines())

@@ -51,3 +51,36 @@ def shared_smoke_cache_dir(tmp_path_factory):
     Tests that assert cold-vs-warm cache SEMANTICS keep their own
     fresh dirs."""
     return str(tmp_path_factory.mktemp("shared_smoke_compile_cache"))
+
+
+_CBL_MODULE = None
+
+
+def run_check_bench_labels(*args):
+    """Drive tools/check_bench_labels.py main() IN-PROCESS (module
+    loaded once per session) and return a subprocess.run-shaped
+    ``SimpleNamespace(returncode, stdout, stderr)``. The one shared
+    implementation of the fast-tier trim that replaced ~20 × ~3-4s
+    checker subprocesses (test_bench_labels keeps a single real CLI
+    invocation for the script surface)."""
+    import contextlib
+    import importlib.util
+    import io
+    import types
+
+    global _CBL_MODULE
+    if _CBL_MODULE is None:
+        tool = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "check_bench_labels.py")
+        spec = importlib.util.spec_from_file_location(
+            "check_bench_labels", tool)
+        _CBL_MODULE = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(_CBL_MODULE)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        try:
+            rc = _CBL_MODULE.main(list(args))
+        except SystemExit as e:  # argparse error paths
+            rc = e.code if isinstance(e.code, int) else 1
+    return types.SimpleNamespace(returncode=rc, stdout=buf.getvalue(),
+                                 stderr="")

@@ -207,10 +207,10 @@ def test_chaos_sigterm_during_final_save_flushes_emergency_ckpt(
     assert tledger.validate_record(es[0]) == []
 
 
-@pytest.mark.slow  # telemetry-detail twin (fast-tier budget): the
-# durability invariant itself — SIGTERM flushes checkpoint + ledger
-# record — is tier-1 via the final-save twin above; this adds only the
-# watchdog-side ckpt_on_disk reporting
+# re-promoted to tier-1 (ISSUE 7 fast-tier trim): rides the session
+# smoke compile cache (chaos_cache_dir), ~5s warm — the watchdog-side
+# ckpt_on_disk reporting comes back under tier-1 teeth instead of
+# staying demoted
 def test_chaos_watchdog_sigterm_record_reports_disk_checkpoint(
         tmp_path, chaos_cache_dir):
     """The watchdog's own termination record (``bench_watchdog``) must

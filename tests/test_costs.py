@@ -1,0 +1,543 @@
+"""The attribution layer (ISSUE 7): apex_tpu.telemetry.costs cost-block
+schema + derivations, the _compat cost/memory normalizers across every
+observed jax-0.4.37 shape variant, comm-volume accounting from jaxprs
+(incl. the multichip training step), the tiles.py VMEM validation hook,
+profiler-capture artifact stamps, the ledger inspection CLI, and the
+PR-1 invariant: asking XLA to count a program's flops leaves the traced
+jaxpr byte-identical. All CPU-tier, fast (jaxpr traces + one tiny AOT
+compile; no subprocesses)."""
+
+import contextlib
+import io
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from apex_tpu import _compat
+from apex_tpu.dispatch import tiles
+from apex_tpu.telemetry import costs, ledger, profiling
+
+
+# ---------------------------------------------------------------- build()
+
+
+def test_build_derives_floors_and_mfu_bound():
+    """The analytic roofline arithmetic: floors = flops/peak and
+    bytes/bw, step floor = max, MFU bound = model flops at the floor
+    over peak."""
+    peak = costs.V5E_PEAK_BF16_FLOPS
+    bw = costs.V5E_HBM_BYTES_PER_S
+    block = costs.build(
+        xla_flops=peak * 1e-3,            # 1 ms/step compute floor
+        hbm_bytes=bw * 2e-3,              # 2 ms/step bandwidth floor
+        steps=10, model_flops_per_step=peak * 0.9e-3,  # 0.9ms of "model"
+        platform="tpu", source="compiled")
+    assert block["steps"] == 10  # metadata, never a divisor
+    assert block["xla_flops_per_step"] == pytest.approx(peak * 1e-3)
+    assert block["compute_floor_ms"] == pytest.approx(1.0)
+    assert block["bandwidth_floor_ms"] == pytest.approx(2.0)
+    assert block["step_floor_ms"] == pytest.approx(2.0)  # max of the two
+    # mfu_bound = model_flops / floor_seconds / peak = 0.9ms-of-peak / 2ms
+    assert block["mfu_bound"] == pytest.approx(0.45, abs=1e-4)
+    assert costs.validate(block) == []
+
+
+def test_build_peak_hbm_from_memory_analysis():
+    mem = {"argument_size_in_bytes": 100, "output_size_in_bytes": 50,
+           "temp_size_in_bytes": 30, "alias_size_in_bytes": 40,
+           "generated_code_size_in_bytes": 5}
+    block = costs.build(memory=mem, steps=1)
+    assert block["peak_hbm_bytes"] == 100 + 50 + 30 + 5 - 40
+    assert block["memory"]["temp_size_in_bytes"] == 30
+    assert costs.validate(block) == []
+
+
+def test_build_cpu_platform_has_no_roofline():
+    """No committed envelope off-TPU: floors and bound stay None (the
+    same rule as bench.py's mfu=None on CPU)."""
+    block = costs.build(xla_flops=1e9, hbm_bytes=1e6, steps=1,
+                        platform="cpu", source="lowered")
+    assert block["peak_flops"] is None
+    assert block["compute_floor_ms"] is None
+    assert block["mfu_bound"] is None
+    assert costs.validate(block) == []
+
+
+def test_null_block_is_valid_and_all_none():
+    block = costs.null_block()
+    assert set(block) == set(costs.FIELDS)
+    assert all(v is None for v in block.values())
+    assert costs.validate(block) == []
+
+
+def test_capture_without_stage_degrades_not_raises():
+    block = costs.capture(lowered=None, compiled=None, steps=4,
+                          model_flops_per_step=123.0, platform="cpu")
+    assert block["source"] is None
+    assert block["xla_flops_per_step"] is None
+    assert block["model_flops_per_step"] == 123.0
+    assert costs.validate(block) == []
+
+
+def test_capture_real_aot_stage_reports_xla_numbers():
+    """One tiny real AOT pair on CPU: the capture path reads flops and
+    memory from the actual jax surfaces through the _compat
+    normalizers."""
+    f = jax.jit(lambda x: (x @ x).sum())
+    x = jnp.ones((16, 16), jnp.float32)
+    lowered = f.lower(x)
+    compiled = lowered.compile()
+    block = costs.capture(lowered=lowered, compiled=compiled, steps=1,
+                          platform="cpu")
+    assert block["source"] in ("compiled", "lowered")
+    assert block["xla_flops_per_step"] and block["xla_flops_per_step"] > 0
+    assert costs.validate(block) == []
+
+
+def test_memory_key_tuples_stay_in_sync():
+    """costs._MEMORY_KEYS (consumer: build/validate) must equal
+    _compat._MEMORY_FIELDS (producer: memory_analysis_dict) — the
+    tuples are deliberately duplicated (costs stays stdlib-only at
+    import; _compat imports jax at module top), so drift between them
+    would silently null memory fields and skew peak_hbm_bytes with
+    validate() still passing."""
+    from apex_tpu import _compat
+
+    assert costs._MEMORY_KEYS == _compat._MEMORY_FIELDS
+
+
+def test_xla_counts_scan_body_once_calibration():
+    """The calibration behind build()'s no-division rule: XLA's
+    cost_analysis counts a lax.scan body ONCE, not × trip count, so
+    the analyses' numbers are per-step already for a K-scan program.
+    If a jax upgrade changes the counting, this fails loudly and
+    build()'s semantics must be revisited — otherwise every stamped
+    floor/mfu_bound silently goes ~K× wrong again."""
+    from apex_tpu import _compat
+
+    def body(c, _):
+        return c @ c, None
+
+    x = jnp.ones((64, 64), jnp.float32)
+    one = jax.jit(lambda x: x @ x).lower(x)
+    scan16 = jax.jit(
+        lambda x: jax.lax.scan(body, x, None, length=16)[0]).lower(x)
+    f_one = _compat.cost_analysis_dict(one)["flops"]
+    f_scan = _compat.cost_analysis_dict(scan16)["flops"]
+    assert f_one > 0
+    # one body + loop overhead, nowhere near 16 bodies
+    assert f_one <= f_scan < 2 * f_one
+
+    block = costs.capture(lowered=scan16, steps=16, platform="cpu")
+    assert block["steps"] == 16
+    assert block["xla_flops_per_step"] == pytest.approx(f_scan)
+
+
+def test_capture_escape_hatch_env(monkeypatch):
+    """APEX_COST_ANALYSIS=0 skips the XLA reads outright but still
+    stamps a (degraded) block — degradation, never omission."""
+    monkeypatch.setenv("APEX_COST_ANALYSIS", "0")
+    assert costs.enabled(default=True) is False
+    f = jax.jit(lambda x: x + 1)
+    lowered = f.lower(jnp.ones(4))
+    block = costs.capture(lowered=lowered, compiled=None, steps=2,
+                          platform="cpu")
+    assert block["source"] is None
+    assert block["xla_flops_per_step"] is None
+    monkeypatch.setenv("APEX_COST_ANALYSIS", "1")
+    assert costs.enabled(default=False) is True
+
+
+# ------------------------------------------------------ validate() teeth
+
+
+@pytest.mark.parametrize("mutate, frag", [
+    (lambda b: b.pop("mfu_bound"), "missing field"),
+    (lambda b: b.update(xla_flops_per_step=-1.0), "non-negative"),
+    (lambda b: b.update(source="guessed"), "source"),
+    (lambda b: b.update(steps=0), "steps"),
+    (lambda b: b.update(memory={"argument_size_in_bytes": "big"}),
+     "memory.argument_size_in_bytes"),
+    (lambda b: b.update(comm_bytes_per_axis={"dp": -5}),
+     "comm_bytes_per_axis"),
+])
+def test_validate_rejects_malformed(mutate, frag):
+    block = costs.null_block()
+    mutate(block)
+    problems = costs.validate(block)
+    assert problems and any(frag in p for p in problems), problems
+
+
+def test_validate_record_polices_cost_block(tmp_path):
+    """ledger.validate_record runs the cost validator on every record
+    carrying the block — a malformed block is a schema finding."""
+    rec = ledger.make_record("bench", "cpu", 0.5, 2, git="abc", ts=1.0,
+                             extra={"cost": costs.null_block()})
+    assert ledger.validate_record(rec) == []
+    bad = dict(costs.null_block(), mfu_bound=-2.0)
+    rec2 = ledger.make_record("bench", "cpu", 0.5, 2, git="abc", ts=1.0,
+                              extra={"cost": bad})
+    assert any("cost:" in p for p in ledger.validate_record(rec2))
+
+
+# ------------------------------------------------- _compat normalizers
+
+
+class _Stage:
+    def __init__(self, raw=None, raise_=False, absent=False):
+        if not absent:
+            self._raw, self._raise = raw, raise_
+            self.cost_analysis = self._call
+            self.memory_analysis = self._call
+
+    def _call(self):
+        if self._raise:
+            raise NotImplementedError("backend can't report")
+        return self._raw
+
+
+class _MemStats:
+    """The CompiledMemoryStats extension-object variant: attributes,
+    not keys."""
+    argument_size_in_bytes = 64
+    output_size_in_bytes = 32
+    temp_size_in_bytes = 128
+    alias_size_in_bytes = 16
+    generated_code_size_in_bytes = 8
+
+
+def test_cost_analysis_dict_variants():
+    # absent method (old stages, custom wrappers)
+    assert _compat.cost_analysis_dict(object()) is None
+    # returns None / raises (unimplemented backend)
+    assert _compat.cost_analysis_dict(_Stage(raw=None)) is None
+    assert _compat.cost_analysis_dict(_Stage(raise_=True)) is None
+    # Lowered-style flat dict: passed through
+    assert _compat.cost_analysis_dict(
+        _Stage(raw={"flops": 10.0})) == {"flops": 10.0}
+    # Compiled-style list of per-computation dicts: key-wise sum
+    out = _compat.cost_analysis_dict(_Stage(raw=[
+        {"flops": 10.0, "bytes accessed": 4.0},
+        {"flops": 5.0, "transcendentals": 1.0}]))
+    assert out == {"flops": 15.0, "bytes accessed": 4.0,
+                   "transcendentals": 1.0}
+    # degenerate lists
+    assert _compat.cost_analysis_dict(_Stage(raw=[])) is None
+    assert _compat.cost_analysis_dict(_Stage(raw=["hlo"])) is None
+    assert _compat.cost_analysis_dict(_Stage(raw={})) is None
+    assert _compat.cost_analysis_dict(_Stage(raw=42)) is None
+
+
+def test_memory_analysis_dict_variants():
+    assert _compat.memory_analysis_dict(object()) is None
+    assert _compat.memory_analysis_dict(_Stage(raw=None)) is None
+    assert _compat.memory_analysis_dict(_Stage(raise_=True)) is None
+    # extension-object variant (attribute read)
+    out = _compat.memory_analysis_dict(_Stage(raw=_MemStats()))
+    assert out == {"argument_size_in_bytes": 64,
+                   "output_size_in_bytes": 32,
+                   "temp_size_in_bytes": 128,
+                   "alias_size_in_bytes": 16,
+                   "generated_code_size_in_bytes": 8}
+    # plain-dict variant (key filter; missing fields degrade to 0)
+    out = _compat.memory_analysis_dict(
+        _Stage(raw={"temp_size_in_bytes": 7, "host_temp_size_in_bytes": 9}))
+    assert out["temp_size_in_bytes"] == 7
+    assert out["argument_size_in_bytes"] == 0
+    assert "host_temp_size_in_bytes" not in out
+    # all-zero stats carry no information -> "can't report"
+    assert _compat.memory_analysis_dict(
+        _Stage(raw={"temp_size_in_bytes": 0})) is None
+
+
+def test_real_jax_0437_surfaces_normalize():
+    """Calibration against the container's actual jax: whatever shapes
+    Lowered/Compiled return here, the normalizers fold them into the
+    one flat shape (or None) — this is the test that breaks loudly on
+    a jax upgrade that changes the surface."""
+    f = jax.jit(lambda x: jnp.tanh(x @ x))
+    lowered = f.lower(jnp.ones((8, 8), jnp.float32))
+    compiled = lowered.compile()
+    for stage in (lowered, compiled):
+        ca = _compat.cost_analysis_dict(stage)
+        assert ca is None or (isinstance(ca, dict) and all(
+            isinstance(v, (int, float)) for v in ca.values()))
+    ma = _compat.memory_analysis_dict(compiled)
+    assert ma is None or set(ma) == set(_compat._MEMORY_FIELDS)
+    # at least one of the surfaces must report on CPU jax-0.4.37 —
+    # otherwise the whole attribution layer is silently dark
+    assert _compat.cost_analysis_dict(compiled) is not None \
+        or _compat.cost_analysis_dict(lowered) is not None
+
+
+# ---------------------------------------------------- comm accounting
+
+
+def test_comm_from_jaxpr_counts_psum_per_axis():
+    mesh = jax.sharding.Mesh(np.array(jax.devices()).reshape(4, 2),
+                             ("dp", "tp"))
+
+    def f(x):
+        return jax.lax.psum(x, "dp") + jax.lax.psum(x, "tp")
+
+    g = jax.shard_map(f, mesh=mesh,
+                      in_specs=jax.sharding.PartitionSpec("dp"),
+                      out_specs=jax.sharding.PartitionSpec("dp"),
+                      check_vma=False)
+    x = jnp.ones((8, 16), jnp.float32)
+    comm = costs.comm_from_jaxpr(jax.make_jaxpr(g)(x))
+    # per-participant payload: the (2,16) f32 shard = 128 bytes per psum
+    assert comm == {"dp": 128, "tp": 128}
+
+
+def test_comm_from_jaxpr_multiplies_scan_trip_count():
+    mesh = jax.sharding.Mesh(np.array(jax.devices()), ("dp",))
+
+    def body(c, _):
+        return jax.lax.psum(c, "dp"), ()
+
+    def f(x):
+        out, _ = jax.lax.scan(body, x, None, length=5)
+        return out
+
+    g = jax.shard_map(f, mesh=mesh,
+                      in_specs=jax.sharding.PartitionSpec(),
+                      out_specs=jax.sharding.PartitionSpec(),
+                      check_vma=False)
+    x = jnp.ones((4,), jnp.float32)  # 16 bytes per psum, x5 iterations
+    comm = costs.comm_from_jaxpr(jax.make_jaxpr(g)(x))
+    assert comm == {"dp": 80}
+
+
+def test_comm_from_jaxpr_no_collectives_is_empty_and_never_raises():
+    jaxpr = jax.make_jaxpr(lambda x: x * 2)(jnp.ones(4))
+    assert costs.comm_from_jaxpr(jaxpr) == {}
+    assert costs.comm_from_jaxpr(object()) == {}  # unknown shape: {}
+
+
+def test_training_comm_bytes_multichip_topology():
+    """The dryrun MULTICHIP comm accounting (ROADMAP item 3 seed): a
+    (pp=2, dp=2, tp=2) minimal-GPT training step traced to a jaxpr
+    reports nonzero collective payload on the axes that exist, and a
+    size-1 axis is filtered (its collectives move nothing)."""
+    from apex_tpu.transformer.testing.minimal import training_comm_bytes
+    from apex_tpu.transformer.testing import TransformerConfig
+
+    devices = jax.devices()
+    cfg = TransformerConfig(
+        hidden_size=32, num_layers=2, num_attention_heads=2,
+        vocab_size=64, max_position_embeddings=8, hidden_dropout=0.0,
+        attention_dropout=0.0, bf16=True,
+        apply_query_key_layer_scaling=False)
+    comm = training_comm_bytes(devices, cfg, (2, 2, 2),
+                               num_microbatches=2, micro_batch_size=1,
+                               seq_len=8)
+    assert comm.get("tp", 0) > 0, comm   # tensor-parallel matmul psums
+    assert comm.get("dp", 0) > 0, comm   # grad allreduce
+    comm2 = training_comm_bytes(devices, cfg, (2, 4, 1),
+                                num_microbatches=2, micro_batch_size=1,
+                                seq_len=8)
+    assert "tp" not in comm2, comm2      # size-1 axis filtered
+
+
+# ------------------------------------------------ starvation economics
+
+
+def test_starvation_verdicts(monkeypatch):
+    monkeypatch.delenv("APEX_STARVE_HBM_BYTES", raising=False)
+    cap = costs.V5E_HBM_CAPACITY_BYTES
+    assert costs.starvation(cap + 1, "tpu") == "exceeds-hbm"
+    # no committed threshold: nothing below capacity is flagged
+    assert costs.starvation(cap - 1, "tpu") is None
+    monkeypatch.setenv("APEX_STARVE_HBM_BYTES", str(2 ** 30))
+    assert costs.starvation(2 ** 30 + 1, "tpu") == "starvation-risk"
+    assert costs.starvation(2 ** 30 - 1, "tpu") is None
+    assert costs.starvation(None, "tpu") is None
+    assert costs.starvation(0, "tpu") is None
+
+
+# ------------------------------------------- tiles VMEM validation hook
+
+
+def test_tiles_model_vmem_and_compare():
+    dims = {"rows": 4096, "hidden": 1024}
+    model = tiles.model_vmem_bytes("layer_norm", dims, "float32")
+    assert isinstance(model, int) and model > 0
+    # within the coarse 4x band in either direction
+    res = tiles.compare_vmem("layer_norm", dims, "float32", None,
+                             xla_bytes=model * 3)
+    assert res["within"] is True and res["ratio"] == 3.0
+    # order-of-magnitude drift is the failure the hook exists to catch
+    res = tiles.compare_vmem("layer_norm", dims, "float32", None,
+                             xla_bytes=model * 10)
+    assert res["within"] is False
+    # either side unable to report -> None, never a crash
+    assert tiles.compare_vmem("layer_norm", dims, "float32", None,
+                              xla_bytes=None) is None
+    assert tiles.compare_vmem("nope", dims, "float32", None,
+                              xla_bytes=100) is None
+
+
+# --------------------------------------------------- profiler artifacts
+
+
+def test_artifact_block_hashes_and_tamper_evidence(tmp_path):
+    d = tmp_path / "capture"
+    d.mkdir()
+    (d / "trace.pb").write_bytes(b"abc")
+    (d / "meta.json").write_bytes(b"{}")
+    block = profiling.artifact_block(str(d))
+    assert block["files"] == 2 and block["bytes"] == 5
+    assert profiling.validate_block(block) == []
+    # tamper evidence: editing a file changes the stamped hash
+    (d / "trace.pb").write_bytes(b"abX")
+    assert profiling.artifact_block(str(d))["sha256"] != block["sha256"]
+    # empty/unreadable dir reports zero files, hash None — still valid
+    empty = profiling.artifact_block(str(tmp_path / "nope"))
+    assert empty["files"] == 0 and empty["sha256"] is None
+    assert profiling.validate_block(empty) == []
+
+
+def test_profile_validate_block_teeth():
+    assert profiling.validate_block("x") == ["profile is not a dict"]
+    bad = {"dir": 3, "files": -1, "bytes": "many", "sha256": "short"}
+    problems = profiling.validate_block(bad)
+    assert len(problems) == 4, problems
+    # files without a content hash: the tamper-evidence gap
+    assert profiling.validate_block(
+        {"dir": "d", "files": 2, "bytes": 5, "sha256": None})
+
+
+def test_profile_refusal_under_fault_plan(monkeypatch):
+    monkeypatch.setenv("APEX_FAULT_PLAN", json.dumps({"faults": []}))
+    assert profiling.refusal() is not None
+    monkeypatch.delenv("APEX_FAULT_PLAN")
+    assert profiling.refusal() is None
+
+
+def test_profile_trace_degrades_without_jax_profiler(tmp_path,
+                                                     monkeypatch):
+    """The feature-detect contract: a backend without a working
+    jax.profiler still runs the body (traced=False)."""
+    import jax.profiler as jp
+
+    def boom(*a, **k):
+        raise RuntimeError("no profiler on this backend")
+
+    monkeypatch.setattr(jp, "trace", boom)
+    ran = []
+    with profiling.trace(str(tmp_path)) as traced:
+        ran.append(traced)
+    assert ran == [False]
+
+
+def test_profile_knob_parsing(monkeypatch):
+    monkeypatch.delenv("APEX_PROFILE_TIMEOUT", raising=False)
+    assert profiling.timeout_s() == profiling.DEFAULT_TIMEOUT_S
+    monkeypatch.setenv("APEX_PROFILE_TIMEOUT", "120")
+    assert profiling.timeout_s() == 120
+    monkeypatch.setenv("APEX_PROFILE_TIMEOUT", "bogus")
+    assert profiling.timeout_s() == profiling.DEFAULT_TIMEOUT_S
+    monkeypatch.setenv("APEX_PROFILE_DIR", str("/tmp/x"))
+    assert profiling.profile_root() == "/tmp/x"
+    monkeypatch.setenv("APEX_PROFILE_CAPTURE", "1")
+    assert profiling.requested() is True
+    monkeypatch.setenv("APEX_PROFILE_INNER", "1")
+    assert profiling.capture_active() is True
+
+
+# ------------------------------------------------- ledger inspection CLI
+
+
+def _cli(*args):
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = ledger.main(list(args))
+    return rc, buf.getvalue()
+
+
+def _seed_ledger(tmp_path, n=3):
+    path = str(tmp_path / "ledger.jsonl")
+    ids = []
+    for i in range(n):
+        rec = ledger.append_record(
+            "bench" if i else "profile_gpt", "cpu", 0.5, 2,
+            path=path, extra={"cost": costs.null_block(),
+                              "value": 100.0 + i})
+        ids.append(rec)
+    return path, ids
+
+
+def test_ledger_cli_status_tail_show(tmp_path):
+    path, ids = _seed_ledger(tmp_path)
+    rc, out = _cli("--ledger", path, "status")
+    assert rc == 0
+    assert "3 record(s)" in out and "schema findings: 0" in out
+    rc, out = _cli("--ledger", path, "tail", "2")
+    assert rc == 0
+    assert len(out.strip().splitlines()) == 2
+    assert ids[-1] in out and "value=102.0" in out
+    rc, out = _cli("--ledger", path, "show", ids[0])
+    assert rc == 0
+    shown = json.loads(out)
+    assert shown["id"] == ids[0] and shown["harness"] == "profile_gpt"
+
+
+def test_ledger_cli_missing_and_corrupt(tmp_path):
+    rc, out = _cli("--ledger", str(tmp_path / "nope.jsonl"), "status")
+    assert rc == 1 and "no ledger" in out
+    path, ids = _seed_ledger(tmp_path, n=1)
+    rc, out = _cli("--ledger", path, "show", "lg-nonexistent")
+    assert rc == 1 and "no record" in out
+    with open(path, "a") as f:
+        f.write("{truncated\n")
+    rc, out = _cli("--ledger", path, "status")
+    assert rc == 1 and "CORRUPT" in out
+
+
+def test_ledger_cli_flags_schema_findings(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    rec = ledger.make_record("bench", "cpu", 0.5, 2, git="abc", ts=1.0,
+                             extra={"cost": {"not": "a block"}})
+    with open(path, "w") as f:
+        f.write(json.dumps(rec) + "\n")
+    rc, out = _cli("--ledger", path, "status")
+    assert rc == 1 and "schema findings: 1" in out
+    rc, out = _cli("--ledger", path, "show", rec["id"])
+    assert rc == 1 and "FINDING" in out
+
+
+# ------------------------------------------- the disabled-is-free proof
+
+
+def test_cost_capture_leaves_jaxpr_byte_identical():
+    """PR-1 invariant for the attribution layer: running the XLA
+    analyses (lower + cost_analysis + memory_analysis + a jaxpr comm
+    walk) does not perturb the program it describes — the jaxpr traced
+    after a capture is byte-identical to one traced before, and
+    identical to a capture-disabled process's trace."""
+
+    def step(params, x):
+        h = jnp.tanh(x @ params["w"])
+        return {"w": params["w"] - 1e-3 * (h.T @ x)}, h.sum()
+
+    f = jax.jit(step)
+    params = {"w": jnp.ones((16, 16), jnp.float32)}
+    x = jnp.ones((8, 16), jnp.float32)
+    before = str(jax.make_jaxpr(step)(params, x))
+    lowered = f.lower(params, x)
+    block = costs.capture(lowered=lowered, compiled=lowered.compile(),
+                          steps=1, platform="cpu")
+    costs.comm_from_jaxpr(jax.make_jaxpr(step)(params, x))
+    assert block["source"] is not None
+    after = str(jax.make_jaxpr(step)(params, x))
+    assert before == after

@@ -561,13 +561,11 @@ def test_autotune_smoke_end_to_end(tmp_path):
 
 def test_check_tool_validates_table(tmp_path):
     """tools/check_bench_labels.py check 3: unresolvable citations and
-    pin drift in the dispatch table fail tier-1."""
-    import os
-    import subprocess
-    import sys
+    pin drift in the dispatch table fail tier-1. Driven in-process
+    (tests/test_bench_labels.py covers the CLI surface once) — each of
+    the four invocations here used to be a ~3s subprocess."""
+    from tests.conftest import run_check_bench_labels
 
-    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    tool = os.path.join(REPO, "tools", "check_bench_labels.py")
     rec = ledger.make_record("profile_gpt", "cpu", 0.5, 2,
                              knobs={"APEX_ATTN_IMPL": "rows"}, git="abc",
                              ts=1.0)
@@ -579,11 +577,8 @@ def test_check_tool_validates_table(tmp_path):
     def run(table_lines):
         tpath = tmp_path / "table.jsonl"
         tpath.write_text("".join(table_lines))
-        env = dict(os.environ, PALLAS_AXON_POOL_IPS="")
-        return subprocess.run(
-            [sys.executable, tool, "--perf", str(perf), "--ledger",
-             str(lpath), "--table", str(tpath)],
-            capture_output=True, text=True, timeout=120, env=env)
+        return run_check_bench_labels("--perf", str(perf), "--ledger",
+                                      str(lpath), "--table", str(tpath))
 
     ok = _entry("attention", dict(b=8), "bfloat16", "rows",
                 ledger_id=rec["id"], pins={"APEX_ATTN_IMPL": "rows"})

@@ -237,13 +237,11 @@ def test_chaos_ladder_picks_b8_over_starved_b16(tmp_path):
     assert "degraded_large_hbm" in out.stderr
 
 
-@pytest.mark.slow  # near-twin demotion (ISSUE 5 fast-tier budget):
-# the hang→ride-the-budget→fabricated-timeout-record chaos path stays
-# tier-1 via test_chaos_ladder_picks_b8_over_starved_b16 (same hang
-# injection, same timeout_record flush), and the lazy-cap state
-# machine itself is tier-1 unit-covered by
-# test_retry_policy_lazy_cap_state_machine; this twin only adds the
-# all-attempts-hang composition, so it rides the slow tier
+# re-promoted to tier-1 (ISSUE 7 fast-tier trim): the budget the ISSUE-5
+# demotion bought is now covered by the in-process check_bench_labels
+# conversion, and the all-attempts-hang composition (~11s — the plan
+# fires pre-backend, nothing compiles) is the one watchdog path no other
+# tier-1 test walks end-to-end
 def test_chaos_full_timeout_wedge_arms_lazy_cap(tmp_path):
     """Backend-init hang on every attempt: each rides its entire budget,
     the first arms the 900s wedge cap (visible in the liveness log),
@@ -305,10 +303,9 @@ def test_chaos_truncated_json_is_no_measurement_then_retried(tmp_path):
     assert "inner bench process crashed" in out.stderr
 
 
-@pytest.mark.slow  # crash-retry is already tier-1-covered by the
-# truncated-JSON chaos test (same no-measurement crash path); this twin
-# only varies the exit style, so it rides the slow tier (CLAUDE.md
-# fast-tier budget)
+# re-promoted to tier-1 (ISSUE 7 fast-tier trim): ~7s, fabricate-only
+# (no compile), and it is the one tier-1 walk of the rc!=0 exit style
+# through the crash-wait branch
 def test_chaos_relay_init_crash_is_retried_with_short_wait(tmp_path):
     """A relay-init crash (connection reset instead of a hang — the
     watchdog docstring's round-3 mode): non-zero exit, no JSON, short
@@ -652,7 +649,8 @@ def test_manifest_record_check_status_roundtrip(tmp_path, capsys):
     capsys.readouterr()
     assert manifest_mod.main(["status", "--manifest", p]) == 1
     out = capsys.readouterr().out
-    assert "1/25 rows cashed" in out and "xent(wedged)" in out
+    n_rows = len(manifest_mod.PASS_ROWS)
+    assert f"1/{n_rows} rows cashed" in out and "xent(wedged)" in out
     entry = manifest_mod.load(p)["rows"]["bench_first"]
     assert entry["pass"] == "pass1"
 

@@ -1,0 +1,195 @@
+"""tools/window_report.py — the window-economics reporter (ISSUE 7
+acceptance: "reproduces the round-5 window timeline from committed
+artifacts alone"). The golden half runs against the REAL committed
+``benchmarks/device_logs_r05`` directory (frozen history — exact
+assertions are safe); the ledger/manifest/probe summaries get synthetic
+fixtures so the test doesn't chase the live ledger as later rounds
+append to it. Jax-free and subprocess-free (the tool itself never
+touches a backend)."""
+
+import contextlib
+import importlib.util
+import io
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from apex_tpu.resilience import manifest as manifest_mod
+from apex_tpu.telemetry import costs, ledger
+
+_spec = importlib.util.spec_from_file_location(
+    "window_report", os.path.join(REPO, "tools", "window_report.py"))
+wr = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(wr)
+
+R05_LOGS = os.path.join(REPO, "benchmarks", "device_logs_r05")
+
+
+# ------------------------------------------- round-5 golden timeline
+
+
+def test_round5_timeline_golden():
+    """The committed round-5 logs reconstruct the one 50-minute window
+    the round got: where its minutes went, per-program, with the
+    verdicts the resilience classifier assigns today."""
+    entries, timed = wr.logs_timeline(R05_LOGS)
+    by_name = {e["name"]: e for e in entries}
+
+    # the scored bench slot: 3 attempts (3 backend-init banners), a
+    # degraded-relay JSON line, 12.4 minutes of window
+    bench = by_name["bench.log"]
+    assert bench["attempts"] == 3
+    assert bench["verdict"] == "degraded_relay"
+    assert bench["value"] == 7842.6 and bench["mfu"] == 0.0297
+    assert bench["slot_minutes"] == 12.4
+
+    # the §10b wedge signature: banner, then nothing — gpt_rows burned
+    # 15 minutes producing no output (the slot the report exists to
+    # make visible)
+    rows = by_name["gpt_rows.log"]
+    assert rows["verdict"] == "no-output" and rows["rows"] == 0
+    assert rows["slot_minutes"] == 15.0
+
+    # the final slot is unknowable from logs alone, and bench2's last
+    # JSON line classifies as wedged
+    assert timed[-1]["name"] == "bench2.log"
+    assert timed[-1]["slot_minutes"] is None
+    assert timed[-1]["verdict"] == "wedged"
+
+    # table harnesses: rows counted, optimistic "table" verdict
+    assert by_name["attention.log"]["verdict"] == "table"
+    # 22 measured rows — the Tracer "dispatch overhead ... ms" header
+    # is NOT a row (a log holding only the header reads no-output)
+    assert by_name["attention.log"]["rows"] == 22
+
+    # timeline is sorted by first banner and every slot is anchored
+    starts = [e["starts"][0] for e in timed]
+    assert starts == sorted(starts)
+    assert [e["name"] for e in timed][:2] == ["attention.log",
+                                              "bench.log"]
+
+
+def test_header_only_log_is_no_output(tmp_path):
+    """A run that wedged right after calibration leaves a banner plus
+    the Tracer header ("dispatch overhead 82.6 ms subtracted") and no
+    measured rows — the report must call that dead slot no-output, not
+    a productive "table" (the header's "ms" must not count as a row)."""
+    log = tmp_path / "wedged.log"
+    log.write_text(
+        "WARNING:2026-08-01 09:00:00,123:jax._src.xla_bridge:794: ...\n"
+        "params: 124.5M   (method: 32-step lax.scan, 1 dispatch, "
+        "dispatch overhead 82.6 ms subtracted)\n")
+    entry = wr.parse_log(str(log))
+    assert entry["rows"] == 0
+    assert entry["verdict"] == "no-output"
+
+
+def test_round5_window_envelope():
+    report = wr.build_report(logs_dir=R05_LOGS)
+    w = report["logs"]["window"]
+    assert w["start"] == "2026-08-01 08:31:29"
+    assert w["last_activity"] == "2026-08-01 09:42:51"
+    assert w["minutes"] == 71.4
+    assert report["logs"]["unanchored"] == []
+
+
+def test_round5_report_prints_and_cli_runs():
+    report = wr.build_report(logs_dir=R05_LOGS)
+    buf = io.StringIO()
+    wr.print_report(report, out=buf)
+    text = buf.getvalue()
+    assert "71.4 min of anchored activity" in text
+    assert "gpt_rows.log" in text and "no-output" in text
+    # the CLI surface (in-process main; --json appends one JSON line —
+    # the driver-interface idiom)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = wr.main(["--ledger", os.devnull, "--logs", R05_LOGS,
+                      "--json"])
+    assert rc == 0
+    last = buf.getvalue().strip().splitlines()[-1]
+    parsed = json.loads(last)
+    assert parsed["logs"]["window"]["minutes"] == 71.4
+
+
+# ------------------------------------------------ ledger-side summary
+
+
+def _seed(path, **extra):
+    return ledger.append_record("bench", "cpu", 0.5, 2, path=path,
+                                extra=extra)
+
+
+def test_ledger_summary_counts_and_attribution(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    cost = costs.build(xla_flops=2e12, hbm_bytes=1e10, steps=2,
+                       model_flops_per_step=1.2e12, platform="tpu",
+                       source="compiled")
+    _seed(path, value=1000.0, mfu=0.30, cost=cost,
+          compile_cache={"enabled": True, "hits": 5, "misses": 2})
+    _seed(path, cost=costs.null_block())
+    records = ledger.read_ledger(path)
+    led = wr.ledger_summary(records)
+    assert led["records"] == 2
+    assert led["cost_blocks"] == {"present": 2, "reporting": 1}
+    assert led["compile_cache"]["hits"] == 5
+    assert len(led["attribution"]) == 1
+    a = led["attribution"][0]
+    assert a["mfu"] == 0.30 and a["mfu_bound"] == cost["mfu_bound"]
+    # and the text report names the measured-vs-bound gap
+    buf = io.StringIO()
+    wr.print_report({"ledger": led}, out=buf)
+    assert "attribution" in buf.getvalue()
+    assert "cost blocks: 2 present, 1 with XLA numbers" in buf.getvalue()
+
+
+def test_committed_ledger_is_summarizable():
+    """The real committed ledger always produces a summary (the
+    acceptance criterion's 'from committed artifacts alone') — loose
+    assertions only; later rounds append records."""
+    led = wr.ledger_summary(ledger.read_ledger(
+        os.path.join(REPO, "benchmarks", "ledger.jsonl")))
+    assert led["records"] >= 34
+    assert led["injected"] == 0
+    assert "bench" in led["by_harness"]
+
+
+# ------------------------------------------- manifest + probe summaries
+
+
+def test_manifest_and_probe_summaries(tmp_path):
+    man = str(tmp_path / "manifest.json")
+    manifest_mod.record(man, "bench_first", "healthy", rc=0)
+    summary = wr.manifest_summary(man)
+    assert "bench_first" in summary["cashed"]
+    assert summary["verdicts"]["bench_first"] == "healthy"
+    assert set(summary["owed"]) | set(summary["cashed"]) >= set(
+        manifest_mod.PASS_ROWS)
+
+    probe = tmp_path / "probe_state.json"
+    probe.write_text(json.dumps(
+        {"ts": 1754000000.0, "verdict": "healthy", "rc": 0,
+         "detail": "value=102196"}))
+    ps = wr.probe_summary(str(probe))
+    assert ps["verdict"] == "healthy" and "at" in ps
+
+    # degradation, never a crash: missing probe file is None, garbage
+    # manifest is an error entry — and both print
+    assert wr.probe_summary(str(tmp_path / "nope.json")) is None
+    bad = tmp_path / "bad.json"
+    bad.write_text("[1, 2")
+    buf = io.StringIO()
+    wr.print_report({"manifest": wr.manifest_summary(str(bad)),
+                     "probe": wr.probe_summary(str(bad))}, out=buf)
+    assert "unreadable" in buf.getvalue()
+
+
+def test_empty_round_is_a_report_not_an_error(tmp_path):
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = wr.main(["--ledger", str(tmp_path / "none.jsonl")])
+    assert rc == 0
+    assert "nothing to report" in buf.getvalue()

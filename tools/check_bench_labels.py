@@ -56,6 +56,16 @@ Checks:
    is not a cold start, whatever its compile-cache counters say.
    The same pin-match applies to dispatch-table entries citing
    resumed records.
+6. **MFU/cost arithmetic** — a cited record that reports an ``mfu``
+   AND carries a cost block with ``model_flops_per_step`` /
+   ``peak_flops`` (plus ``value`` and ``config.batch``/``config.s``)
+   must be arithmetically consistent:
+   ``mfu == model_flops_per_step * value / (batch * s * peak_flops)``
+   within rounding tolerance. A headline MFU that disagrees with its
+   own record's flops accounting is the §10 label-drift class wearing
+   an attribution costume. Records without the block (legacy, or
+   null-degraded backends) are skipped — no block, no claim to check.
+   Applies to PERF.md citations AND dispatch-table-cited records.
 
 New PERF.md table rows must cite their ledger record id in the caption
 (``ledger:<id>``) — uncited legacy paragraphs are not flagged, but they
@@ -113,6 +123,38 @@ def resume_problems(rec, rid):
             f"under DIFFERENT measurement pins ({detail}) — the row "
             f"mixes two configs under one label")
     return problems
+
+
+def mfu_problems(rec, rid):
+    """Check-6 arithmetic for one cited record; [] when clean or when
+    the record carries no checkable (mfu, cost) pair. The recomputation
+    uses ONLY fields inside the content-hashed record — value, config
+    batch/s, and the cost block's model flops + peak — so a drifted MFU
+    cannot be repaired by editing any one of them without breaking the
+    record's own id."""
+    mfu = rec.get("mfu")
+    cost = rec.get("cost")
+    if mfu is None or not isinstance(cost, dict):
+        return []
+    model_flops = cost.get("model_flops_per_step")
+    peak = cost.get("peak_flops")
+    value = rec.get("value")
+    cfg = rec.get("config") if isinstance(rec.get("config"), dict) else {}
+    b, s = cfg.get("batch"), cfg.get("s")
+    inputs = (model_flops, peak, value, b, s)
+    if any(not isinstance(x, (int, float)) or isinstance(x, bool)
+           or x <= 0 for x in inputs):
+        return []  # null-degraded block / legacy record: nothing to check
+    expect = model_flops * value / (b * s * peak)
+    # mfu rounds to 4 decimals, value to 0.1 — tolerate both roundings
+    tol = max(5e-4, 0.002 * expect)
+    if abs(mfu - expect) > tol:
+        return [f"record {rid} reports mfu={mfu} but its cost block's "
+                f"flops imply {expect:.4f} "
+                f"(model_flops_per_step={model_flops:g}, value={value:g} "
+                f"tok/s, tokens={b * s}, peak={peak:g}) — MFU/cost "
+                f"arithmetic drift"]
+    return []
 
 
 def _paragraphs(text):
@@ -177,6 +219,9 @@ def check_captions(perf_text, perf_path, records):
                     f"measurements")
             # check 5: resume provenance — pin-match + cold-start gate
             for p in resume_problems(rec, rid):
+                problems.append(f"{perf_path}:{lineno}: {p}")
+            # check 6: MFU/cost-block arithmetic consistency
+            for p in mfu_problems(rec, rid):
                 problems.append(f"{perf_path}:{lineno}: {p}")
             if rec.get("resumed_from") is not None \
                     and COLD_RE.search(para):
@@ -259,6 +304,9 @@ def check_dispatch_table(path, records):
                 # check 5 on the table side: a dispatch default decided
                 # by a resumed run must pin-match its checkpoint
                 for p in resume_problems(rec, rid):
+                    problems.append(f"{tag}: {p}")
+                # check 6 on the table side: same arithmetic teeth
+                for p in mfu_problems(rec, rid):
                     problems.append(f"{tag}: {p}")
     return problems, len(entries)
 
