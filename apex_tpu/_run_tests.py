@@ -26,7 +26,8 @@ SUITES = {
                          "test_layer_norm_pallas.py"],
     "mlp": ["test_mlp_dense.py"],
     "rnn": ["test_rnn.py"],
-    "parallel": ["test_parallel.py", "test_multiproc.py"],
+    "parallel": ["test_parallel.py", "test_multiproc.py",
+                 "test_collectives.py"],
     "transformer": ["test_tensor_parallel.py", "test_pipeline_parallel.py",
                     "test_transformer_models.py", "test_moe.py",
                     "test_context_parallel.py", "test_arguments.py",

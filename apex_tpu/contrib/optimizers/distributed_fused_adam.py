@@ -29,12 +29,14 @@ from jax import lax
 
 from apex_tpu.optimizers._fused import (
     get_meta,
+    zero_ef_residuals,
     zero_gather_updates,
     zero_grad_shard,
     zero_master_shard,
     zero_padded_total,
 )
 from apex_tpu.optimizers.fused_adam import _adam_flat
+from apex_tpu.parallel import collectives
 
 
 class DistAdamState(NamedTuple):
@@ -42,32 +44,58 @@ class DistAdamState(NamedTuple):
     m: jnp.ndarray       # [padded_total / num_shards] fp32, THIS rank's shard
     v: jnp.ndarray
     master: jnp.ndarray  # fp32 master copy of this rank's param shard
+    # error-feedback residuals of the quantized collective hops
+    # (apex_tpu.parallel.collectives; None — an empty pytree slot, so
+    # the state stays leaf-identical to the 4-field layout — whenever
+    # compression is off)
+    g_residual: jnp.ndarray = None   # grad reduce-scatter send error
+    u_residual: jnp.ndarray = None   # update all-gather send error
 
 
 def distributed_fused_adam(learning_rate=1e-3, betas=(0.9, 0.999), eps=1e-8,
                            weight_decay=0.0, adam_w_mode=True,
                            bias_correction=True, max_grad_norm=0.0, *,
-                           num_shards, axis_name="dp", grad_average=True):
-    """optax-style ZeRO-2 Adam for use INSIDE shard_map over ``axis_name``.
+                           num_shards, axis_name="dp", grad_average=True,
+                           grad_compress=None, hier_allreduce=None):
+    """optax-style ZeRO-2 Adam for use INSIDE shard_map over ``axis_name``
+    (a mesh-axis name, or an (inner, outer) pair for the staged
+    hierarchical collectives).
 
     ``num_shards`` must equal the mesh axis size (static — shard shapes
     depend on it). Gradients passed to ``update`` are the LOCAL grads;
     the transform performs the cross-replica reduction itself (do NOT
     pre-pmean them — that is this optimizer's job, like the reference DDP
     interplay, distributed_fused_adam.py:76-120).
+
+    ``grad_compress``/``hier_allreduce`` are the per-call knob forms
+    (raise on un-honorable requests); None consults the process-wide
+    ``collectives`` setters / ``APEX_GRAD_COMPRESS`` /
+    ``APEX_HIER_ALLREDUCE``. Resolution happens ONCE, here — the state
+    layout (error-feedback residual slots) must agree between ``init``
+    and every ``update``.
     """
     beta1, beta2 = betas
+    scheme = collectives.resolve_compress(grad_compress)
+    hier = collectives.resolve_hier(hier_allreduce,
+                                    collectives.axes_tuple(axis_name))
+    _compress = scheme if scheme is not None else False
 
     def init(params):
         leaves = jax.tree_util.tree_leaves(params)
         meta = get_meta(leaves)
         master = zero_master_shard(meta, leaves, num_shards, axis_name)
         shard = master.shape[0]
+        g_res = u_res = None
+        if scheme is not None:
+            g_res, u_res = zero_ef_residuals(meta.total, num_shards,
+                                             axis_name, hier)
         return DistAdamState(
             count=jnp.zeros((), jnp.int32),
             m=jnp.zeros((shard,), jnp.float32),
             v=jnp.zeros((shard,), jnp.float32),
             master=master,
+            g_residual=g_res,
+            u_residual=u_res,
         )
 
     def update(grads, state, params=None):
@@ -77,7 +105,9 @@ def distributed_fused_adam(learning_rate=1e-3, betas=(0.9, 0.999), eps=1e-8,
         meta = get_meta(leaves_p)
 
         # ZeRO grad sync: reduce-scatter (sum) → my shard
-        g_shard = zero_grad_shard(meta, leaves_g, num_shards, axis_name)
+        g_shard, g_res = zero_grad_shard(
+            meta, leaves_g, num_shards, axis_name, compress=_compress,
+            hierarchical=hier, residual=state.g_residual)
         if grad_average:
             g_shard = g_shard / num_shards
 
@@ -97,10 +127,13 @@ def distributed_fused_adam(learning_rate=1e-3, betas=(0.9, 0.999), eps=1e-8,
         master = state.master + upd_shard
 
         # ZeRO param sync: all-gather updated shards → full flat update
-        updates = jax.tree_util.tree_unflatten(
-            treedef, zero_gather_updates(meta, upd_shard, axis_name,
-                                         [x.dtype for x in leaves_p]))
-        return updates, DistAdamState(count=count, m=m, v=v, master=master)
+        upd_leaves, u_res = zero_gather_updates(
+            meta, upd_shard, axis_name, [x.dtype for x in leaves_p],
+            compress=_compress, hierarchical=hier,
+            residual=state.u_residual)
+        updates = jax.tree_util.tree_unflatten(treedef, upd_leaves)
+        return updates, DistAdamState(count=count, m=m, v=v, master=master,
+                                      g_residual=g_res, u_residual=u_res)
 
     return optax.GradientTransformation(init, update)
 
@@ -117,14 +150,16 @@ class DistributedFusedAdam:
                  dwu_group_size=0, dwu_num_blocks=4, dwu_num_rs_pg=1,
                  dwu_num_ar_pg=4, dwu_num_ag_pg=0, dwu_num_chunks=4,
                  revert_method=1, full_pipeline=True, e5m2_allgather=False,
-                 *, num_shards, axis_name="dp"):
+                 *, num_shards, axis_name="dp", grad_compress=None,
+                 hier_allreduce=None):
         assert not amsgrad, "amsgrad is not supported (as in the reference)"
         self.params = params
         self.tx = distributed_fused_adam(
             learning_rate=lr, betas=betas, eps=eps,
             weight_decay=weight_decay, bias_correction=bias_correction,
             adam_w_mode=False, max_grad_norm=max_grad_norm,
-            num_shards=num_shards, axis_name=axis_name)
+            num_shards=num_shards, axis_name=axis_name,
+            grad_compress=grad_compress, hier_allreduce=hier_allreduce)
         self.state = None
 
     def init_params(self, params=None):

@@ -29,11 +29,13 @@ from jax import lax
 
 from apex_tpu.optimizers._fused import (
     get_meta,
+    zero_ef_residuals,
     zero_gather_updates,
     zero_grad_shard,
     zero_master_shard,
     zero_padded_total,
 )
+from apex_tpu.parallel import collectives
 
 
 class DistLambState(NamedTuple):
@@ -41,6 +43,10 @@ class DistLambState(NamedTuple):
     m: jnp.ndarray
     v: jnp.ndarray
     master: jnp.ndarray
+    # error-feedback residuals (see DistAdamState): None slots when
+    # compression is off, so the 4-field leaf layout is preserved
+    g_residual: jnp.ndarray = None
+    u_residual: jnp.ndarray = None
 
 
 def distributed_fused_lamb(learning_rate=1e-3, betas=(0.9, 0.999), eps=1e-6,
@@ -48,22 +54,35 @@ def distributed_fused_lamb(learning_rate=1e-3, betas=(0.9, 0.999), eps=1e-6,
                            adam_w_mode=True, grad_averaging=True,
                            max_grad_norm=1.0, use_nvlamb=False,
                            clip_after_ar=True, allgather_in_fp32=True, *,
-                           num_shards, axis_name="dp"):
-    """optax-style ZeRO LAMB for use INSIDE shard_map over ``axis_name``.
-    Takes LOCAL grads; reduction is internal (see distributed_fused_adam).
-    """
+                           num_shards, axis_name="dp", grad_compress=None,
+                           hier_allreduce=None):
+    """optax-style ZeRO LAMB for use INSIDE shard_map over ``axis_name``
+    (name or (inner, outer) pair). Takes LOCAL grads; reduction is
+    internal (see distributed_fused_adam — same per-call-raises /
+    preference-falls-back knob contract, resolved once here so init
+    and update agree on the residual slots)."""
     beta1, beta2 = betas
+    scheme = collectives.resolve_compress(grad_compress)
+    hier = collectives.resolve_hier(hier_allreduce,
+                                    collectives.axes_tuple(axis_name))
+    _compress = scheme if scheme is not None else False
 
     def init(params):
         leaves = jax.tree_util.tree_leaves(params)
         meta = get_meta(leaves)
         master = zero_master_shard(meta, leaves, num_shards, axis_name)
         shard = master.shape[0]
+        g_res = u_res = None
+        if scheme is not None:
+            g_res, u_res = zero_ef_residuals(meta.total, num_shards,
+                                             axis_name, hier)
         return DistLambState(
             count=jnp.zeros((), jnp.int32),
             m=jnp.zeros((shard,), jnp.float32),
             v=jnp.zeros((shard,), jnp.float32),
             master=master,
+            g_residual=g_res,
+            u_residual=u_res,
         )
 
     def update(grads, state, params=None):
@@ -73,9 +92,11 @@ def distributed_fused_lamb(learning_rate=1e-3, betas=(0.9, 0.999), eps=1e-6,
         meta = get_meta(leaves_p)
         P = zero_padded_total(meta.total, num_shards)
         shard = P // num_shards
-        idx = lax.axis_index(axis_name)
+        idx = collectives.axes_index(axis_name)
 
-        g_shard = zero_grad_shard(meta, leaves_g, num_shards, axis_name)
+        g_shard, g_res = zero_grad_shard(
+            meta, leaves_g, num_shards, axis_name, compress=_compress,
+            hierarchical=hier, residual=state.g_residual)
         # cross-rank averaging is unconditional (grad_averaging only
         # selects LAMB's beta3, as in the reference)
         g_shard = g_shard / num_shards
@@ -130,11 +151,13 @@ def distributed_fused_lamb(learning_rate=1e-3, betas=(0.9, 0.999), eps=1e-6,
         master = p + upd_shard
 
         gather_dtype = jnp.float32 if allgather_in_fp32 else jnp.bfloat16
-        updates = jax.tree_util.tree_unflatten(
-            treedef, zero_gather_updates(meta, upd_shard, axis_name,
-                                         [x.dtype for x in leaves_p],
-                                         gather_dtype))
-        return updates, DistLambState(count=count, m=m, v=v, master=master)
+        upd_leaves, u_res = zero_gather_updates(
+            meta, upd_shard, axis_name, [x.dtype for x in leaves_p],
+            gather_dtype, compress=_compress, hierarchical=hier,
+            residual=state.u_residual)
+        updates = jax.tree_util.tree_unflatten(treedef, upd_leaves)
+        return updates, DistLambState(count=count, m=m, v=v, master=master,
+                                      g_residual=g_res, u_residual=u_res)
 
     return optax.GradientTransformation(init, update)
 
@@ -154,7 +177,8 @@ class DistributedFusedLAMB:
                  full_ar=False, set_param_views_to_flat_buffer=False,
                  skip_allgather=False, fuse_scale=False,
                  param_order=None, nccl_allgather_channels=0, *,
-                 num_shards, axis_name="dp"):
+                 num_shards, axis_name="dp", grad_compress=None,
+                 hier_allreduce=None):
         self.params = params
         self.tx = distributed_fused_lamb(
             learning_rate=lr, betas=betas, eps=eps,
@@ -162,7 +186,8 @@ class DistributedFusedLAMB:
             adam_w_mode=adam_w_mode, max_grad_norm=max_grad_norm,
             use_nvlamb=use_nvlamb, clip_after_ar=clip_after_ar,
             allgather_in_fp32=not e5m2_allgather, num_shards=num_shards,
-            axis_name=axis_name)
+            axis_name=axis_name, grad_compress=grad_compress,
+            hier_allreduce=hier_allreduce)
         self.state = None
 
     def init(self):

@@ -83,7 +83,10 @@ from apex_tpu.dispatch import tiles
 # Pallas kernel vs the XLA-fused jnp path; "lm_head" is the fused
 # linear-CE head vs materialized logits; "lamb" is FusedLAMB's compute
 # structure; "remat" the trunk recompute granularity; "bench_batch"
-# bench.py's default batch (choice is the batch as a string).
+# bench.py's default batch (choice is the batch as a string);
+# "grad_comm" the DDP gradient-sync algorithm
+# (apex_tpu.parallel.collectives: int8 block quantization and/or the
+# hierarchical two-stage reduction), keyed on the flat payload size.
 OP_CHOICES = {
     "attention": ("flash", "rows"),
     "attention_bwd": ("monolithic", "split"),
@@ -93,6 +96,7 @@ OP_CHOICES = {
     "lamb": ("two_pass", "one_pass"),
     "remat": ("none", "selective", "full"),
     "bench_batch": None,  # any positive int (as str)
+    "grad_comm": ("off", "int8", "hier", "int8_hier"),
 }
 
 REQUIRED_FIELDS = ("op", "bucket", "dtype", "backend", "choice", "ledger")

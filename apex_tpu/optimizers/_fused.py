@@ -96,40 +96,87 @@ def tree_meta(params_tree):
 
 # --------------------------- ZeRO shard plumbing ---------------------------
 # shared by contrib.optimizers.distributed_fused_{adam,lamb} (the reference
-# duplicates this machinery per optimizer; here it is one implementation)
+# duplicates this machinery per optimizer; here it is one implementation).
+# The collective hops route through apex_tpu.parallel.collectives, so the
+# APEX_GRAD_COMPRESS / APEX_HIER_ALLREDUCE knobs (int8 + error feedback,
+# staged (inner, outer) reduction) apply to ZeRO exactly as to DDP; with
+# both off the emitted jaxpr is the pre-collectives psum_scatter /
+# all_gather, byte-identical.
+
+def _collectives():
+    # lazy: optimizers._fused must stay importable without dragging the
+    # parallel package in at module load (and vice versa)
+    from apex_tpu.parallel import collectives
+    return collectives
+
 
 def zero_padded_total(total, num_shards):
     return (total + num_shards - 1) // num_shards * num_shards
 
 
+def zero_ef_residuals(total, num_shards, axis_name, hier):
+    """Zero ``(g_residual, u_residual)`` error-feedback state for the
+    quantized ZeRO hops — ONE implementation for both contrib
+    optimizers (their init/update state layouts must agree with what
+    the hops in this module emit): the grad reduce-scatter's residual
+    is the full padded flat grad (its 1/inner piece when ``hier`` —
+    only the inter-slice hop quantizes), the update all-gather's is
+    the per-rank update shard. Call inside shard_map."""
+    C = _collectives()
+    P = zero_padded_total(total, num_shards)
+    g_len = P
+    if hier:
+        inner = C.axes_tuple(axis_name)[0]
+        g_len = P // jax.lax.axis_size(inner)
+    return (jnp.zeros((g_len,), jnp.float32),
+            jnp.zeros((P // num_shards,), jnp.float32))
+
+
 def zero_master_shard(meta, leaves, num_shards, axis_name):
     """This rank's fp32 shard of the flattened+padded params (ZeRO state
     init). Asserts the mesh axis matches num_shards — shard shapes are
-    static and silently wrong otherwise."""
-    assert jax.lax.axis_size(axis_name) == num_shards, (
+    static and silently wrong otherwise. Shard index over a factored
+    (inner, outer) axis is row-major (``collectives.axes_index``), the
+    chunk order both the flat tuple-axis and the staged hierarchical
+    collectives produce."""
+    C = _collectives()
+    assert C.axes_size(axis_name) == num_shards, (
         f"num_shards ({num_shards}) != size of mesh axis {axis_name!r} "
-        f"({jax.lax.axis_size(axis_name)})")
+        f"({C.axes_size(axis_name)})")
     P = zero_padded_total(meta.total, num_shards)
     shard = P // num_shards
     flat = jnp.concatenate(
         [meta.flatten(leaves), jnp.zeros((P - meta.total,), jnp.float32)])
-    idx = jax.lax.axis_index(axis_name)
+    idx = C.axes_index(axis_name)
     return jax.lax.dynamic_slice_in_dim(flat, idx * shard, shard)
 
 
-def zero_grad_shard(meta, leaves_g, num_shards, axis_name):
+def zero_grad_shard(meta, leaves_g, num_shards, axis_name,
+                    compress=None, hierarchical=None, residual=None):
     """Reduce-scatter the flat grads: each rank gets the SUM of its padded
-    shard (the ZeRO-2 grad sync). Caller divides for averaging."""
+    shard (the ZeRO-2 grad sync). Caller divides for averaging.
+
+    Returns ``(shard, new_residual)`` — the second element is the
+    error-feedback residual when ``residual`` (and compression) is
+    threaded, else whatever was passed in (None normally)."""
     P = zero_padded_total(meta.total, num_shards)
     flat_g = jnp.concatenate(
         [meta.flatten(leaves_g), jnp.zeros((P - meta.total,), jnp.float32)])
-    return jax.lax.psum_scatter(flat_g, axis_name, scatter_dimension=0,
-                                tiled=True)
+    return _collectives().reduce_scatter_flat(
+        flat_g, axis_name, compress=compress, hierarchical=hierarchical,
+        residual=residual)
 
 
 def zero_gather_updates(meta, upd_shard, axis_name, dtypes,
-                        gather_dtype=jnp.float32):
-    """All-gather updated shards back to full per-tensor updates."""
-    flat_u = jax.lax.all_gather(upd_shard.astype(gather_dtype), axis_name,
-                                tiled=True).astype(jnp.float32)
-    return meta.unflatten(flat_u[:meta.total], dtypes)
+                        gather_dtype=jnp.float32, compress=None,
+                        hierarchical=None, residual=None):
+    """All-gather updated shards back to full per-tensor updates.
+    Returns ``(updates, new_residual)`` (same residual contract as
+    :func:`zero_grad_shard`; ``gather_dtype`` governs the uncompressed
+    hops — the reference's ``e5m2_allgather`` analog)."""
+    full, new_res = _collectives().all_gather_flat(
+        upd_shard, axis_name, compress=compress,
+        hierarchical=hierarchical, residual=residual,
+        gather_dtype=gather_dtype)
+    flat_u = full.astype(jnp.float32)
+    return meta.unflatten(flat_u[:meta.total], dtypes), new_res

@@ -6,6 +6,7 @@ LARC, multiproc). NCCL process groups become mesh axis names; collectives
 are XLA psum/all_gather over ICI.
 """
 
+from apex_tpu.parallel import collectives
 from apex_tpu.parallel.distributed import (
     pvary,
     DistributedDataParallel,
@@ -25,4 +26,5 @@ __all__ = [
     "DistributedDataParallel", "Reducer", "allreduce_gradients",
     "pvary", "broadcast_params", "SyncBatchNorm", "sync_batch_norm",
     "convert_syncbn_model", "create_syncbn_process_group", "LARC", "larc",
+    "collectives",
 ]

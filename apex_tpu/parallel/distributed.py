@@ -29,10 +29,13 @@ reduced explicitly) corresponds to *varying* params: apply
 ``average_gradients``. ``broadcast_params`` returns varying params.
 """
 
+import math
 import warnings
 
 import jax
 import jax.numpy as jnp
+
+from apex_tpu.parallel import collectives
 
 
 def pvary(x, axis_name):
@@ -49,31 +52,75 @@ def pvary(x, axis_name):
 
 def allreduce_gradients(grads, axis_name="data", gradient_average=True,
                         allreduce_always_fp32=False,
-                        gradient_predivide_factor=1.0):
-    """All-reduce (mean) a gradient pytree over ``axis_name``.
+                        gradient_predivide_factor=1.0, *,
+                        compress=None, hierarchical=None, ef_state=None):
+    """All-reduce (mean) a gradient pytree over ``axis_name`` (a mesh
+    axis name, or a declared ``(inner, outer)`` pair for hierarchical
+    reduction).
 
     The functional core of DDP (reference hot path:
     apex/parallel/distributed.py:425-475 allreduce_bucket →
     allreduce_maybe_retain). One psum per dtype-group; XLA combines and
     overlaps.
-    """
-    world = jax.lax.psum(1, axis_name)
 
-    def reduce_one(g):
-        orig = g.dtype
-        if allreduce_always_fp32:
-            g = g.astype(jnp.float32)
-        if gradient_predivide_factor != 1.0:
-            g = g / gradient_predivide_factor
-        g = jax.lax.psum(g, axis_name)
-        if gradient_average:
-            post = world / gradient_predivide_factor if gradient_predivide_factor != 1.0 else world
-            g = g / post
-        elif gradient_predivide_factor != 1.0:
-            g = g * gradient_predivide_factor
-        return g.astype(orig) if allreduce_always_fp32 else g
+    Scale-out knobs (``apex_tpu.parallel.collectives``): ``compress``
+    (per-call scheme, raises on unknown; None consults
+    ``set_grad_compress``/``APEX_GRAD_COMPRESS``) and ``hierarchical``
+    (per-call, raises over an unfactored axis; None consults
+    ``set_hier_allreduce``/``APEX_HIER_ALLREDUCE``). With both
+    resolved off the jaxpr is byte-identical to the pre-collectives
+    psum path. ``ef_state`` threads the error-feedback residual
+    (``collectives.ef_init``): when it is not None the return value
+    is ``(grads, new_ef_state)`` instead of ``grads`` — compensation
+    is state the caller carries across steps, not a side effect."""
+    axes = collectives.axes_tuple(axis_name)
+    nelems = sum(math.prod(g.shape) for g in
+                 jax.tree_util.tree_leaves(grads))
+    scheme = collectives.resolve_compress(compress, nelems=nelems)
+    hier = collectives.resolve_hier(hierarchical, axes, nelems=nelems)
+    if scheme is None and not hier:
+        axis = axes if len(axes) > 1 else axes[0]
+        world = jax.lax.psum(1, axis)
 
-    return jax.tree_util.tree_map(reduce_one, grads)
+        def reduce_one(g):
+            orig = g.dtype
+            if allreduce_always_fp32:
+                g = g.astype(jnp.float32)
+            if gradient_predivide_factor != 1.0:
+                g = g / gradient_predivide_factor
+            g = jax.lax.psum(g, axis)
+            if gradient_average:
+                post = world / gradient_predivide_factor if gradient_predivide_factor != 1.0 else world
+                g = g / post
+            elif gradient_predivide_factor != 1.0:
+                g = g * gradient_predivide_factor
+            return g.astype(orig) if allreduce_always_fp32 else g
+
+        reduced = jax.tree_util.tree_map(reduce_one, grads)
+        return reduced if ef_state is None else (reduced, ef_state)
+
+    # compressed / hierarchical route: the collectives layer works on
+    # one flat fp32 buffer (allreduce_always_fp32 is trivially
+    # satisfied); predivide still happens BEFORE the payload is built
+    # (its job is dynamic-range protection, which quantization cares
+    # about more, not less)
+    pre = gradient_predivide_factor if gradient_predivide_factor != 1.0 \
+        else None
+    scaled = grads if pre is None else jax.tree_util.tree_map(
+        lambda g: g / pre, grads)
+    reduced, new_ef = collectives.allreduce_tree(
+        scaled, axes, mean=False,
+        compress=scheme if scheme is not None else False,
+        hierarchical=hier, ef_state=ef_state)
+    world = collectives.axes_size(axes)
+    if gradient_average:
+        post = world / pre if pre is not None else world
+        reduced = jax.tree_util.tree_map(lambda g: (g / post).astype(
+            g.dtype), reduced)
+    elif pre is not None:
+        reduced = jax.tree_util.tree_map(lambda g: (g * pre).astype(
+            g.dtype), reduced)
+    return reduced if ef_state is None else (reduced, new_ef)
 
 
 def broadcast_params(params, axis_name="data", src_index=0):
@@ -119,7 +166,8 @@ class DistributedDataParallel:
                  allreduce_always_fp32=False, num_allreduce_streams=1,
                  allreduce_communicators=None, gradient_average=True,
                  gradient_predivide_factor=1.0, gradient_average_split_factor=None,
-                 prof=False, axis_name="data"):
+                 prof=False, axis_name="data", compress=None,
+                 hierarchical=None):
         if shared_param is not None:
             raise ValueError(
                 "shared_param is no longer supported as an option.")
@@ -128,6 +176,15 @@ class DistributedDataParallel:
         self.allreduce_always_fp32 = allreduce_always_fp32
         self.gradient_average = gradient_average
         self.gradient_predivide_factor = gradient_predivide_factor
+        # per-call knob semantics at ctor time (explicit request ≠
+        # preference): an unknown scheme / unfactored hierarchical
+        # demand raises HERE, not mid-trace
+        self.compress = compress
+        self.hierarchical = hierarchical
+        collectives.resolve_compress(compress)
+        if hierarchical:
+            collectives.resolve_hier(
+                hierarchical, collectives.axes_tuple(axis_name))
         for name, val, default in (
             ("message_size", message_size, 10000000),
             ("delay_allreduce", delay_allreduce, False),
@@ -145,12 +202,23 @@ class DistributedDataParallel:
                     "with no TPU counterpart — XLA handles collective "
                     "combining and overlap; option ignored.")
 
-    def average_gradients(self, grads):
+    def average_gradients(self, grads, ef_state=None):
         return allreduce_gradients(
             grads, self.axis_name,
             gradient_average=self.gradient_average,
             allreduce_always_fp32=self.allreduce_always_fp32,
-            gradient_predivide_factor=self.gradient_predivide_factor)
+            gradient_predivide_factor=self.gradient_predivide_factor,
+            compress=self.compress, hierarchical=self.hierarchical,
+            ef_state=ef_state)
+
+    def init_ef_state(self, grads):
+        """Zero error-feedback residual for ``average_gradients``
+        under this config's resolved knobs (None when compression is
+        off). Call inside shard_map; thread the returned state through
+        your step."""
+        return collectives.ef_init(
+            grads, self.axis_name, compress=self.compress,
+            hierarchical=self.hierarchical)
 
     def broadcast_params(self, params):
         return broadcast_params(params, self.axis_name)

@@ -129,9 +129,30 @@ def null_block():
     return block
 
 
+def comm_compression_block(snapshot, uncompressed=None):
+    """The comm-compression stamp for a cost block:
+    ``{scheme, hierarchical, block, uncompressed_bytes_per_axis}``.
+    ``snapshot`` is ``parallel.collectives.snapshot()`` (the resolved
+    process-wide knobs the measured program traced under);
+    ``uncompressed`` the per-axis byte counts of the program's
+    uncompressed twin (traced under ``collectives.disabled()``), so a
+    record claiming a payload cut carries BOTH sides of the claim.
+    Returns None when nothing is compressed (the block is only stamped
+    where it says something — old records stay valid without it)."""
+    if not snapshot.get("scheme") and not snapshot.get("hierarchical"):
+        return None
+    out = {"scheme": snapshot.get("scheme"),
+           "hierarchical": bool(snapshot.get("hierarchical")),
+           "block": snapshot.get("block")}
+    if isinstance(uncompressed, dict):
+        out["uncompressed_bytes_per_axis"] = {
+            str(k): float(v) for k, v in sorted(uncompressed.items())}
+    return out
+
+
 def build(xla_flops=None, hbm_bytes=None, memory=None, comm=None,
           steps=None, model_flops_per_step=None, platform=None,
-          source=None):
+          source=None, comm_compression=None):
     """Assemble a validated cost block from XLA's reported numbers.
 
     ``xla_flops`` / ``hbm_bytes`` are the analyses' reported counts,
@@ -168,6 +189,11 @@ def build(xla_flops=None, hbm_bytes=None, memory=None, comm=None,
     if isinstance(comm, dict):
         block["comm_bytes_per_axis"] = {str(k): float(v)
                                         for k, v in sorted(comm.items())}
+    if isinstance(comm_compression, dict):
+        # the quantized/hierarchical-collectives stamp
+        # (comm_compression_block): which knobs shaped the traced
+        # payload, and what the uncompressed twin would have moved
+        block["comm_compression"] = comm_compression
     peak = peak_flops_for(platform)
     bw = hbm_bw_for(platform)
     block["peak_flops"] = peak
@@ -190,7 +216,8 @@ def build(xla_flops=None, hbm_bytes=None, memory=None, comm=None,
 
 
 def capture(lowered=None, compiled=None, steps=1, comm=None,
-            model_flops_per_step=None, platform=None):
+            model_flops_per_step=None, platform=None,
+            comm_compression=None):
     """The capture path: feature-detected ``cost_analysis`` /
     ``memory_analysis`` off an AOT stage pair, folded into one block.
 
@@ -201,7 +228,8 @@ def capture(lowered=None, compiled=None, steps=1, comm=None,
     if not enabled() or (lowered is None and compiled is None):
         return build(comm=comm, steps=steps,
                      model_flops_per_step=model_flops_per_step,
-                     platform=platform, source=None)
+                     platform=platform, source=None,
+                     comm_compression=comm_compression)
     try:
         from apex_tpu import _compat
     except Exception:
@@ -222,7 +250,7 @@ def capture(lowered=None, compiled=None, steps=1, comm=None,
         hbm_bytes=ca.get("bytes accessed") if ca else None,
         memory=ma, comm=comm, steps=steps,
         model_flops_per_step=model_flops_per_step, platform=platform,
-        source=source)
+        source=source, comm_compression=comm_compression)
 
 
 # --------------------------------------------------------- comm accounting
@@ -373,4 +401,39 @@ def validate(block):
                     problems.append(
                         f"comm_bytes_per_axis[{k!r}] is not a "
                         f"non-negative number")
+    cc = block.get("comm_compression")
+    if cc is not None:
+        # the quantized/hierarchical-collectives stamp — OPTIONAL
+        # (legacy blocks carry none), but malformed is a finding: a
+        # broken stamp could pass off a compressed row as uncompressed
+        if not isinstance(cc, dict):
+            problems.append("comm_compression is not a dict")
+        else:
+            scheme = cc.get("scheme")
+            if scheme is not None and not isinstance(scheme, str):
+                problems.append("comm_compression.scheme is not a "
+                                "string or null")
+            if not isinstance(cc.get("hierarchical"), bool):
+                problems.append("comm_compression.hierarchical is not "
+                                "a bool")
+            blk = cc.get("block")
+            if blk is not None and (not isinstance(blk, int)
+                                    or isinstance(blk, bool) or blk <= 0):
+                problems.append("comm_compression.block is not a "
+                                "positive int")
+            unc = cc.get("uncompressed_bytes_per_axis")
+            if unc is not None:
+                if not isinstance(unc, dict):
+                    problems.append("comm_compression."
+                                    "uncompressed_bytes_per_axis is "
+                                    "not a dict")
+                else:
+                    for k, v in unc.items():
+                        if not isinstance(k, str) or not isinstance(
+                                v, (int, float)) or isinstance(v, bool) \
+                                or v < 0:
+                            problems.append(
+                                f"comm_compression."
+                                f"uncompressed_bytes_per_axis[{k!r}] "
+                                f"is not a non-negative number")
     return problems

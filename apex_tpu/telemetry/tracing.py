@@ -181,7 +181,8 @@ class Tracer:
     def overhead_ms(self):
         return self.overhead * 1e3
 
-    def _capture_cost(self, call, args, flops_per_iter, compiled=None):
+    def _capture_cost(self, call, args, flops_per_iter, compiled=None,
+                      comm=None, comm_compression=None):
         """Attribution block for one measured program (cost_analysis /
         memory_analysis via apex_tpu.telemetry.costs): ``compiled`` is
         the free-harvest path (the warm mode already paid for the AOT
@@ -204,14 +205,16 @@ class Tracer:
         block = costs.capture(lowered=lowered, compiled=compiled,
                               steps=self.k,
                               model_flops_per_step=flops_per_iter,
-                              platform=platform)
+                              platform=platform, comm=comm,
+                              comm_compression=comm_compression)
         if self.cost is None:
             self.cost = block
         return block
 
     def time_call(self, name, call, warm_args, timed_args,
                   flops_per_iter=None, extra=None, on_fail="raise",
-                  sync_out=sync, capture_cost=False):
+                  sync_out=sync, capture_cost=False, comm=None,
+                  comm_compression=None):
         """Warm (compile + drain) with ``warm_args``, then time one
         dispatch of ``call(*timed_args)``; per-iteration time = (wall -
         overhead) / K. The two argument tuples must differ in a traced
@@ -239,7 +242,8 @@ class Tracer:
                         # same — predicted peak HBM before any dispatch)
                         warm_cost = self._capture_cost(
                             call, warm_args, flops_per_iter,
-                            compiled=compiled)
+                            compiled=compiled, comm=comm,
+                            comm_compression=comm_compression)
                 else:
                     sync_out(call(*warm_args))
                     info = {"executed": True}
@@ -276,8 +280,9 @@ class Tracer:
         if capture_cost:
             # AFTER the timed region: the lower/compile are host work
             # that must never straddle t0 (the calibration-flap class)
-            span_extra["cost"] = self._capture_cost(call, warm_args,
-                                                    flops_per_iter)
+            span_extra["cost"] = self._capture_cost(
+                call, warm_args, flops_per_iter, comm=comm,
+                comm_compression=comm_compression)
         span = Span(name, (total - self.overhead) / self.k, total, self.k,
                     self.overhead, flops_per_iter=flops_per_iter,
                     extra=span_extra)
@@ -286,7 +291,7 @@ class Tracer:
 
     def scan_time(self, name, make_body, carry0, ops, wrap=None,
                   flops_per_iter=None, extra=None, on_fail="raise",
-                  capture_cost=False):
+                  capture_cost=False, comm=None, comm_compression=None):
         """The §0 protocol in one call. ``make_body(eps, *ops)`` returns
         ``body(carry, t) -> (carry, metric)``; ``ops`` (big arrays) are
         jit ARGUMENTS — closure-captured constants would be inlined into
@@ -303,7 +308,8 @@ class Tracer:
             name, f, (carry0, jnp.float32(0.0)) + tuple(ops),
             (carry0, jnp.float32(1e-30)) + tuple(ops),
             flops_per_iter=flops_per_iter, extra=extra, on_fail=on_fail,
-            capture_cost=capture_cost)
+            capture_cost=capture_cost, comm=comm,
+            comm_compression=comm_compression)
 
     def flush_ledger(self, harness, platform=None, relay=None, extra=None,
                      path=None):

@@ -205,7 +205,8 @@ def global_grad_norm(grads):
 
 
 def gpt_train_step_fn(cfg, pp, num_microbatches, lr=1e-4,
-                      checkpoint_stages=True, with_grad_norm=False):
+                      checkpoint_stages=True, with_grad_norm=False,
+                      dp_axes=DATA_AXIS, compress=None, hierarchical=None):
     """Returns ``(step, tx, scaler)`` where ``step(params, opt_state,
     scaler_state, batch) -> (params, opt_state, scaler_state, loss)`` — to
     be called INSIDE shard_map over the (pp, dp, tp) mesh; ``tx``/``scaler``
@@ -214,11 +215,24 @@ def gpt_train_step_fn(cfg, pp, num_microbatches, lr=1e-4,
     ``with_grad_norm``: append the unscaled `global_grad_norm` as a 5th
     output (trajectory-parity diagnostics).
 
+    ``dp_axes``: the data-parallel axis — a name, or the declared
+    ``(inner, outer)`` pair of a hierarchically factored dp mesh.
+    ``compress``/``hierarchical`` ride to
+    ``parallel.distributed.allreduce_gradients`` as per-call knob forms
+    (None = the process-wide APEX_GRAD_COMPRESS / APEX_HIER_ALLREDUCE
+    preferences); with everything off the emitted jaxpr is
+    byte-identical to the historical per-leaf pmean. The compressed
+    grad sync here is stateless (no error-feedback residual is
+    threaded — the step signature stays fixed); EF-carried compression
+    lives in the ZeRO optimizers, whose state holds the residual.
+
     The full apex training semantics: forward/backward through the 1F1B
-    schedule with loss scaling, DP gradient pmean (the DDP allreduce),
-    found_inf-gated fused-Adam update (the skip-step of
+    schedule with loss scaling, DP gradient allreduce (the DDP
+    reduction), found_inf-gated fused-Adam update (the skip-step of
     apex/amp/handle.py:128-154), dynamic scale update.
     """
+    from apex_tpu.parallel.distributed import allreduce_gradients
+
     fns, _ = make_gpt_fns(cfg, pp)
     stage_fn, embed_fn, loss_fn = fns
     scaler = LossScaler()  # dynamic, 2^16
@@ -237,9 +251,10 @@ def gpt_train_step_fn(cfg, pp, num_microbatches, lr=1e-4,
             batch, params, num_microbatches=num_microbatches,
             checkpoint_stages=checkpoint_stages)
         # DDP: data-parallel gradient averaging (reference
-        # apex/parallel/distributed.py:425-475 → one pmean over "dp")
-        grads = jax.tree_util.tree_map(
-            lambda g: lax.pmean(g, DATA_AXIS), grads)
+        # apex/parallel/distributed.py:425-475) through the ONE
+        # collectives layer — psum+mean when the knobs are off
+        grads = allreduce_gradients(
+            grads, dp_axes, compress=compress, hierarchical=hierarchical)
         # unscale + overflow detect; found_inf is synced over pp/tp like
         # transformer.amp.GradScaler (grad_scaler.py:38-49)
         grads, found_inf = scaler.unscale(grads, scaler_state)
@@ -261,6 +276,32 @@ def gpt_train_step_fn(cfg, pp, num_microbatches, lr=1e-4,
         return new_params, new_opt_state, new_scaler_state, loss
 
     return step, tx, scaler
+
+
+def dp_axes_of(dp):
+    """Normalize a topology's dp entry: an int declares the flat
+    ``DATA_AXIS``; an ``(inner, outer)`` pair declares the
+    hierarchically factored axes ``(dp_in, dp_out)`` (intra-slice,
+    inter-slice — the two-stage collectives of
+    ``apex_tpu.parallel.collectives``). Returns ``(dp_size,
+    axis_names_tuple, mesh_axis_sizes_tuple)``."""
+    if isinstance(dp, (tuple, list)):
+        inner, outer = dp
+        return inner * outer, (DATA_AXIS + "_in", DATA_AXIS + "_out"), \
+            (inner, outer)
+    return dp, (DATA_AXIS,), (dp,)
+
+
+def dp_axis_arg(dp_names):
+    """The ONE collapse of a dp-names tuple to the form consumers
+    pass around: the bare name for a flat dp, the (inner, outer)
+    tuple for a factored declaration. Used both as the collective
+    axis argument (``allreduce_gradients``/``lax.pmean``) and as the
+    PartitionSpec entry sharding the batch."""
+    return dp_names[0] if len(dp_names) == 1 else tuple(dp_names)
+
+
+_dp_spec = dp_axis_arg  # the spec entry is the same collapse
 
 
 def factorize_mesh(n_devices):
@@ -399,22 +440,31 @@ def reference_training(cfg, pp, batch, num_steps, lr=1e-4, device=None):
 
 
 def training_comm_bytes(devices, cfg, topology, num_microbatches=4,
-                        micro_batch_size=2, seq_len=16):
+                        micro_batch_size=2, seq_len=16, compress=None,
+                        hierarchical=None):
     """Per-mesh-axis collective payload bytes of ONE (pp, dp, tp)
     training step — init + 1 full step traced to a jaxpr and counted by
     ``apex_tpu.telemetry.costs.comm_from_jaxpr`` (psum/all_gather/
     ppermute/all_to_all operand bytes; microbatch scan bodies
     multiplied by their trip count). Pure host tracing: nothing is
     compiled or executed, so the dryrun can print the counts for every
-    topology at jaxpr cost. Returns ``{axis: bytes}`` — the telemetry
-    seed for quantized-collective accounting (ROADMAP item 3)."""
+    topology at jaxpr cost. Returns ``{axis: bytes}`` — the checkable
+    claim surface for the quantized/hierarchical collectives (ROADMAP
+    item 3): ``compress``/``hierarchical`` ride per-call into the dp
+    grad sync (None = the APEX_GRAD_COMPRESS / APEX_HIER_ALLREDUCE
+    preferences), and the topology's dp entry may be a declared
+    ``(inner, outer)`` pair (axes ``dp_in``/``dp_out``)."""
     pp, dp, tp = topology
-    assert pp * dp * tp == len(devices), (topology, len(devices))
-    mesh = Mesh(np.asarray(devices).reshape(pp, dp, tp),
-                (PIPELINE_AXIS, DATA_AXIS, TENSOR_AXIS))
+    dp_size, dp_names, dp_sizes = dp_axes_of(dp)
+    assert pp * dp_size * tp == len(devices), (topology, len(devices))
+    mesh = Mesh(np.asarray(devices).reshape(pp, *dp_sizes, tp),
+                (PIPELINE_AXIS, *dp_names, TENSOR_AXIS))
+    dp_axes = dp_axis_arg(dp_names)
     _, init_params = make_gpt_fns(cfg, pp)
-    step, tx, scaler = gpt_train_step_fn(cfg, pp, num_microbatches)
-    global_mb = micro_batch_size * dp
+    step, tx, scaler = gpt_train_step_fn(
+        cfg, pp, num_microbatches, dp_axes=dp_axes, compress=compress,
+        hierarchical=hierarchical)
+    global_mb = micro_batch_size * dp_size
     batch = toy_batch(cfg.vocab_size, num_microbatches, global_mb,
                       seq_len)
 
@@ -424,12 +474,12 @@ def training_comm_bytes(devices, cfg, topology, num_microbatches=4,
         opt_state = tx.init(params)
         scaler_state = scaler.init()
         out = step(params, opt_state, scaler_state, batch)
-        return lax.pmean(out[3], DATA_AXIS)
+        return lax.pmean(out[3], dp_axes)
 
+    spec = _dp_spec(dp_names)
     f = jax.shard_map(
         one, mesh=mesh,
-        in_specs=({"ids": P(None, DATA_AXIS),
-                   "labels": P(None, DATA_AXIS)},),
+        in_specs=({"ids": P(None, spec), "labels": P(None, spec)},),
         out_specs=P(), check_vma=False)
     from apex_tpu.telemetry import costs
 
@@ -437,7 +487,8 @@ def training_comm_bytes(devices, cfg, topology, num_microbatches=4,
     # a size-1 axis's collectives are no-ops on the wire: the payload
     # is traced (the jaxpr still carries the psum) but nothing moves —
     # reporting it as comm would overstate every degenerate topology
-    sizes = {PIPELINE_AXIS: pp, DATA_AXIS: dp, TENSOR_AXIS: tp}
+    sizes = {PIPELINE_AXIS: pp, TENSOR_AXIS: tp}
+    sizes.update(dict(zip(dp_names, dp_sizes)))
     return {ax: v for ax, v in comm.items() if sizes.get(ax, 2) > 1}
 
 
@@ -450,7 +501,10 @@ def run_minimal_gpt_training(n_devices=None, cfg=None, num_microbatches=4,
 
     ``topology``: explicit (pp, dp, tp) overriding ``factorize_mesh`` —
     tests drive tp=4 / pp=4 programs through this (reference grid:
-    parallel_state tests cover the full (pp, dp, tp) factor grid).
+    parallel_state tests cover the full (pp, dp, tp) factor grid). The
+    dp entry may be a declared ``(inner, outer)`` pair: the mesh then
+    carries the factored ``dp_in``/``dp_out`` axes and the grad sync
+    goes through the hierarchical-capable collectives layer.
 
     This is the dryrun/CI entry: init + steps execute in shard_map with
     real tp/pp/dp shardings; on CPU it runs under
@@ -460,7 +514,8 @@ def run_minimal_gpt_training(n_devices=None, cfg=None, num_microbatches=4,
         devices = jax.devices()[:n_devices] if n_devices else jax.devices()
     n = len(devices)
     pp, dp, tp = topology or factorize_mesh(n)
-    assert pp * dp * tp == n, (
+    dp_size, dp_names, dp_sizes = dp_axes_of(dp)
+    assert pp * dp_size * tp == n, (
         f"topology {(pp, dp, tp)} does not factor {n} devices")
     # apply_query_key_layer_scaling off: its coeff is the GLOBAL layer
     # number, which is stage-dependent — a non-uniform static in the SPMD
@@ -470,14 +525,16 @@ def run_minimal_gpt_training(n_devices=None, cfg=None, num_microbatches=4,
         vocab_size=128, max_position_embeddings=seq_len,
         hidden_dropout=0.0, attention_dropout=0.0, bf16=True,
         apply_query_key_layer_scaling=False)
-    mesh = Mesh(np.asarray(devices).reshape(pp, dp, tp),
-                (PIPELINE_AXIS, DATA_AXIS, TENSOR_AXIS))
+    mesh = Mesh(np.asarray(devices).reshape(pp, *dp_sizes, tp),
+                (PIPELINE_AXIS, *dp_names, TENSOR_AXIS))
+    dp_axes = dp_axis_arg(dp_names)
 
     _, init_params = make_gpt_fns(cfg, pp)
     step, tx, scaler = gpt_train_step_fn(cfg, pp, num_microbatches,
-                                         with_grad_norm=return_grad_norms)
+                                         with_grad_norm=return_grad_norms,
+                                         dp_axes=dp_axes)
 
-    global_mb = micro_batch_size * dp
+    global_mb = micro_batch_size * dp_size
     batch = toy_batch(cfg.vocab_size, num_microbatches, global_mb, seq_len)
 
     def whole_run(batch):
@@ -489,7 +546,7 @@ def run_minimal_gpt_training(n_devices=None, cfg=None, num_microbatches=4,
         for _ in range(num_steps):
             out = step(params, opt_state, scaler_state, batch)
             params, opt_state, scaler_state, loss = out[:4]
-            losses.append(lax.pmean(loss, DATA_AXIS))
+            losses.append(lax.pmean(loss, dp_axes))
             if return_grad_norms:
                 gnorms.append(out[4])
         if return_grad_norms:
@@ -497,9 +554,10 @@ def run_minimal_gpt_training(n_devices=None, cfg=None, num_microbatches=4,
         return jnp.stack(losses)
 
     out_specs = (P(), P()) if return_grad_norms else P()
+    spec = _dp_spec(dp_names)
     f = jax.jit(jax.shard_map(
         whole_run, mesh=mesh,
-        in_specs=({"ids": P(None, DATA_AXIS), "labels": P(None, DATA_AXIS)},),
+        in_specs=({"ids": P(None, spec), "labels": P(None, spec)},),
         out_specs=out_specs, check_vma=False))
     out = jax.block_until_ready(f(batch))
     if return_grad_norms:

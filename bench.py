@@ -149,6 +149,7 @@ def _capture_step_cost(step, run, step_args, iters, model_flops_per_step,
             compiled = lowered.compile()
     except Exception:
         pass
+    comm_compression = None
     try:
         import jax
 
@@ -157,12 +158,30 @@ def _capture_step_cost(step, run, step_args, iters, model_flops_per_step,
         # length (comm_from_jaxpr multiplies scan bodies by length)
         total = costs.comm_from_jaxpr(jax.make_jaxpr(run)(*step_args))
         comm = {k: v / iters for k, v in total.items()}
+        # comm-compression stamp (apex_tpu.parallel.collectives): when
+        # the process-wide comm knobs are on, the measured program's
+        # payload above is the COMPRESSED one — trace the uncompressed
+        # twin (collectives.disabled(): preferences resolve off, the
+        # program re-traces to the plain psum path) so the record
+        # carries both sides of the payload claim
+        from apex_tpu.parallel import collectives
+
+        snap = collectives.snapshot()
+        if snap.get("scheme") or snap.get("hierarchical"):
+            with collectives.disabled():
+                # fresh lambda: jax traces cache by function identity,
+                # and the twin must RE-trace under the disabled knobs
+                twin = costs.comm_from_jaxpr(
+                    jax.make_jaxpr(lambda *a: run(*a))(*step_args))
+            comm_compression = costs.comm_compression_block(
+                snap, {k: v / iters for k, v in twin.items()})
     except Exception:
         pass
     return costs.capture(lowered=lowered, compiled=compiled, steps=iters,
                          comm=comm,
                          model_flops_per_step=model_flops_per_step,
-                         platform=platform)
+                         platform=platform,
+                         comm_compression=comm_compression)
 
 
 def make_one_step(model, scaler, tx):

@@ -65,9 +65,14 @@ def shape_info(smoke):
     smoke vs TPU branches)."""
     if smoke:
         return dict(b=2, s=128, h=128, layers=2, heads=4, d=32,
-                    vocab=512, bench_b0=2, bench_b1=4)
+                    vocab=512, bench_b0=2, bench_b1=4,
+                    # profile_comm's flat grad payload (param count of
+                    # its minimal-GPT cfg — tests/test_collectives.py
+                    # asserts the mirror via eval_shape)
+                    comm_payload=118528)
     return dict(b=8, s=1024, h=768, layers=12, heads=12, d=64,
-                vocab=50304, bench_b0=8, bench_b1=16)
+                vocab=50304, bench_b0=8, bench_b1=16,
+                comm_payload=162716160)
 
 
 def rung_groups(smoke):
@@ -113,6 +118,26 @@ def rung_groups(smoke):
              variants={str(si["bench_b0"]): {"APEX_BENCH_BATCH": None},
                        str(si["bench_b1"]):
                            {"APEX_BENCH_BATCH": str(si["bench_b1"])}}),
+        # dp gradient-sync algorithm (apex_tpu.parallel.collectives,
+        # ROADMAP item 3): int8 block quantization + hierarchical
+        # two-stage reduction, A/B'd on benchmarks/profile_comm.py's
+        # minimal-GPT dp step. Keyed on the flat grad payload — the
+        # same bucket collectives' trace-time "grad_comm" consult uses.
+        # On the 1-chip window dp=1: the rung measures the compression
+        # COMPUTE overhead bound (the honest reason defaults stay off);
+        # a pod-slice window re-measures the same rung with real dp.
+        dict(name="grad_comm", op="grad_comm", harness="profile_comm",
+             metric="dp grad sync step",
+             dims=dict(n=si["comm_payload"]),
+             dtype="float32",
+             variants={"off": {"APEX_GRAD_COMPRESS": None,
+                               "APEX_HIER_ALLREDUCE": None},
+                       "int8": {"APEX_GRAD_COMPRESS": "int8",
+                                "APEX_HIER_ALLREDUCE": None},
+                       "hier": {"APEX_GRAD_COMPRESS": None,
+                                "APEX_HIER_ALLREDUCE": "1"},
+                       "int8_hier": {"APEX_GRAD_COMPRESS": "int8",
+                                     "APEX_HIER_ALLREDUCE": "1"}}),
     ]
 
 
@@ -170,6 +195,8 @@ def run_rung(harness, variant_env, smoke, ledger_path, timeout, log_dir,
     elif harness == "profile_gpt":
         cmd += [os.path.join(REPO, "benchmarks", "profile_gpt.py")]
         variant_env = dict(variant_env, APEX_GPT_ONLY_STEP="1")
+    elif harness == "profile_comm":
+        cmd += [os.path.join(REPO, "benchmarks", "profile_comm.py")]
     elif harness == "profile_optimizers":
         cmd += [os.path.join(REPO, "benchmarks", "profile_optimizers.py")]
     else:
@@ -269,9 +296,9 @@ def _measure(group, vname, venv, ctx):
                 # the chip (PERF.md §0)
                 result = {"value": rec["value"], "unit": "tokens/s",
                           "ledger": rec["ledger_id"], "pins": pins}
-        else:  # profile_gpt
+        else:  # profile_gpt / profile_comm (Tracer span harnesses)
             rec = next((r for r in reversed(recs)
-                        if r.get("harness") == "profile_gpt"), None)
+                        if r.get("harness") == harness), None)
             if rec:
                 ms = _span_ms(rec, group.get("metric", "FULL train step"))
                 if ms is not None:

@@ -184,6 +184,11 @@ def main():
             if g["harness"] == "bench":
                 env.setdefault("APEX_BENCH_ATTEMPTS", "1")
                 cmd = [sys.executable, bench]
+            elif g["harness"] == "profile_comm":
+                # the grad_comm A/B (apex_tpu.parallel.collectives):
+                # warmed under the exact knob env the rung measures with
+                cmd = [sys.executable,
+                       os.path.join(REPO, "benchmarks", "profile_comm.py")]
             else:
                 env["APEX_GPT_ONLY_STEP"] = "1"
                 cmd = [sys.executable, gpt]

@@ -66,6 +66,16 @@ Checks:
    an attribution costume. Records without the block (legacy, or
    null-degraded backends) are skipped — no block, no claim to check.
    Applies to PERF.md citations AND dispatch-table-cited records.
+7. **Comm-compression pin-match** — a cited record whose cost block
+   (run-level or any span's) carries a ``comm_compression`` stamp
+   claiming the payload was compressed (``scheme`` non-null) or
+   hierarchically staged must PIN the selecting knob in its recorded
+   ``knobs`` (``APEX_GRAD_COMPRESS``/``APEX_HIER_ALLREDUCE`` — the
+   quantized collectives of ``apex_tpu.parallel.collectives``): a row
+   measured with compression engaged through a process-wide setter
+   alone carries no pin the label can be checked against — the same
+   drift class as an unpinned A/B. Applies to PERF.md citations AND
+   dispatch-table-cited records.
 
 New PERF.md table rows must cite their ledger record id in the caption
 (``ledger:<id>``) — uncited legacy paragraphs are not flagged, but they
@@ -157,6 +167,41 @@ def mfu_problems(rec, rid):
     return []
 
 
+def comm_compress_problems(rec, rid):
+    """Check-7 pin-match for one cited record; [] when clean or when no
+    cost block carries a compression claim. The stamp's scheme /
+    hierarchical flags come from ``collectives.snapshot()`` at capture
+    time, so a setter-engaged compression that never pinned its env
+    knob is caught here — the record claims a compressed payload its
+    pins do not select."""
+    blocks = [rec.get("cost")]
+    for s in rec.get("spans") or []:
+        if isinstance(s, dict):
+            blocks.append(s.get("cost"))
+    knobs = rec.get("knobs") if isinstance(rec.get("knobs"), dict) else {}
+    problems = set()
+    for b in blocks:
+        cc = b.get("comm_compression") if isinstance(b, dict) else None
+        if not isinstance(cc, dict):
+            continue
+        scheme = cc.get("scheme")
+        if scheme and knobs.get("APEX_GRAD_COMPRESS") != scheme:
+            problems.add(
+                f"record {rid} was measured with compressed collectives "
+                f"(comm_compression.scheme={scheme!r}) but does not pin "
+                f"APEX_GRAD_COMPRESS={scheme!r} in its knobs "
+                f"(recorded: {knobs.get('APEX_GRAD_COMPRESS')!r}) — an "
+                f"unpinned compressed row cannot be cited")
+        if cc.get("hierarchical") \
+                and knobs.get("APEX_HIER_ALLREDUCE") != "1":
+            problems.add(
+                f"record {rid} was measured with hierarchical "
+                f"collectives (comm_compression.hierarchical=true) but "
+                f"does not pin APEX_HIER_ALLREDUCE=1 in its knobs "
+                f"(recorded: {knobs.get('APEX_HIER_ALLREDUCE')!r})")
+    return sorted(problems)
+
+
 def _paragraphs(text):
     """(start_lineno, paragraph_text) blocks of consecutive non-blank
     lines — the unit a caption and its numbers share."""
@@ -222,6 +267,9 @@ def check_captions(perf_text, perf_path, records):
                 problems.append(f"{perf_path}:{lineno}: {p}")
             # check 6: MFU/cost-block arithmetic consistency
             for p in mfu_problems(rec, rid):
+                problems.append(f"{perf_path}:{lineno}: {p}")
+            # check 7: comm-compression pin-match
+            for p in comm_compress_problems(rec, rid):
                 problems.append(f"{perf_path}:{lineno}: {p}")
             if rec.get("resumed_from") is not None \
                     and COLD_RE.search(para):
@@ -307,6 +355,10 @@ def check_dispatch_table(path, records):
                     problems.append(f"{tag}: {p}")
                 # check 6 on the table side: same arithmetic teeth
                 for p in mfu_problems(rec, rid):
+                    problems.append(f"{tag}: {p}")
+                # check 7 on the table side: a grad_comm entry decided
+                # by a compressed row must cite a knob-pinned record
+                for p in comm_compress_problems(rec, rid):
                     problems.append(f"{tag}: {p}")
     return problems, len(entries)
 

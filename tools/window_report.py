@@ -115,6 +115,7 @@ def ledger_summary(records):
     cost_present = cost_reporting = 0
     injected = 0
     attribution = []
+    comm_rows = []
     for rec in records:
         by_harness[rec.get("harness", "?")] = \
             by_harness.get(rec.get("harness", "?"), 0) + 1
@@ -141,6 +142,23 @@ def ledger_summary(records):
                     "step_floor_ms": cost.get("step_floor_ms"),
                     "peak_hbm_bytes": cost.get("peak_hbm_bytes"),
                 })
+            # the comm column: per-axis collective payload from the
+            # cost block, compressed-vs-uncompressed where the record
+            # carries the collectives stamp — comm gets attributed the
+            # same way flops do (ROADMAP item 3)
+            comm = cost.get("comm_bytes_per_axis")
+            if isinstance(comm, dict) and comm:
+                stamp = cost.get("comm_compression") \
+                    if isinstance(cost.get("comm_compression"), dict) \
+                    else {}
+                comm_rows.append({
+                    "id": rec.get("id"), "harness": rec.get("harness"),
+                    "bytes_per_axis": comm,
+                    "scheme": stamp.get("scheme"),
+                    "hierarchical": stamp.get("hierarchical"),
+                    "uncompressed_bytes_per_axis":
+                        stamp.get("uncompressed_bytes_per_axis"),
+                })
     ts = [r["ts"] for r in records
           if isinstance(r.get("ts"), (int, float))]
     return {
@@ -154,6 +172,7 @@ def ledger_summary(records):
                         "reporting": cost_reporting},
         "injected": injected,
         "attribution": attribution,
+        "comm": comm_rows,
     }
 
 
@@ -255,6 +274,19 @@ def print_report(report, out=None):
                    "check the model)")
             p(f"  attribution {a['id']} ({a['harness']}): measured MFU "
               f"{a['mfu']:.3f} vs bound {a['mfu_bound']:.3f}{gap}")
+        for c in led.get("comm", []):
+            axes = " ".join(f"{k}={int(v)}B" for k, v in
+                            sorted(c["bytes_per_axis"].items()))
+            line = (f"  comm {c['id']} ({c['harness']}): {axes}")
+            if c.get("scheme") or c.get("hierarchical"):
+                unc = c.get("uncompressed_bytes_per_axis") or {}
+                unc_s = " ".join(f"{k}={int(v)}B" for k, v in
+                                 sorted(unc.items()))
+                line += (f" [scheme={c['scheme']}"
+                         f" hier={bool(c['hierarchical'])}"
+                         + (f" uncompressed: {unc_s}" if unc_s else "")
+                         + "]")
+            p(line)
     logs = report.get("logs")
     if logs:
         p(f"logs: {logs['dir']}")
