@@ -37,7 +37,9 @@ SUITES = {
                 "test_contrib_sparsity_permutation.py"],
     "ops": ["test_ops_attention.py", "test_softmax_pallas.py",
             "test_attention_pallas.py", "test_xent_pallas.py",
-            "test_mosaic_block_rules.py", "test_tile_params.py"],
+            "test_mosaic_block_rules.py", "test_tile_params.py",
+            "test_decode_attention_pallas.py"],
+    "serving": ["test_serving.py"],
     "api_parity": ["test_api_parity_round3.py"],
     "harness": ["test_run_tests.py", "test_bench_contract.py",
                 "test_compile_cache.py", "test_resilience.py"],

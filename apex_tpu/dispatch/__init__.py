@@ -11,10 +11,11 @@ impl **and the ``ledger:<id>`` of the run that measured it**
 auditable back to a raw record — ``tools/check_bench_labels.py``
 validates the citation and the knob pins mechanically, in tier-1.
 
-Consulted at trace time by the four Pallas op families
-(attention/rows, layer-norm, scale-mask softmax, fused LM head), the
-FusedLAMB ``impl`` structure, the trunk remat policy, and bench.py's
-batch ladder — strictly BELOW any explicit signal. The precedence at
+Consulted at trace time by the five Pallas op families
+(attention/rows, layer-norm, scale-mask softmax, fused LM head, and
+the serving decode-attention kernel), the FusedLAMB ``impl``
+structure, the trunk remat policy, the grad-comm scheme, and
+bench.py's batch ladder — strictly BELOW any explicit signal. The precedence at
 every call site is:
 
     per-call knob  >  process-wide setter  >  table entry  >  built-in
@@ -97,6 +98,10 @@ OP_CHOICES = {
     "remat": ("none", "selective", "full"),
     "bench_batch": None,  # any positive int (as str)
     "grad_comm": ("off", "int8", "hier", "int8_hier"),
+    # the FIFTH Pallas family (serving decode, ISSUE 10): the q_len=1
+    # paged-KV kernel (ops/decode_attention_pallas.py) vs the XLA
+    # gather-attention reference path
+    "decode_attention": ("jnp", "pallas"),
 }
 
 REQUIRED_FIELDS = ("op", "bucket", "dtype", "backend", "choice", "ledger")

@@ -7,8 +7,10 @@ that arithmetic (layer_norm_pallas ``_row_block``, softmax_pallas
 ``_sq_block``, attention_pallas ``_q_block``/``_split_ok``, xent_pallas
 ``_row_block``/``_v_chunk``) and the block size itself was an
 *asserted* heuristic — the one dispatch decision the measured-dispatch
-rule didn't reach. This module is the single implementation all four
-kernels (and the dispatch table's ``params`` payloads) consult:
+rule didn't reach. This module is the single implementation all the
+Pallas kernels (the four training families plus the serving
+decode-attention kernel) and the dispatch table's ``params`` payloads
+consult:
 
 * ``legal(op, dims, dtype, params)`` — the judge. Empty list = the
   tile lowers (divisibility + VMEM model); non-empty names every
@@ -44,6 +46,8 @@ layer_norm     ``block_rows`` (row block, fwd + bwd)
 softmax        ``block_rows`` (sq block, fwd + bwd)
 lm_head        ``row_block`` (exact row block), ``vmem_budget``
                (bytes — the model cap the row block is sized under)
+decode_        ``block_h`` (heads per grid step of the paged-KV
+attention      serving decode kernel — ISSUE 10)
 =============  =====================================================
 """
 
@@ -78,12 +82,21 @@ XENT_ROW_CAP = 512  # the shipped _ROW_BLOCK cap
 XENT_MIN_VMEM = 1 * 1024 * 1024
 XENT_MAX_VMEM = 16 * 1024 * 1024
 
+# decode attention (ops/decode_attention_pallas.py — the serving
+# q_len=1 kernel over paged K/V, ISSUE 10): per grid step, block_h
+# heads' K and V page blocks plus the fp32 online-softmax accumulators
+# stay VMEM-resident. The page/head_dim block dims always span their
+# full array axes (the kernel's layout puts them last), so legality
+# here is divisibility of block_h into h plus the working-set budget.
+DECODE_VMEM_BUDGET = 8 * 1024 * 1024
+
 PARAM_KEYS = {
     "attention": ("block_q", "bwd_block_q", "block_k"),
     "attention_bwd": ("bwd_block_q", "block_k"),
     "layer_norm": ("block_rows",),
     "softmax": ("block_rows",),
     "lm_head": ("row_block", "vmem_budget"),
+    "decode_attention": ("block_h",),
 }
 
 # dims each op's model needs (the same names its dispatch bucket uses)
@@ -93,6 +106,7 @@ DIM_KEYS = {
     "layer_norm": ("rows", "hidden"),
     "softmax": ("b", "h", "sq", "sk"),
     "lm_head": ("n", "v", "h"),
+    "decode_attention": ("b", "h", "pages", "ps", "d"),
 }
 
 _DTYPE_BYTES = {"bfloat16": 2, "float16": 2, "float32": 4, "float64": 8}
@@ -109,11 +123,36 @@ def env_int(name):
     """Positive-int env tile knob, read at TRACE time (None when unset
     or garbage — an env knob is a preference, never a raise). The one
     parser behind APEX_ATTN_BLOCK_Q / APEX_LN_BLOCK_ROWS /
-    APEX_SOFTMAX_BLOCK_ROWS / APEX_XENT_ROW_BLOCK, so the kernels'
-    knob-parsing semantics cannot drift apart."""
+    APEX_SOFTMAX_BLOCK_ROWS / APEX_XENT_ROW_BLOCK /
+    APEX_DECODE_ATTN_BLOCK_H, so the kernels' knob-parsing semantics
+    cannot drift apart."""
     v = os.environ.get(name)
     if v and v.isdigit() and int(v) > 0:
         return int(v)
+    return None
+
+
+_warned_env = set()
+
+
+def env_choice(name, allowed):
+    """Enumerated env preference: the value when it is in ``allowed``,
+    else None — an unknown value warns ONCE per (knob, value) and is
+    ignored (env knobs are preferences, never raises; per-call
+    arguments raise instead). The one implementation behind
+    APEX_DECODE_ATTN_IMPL and APEX_SERVE_WEIGHT_QUANT, so the
+    warn-once-and-ignore semantics cannot drift per module."""
+    v = os.environ.get(name)
+    if v in (None, ""):
+        return None
+    if v in allowed:
+        return v
+    if (name, v) not in _warned_env:
+        import warnings
+
+        warnings.warn(f"{name}={v!r} is not one of {sorted(allowed)} "
+                      f"— ignored (preference semantics)")
+        _warned_env.add((name, v))
     return None
 
 
@@ -335,6 +374,46 @@ def _xent_legal(dims, dtype, params):
     return problems
 
 
+# ------------------------------------------------------ decode attention
+
+def decode_vmem_bytes(bh, ps, d, itembytes):
+    """Resident set of one decode-attention grid step: block_h heads'
+    K + V page blocks plus the fp32 q row and (acc, m, l) online-softmax
+    accumulators."""
+    return 2 * bh * ps * d * itembytes + 4 * bh * d + 4 * bh * (d + 2)
+
+
+def decode_block_h(h, ps, d, itembytes):
+    """The decode-attention heuristic: largest power-of-two head block
+    dividing h whose page working set fits the budget (>= 1 — a single
+    head's page block is the kernel's minimum unit; 0 only when even
+    that overflows)."""
+    cap = max(1, DECODE_VMEM_BUDGET // max(1, decode_vmem_bytes(
+        1, ps, d, itembytes)))
+    b = chain_block(h, cap)
+    return b if decode_vmem_bytes(b, ps, d, itembytes) \
+        <= DECODE_VMEM_BUDGET else 0
+
+
+def _decode_legal(dims, dtype, params):
+    h, ps, d = dims["h"], dims["ps"], dims["d"]
+    bh = params.get("block_h")
+    problems = []
+    if bh is not None:
+        if not isinstance(bh, int) or bh < 1:
+            problems.append(f"block_h={bh!r} must be a positive int")
+        elif h % bh:
+            problems.append(f"block_h={bh} does not divide h={h}")
+        elif decode_vmem_bytes(bh, ps, d, itemsize(dtype)) \
+                > DECODE_VMEM_BUDGET:
+            problems.append(
+                f"block_h={bh}: page working set "
+                f"{decode_vmem_bytes(bh, ps, d, itemsize(dtype))} B "
+                f"exceeds the {DECODE_VMEM_BUDGET} B VMEM budget at "
+                f"ps={ps} d={d}")
+    return problems
+
+
 # ----------------------------------------------------------- the surface
 
 _LEGAL = {
@@ -343,6 +422,7 @@ _LEGAL = {
     "layer_norm": _ln_legal,
     "softmax": _sm_legal,
     "lm_head": _xent_legal,
+    "decode_attention": _decode_legal,
 }
 
 
@@ -402,6 +482,13 @@ def model_vmem_bytes(op, dims, dtype, params=None):
             return None
         h = dims["h"]
         return 6 * bv * h + br * max(8 * h + 8 * bv, 6 * h + 10 * bv)
+    if op == "decode_attention":
+        bh = params.get("block_h") or decode_block_h(
+            dims["h"], dims["ps"], dims["d"], itemsize(dtype))
+        if not bh:
+            return None
+        return decode_vmem_bytes(bh, dims["ps"], dims["d"],
+                                 itemsize(dtype))
     return None
 
 
@@ -448,6 +535,10 @@ def default_params(op, dims, dtype):
             return None
         br = xent_row_block(dims["n"], dims["h"], bv)
         return {"row_block": br} if br else None
+    if op == "decode_attention":
+        bh = decode_block_h(dims["h"], dims["ps"], dims["d"],
+                            itemsize(dtype))
+        return {"block_h": bh} if bh else None
     return None
 
 
@@ -473,11 +564,17 @@ def candidates(op, dims, dtype, max_candidates=8):
     # pow2 neighborhood of the incumbent: /8 .. x4 (tiles far below the
     # VMEM cap re-read the streamed operands proportionally more — a
     # sweep minute is better spent near the cap; the per-call knob can
-    # still request anything legal)
-    b = max(SUBLANE, base[key] // 8)
+    # still request anything legal). decode_attention's head block has
+    # no sublane floor (a single head's page block is the minimum unit).
+    floor = 1 if op == "decode_attention" else SUBLANE
+    b = max(floor, base[key] // 8)
     while b <= base[key] * 4:
         add({key: b})
         b *= 2
+    if op == "decode_attention":
+        # the all-heads-in-one-step tile is the natural upper candidate
+        # even when h is not a power of two (h=12 -> 12)
+        add({key: dims["h"]})
     if op in ("attention", "attention_bwd"):
         # the split k-major block rides the bwd entry: sweep block_k at
         # the heuristic q block where the split pass is eligible at all

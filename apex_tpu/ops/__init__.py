@@ -11,5 +11,8 @@ from apex_tpu.ops.context_parallel import (  # noqa: F401
     ring_attention,
     ulysses_attention,
 )
+from apex_tpu.ops.decode_attention_pallas import (  # noqa: F401
+    decode_attention,
+)
 from apex_tpu.ops import layer_norm_pallas  # noqa: F401
 from apex_tpu.ops import softmax_pallas  # noqa: F401

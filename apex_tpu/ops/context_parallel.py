@@ -122,13 +122,26 @@ def ring_attention(q, k, v, axis_name, *, causal=True, sm_scale=None,
 
 
 def ulysses_attention(q, k, v, axis_name, *, causal=True, sm_scale=None,
-                      dropout_p=0.0, dropout_seed=None, **attn_kwargs):
+                      dropout_p=0.0, dropout_seed=None, segment_ids=None,
+                      **attn_kwargs):
     """All-to-all (Ulysses) context-parallel attention.
 
     Args/returns as ``ring_attention``. Requires ``h % cp == 0``: the
     all-to-all trades the sequence sharding for a head sharding, each rank
     then runs the ordinary fused attention kernel over FULL sequences for
     its h/cp heads, and the reverse all-to-all restores sequence sharding.
+
+    ``segment_ids``: shard-local ``(seg_q [b, s_loc], seg_kv [b, s_loc])``
+    (or one array for both) — packed varlen batches, exactly the serving
+    prefill input shape (ISSUE 10; reference capability:
+    apex/contrib/fmha packed cu_seqlens). The ids ride their own
+    re-shard: while q/k/v trade sequence sharding for head sharding
+    through the all-to-all, the ids are head-independent, so an
+    ``all_gather`` along the same axis (axis-order concatenation —
+    identical to the all_to_all's sequence order) rebuilds the GLOBAL
+    id row every head group needs; the per-head-group kernel then masks
+    cross-segment pairs exactly like the single-chip path. Parity vs a
+    per-segment dense reference: tests/test_context_parallel.py.
 
     ``dropout_p``/``dropout_seed``: inverted attention dropout via the
     VMEM-rows kernel's in-kernel hash (each rank owns DISJOINT global
@@ -146,11 +159,6 @@ def ulysses_attention(q, k, v, axis_name, *, causal=True, sm_scale=None,
         raise ValueError(f"dropout_p={dropout_p} outside [0, 1)")
     if dropout_p > 0.0 and dropout_seed is None:
         raise ValueError("dropout_p > 0 requires dropout_seed")
-    if "segment_ids" in attn_kwargs and attn_kwargs["segment_ids"] is not None:
-        raise NotImplementedError(
-            "ulysses_attention: segment_ids are shard-local and would need "
-            "their own all-to-all re-shard alongside q/k/v; pass packed "
-            "batches through ring_attention or the single-chip kernel")
 
     def scatter_heads(x):
         # [b, h, s_loc, d] -> [b, h/cp, s_glob, d]: split heads across the
@@ -162,11 +170,21 @@ def ulysses_attention(q, k, v, axis_name, *, causal=True, sm_scale=None,
         return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
                               tiled=True)
 
+    seg_glob = None
+    if segment_ids is not None:
+        seg_q, seg_kv = (segment_ids if isinstance(segment_ids,
+                                                   (tuple, list))
+                         else (segment_ids, segment_ids))
+        seg_glob = tuple(
+            lax.all_gather(sg.astype(jnp.int32), axis_name, axis=1,
+                           tiled=True)
+            for sg in (seg_q, seg_kv))
+
     qh, kh, vh = scatter_heads(q), scatter_heads(k), scatter_heads(v)
     if dropout_p > 0.0:
         from apex_tpu.ops import attention_pallas
 
-        # an explicitly-passed default (e.g. segment_ids=None) IS its
+        # an explicitly-passed default (e.g. force_dense=None) IS its
         # default — only non-default demands are un-honorable
         demands = {k: v for k, v in attn_kwargs.items() if v is not None}
         if demands:
@@ -197,9 +215,9 @@ def ulysses_attention(q, k, v, axis_name, *, causal=True, sm_scale=None,
         ctx = attention_pallas.fused_attention_rows(
             qh, kh, vh, causal,
             sm_scale if sm_scale is not None else 1.0 / math.sqrt(d),
-            None, jax.devices()[0].platform == "cpu", None, None,
+            seg_glob, jax.devices()[0].platform == "cpu", None, None,
             float(dropout_p), seed)
     else:
         ctx = fused_attention(qh, kh, vh, causal=causal, sm_scale=sm_scale,
-                              **attn_kwargs)
+                              segment_ids=seg_glob, **attn_kwargs)
     return gather_heads(ctx)

@@ -50,7 +50,7 @@ PASS_ROWS = (
     "resnet", "pretrain", "pretrain_bert", "pretrain_gpt345",
     "convergence", "gpt_rows", "gpt_fused_head", "gpt_ln_pallas",
     "gpt_remat_sel", "attn_seq4096", "bench", "bench_b32",
-    "bench_b32_remat", "bench_profile",
+    "bench_b32_remat", "bench_profile", "serving",
 )
 
 

@@ -1,0 +1,37 @@
+"""apex_tpu.serving — the inference serving stack (ISSUE 10).
+
+The repo's first decode path: prefill/decode split over a static-shape
+PAGED KV cache, a host-side continuous-batching scheduler, the
+decode-attention Pallas kernel as the fifth dispatch family, and int8
+weight quantization for the decode matmuls behind
+``APEX_SERVE_WEIGHT_QUANT``. Grounded in PAPERS.md "Fine-Tuning and
+Serving Gemma 4 31B on Cloud TPU" (arXiv:2605.25645 — the
+prefill/decode + KV-cache design) with the host/device overlap
+discipline of "Exploring the limits of Concurrency in ML Training on
+Google TPUs" (arXiv:2011.03641).
+
+Layering:
+
+* ``kv_cache``   — the paged cache arrays + the host-side block
+                   allocator (explicit free list; page 0 reserved null)
+* ``model``      — pure jitted prefill / decode-step functions over
+                   the GPTModel param tree (weights shared with
+                   training — no conversion step)
+* ``quant``      — int8 per-channel weight quantization for the
+                   decode matmuls (knob-gated, default OFF)
+* ``scheduler``  — stdlib-only continuous batching: admit/evict
+                   between decode steps against a synthetic trace
+* ``engine``     — the glue: one ServingEngine owning cache, params,
+                   compiled steps and the scheduler loop
+"""
+
+from apex_tpu.serving.kv_cache import (  # noqa: F401
+    PageAllocator,
+    init_cache,
+)
+from apex_tpu.serving.scheduler import (  # noqa: F401
+    ContinuousBatchingScheduler,
+    Request,
+    synthetic_trace,
+)
+from apex_tpu.serving.engine import ServingEngine, detokenize  # noqa: F401

@@ -1,0 +1,250 @@
+"""ServingEngine: cache + compiled steps + scheduler in one object.
+
+The host/device shape follows the concurrency-paper discipline
+(PAPERS.md arXiv:2011.03641): ALL host work — admission, eviction,
+page accounting, array staging — happens between device dispatches,
+and the device programs themselves are compiled exactly once each
+(prefill at one packed bucket shape, decode at the slot shape), so
+the steady-state loop is dispatch → host bookkeeping → dispatch with
+no recompiles on the critical path. Scheduler events change array
+VALUES only; ``decode_cache_size()`` exposes the jit cache size so
+tests (and ``dryrun_serving``) can assert the contract mechanically.
+
+Knob resolution at engine build (the CLAUDE.md asymmetry):
+
+* ``weight_quant=`` per-call True RAISES when the params cannot take
+  the int8 path; None defers to ``quant.set_weight_quant`` /
+  ``APEX_SERVE_WEIGHT_QUANT`` (preferences), default OFF.
+* ``decode_impl=`` / ``decode_block_h=`` ride per-call into the
+  decode-attention family on every step (raising semantics live
+  there); None defers to the family's setter/env/table resolution.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.serving import model as smodel
+from apex_tpu.serving import quant as quant_mod
+from apex_tpu.serving.kv_cache import PageAllocator, init_cache
+from apex_tpu.serving.scheduler import ContinuousBatchingScheduler
+
+
+def detokenize(tokens):
+    """Toy detokenizer for dryruns/smokes: token id -> letter."""
+    return "".join(chr(97 + int(t) % 26) for t in tokens)
+
+
+class ServingEngine:
+    def __init__(self, cfg, params=None, *, num_slots=4, page_size=16,
+                 num_pages=64, max_seq=None, prefill_len=64,
+                 prefill_requests=None, weight_quant=None,
+                 decode_impl=None, decode_block_h=None, interpret=None,
+                 seed=0):
+        smodel.check_serving_config(cfg)
+        self.cfg = cfg
+        self.num_slots = int(num_slots)
+        self.page_size = int(page_size)
+        self.max_seq = int(max_seq or cfg.max_position_embeddings)
+        if self.max_seq > cfg.max_position_embeddings:
+            raise ValueError("max_seq exceeds the position table")
+        self.max_pages = -(-self.max_seq // self.page_size)
+        self.prefill_len = int(prefill_len)
+        self.prefill_requests = int(prefill_requests or num_slots)
+        self.params = params if params is not None \
+            else smodel.init_gpt_params(cfg, seed)
+
+        # weight quant: per-call demand raises on un-honorable;
+        # env/setter preferences fall back (quant.resolve)
+        if weight_quant is True:
+            for name, w in (("word_embeddings",
+                             self.params["word_embeddings"]),):
+                if not quant_mod.quantizable(w):
+                    raise ValueError(
+                        f"weight_quant=True cannot be honored: {name} "
+                        f"has dtype {w.dtype}")
+        self.weight_quant = quant_mod.resolve(weight_quant)
+        self.qparams = smodel.quantize_decode_params(
+            self.params, cfg) if self.weight_quant else None
+        self.decode_impl = decode_impl
+        self.decode_block_h = decode_block_h
+        self.interpret = interpret
+
+        self.cache = init_cache(
+            cfg.num_layers, cfg.num_attention_heads, num_pages,
+            page_size, cfg.head_dim, smodel.compute_dtype(cfg))
+        self.allocator = PageAllocator(num_pages)
+        self.scheduler = ContinuousBatchingScheduler(
+            num_slots, self.max_pages, page_size, self.allocator)
+
+        def _prefill(cache, ids, positions, seg, token_rows,
+                     page_table, last_idx):
+            return smodel.prefill(self.params, cache, ids, positions,
+                                  seg, token_rows, page_table,
+                                  last_idx, cfg=cfg)
+
+        def _decode(cache, tokens, lengths, page_table):
+            return smodel.decode_step(
+                self.params, cache, tokens, lengths, page_table,
+                cfg=cfg, qparams=self.qparams,
+                decode_impl=self.decode_impl,
+                decode_block_h=self.decode_block_h,
+                interpret=self.interpret)
+
+        # donate the cache: the scatter-updated pages stay in place
+        self._prefill_fn = jax.jit(_prefill, donate_argnums=(0,))
+        self._decode_fn = jax.jit(_decode, donate_argnums=(0,))
+        self.tick = 0
+        self.decode_steps = 0
+        self.tokens_generated = 0
+
+    # ---------------------------------------------------------- plumbing
+
+    def decode_cache_size(self):
+        """jit-cache entry count of the decode step — the
+        jaxpr-stability assertion surface (must stay 1 whatever the
+        scheduler admits or evicts)."""
+        return self._decode_fn._cache_size()
+
+    def submit(self, request):
+        """Enqueue one request; impossible requests raise HERE, before
+        anything is enqueued or allocated. The scheduler validates the
+        page budget (max_seq); the engine additionally owns the packed
+        prefill bucket, so the prompt-vs-prefill_len bound — which
+        would otherwise crash _run_prefill mid-round AFTER admission
+        had already filled a slot and allocated pages — is checked at
+        the same front door."""
+        if len(request.prompt) > self.prefill_len:
+            raise ValueError(
+                f"request {request.rid}: prompt ({len(request.prompt)} "
+                f"tokens) exceeds prefill_len={self.prefill_len}")
+        request.enqueue_wall = time.perf_counter()
+        self.scheduler.submit(request)
+
+    # ----------------------------------------------------------- prefill
+
+    def _run_prefill(self, slot_indices):
+        """Pack the newly admitted slots' prompts into [prefill_len]
+        batches (segment ids 1..R per batch; padding 0 -> null page
+        row) and fill the cache. Greedy packing: a batch closes when
+        the next prompt would overflow the bucket or the per-batch
+        request cap — further admissions start a new packed dispatch
+        of the SAME compiled program. Sets each slot's first decode
+        token."""
+        sch = self.scheduler
+        S, R = self.prefill_len, self.prefill_requests
+        batches, cur, used = [], [], 0
+        for si in slot_indices:
+            n = len(sch.slots[si].request.prompt)
+            if n > S:
+                raise ValueError(
+                    f"prompt of request "
+                    f"{sch.slots[si].request.rid} ({n} tokens) exceeds "
+                    f"prefill_len={S}")
+            if cur and (used + n > S or len(cur) >= R):
+                batches.append(cur)
+                cur, used = [], 0
+            cur.append(si)
+            used += n
+        if cur:
+            batches.append(cur)
+        # page table rows [num_slots + 1, max_pages]: the spare row is
+        # the padding tokens' all-null destination
+        pt = np.zeros((self.num_slots + 1, self.max_pages), np.int32)
+        pt[:self.num_slots] = sch.page_table_rows()
+        wall = None
+        for batch in batches:
+            ids = np.zeros((S,), np.int32)
+            positions = np.zeros((S,), np.int32)
+            seg = np.zeros((S,), np.int32)
+            token_rows = np.full((S,), self.num_slots, np.int32)
+            last_idx = np.zeros((R,), np.int32)
+            cursor = 0
+            for r, si in enumerate(batch):
+                prompt = sch.slots[si].request.prompt
+                n = len(prompt)
+                ids[cursor:cursor + n] = prompt
+                positions[cursor:cursor + n] = np.arange(n)
+                seg[cursor:cursor + n] = r + 1
+                token_rows[cursor:cursor + n] = si
+                last_idx[r] = cursor + n - 1
+                cursor += n
+            self.cache, logits = self._prefill_fn(
+                self.cache, jnp.asarray(ids), jnp.asarray(positions),
+                jnp.asarray(seg), jnp.asarray(token_rows),
+                jnp.asarray(pt), jnp.asarray(last_idx))
+            next_toks = np.asarray(
+                jnp.argmax(logits.astype(jnp.float32), axis=-1))
+            wall = time.perf_counter()
+            for r, si in enumerate(batch):
+                slot = sch.slots[si]
+                slot.pos = len(slot.request.prompt)
+                tok = int(next_toks[r])
+                slot.request.out_tokens.append(tok)
+                slot.next_token = tok
+                self.tokens_generated += 1
+                if slot.request.done():
+                    slot.request.finish_wall = wall
+        return slot_indices
+
+    # ------------------------------------------------------------- steps
+
+    def step(self, arrivals=None):
+        """One scheduler round: enqueue due arrivals, evict, admit (+
+        prefill), decode every active slot. Returns a dict of what
+        happened (the dryrun/trace-replay surface)."""
+        sch = self.scheduler
+        now = self.tick
+        if arrivals:
+            for req in arrivals:
+                self.submit(req)
+        wall = time.perf_counter()
+        evicted = sch.evict_done(now, wall)
+        admitted = sch.admit(now)
+        prefilled = self._run_prefill(admitted) if admitted else []
+        active = sch.active_indices()
+        decoded = 0
+        if active:
+            tokens, lengths = sch.decode_inputs()
+            pt = np.asarray(sch.page_table_rows(), np.int32)
+            self.cache, next_toks, _ = self._decode_fn(
+                self.cache, jnp.asarray(tokens, dtype=jnp.int32),
+                jnp.asarray(lengths, dtype=jnp.int32), jnp.asarray(pt))
+            next_toks = np.asarray(next_toks)
+            wall2 = time.perf_counter()
+            for i in active:
+                slot = sch.slots[i]
+                slot.pos += 1
+                if not slot.request.done():
+                    tok = int(next_toks[i])
+                    slot.request.out_tokens.append(tok)
+                    slot.next_token = tok
+                    self.tokens_generated += 1
+                    if slot.request.done():
+                        slot.request.finish_wall = wall2
+                decoded += 1
+            self.decode_steps += 1
+        # a slot whose LAST token was just produced frees at the next
+        # round's evict — one round of slack, never a starved queue
+        self.tick += 1
+        return {"tick": now, "evicted": [r.rid for r in evicted],
+                "admitted": admitted, "prefilled": prefilled,
+                "decoded_slots": decoded}
+
+    def run_trace(self, requests, max_ticks=10000):
+        """Replay a synthetic trace to completion: requests are
+        submitted when their arrival tick is due; returns the
+        completed Request list (latency fields filled)."""
+        pending = sorted(requests, key=lambda r: (r.arrival, r.rid))
+        n_total = len(pending)
+        while len(self.scheduler.completed) < n_total:
+            if self.tick >= max_ticks:
+                raise RuntimeError(
+                    f"trace did not drain in {max_ticks} ticks "
+                    f"({len(self.scheduler.completed)}/{n_total} done)")
+            due = [r for r in pending if r.arrival <= self.tick]
+            pending = [r for r in pending if r.arrival > self.tick]
+            self.step(arrivals=due)
+        return list(self.scheduler.completed)

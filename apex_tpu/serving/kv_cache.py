@@ -1,0 +1,102 @@
+"""Paged KV cache: block-granular allocation as index arithmetic.
+
+Device side: per layer, K and V live as ``[h, num_pages, page_size,
+head_dim]`` arrays stacked over layers into ``[layers, h, num_pages,
+page_size, head_dim]`` — the page axis is a plain array axis, so
+"allocating" a page to a sequence is writing its index into that
+sequence's page-table row and "freeing" it is forgetting the index.
+No reshape, no growing array, no recompile: the decode step's operand
+shapes are fixed for the life of the engine, whatever the scheduler
+does between steps (the ISSUE 10 jaxpr-stability contract, asserted
+by tests/test_serving.py).
+
+The head axis leads the page axis because the decode-attention
+kernel's BlockSpec tiles heads (``block_h``) while the page block's
+trailing ``(page_size, head_dim)`` dims span their full array axes —
+Mosaic's last-two-dims rule is then satisfied for every legal head
+block (see ops/decode_attention_pallas.py).
+
+Host side: :class:`PageAllocator` — an explicit free list over pages
+``1..num_pages-1``. Page 0 is RESERVED as the null page: padded
+page-table tails and padded prefill tokens point at it, so a garbage
+index can never alias a live sequence's data (the kernel skips those
+positions by context length; the null page absorbs the writes).
+"""
+
+import jax.numpy as jnp
+
+
+def init_cache(num_layers, num_heads, num_pages, page_size, head_dim,
+               dtype=jnp.bfloat16):
+    """Zeroed cache dict ``{"k", "v"}`` of
+    ``[layers, h, num_pages, page_size, head_dim]`` arrays."""
+    shape = (num_layers, num_heads, num_pages, page_size, head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def pages_needed(tokens, page_size):
+    """Pages to hold ``tokens`` positions at this page size."""
+    return -(-int(tokens) // int(page_size))
+
+
+class PageAllocator:
+    """Explicit-free-list page allocator (host-side, stdlib-only).
+
+    Pages ``1..num_pages-1`` are allocatable; page 0 is the reserved
+    null page (module docstring). Allocation is all-or-nothing per
+    request: :meth:`alloc` returns the page list or None when the free
+    list is short — the scheduler then leaves the request queued
+    (admission control, never a partial grant).
+    """
+
+    def __init__(self, num_pages):
+        if num_pages < 2:
+            raise ValueError("need >= 2 pages (page 0 is reserved)")
+        self.num_pages = int(num_pages)
+        # LIFO free list: recently freed pages are re-used first (their
+        # cache lines are the warmest)
+        self._free = list(range(1, self.num_pages))
+        self._owned = {}  # owner id -> list of page indices
+
+    @property
+    def free_count(self):
+        return len(self._free)
+
+    def live_pages(self, owner=None):
+        if owner is not None:
+            return list(self._owned.get(owner, ()))
+        return [p for pages in self._owned.values() for p in pages]
+
+    def alloc(self, owner, n):
+        """Allocate ``n`` pages to ``owner`` (appending to any it
+        already holds); returns the new page list or None when the
+        free list cannot cover the request (state unchanged)."""
+        n = int(n)
+        if n == 0:
+            return []  # no phantom empty ownership entry either
+        if len(self._free) < n:
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        self._owned.setdefault(owner, []).extend(pages)
+        return pages
+
+    def free(self, owner):
+        """Return all of ``owner``'s pages to the free list."""
+        for p in self._owned.pop(owner, ()):
+            self._free.append(p)
+
+    def check_invariants(self):
+        """Raise AssertionError on aliasing or accounting drift — the
+        test surface for the paged-allocator invariants (ISSUE 10):
+        no page owned twice, no page both free and owned, page 0 never
+        handed out, free + live == allocatable."""
+        live = self.live_pages()
+        assert len(live) == len(set(live)), (
+            f"page aliasing across live owners: {sorted(live)}")
+        assert 0 not in live and 0 not in self._free, (
+            "null page 0 escaped the reservation")
+        overlap = set(live) & set(self._free)
+        assert not overlap, f"pages both free and owned: {overlap}"
+        assert len(live) + len(self._free) == self.num_pages - 1, (
+            f"accounting drift: {len(live)} live + "
+            f"{len(self._free)} free != {self.num_pages - 1}")

@@ -1,0 +1,321 @@
+"""Pure prefill / decode-step functions over the GPTModel param tree.
+
+The serving forward consumes the EXACT parameter tree
+``GPTModel.init`` produces (standalone_transformer_lm.py — flagship
+model; weights move from training to serving with no conversion), and
+mirrors its numerics op-for-op: fp32 layer-norm statistics
+(normalization/fused_layer_norm.py jnp path), ``x @ W^T`` matmuls with
+fp32 accumulation cast back to the compute dtype
+(tensor_parallel/layers.py ``_mm``), the per-head ``[q|k|v]``
+interleaving of the fused qkv projection, approximate-gelu MLP, and
+tied logits against the word table (``parallel_lm_logits``). Parity
+with ``GPTModel.apply`` is asserted in tests/test_serving.py — the
+serving stack's numbers are the training stack's numbers.
+
+Two jitted programs (built once per engine — the ISSUE 10
+jaxpr-stability contract):
+
+* :func:`prefill` — one packed varlen prompt batch ``[S_pack]`` with
+  segment ids (exactly the fmha-style packed shape the CP satellite
+  opens up): causal + segment-masked attention via ``fused_attention``,
+  every token's K/V scattered into its request's cache pages (pure
+  index arithmetic — page/offset computed from the page table), and
+  the next-token logits gathered at each request's last prompt token.
+* :func:`decode_step` — one token per active slot over the paged
+  cache: append K/V at ``length-1``, attend through the dispatched
+  decode-attention family (ops/decode_attention_pallas.py), greedy
+  next token. Decode matmuls optionally run int8-quantized weights
+  (``apex_tpu.serving.quant`` — knob-gated, default OFF).
+
+Serving constraints (validated by :func:`check_serving_config`): no
+dropout, no query-key layer scaling (its coeff is a training-range
+trick; minimal.py disables it for the same uniformity reason), single
+chip (tp=1 param shapes), no MoE/sequence/context parallelism.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from apex_tpu.serving import quant as quant_mod
+
+
+def check_serving_config(cfg):
+    """Raise on TransformerConfig options the serving forward does not
+    model (explicit refusal beats silent numeric drift)."""
+    problems = []
+    if cfg.hidden_dropout or cfg.attention_dropout:
+        problems.append("dropout > 0 (serving is deterministic)")
+    if cfg.apply_query_key_layer_scaling:
+        problems.append("apply_query_key_layer_scaling (training-range "
+                        "trick; set False like minimal.py)")
+    if cfg.num_moe_experts:
+        problems.append("MoE")
+    if cfg.sequence_parallel or cfg.context_parallel_axis:
+        problems.append("sequence/context parallelism (single-chip "
+                        "serving engine)")
+    if problems:
+        raise ValueError("serving does not support: "
+                         + "; ".join(problems))
+
+
+def compute_dtype(cfg):
+    return jnp.bfloat16 if cfg.bf16 else (
+        jnp.float16 if cfg.fp16 else jnp.float32)
+
+
+def init_gpt_params(cfg, seed=0):
+    """GPTModel.init on a 1-device TENSOR_AXIS mesh (the lax.axis_size
+    calls inside the model need the axis bound) — the serving param
+    source when no trained checkpoint is supplied."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+    from apex_tpu.transformer.testing import GPTModel
+
+    model = GPTModel(cfg)
+    mesh = Mesh(np.asarray(jax.devices()[:1]), (TENSOR_AXIS,))
+    b, s = 1, min(8, cfg.max_position_embeddings)
+    ids = jnp.zeros((b, s), jnp.int32)
+    pos = jnp.zeros((b, s), jnp.int32)
+
+    def init(ids, pos):
+        return model.init(jax.random.PRNGKey(seed), ids, pos,
+                          None)["params"]
+
+    return jax.jit(jax.shard_map(
+        init, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+        check_vma=False))(ids, pos)
+
+
+def _mm(x, w, dtype):
+    """x @ w^T, fp32 accumulation (the layers.py `_mm` idiom)."""
+    return lax.dot_general(
+        x.astype(dtype), w.astype(dtype),
+        (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dtype)
+
+
+def _layer_norm(x, p, eps):
+    """fp32-stats LN (fused_layer_norm's jnp path, op-for-op)."""
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + eps)
+    y = y * p["weight"].astype(jnp.float32) \
+        + p["bias"].astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def _split_qkv(qkv, n_heads, hd):
+    """[rows, 3*proj] -> (q, k, v) each [rows, n_heads, hd] with the
+    per-head [q|k|v] interleaving of ParallelAttention's fused
+    projection (reshape to [rows, np, 3*hd], split on the last axis)."""
+    rows = qkv.shape[0]
+    qkv = qkv.reshape(rows, n_heads, 3 * hd)
+    return (qkv[..., :hd], qkv[..., hd:2 * hd], qkv[..., 2 * hd:])
+
+
+def quantize_decode_params(params, cfg):
+    """The decode-side weight records: each matmul weight becomes
+    ``{"wq", "scale"}`` (int8 + per-channel fp32); biases and norms
+    stay full precision, and the word table keeps its float copy for
+    the embedding GATHER (only the logits MATMUL runs the int8 copy —
+    the gather reads one row per token, the matmul reads them all)."""
+    qp = {"layers": [], "word_logits": None}
+    for i in range(cfg.num_layers):
+        lp = params["transformer"][f"layer_{i}"]
+        rec = {}
+        for name, sub in (("qkv", lp["self_attention"]["query_key_value"]),
+                          ("dense", lp["self_attention"]["dense"]),
+                          ("h4", lp["mlp"]["dense_h_to_4h"]),
+                          ("4h", lp["mlp"]["dense_4h_to_h"])):
+            wq, scale = quant_mod.quantize_weight(sub["weight"])
+            rec[name] = {"wq": wq, "scale": scale}
+        qp["layers"].append(rec)
+    wq, scale = quant_mod.quantize_weight(params["word_embeddings"])
+    qp["word_logits"] = {"wq": wq, "scale": scale}
+    return qp
+
+
+def _wmat(x, full_w, qrec, dtype):
+    """One decode matmul: the int8 record when quantization resolved
+    ON (qrec non-None), else the full-precision weight."""
+    if qrec is not None:
+        return quant_mod.qmatmul(x, qrec["wq"], qrec["scale"], dtype)
+    return _mm(x, full_w, dtype)
+
+
+def _trunk_layer(x, lp, qr, cfg, attn):
+    """ONE transformer layer of the serving trunk — shared verbatim by
+    prefill and decode so the two paths cannot drift numerically (the
+    decode-vs-prefill parity the acceptance pins is a property of this
+    function, applied twice). ``qr`` is the layer's int8 record dict
+    ({} = full precision — ``_wmat`` with qrec None IS ``_mm``);
+    ``attn(q, k, v)`` owns everything path-specific: the cache scatter
+    for this layer's k/v and the attention itself, returning the
+    ``[rows, n_heads*head_dim]`` context."""
+    dtype = x.dtype
+    ln1 = _layer_norm(x, lp["input_layernorm"], cfg.layernorm_epsilon)
+    sa = lp["self_attention"]
+    qkv = _wmat(ln1, sa["query_key_value"]["weight"], qr.get("qkv"),
+                dtype) + sa["query_key_value"]["bias"].astype(dtype)
+    q, k, v = _split_qkv(qkv, cfg.num_attention_heads, cfg.head_dim)
+    ctx = attn(q, k, v)
+    attn_out = _wmat(ctx, sa["dense"]["weight"], qr.get("dense"),
+                     dtype) + sa["dense"]["bias"].astype(dtype)
+    x = x + attn_out
+    ln2 = _layer_norm(x, lp["post_attention_layernorm"],
+                      cfg.layernorm_epsilon)
+    mlp = lp["mlp"]
+    inter = _wmat(ln2, mlp["dense_h_to_4h"]["weight"], qr.get("h4"),
+                  dtype) + mlp["dense_h_to_4h"]["bias"].astype(dtype)
+    inter = jax.nn.gelu(inter, approximate=True)
+    out = _wmat(inter, mlp["dense_4h_to_h"]["weight"], qr.get("4h"),
+                dtype) + mlp["dense_4h_to_h"]["bias"].astype(dtype)
+    return x + out
+
+
+# --------------------------------------------------------------- prefill
+
+def prefill(params, cache, ids, positions, seg, token_rows, page_table,
+            last_idx, *, cfg):
+    """One packed prompt batch through the trunk, filling the cache.
+
+    ids/positions/seg/token_rows: ``[S_pack]`` — token values, their
+    within-request positions, segment ids (0 = padding, 1..R real),
+    and each token's row into ``page_table`` (padding rows point at
+    the all-null spare row). page_table: ``[R_rows, max_pages]``.
+    last_idx: ``[R_max]`` pack index of each request's last prompt
+    token (inactive entries 0 — callers mask). Returns ``(cache,
+    logits_last [R_max, vocab])``.
+    """
+    dtype = compute_dtype(cfg)
+    hd, n_heads = cfg.head_dim, cfg.num_attention_heads
+    ps = cache["k"].shape[3]
+    S = ids.shape[0]
+
+    word = params["word_embeddings"]
+    x = jnp.take(word, ids, axis=0) \
+        + jnp.take(params["embedding"]["position_embeddings"],
+                   positions, axis=0)
+    x = x.astype(dtype)
+
+    dest_page = jnp.take_along_axis(
+        token_rows_to_pages(page_table, token_rows),
+        (positions // ps)[:, None], axis=1)[:, 0]
+    dest_off = positions % ps
+
+    from apex_tpu.ops import fused_attention
+
+    seg2 = seg.astype(jnp.int32)[None, :]
+    for i in range(cfg.num_layers):
+        def attn(q, k, v, i=i):
+            # scatter this layer's K/V into the paged cache: values
+            # are [S, H, d] as produced (mixed basic/advanced indexing
+            # puts the gathered token axis FIRST) at (page, offset) —
+            # index arithmetic only — then packed causal+segment
+            # attention over the full bucket
+            cache["k"] = cache["k"].at[
+                i, :, dest_page, dest_off, :].set(
+                k.astype(cache["k"].dtype))
+            cache["v"] = cache["v"].at[
+                i, :, dest_page, dest_off, :].set(
+                v.astype(cache["v"].dtype))
+            ctx = fused_attention(
+                q.transpose(1, 0, 2)[None],
+                k.transpose(1, 0, 2)[None],
+                v.transpose(1, 0, 2)[None], causal=True,
+                sm_scale=1.0 / math.sqrt(hd),
+                segment_ids=(seg2, seg2))
+            return ctx[0].transpose(1, 0, 2).reshape(S, n_heads * hd)
+
+        x = _trunk_layer(x, params["transformer"][f"layer_{i}"], {},
+                         cfg, attn)
+
+    x = _layer_norm(x, params["transformer"]["final_layernorm"],
+                    cfg.layernorm_epsilon)
+    x_last = jnp.take(x, last_idx, axis=0)
+    logits = _mm(x_last, word, dtype)
+    return cache, logits
+
+
+def token_rows_to_pages(page_table, token_rows):
+    """[S, max_pages] per-token page-table rows (a gather; split out
+    so the scatter line above stays readable)."""
+    return jnp.take(page_table, token_rows, axis=0)
+
+
+# ---------------------------------------------------------------- decode
+
+def decode_step(params, cache, tokens, lengths, page_table, *, cfg,
+                qparams=None, decode_impl=None, decode_block_h=None,
+                interpret=None):
+    """One greedy decode step for every slot (q_len = 1).
+
+    tokens/lengths: ``[B]`` — the token to process and the context
+    length INCLUDING it (0 = inactive slot: its writes land on the
+    null page, its logits/next token are zeros). page_table:
+    ``[B, max_pages]``. Returns ``(cache, next_tokens [B],
+    logits [B, vocab])``.
+
+    ``qparams`` (from :func:`quantize_decode_params`) switches the
+    decode matmuls to the int8 records; ``decode_impl`` /
+    ``decode_block_h`` ride per-call into the decode-attention family
+    (None = the family's own knob/table resolution).
+    """
+    from apex_tpu.ops import decode_attention_pallas as dap
+
+    dtype = compute_dtype(cfg)
+    hd, n_heads = cfg.head_dim, cfg.num_attention_heads
+    ps = cache["k"].shape[3]
+    B = tokens.shape[0]
+
+    active = lengths > 0
+    positions = jnp.maximum(lengths - 1, 0)
+    write_page = jnp.where(
+        active,
+        jnp.take_along_axis(page_table, (positions // ps)[:, None],
+                            axis=1)[:, 0],
+        0)
+    write_off = jnp.where(active, positions % ps, 0)
+
+    word = params["word_embeddings"]
+    x = jnp.take(word, tokens, axis=0) \
+        + jnp.take(params["embedding"]["position_embeddings"],
+                   positions, axis=0)
+    x = x.astype(dtype)
+
+    ql = qparams["layers"] if qparams is not None else None
+    for i in range(cfg.num_layers):
+        def attn(q, k, v, i=i):
+            # append this step's k/v at (page, offset), then paged
+            # decode attention through the dispatched fifth family
+            cache["k"] = cache["k"].at[
+                i, :, write_page, write_off, :].set(
+                k.astype(cache["k"].dtype))  # [B, H, d] values
+            cache["v"] = cache["v"].at[
+                i, :, write_page, write_off, :].set(
+                v.astype(cache["v"].dtype))
+            ctx = dap.decode_attention(
+                q.astype(dtype), cache["k"][i], cache["v"][i],
+                page_table, lengths, sm_scale=1.0 / math.sqrt(hd),
+                impl=decode_impl, block_h=decode_block_h,
+                interpret=interpret)
+            return ctx.reshape(B, n_heads * hd).astype(dtype)
+
+        x = _trunk_layer(x, params["transformer"][f"layer_{i}"],
+                         ql[i] if ql is not None else {}, cfg, attn)
+
+    x = _layer_norm(x, params["transformer"]["final_layernorm"],
+                    cfg.layernorm_epsilon)
+    logits = _wmat(x, word,
+                   qparams["word_logits"] if qparams is not None
+                   else None, dtype)
+    next_tokens = jnp.where(
+        active, jnp.argmax(logits.astype(jnp.float32), axis=-1)
+        .astype(jnp.int32), 0)
+    return cache, next_tokens, logits

@@ -1,0 +1,87 @@
+"""int8 weight quantization for the serving decode matmuls.
+
+Decode is bandwidth-bound: every step streams the full weight set out
+of HBM for one token per sequence. Storing the matmul weights as int8
+with per-output-channel fp32 scales halves (vs bf16) the bytes each
+step moves; the matmul runs on the int8 array (XLA fuses the widening
+convert into the operand stream — the HBM reads stay int8) and the
+scale is applied to the OUTPUT columns, so no dequantized weight copy
+is ever materialized.
+
+Knob: ``APEX_SERVE_WEIGHT_QUANT`` ∈ {"1", "0"} (preference; unknown
+values warn once and are ignored), ``set_weight_quant(True/False/None)``
+the process-wide setter, and the engine's per-call ``weight_quant=``
+which RAISES on an un-honorable request (non-float params) — the
+CLAUDE.md asymmetry. Default OFF per the measured-dispatch rule: the
+bandwidth argument is an expectation, not a measurement, so the
+int8-vs-bf16 decode A/B is queued in PERF.md §2 and the default flips
+only on a committed device row.
+"""
+
+import jax.numpy as jnp
+
+from apex_tpu.dispatch import tiles
+
+_QUANT = None  # process-wide tri-state preference
+
+
+def set_weight_quant(value):
+    """Pin the process-wide weight-quant preference (True/False), or
+    un-pin with None (env then default apply). A setter CALL with a
+    non-bool raises."""
+    global _QUANT
+    if value is not None and not isinstance(value, bool):
+        raise ValueError(
+            f"set_weight_quant wants True/False/None, got {value!r}")
+    _QUANT = value
+
+
+def resolve(per_call=None):
+    """The effective weight-quant decision: per-call (validated by the
+    caller — the engine raises on un-honorable) > setter > env
+    ``APEX_SERVE_WEIGHT_QUANT`` (tiles.env_choice: unknown values
+    warn once and are ignored) > built-in OFF."""
+    if per_call is not None:
+        return bool(per_call)
+    if _QUANT is not None:
+        return _QUANT
+    v = tiles.env_choice("APEX_SERVE_WEIGHT_QUANT", ("1", "0"))
+    if v is not None:
+        return v == "1"
+    return False
+
+
+def quantizable(w):
+    """Whether a weight array can take the int8 path (the per-call
+    demand's honorability test)."""
+    return hasattr(w, "dtype") and jnp.issubdtype(w.dtype, jnp.floating)
+
+
+def quantize_weight(w):
+    """``(w_q int8 [out, in], scale fp32 [out])`` — symmetric
+    per-output-channel quantization of a ``[out, in]`` matmul weight.
+    All-zero rows get scale 0 (dequantizes to exact 0)."""
+    if not quantizable(w):
+        raise ValueError(
+            f"cannot int8-quantize dtype {getattr(w, 'dtype', None)}")
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=1)
+    scale = amax / 127.0
+    inv = jnp.where(scale > 0, 1.0 / jnp.where(scale > 0, scale, 1.0),
+                    0.0)
+    wq = jnp.clip(jnp.round(wf * inv[:, None]), -127, 127).astype(
+        jnp.int8)
+    return wq, scale
+
+
+def qmatmul(x, wq, scale, compute_dtype):
+    """``x @ dequant(wq, scale)^T`` without materializing the
+    dequantized weight: the int8 operand is widened in-stream and the
+    per-channel scale lands on the output columns."""
+    from jax import lax
+
+    y = lax.dot_general(
+        x.astype(compute_dtype), wq.astype(compute_dtype),
+        (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return (y * scale.astype(jnp.float32)).astype(compute_dtype)

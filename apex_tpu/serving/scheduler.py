@@ -1,0 +1,183 @@
+"""Continuous-batching scheduler (host-side, stdlib-only).
+
+Runs BETWEEN decode steps: admit queued requests into free decode
+slots (allocating their cache pages up front — all-or-nothing, so a
+mid-stream request can never run out of pages), evict completed ones
+(freeing pages), and materialize the static-shape arrays the jitted
+decode step consumes. Only array VALUES change across admit/evict
+events — shapes are fixed at construction, so the decode program
+compiles exactly once (the ISSUE 10 jaxpr-stability contract).
+
+Admission is strict FIFO with head-of-line blocking: if the oldest
+queued request does not fit (no free slot, or the free list cannot
+cover its ``prompt + max_new_tokens`` pages), nothing younger is
+admitted over it — the no-starvation property
+(tests/test_serving.py asserts completion order ⊇ arrival order under
+the synthetic trace).
+
+The synthetic traffic trace (:func:`synthetic_trace`) is the
+deterministic workload every serving measurement pins: request
+arrival ticks, prompt lengths and output lengths from one seeded
+stdlib RNG, identified by a content hash (``trace_id``) that rides in
+the ledger's serving block.
+"""
+
+import dataclasses
+import hashlib
+import random
+from collections import deque
+from typing import List, Optional
+
+from apex_tpu.serving.kv_cache import pages_needed
+
+
+@dataclasses.dataclass
+class Request:
+    rid: int
+    prompt: List[int]
+    max_new_tokens: int
+    arrival: float = 0.0          # logical tick the request appears at
+    # filled in by the engine/scheduler:
+    out_tokens: List[int] = dataclasses.field(default_factory=list)
+    enqueue_wall: Optional[float] = None
+    finish_wall: Optional[float] = None
+    admitted_tick: Optional[int] = None
+    finished_tick: Optional[int] = None
+
+    def done(self):
+        return len(self.out_tokens) >= self.max_new_tokens
+
+
+@dataclasses.dataclass
+class Slot:
+    request: Request
+    pages: List[int]
+    pos: int = 0                  # context length held in the cache
+    next_token: int = 0           # token the next decode step consumes
+
+
+class ContinuousBatchingScheduler:
+    def __init__(self, num_slots, max_pages_per_slot, page_size,
+                 allocator):
+        self.num_slots = int(num_slots)
+        self.max_pages = int(max_pages_per_slot)
+        self.page_size = int(page_size)
+        self.allocator = allocator
+        self.slots = [None] * self.num_slots
+        self.queue = deque()
+        self.completed = []
+
+    # ------------------------------------------------------- bookkeeping
+
+    def submit(self, request):
+        """Enqueue one request. An impossible request (prompt +
+        max_new_tokens over the per-slot page table, i.e. over
+        max_seq) raises HERE — before anything is enqueued — so one
+        malformed submission can never crash a later scheduler round
+        mid-step and take the whole serving loop (and every other
+        queued request) down with it."""
+        if request.max_new_tokens < 1:
+            raise ValueError(
+                f"request {request.rid}: max_new_tokens must be >= 1 "
+                f"(prefill always samples the first token)")
+        need = self._request_pages(request)
+        if need > self.max_pages:
+            raise ValueError(
+                f"request {request.rid}: {need} pages exceed the "
+                f"per-slot table ({self.max_pages}) — prompt + "
+                f"max_new_tokens over max_seq")
+        self.queue.append(request)
+
+    def active_indices(self):
+        return [i for i, s in enumerate(self.slots) if s is not None]
+
+    def _request_pages(self, req):
+        return pages_needed(len(req.prompt) + req.max_new_tokens,
+                            self.page_size)
+
+    def admit(self, tick):
+        """FIFO admission of every queued request that fits, stopping
+        at the first that does not (head-of-line blocking — the
+        no-starvation rule). Returns the newly filled slot indices."""
+        admitted = []
+        while self.queue:
+            req = self.queue[0]
+            free = [i for i, s in enumerate(self.slots) if s is None]
+            need = self._request_pages(req)
+            # submit() already refused impossible requests; anything
+            # queued is admittable once slots/pages free up
+            assert need <= self.max_pages, (req.rid, need)
+            if not free:
+                break
+            pages = self.allocator.alloc(("req", req.rid), need)
+            if pages is None:
+                break
+            self.queue.popleft()
+            idx = free[0]
+            self.slots[idx] = Slot(request=req, pages=pages)
+            req.admitted_tick = tick
+            admitted.append(idx)
+        return admitted
+
+    def evict_done(self, tick, wall_time=None):
+        """Free slots/pages of completed requests; returns them."""
+        done = []
+        for i, slot in enumerate(self.slots):
+            if slot is not None and slot.request.done():
+                self.allocator.free(("req", slot.request.rid))
+                slot.request.finished_tick = tick
+                if wall_time is not None \
+                        and slot.request.finish_wall is None:
+                    slot.request.finish_wall = wall_time
+                self.completed.append(slot.request)
+                done.append(slot.request)
+                self.slots[i] = None
+        return done
+
+    # ------------------------------------------- static-shape array views
+
+    def page_table_rows(self):
+        """int32 [num_slots, max_pages]; empty slots / unallocated
+        tail -> null page 0."""
+        rows = [[0] * self.max_pages for _ in range(self.num_slots)]
+        for i, slot in enumerate(self.slots):
+            if slot is not None:
+                for j, p in enumerate(slot.pages):
+                    rows[i][j] = p
+        return rows
+
+    def decode_inputs(self):
+        """(tokens, lengths) int lists for the decode step: length 0
+        marks an inactive slot (the step zeros its lane)."""
+        tokens = [0] * self.num_slots
+        lengths = [0] * self.num_slots
+        for i, slot in enumerate(self.slots):
+            if slot is not None:
+                tokens[i] = int(slot.next_token)
+                lengths[i] = slot.pos + 1
+        return tokens, lengths
+
+
+def synthetic_trace(seed=0, n_requests=16, vocab=256, prompt_lo=4,
+                    prompt_hi=24, new_lo=4, new_hi=32,
+                    mean_interarrival=0.5):
+    """Deterministic request trace: ``(requests, trace_id)``. Arrival
+    is in decode-step ticks; the id is a content hash of every
+    request's (arrival, prompt, max_new) so a cited serving row names
+    exactly the workload it measured."""
+    rng = random.Random(seed)
+    t = 0.0
+    reqs = []
+    for rid in range(n_requests):
+        t += rng.expovariate(1.0 / mean_interarrival) \
+            if mean_interarrival > 0 else 0.0
+        plen = rng.randint(prompt_lo, prompt_hi)
+        prompt = [rng.randrange(vocab) for _ in range(plen)]
+        reqs.append(Request(
+            rid=rid, prompt=prompt,
+            max_new_tokens=rng.randint(new_lo, new_hi),
+            arrival=round(t, 3)))
+    h = hashlib.sha1(repr(
+        [(r.arrival, tuple(r.prompt), r.max_new_tokens)
+         for r in reqs]).encode()).hexdigest()[:10]
+    return reqs, f"tr-{h}"

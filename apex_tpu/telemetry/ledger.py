@@ -62,7 +62,7 @@ INFRA_KNOB_PREFIXES = (
     "APEX_TELEMETRY_LEDGER", "APEX_TELEMETRY_PATH",
     "APEX_COMPILE_CACHE", "APEX_WARM_ONLY", "APEX_WARM_TIMEOUT",
     "APEX_PROBE_", "APEX_FAULT_PLAN", "APEX_COLLECT_MANIFEST",
-    "APEX_PROFILE_", "APEX_COST_ANALYSIS",
+    "APEX_PROFILE_", "APEX_COST_ANALYSIS", "APEX_SERVE_BENCH",
 )
 
 
@@ -277,6 +277,33 @@ def validate_record(rec):
         from apex_tpu.telemetry import costs as _costs
 
         problems += [f"cost: {p}" for p in _costs.validate(cost)]
+    sv = rec.get("serving")
+    if sv is not None:
+        # the serving-bench block (benchmarks/profile_serving.py,
+        # ISSUE 10): a malformed one could claim a tokens/s or latency
+        # figure no trace produced — same teeth as the cost block
+        if not isinstance(sv, dict):
+            problems.append("serving is not a dict")
+        else:
+            for field in ("tokens_per_s", "p50_ms", "p99_ms"):
+                v = sv.get(field)
+                if v is not None and not (isinstance(v, (int, float))
+                                          and not isinstance(v, bool)
+                                          and v >= 0):
+                    problems.append(
+                        f"serving.{field} is not a non-negative number")
+            p50, p99 = sv.get("p50_ms"), sv.get("p99_ms")
+            if isinstance(p50, (int, float)) \
+                    and isinstance(p99, (int, float)) and p50 > p99:
+                problems.append("serving.p50_ms exceeds serving.p99_ms")
+            if not (isinstance(sv.get("trace_id"), str)
+                    and sv["trace_id"].startswith("tr-")):
+                problems.append(
+                    "serving.trace_id is not a trace hash (tr-...)")
+            kp = sv.get("kv_pages")
+            if not (isinstance(kp, int) and not isinstance(kp, bool)
+                    and kp > 0):
+                problems.append("serving.kv_pages is not a positive int")
     rf = rec.get("resumed_from")
     if rf is not None:
         # resume provenance (bench.py --resume / profile_gpt): rides

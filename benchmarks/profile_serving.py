@@ -1,0 +1,207 @@
+"""Serving-path measurement: decode tokens/s + per-request latency.
+
+Two evidence classes in one Tracer run (ISSUE 10):
+
+* **decode step (batch full)** — the §0 protocol (K chained decode
+  steps in ONE ``lax.scan`` dispatch, traced-eps chain, overhead
+  subtracted) over a full slot batch: the steady-state decode
+  throughput headline, with a validated cost block captured off the
+  same program.
+* **trace replay** — the host-side serving loop (admit → prefill →
+  decode → evict, ``apex_tpu.serving.ServingEngine``) replayed over
+  the committed synthetic traffic trace, per-dispatch like production
+  serving actually runs: per-request p50/p99 latency plus end-to-end
+  tokens/s. The replay is host-clocked (each decode dispatch is a
+  round trip — exactly the per-token cost a user sees), so its
+  tokens/s is the honest lower line under the scan row's upper line.
+
+The ledger record carries the validated ``serving`` block
+``{tokens_per_s, p50_ms, p99_ms, trace_id, kv_pages}``
+(``ledger.validate_record``) and PINS both serving dispatch knobs —
+``APEX_SERVE_WEIGHT_QUANT`` and ``APEX_DECODE_ATTN_IMPL`` — at their
+RESOLVED values before the write, so every serving row is citable
+under ``tools/check_bench_labels.py`` check 8 by construction.
+
+Run on the real TPU (dead-last in run_all_tpu.sh behind
+``APEX_SERVE_BENCH=1`` — the still-owed training headlines outrank
+it); ``--smoke`` / ``APEX_BENCH_SMOKE=1`` is the CPU sanity mode.
+AOT-warmed by ``benchmarks/warm_cache.py`` when the rung is armed.
+"""
+
+import os
+import sys
+
+if "--smoke" in sys.argv[1:]:
+    os.environ["APEX_BENCH_SMOKE"] = "1"
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+from benchmarks._smoke import smoke_mode  # noqa: E402
+
+SMOKE = smoke_mode("APEX_BENCH_SMOKE")
+
+from benchmarks._timing import Tracer  # noqa: E402
+
+from apex_tpu import compile_cache, dispatch  # noqa: E402
+from apex_tpu.serving import (  # noqa: E402
+    ServingEngine,
+    synthetic_trace,
+)
+from apex_tpu.serving import model as smodel  # noqa: E402
+from apex_tpu.serving import quant as quant_mod  # noqa: E402
+from apex_tpu.telemetry import costs as _costs  # noqa: E402
+from apex_tpu.telemetry.costs import V5E_PEAK_BF16_FLOPS as PEAK  # noqa: E402
+from apex_tpu.transformer.testing import TransformerConfig  # noqa: E402
+
+K = 2 if SMOKE else 32
+
+if SMOKE:
+    cfg = TransformerConfig(
+        hidden_size=64, num_layers=2, num_attention_heads=4,
+        vocab_size=256, max_position_embeddings=64,
+        hidden_dropout=0.0, attention_dropout=0.0,
+        apply_query_key_layer_scaling=False, bf16=True)
+    SLOTS, PS, PAGES, MAX_SEQ, PRE_LEN = 4, 16, 24, 64, 64
+else:
+    cfg = TransformerConfig(
+        hidden_size=768, num_layers=12, num_attention_heads=12,
+        vocab_size=50304, max_position_embeddings=1024,
+        hidden_dropout=0.0, attention_dropout=0.0,
+        apply_query_key_layer_scaling=False, bf16=True)
+    SLOTS, PS, PAGES, MAX_SEQ, PRE_LEN = 8, 128, 72, 1024, 512
+
+MAX_PAGES = -(-MAX_SEQ // PS)
+
+# ---------------------------------------------------------------- pins
+# Resolve BOTH serving dispatch knobs and pin them into the
+# environment BEFORE anything traces: the ledger record's knobs then
+# carry exactly the values the measured program ran under (check 8),
+# and the engine's own resolution (env > table > built-in) reads the
+# very same pins — label and program cannot drift apart.
+WQ = quant_mod.resolve()
+os.environ["APEX_SERVE_WEIGHT_QUANT"] = "1" if WQ else "0"
+IMPL = os.environ.get("APEX_DECODE_ATTN_IMPL")
+if IMPL not in ("jnp", "pallas"):
+    choice, tparams = dispatch.lookup_params(
+        "decode_attention", dtype=jnp.bfloat16, b=SLOTS,
+        h=cfg.num_attention_heads, pages=MAX_PAGES, ps=PS,
+        d=cfg.head_dim)
+    IMPL = choice or "jnp"
+    # pinning the impl env SHORT-CIRCUITS the kernel's table consult,
+    # which would silently drop the same entry's measured block_h tile
+    # — the bench would then time a different program than unpinned
+    # dispatch runs. Pin the tile payload alongside the impl (and into
+    # the record's knobs), so label and program stay one thing.
+    if tparams and tparams.get("block_h") \
+            and not os.environ.get("APEX_DECODE_ATTN_BLOCK_H"):
+        os.environ["APEX_DECODE_ATTN_BLOCK_H"] = str(
+            tparams["block_h"])
+os.environ["APEX_DECODE_ATTN_IMPL"] = IMPL
+
+engine = ServingEngine(cfg, num_slots=SLOTS, page_size=PS,
+                       num_pages=PAGES, max_seq=MAX_SEQ,
+                       prefill_len=PRE_LEN)
+n_params = sum(x.size for x in jax.tree_util.tree_leaves(engine.params))
+TRACER = Tracer(K, peak_flops=PEAK)
+print(f"serving: {n_params / 1e6:.1f}M params, {SLOTS} slots, "
+      f"{PAGES} pages x {PS}, quant={'int8' if WQ else 'off'}, "
+      f"decode-attn={IMPL}   (method: {K}-step decode scan, "
+      f"dispatch overhead {TRACER.overhead_ms:.1f} ms subtracted)")
+
+# ------------------------------------------- row 1: decode scan (full)
+# Fill every slot (prompt + one engine step), then harvest the cache /
+# page-table arrays for the K-step scan. max_new covers the scan range
+# so the page tables stay valid as lengths advance.
+from apex_tpu.serving.scheduler import Request  # noqa: E402
+
+rs = np.random.RandomState(0)
+warm_reqs = [
+    Request(rid=1000 + i,
+            prompt=[int(t) for t in rs.randint(0, cfg.vocab_size, 8)],
+            max_new_tokens=K + 4)
+    for i in range(SLOTS)]
+for r in warm_reqs:
+    engine.submit(r)
+engine.step()
+tokens0, lengths0 = engine.scheduler.decode_inputs()
+pt0 = np.asarray(engine.scheduler.page_table_rows(), np.int32)
+qparams = engine.qparams
+
+
+def make_decode_scan(eps, pt):
+    def body(carry, _):
+        cache, tokens, lengths = carry
+        # consume eps so warm and timed dispatches differ in a traced
+        # value (the §0 result-caching rule); semantically zero
+        tokens = tokens + (eps * 0.0).astype(jnp.int32)
+        cache, nxt, _ = smodel.decode_step(
+            engine.params, cache, tokens, lengths, pt, cfg=cfg,
+            qparams=qparams, interpret=engine.interpret)
+        return (cache, nxt, lengths + 1), nxt[0]
+    return body
+
+
+decode_flops = 2 * n_params * SLOTS
+span = TRACER.scan_time(
+    "decode step (batch full)", make_decode_scan,
+    (engine.cache, jnp.asarray(tokens0, dtype=jnp.int32),
+     jnp.asarray(lengths0, dtype=jnp.int32)),
+    (jnp.asarray(pt0),), flops_per_iter=decode_flops,
+    capture_cost=_costs.enabled(default=not SMOKE), on_fail="span")
+print(span.format_row(PEAK))
+scan_tps = None
+if span.seconds:
+    scan_tps = SLOTS / span.seconds
+    print(f"{'':28s} -> {scan_tps:.0f} tok/s (scan upper line)")
+
+# ---------------------------------------------- row 2: trace replay
+serving_block = None
+if not compile_cache.warm_only():
+    import time
+
+    n_req = 6 if SMOKE else 32
+    trace, trace_id = synthetic_trace(
+        seed=7, n_requests=n_req, vocab=cfg.vocab_size,
+        prompt_lo=4, prompt_hi=min(24, PRE_LEN // 2),
+        new_lo=4, new_hi=min(24, MAX_SEQ - 32),
+        mean_interarrival=0.5)
+    replay = ServingEngine(cfg, params=engine.params, num_slots=SLOTS,
+                           page_size=PS, num_pages=PAGES,
+                           max_seq=MAX_SEQ, prefill_len=PRE_LEN)
+    t0 = time.perf_counter()
+    done = replay.run_trace(trace)
+    wall = time.perf_counter() - t0
+    lats = sorted((r.finish_wall - r.enqueue_wall) * 1e3 for r in done
+                  if r.finish_wall and r.enqueue_wall)
+    p50 = lats[len(lats) // 2]
+    p99 = lats[min(len(lats) - 1, int(len(lats) * 0.99))]
+    replay_tps = replay.tokens_generated / wall
+    serving_block = {
+        "tokens_per_s": round(replay_tps, 2),
+        "scan_tokens_per_s": None if scan_tps is None
+        else round(scan_tps, 2),
+        "p50_ms": round(p50, 2), "p99_ms": round(p99, 2),
+        "trace_id": trace_id, "kv_pages": PAGES,
+        "requests": len(done),
+        "decode_steps": replay.decode_steps,
+    }
+    print(f"{'trace replay':28s} {len(done)} req, "
+          f"{replay.tokens_generated} tok in {wall:.2f}s -> "
+          f"{replay_tps:.0f} tok/s, p50 {p50:.1f} ms, p99 {p99:.1f} ms "
+          f"[{trace_id}]")
+    assert replay.decode_cache_size() == 1, (
+        "decode step recompiled during the trace — the scheduler "
+        "changed a shape (jaxpr-stability contract broken)")
+
+rid = TRACER.flush_ledger("profile_serving", extra={
+    "serving": serving_block,
+    "config": {"slots": SLOTS, "page_size": PS, "pages": PAGES,
+               "max_seq": MAX_SEQ, "prefill_len": PRE_LEN,
+               "params_m": round(n_params / 1e6, 1),
+               "weight_quant": WQ, "decode_impl": IMPL}})
+if rid:
+    print(f"ledger: {rid}")

@@ -164,6 +164,16 @@ run bench_b32_remat  1500 env APEX_PROFILE_CAPTURE= APEX_CKPT_DIR="$CKPT_ROOT/be
 if [ "${APEX_PROFILE_CAPTURE:-}" = "1" ]; then
 run bench_profile    2400 env APEX_BENCH_ATTEMPTS=1 python bench.py
 fi
+# Serving bench DEAD LAST behind its own knob (ISSUE 10): the decode
+# path's tokens/s + p50/p99 row (benchmarks/profile_serving.py) is a
+# NEW evidence class, but the still-owed training headlines (BENCH_r06,
+# the step A/Bs, the tile sweep) outrank it — an unarmed pass must not
+# spend a minute of a short window here. warm_cache.py AOT-warms the
+# serving program set only when this same knob is set. Slot budget:
+# one prefill+decode compile set + the K-scan row + the trace replay.
+if [ "${APEX_SERVE_BENCH:-}" = "1" ]; then
+run serving          1800 python benchmarks/profile_serving.py
+fi
 
 echo "=== done; feed the logs into PERF.md"
 # the round's account: what this pass banked, what the next window owes

@@ -224,6 +224,21 @@ def main():
                         [sys.executable, tiles_py, "--child", spec],
                         {"APEX_DISPATCH": "off"}, timeout)
 
+    # serving program set (benchmarks/profile_serving.py) — ONLY when
+    # its collection rung is armed (APEX_SERVE_BENCH=1 gates the
+    # dead-last run_all_tpu.sh row): an unarmed round must not spend
+    # probe minutes AOT-compiling programs no row will dispatch
+    if os.environ.get("APEX_SERVE_BENCH") == "1":
+        if "serving" in cashed:
+            print("warm profile_serving: skipped (row cashed in the "
+                  "round manifest)", flush=True)
+        else:
+            warm_target(
+                "profile_serving",
+                [sys.executable,
+                 os.path.join(REPO, "benchmarks", "profile_serving.py")],
+                {}, timeout)
+
     from apex_tpu import compile_cache
 
     print(f"warm_cache: cache dir {compile_cache.cache_dir()}", flush=True)

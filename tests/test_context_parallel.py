@@ -72,6 +72,54 @@ def test_grads_match_dense(fn):
                                    atol=5e-5, rtol=5e-5)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_segment_ids_match_dense(causal):
+    """The closed CP refusal (ISSUE 10 satellite): packed-varlen
+    segment-aware Ulysses — shard-local segment ids ride their own
+    all_gather re-shard next to the q/k/v all_to_alls, and the result
+    must equal dense attention on the gathered sequence with the SAME
+    global ids (per-segment reference semantics: cross-segment pairs
+    masked, exactly the serving prefill input shape)."""
+    q, k, v = _data(3)
+    # 3 packed segments across the global sequence, lengths not
+    # aligned to the CP shard boundary (the re-shard must still agree)
+    bounds = [0, 10, 21, S]
+    seg = np.zeros((B, S), np.int32)
+    for i in range(len(bounds) - 1):
+        seg[:, bounds[i]:bounds[i + 1]] = i + 1
+    seg = jnp.asarray(seg)
+    want = _dense_attention(q, k, v, causal, 1.0 / np.sqrt(D),
+                            (seg, seg))
+    f = shard_map(
+        lambda q, k, v, s: ulysses_attention(
+            q, k, v, "cp", causal=causal, segment_ids=(s, s)),
+        mesh=cp_mesh(),
+        in_specs=(P(None, None, "cp"),) * 3 + (P(None, "cp"),),
+        out_specs=P(None, None, "cp"), check_vma=False)
+    got = f(q, k, v, seg)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_segment_ids_single_array_form():
+    """One array for both q and kv ids is accepted (the packed-batch
+    convenience form)."""
+    q, k, v = _data(4)
+    seg = jnp.asarray(
+        np.repeat(np.arange(1, 5), S // 4)[None].repeat(B, 0))
+    want = _dense_attention(q, k, v, True, 1.0 / np.sqrt(D),
+                            (seg, seg))
+    f = shard_map(
+        lambda q, k, v, s: ulysses_attention(
+            q, k, v, "cp", causal=True, segment_ids=s),
+        mesh=cp_mesh(),
+        in_specs=(P(None, None, "cp"),) * 3 + (P(None, "cp"),),
+        out_specs=P(None, None, "cp"), check_vma=False)
+    got = f(q, k, v, seg)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
 def test_ulysses_rejects_bad_heads():
     q, k, v = _data(3)
     q3 = q[:, :3]  # 3 heads not divisible by cp=4
@@ -297,6 +345,57 @@ def test_ulysses_dropout_matches_dense_with_same_masks():
     sc = np.where(tri, -1e30, sc)
     e = np.exp(sc - sc.max(-1, keepdims=True))
     probs = e / e.sum(-1, keepdims=True)
+    ms = np.zeros_like(probs)
+    for g in range(H):
+        r, lh = g // hg, g % hg
+        seed_r = np.uint32(seed) ^ np.asarray(ap._fmix32(
+            jnp.uint32(r) + jnp.uint32(0x9E3779B9)))
+        for ib in range(B):
+            ms[ib, g] = np.asarray(ap._dropout_mscale(
+                jnp.asarray(seed_r.astype(np.int32)), jnp.int32(ib),
+                jnp.int32(lh), 0, s_glob, s_glob, p, hg))
+    want = np.einsum("bhqk,bhkd->bhqd", probs * ms, np.asarray(v))
+    np.testing.assert_allclose(got, want, atol=2e-5)
+
+
+@pytest.mark.slow  # interpret rows kernel at s=128, like its sibling
+def test_ulysses_dropout_with_segment_ids_matches_dense():
+    """The dropout branch of the segment-aware Ulysses path (the
+    all_gathered ids thread positionally into fused_attention_rows):
+    dense reference = per-head-group hash masks x segment+causal
+    exclusion semantics."""
+    from apex_tpu.ops import attention_pallas as ap
+
+    p, seed = 0.25, 13
+    s_glob = 128
+    rs = np.random.RandomState(9)
+    mk = lambda: jnp.asarray(rs.randn(B, H, s_glob, D) * 0.5,
+                             jnp.float32)
+    q, k, v = mk(), mk(), mk()
+    seg = jnp.asarray(
+        np.repeat(np.arange(1, 5), s_glob // 4)[None].repeat(B, 0))
+
+    f = shard_map(
+        lambda q_, k_, v_, s_: ulysses_attention(
+            q_, k_, v_, "cp", causal=True, dropout_p=p,
+            dropout_seed=jnp.int32(seed), segment_ids=(s_, s_)),
+        mesh=cp_mesh(),
+        in_specs=(P(None, None, "cp"),) * 3 + (P(None, "cp"),),
+        out_specs=P(None, None, "cp"), check_vma=False)
+    got = np.asarray(f(q, k, v, seg))
+
+    hg = H // CP
+    scale = 1.0 / np.sqrt(D)
+    sc = np.einsum("bhqd,bhkd->bhqk", np.asarray(q),
+                   np.asarray(k)) * scale
+    segn = np.asarray(seg)
+    mask = np.triu(np.ones((s_glob, s_glob), bool), 1)[None, None] \
+        | (segn[:, None, :, None] != segn[:, None, None, :])
+    sc = np.where(mask, -1e30, sc)
+    e = np.exp(sc - sc.max(-1, keepdims=True))
+    e = np.where(mask, 0.0, e)
+    tot = e.sum(-1, keepdims=True)
+    probs = np.where(tot > 0, e / np.where(tot > 0, tot, 1.0), 0.0)
     ms = np.zeros_like(probs)
     for g in range(H):
         r, lh = g // hg, g % hg

@@ -1,0 +1,176 @@
+"""Decode-attention family (ops/decode_attention_pallas.py, ISSUE 10):
+interpret-mode parity vs the jnp gather reference, tile legality and
+knob asymmetry, and the dispatch wiring of the fifth family."""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import dispatch
+from apex_tpu.dispatch import tiles
+from apex_tpu.ops import decode_attention_pallas as dap
+
+B, H, P, PS, D, MAXP = 4, 4, 16, 32, 64, 4
+SCALE = 1.0 / np.sqrt(D)
+
+
+def _data(dtype=jnp.float32, seed=0):
+    rs = np.random.RandomState(seed)
+    q = jnp.asarray(rs.randn(B, H, D), dtype)
+    k = jnp.asarray(rs.randn(H, P, PS, D), dtype)
+    v = jnp.asarray(rs.randn(H, P, PS, D), dtype)
+    # distinct non-contiguous pages per slot; page 0 stays null
+    pt = jnp.asarray(np.stack([
+        rs.permutation(np.arange(1, P))[:MAXP] for _ in range(B)]),
+        jnp.int32)
+    # lengths cover: mid-page, page-aligned, full, inactive
+    lens = jnp.asarray([5, PS, MAXP * PS, 0], jnp.int32)
+    return q, k, v, pt, lens
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16],
+                         ids=["f32", "bf16"])
+def test_kernel_matches_reference(dtype):
+    q, k, v, pt, lens = _data(dtype)
+    want = dap.decode_attention_reference(q, k, v, pt, lens, SCALE)
+    got = dap.decode_attention_pallas(q, k, v, pt, lens, SCALE,
+                                      interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        atol=1e-5 if dtype == jnp.float32 else 5e-2)
+    # inactive slot -> exact zeros (the fully-masked-row contract)
+    assert np.all(np.asarray(got, np.float32)[3] == 0.0)
+
+
+@pytest.mark.parametrize("bh", [1, 2, 4])
+def test_block_h_sweep_parity(bh):
+    q, k, v, pt, lens = _data()
+    want = dap.decode_attention_reference(q, k, v, pt, lens, SCALE)
+    got = dap.decode_attention_pallas(q, k, v, pt, lens, SCALE,
+                                      block_h=bh, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5)
+
+
+def test_per_call_tile_raises_setter_falls_back():
+    q, k, v, pt, lens = _data()
+    # per-call demand on an illegal tile raises with the model verdict
+    with pytest.raises(ValueError, match="does not divide"):
+        dap.decode_attention_pallas(q, k, v, pt, lens, SCALE,
+                                    block_h=3, interpret=True)
+    # the process-wide setter is a preference: an illegal pin falls
+    # back to the heuristic silently (parity still holds)
+    dap.set_block_h(3)
+    try:
+        want = dap.decode_attention_reference(q, k, v, pt, lens, SCALE)
+        got = dap.decode_attention_pallas(q, k, v, pt, lens, SCALE,
+                                          interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5)
+    finally:
+        dap.set_block_h(None)
+    with pytest.raises(ValueError):
+        dap.set_block_h(-2)
+
+
+def test_impl_demand_asymmetry(monkeypatch):
+    q, k, v, pt, lens = _data()
+    with pytest.raises(ValueError, match="unknown decode-attention"):
+        dap.decode_attention(q, k, v, pt, lens, impl="dense")
+    # jnp demand with a pallas tile knob is un-honorable
+    with pytest.raises(ValueError, match="block_h"):
+        dap.decode_attention(q, k, v, pt, lens, impl="jnp", block_h=2)
+    # env preference with garbage warns once and falls back to jnp
+    monkeypatch.setenv("APEX_DECODE_ATTN_IMPL", "banana")
+    tiles._warned_env.clear()
+    with pytest.warns(UserWarning, match="banana"):
+        out = dap.decode_attention(q, k, v, pt, lens)
+    want = dap.decode_attention_reference(q, k, v, pt, lens, SCALE)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               atol=1e-6)
+    with pytest.raises(ValueError):
+        dap.set_decode_impl("banana")
+    # a "pallas" PREFERENCE that falls back on unsupported geometry
+    # (d too large) must still raise for a per-call tile demand: the
+    # path actually taken is jnp, and per-call knobs raise
+    monkeypatch.delenv("APEX_DECODE_ATTN_IMPL")
+    big_d = 1024
+    qb = jnp.zeros((2, 2, big_d), jnp.float32)
+    kb = jnp.zeros((2, 4, 8, big_d), jnp.float32)
+    ptb = jnp.zeros((2, 2), jnp.int32)
+    lb = jnp.zeros((2,), jnp.int32)
+    dap.set_decode_impl("pallas")
+    try:
+        out = dap.decode_attention(qb, kb, kb, ptb, lb)  # falls back
+        assert out.shape == qb.shape
+        with pytest.raises(ValueError, match="jnp path"):
+            dap.decode_attention(qb, kb, kb, ptb, lb, block_h=2)
+    finally:
+        dap.set_decode_impl(None)
+
+
+def test_default_is_jnp_and_table_flips_to_pallas(tmp_path,
+                                                  monkeypatch):
+    """Measured-dispatch: the built-in default is the jnp gather path
+    (no device row yet); a backend-keyed table entry flips an UNPINNED
+    call to the pallas kernel in interpret mode — jaxpr-level proof."""
+    q, k, v, pt, lens = _data()
+
+    def jaxpr_of():
+        return str(jax.make_jaxpr(
+            lambda *a: dap.decode_attention(*a, sm_scale=SCALE))(
+                q, k, v, pt, lens))
+
+    monkeypatch.delenv("APEX_DECODE_ATTN_IMPL", raising=False)
+    dispatch._reset_for_tests()
+    assert "pallas" not in jaxpr_of()  # built-in default: jnp
+    table = tmp_path / "table.jsonl"
+    entry = dispatch.make_entry(
+        "decode_attention",
+        dict(b=B, h=H, pages=MAXP, ps=PS, d=D), jnp.float32, "cpu",
+        "pallas", "lg-0000000000",
+        params={"value": {"block_h": 2}, "ledger": "lg-0000000000"})
+    table.write_text(json.dumps(entry) + "\n")
+    monkeypatch.setenv("APEX_DISPATCH_TABLE", str(table))
+    dispatch._reset_for_tests()
+    try:
+        assert "pallas" in jaxpr_of()  # table entry engaged (interpret)
+        consults = dispatch.consulted()
+        row = next(r for r in consults
+                   if r["op"] == "decode_attention")
+        assert row["choice"] == "pallas"
+        assert row["params"] == {"block_h": 2}
+    finally:
+        dispatch._reset_for_tests()
+
+
+def test_tile_model_surface():
+    """The fifth family in the shared tile model: legality verdicts,
+    heuristic default, candidate enumeration all-legal."""
+    dims = dict(b=B, h=12, pages=MAXP, ps=PS, d=D)
+    assert tiles.legal("decode_attention", dims, jnp.bfloat16,
+                       {"block_h": 5})  # does not divide 12
+    assert not tiles.legal("decode_attention", dims, jnp.bfloat16,
+                           {"block_h": 4})
+    base = tiles.default_params("decode_attention", dims, jnp.bfloat16)
+    assert base and base["block_h"] >= 1 and 12 % base["block_h"] == 0
+    cands = tiles.candidates("decode_attention", dims, jnp.bfloat16)
+    assert cands and cands[0] == base  # incumbent first (hysteresis)
+    assert {"block_h": 12} in cands    # the all-heads tile is swept
+    for c in cands:
+        assert not tiles.legal("decode_attention", dims, jnp.bfloat16,
+                               c), c
+    assert tiles.model_vmem_bytes(
+        "decode_attention", dims, jnp.bfloat16,
+        {"block_h": 4}) == tiles.decode_vmem_bytes(4, PS, D, 2)
+
+
+def test_dispatch_vocabulary_registered():
+    assert dispatch.OP_CHOICES["decode_attention"] == ("jnp", "pallas")
+    assert tiles.PARAM_KEYS["decode_attention"] == ("block_h",)
+    assert tiles.DIM_KEYS["decode_attention"] == (
+        "b", "h", "pages", "ps", "d")

@@ -76,6 +76,17 @@ Checks:
    alone carries no pin the label can be checked against — the same
    drift class as an unpinned A/B. Applies to PERF.md citations AND
    dispatch-table-cited records.
+8. **Serving pin-match** — a cited record carrying a ``serving``
+   block (``benchmarks/profile_serving.py``: {tokens_per_s, p50_ms,
+   p99_ms, trace_id, kv_pages}) must PIN both serving dispatch knobs
+   in its recorded ``knobs``: ``APEX_SERVE_WEIGHT_QUANT`` and
+   ``APEX_DECODE_ATTN_IMPL``. The decode step's program is shaped by
+   both (int8 vs full-precision matmuls; pallas vs jnp gather
+   attention), and a serving row engaged through a process-wide
+   setter alone carries no pin the label can be checked against —
+   same teeth as checks 6-7. The harness stamps the RESOLVED values
+   into its environment before the ledger write, so an unpinned run
+   cannot produce a citable serving row.
 
 New PERF.md table rows must cite their ledger record id in the caption
 (``ledger:<id>``) — uncited legacy paragraphs are not flagged, but they
@@ -202,6 +213,26 @@ def comm_compress_problems(rec, rid):
     return sorted(problems)
 
 
+def serving_problems(rec, rid):
+    """Check-8 pin-match for one cited record; [] when clean or when
+    the record carries no serving block. Both serving dispatch knobs
+    must be PRESENT in the record's knobs — the resolved value is what
+    the label pins; absence means the choice came from a setter or a
+    default the citation cannot be audited against."""
+    sv = rec.get("serving")
+    if not isinstance(sv, dict):
+        return []
+    knobs = rec.get("knobs") if isinstance(rec.get("knobs"), dict) else {}
+    problems = []
+    for knob in ("APEX_SERVE_WEIGHT_QUANT", "APEX_DECODE_ATTN_IMPL"):
+        if knob not in knobs:
+            problems.append(
+                f"record {rid} carries a serving block but does not pin "
+                f"{knob} in its knobs — an unpinned serving row cannot "
+                f"be cited")
+    return problems
+
+
 def _paragraphs(text):
     """(start_lineno, paragraph_text) blocks of consecutive non-blank
     lines — the unit a caption and its numbers share."""
@@ -270,6 +301,9 @@ def check_captions(perf_text, perf_path, records):
                 problems.append(f"{perf_path}:{lineno}: {p}")
             # check 7: comm-compression pin-match
             for p in comm_compress_problems(rec, rid):
+                problems.append(f"{perf_path}:{lineno}: {p}")
+            # check 8: serving-block pin-match
+            for p in serving_problems(rec, rid):
                 problems.append(f"{perf_path}:{lineno}: {p}")
             if rec.get("resumed_from") is not None \
                     and COLD_RE.search(para):
@@ -359,6 +393,10 @@ def check_dispatch_table(path, records):
                 # check 7 on the table side: a grad_comm entry decided
                 # by a compressed row must cite a knob-pinned record
                 for p in comm_compress_problems(rec, rid):
+                    problems.append(f"{tag}: {p}")
+                # check 8 on the table side: a decode_attention entry
+                # decided by a serving row must cite a knob-pinned one
+                for p in serving_problems(rec, rid):
                     problems.append(f"{tag}: {p}")
     return problems, len(entries)
 
