@@ -156,6 +156,32 @@ def env_choice(name, allowed):
     return None
 
 
+def env_float(name, default):
+    """Positive-float env preference: the parsed value when valid,
+    else ``default`` — an unparseable or non-positive value warns
+    ONCE per (knob, value) and is ignored (the same
+    warn-once-and-ignore semantics as :func:`env_choice`, one home).
+    Behind the serving SLO thresholds (APEX_SERVE_SLO_TTFT_MS /
+    APEX_SERVE_SLO_TPOT_MS via ``serving.lifecycle.env_ms``)."""
+    v = os.environ.get(name)
+    if v in (None, ""):
+        return float(default)
+    try:
+        f = float(v)
+        if f > 0:
+            return f
+    except ValueError:
+        pass
+    if (name, v) not in _warned_env:
+        import warnings
+
+        warnings.warn(f"{name}={v!r} is not a positive number — "
+                      f"ignored (preference semantics; default "
+                      f"{float(default):g})")
+        _warned_env.add((name, v))
+    return float(default)
+
+
 def check_setter_value(value, knob):
     """Shared validation for the kernels' process-wide tile setters:
     a positive int pins the preference, None un-pins; anything else

@@ -21,10 +21,18 @@ Layering:
                    decode matmuls (knob-gated, default OFF)
 * ``scheduler``  — stdlib-only continuous batching: admit/evict
                    between decode steps against a synthetic trace
+                   (seeded Poisson/diurnal arrival processes; policy
+                   knob ``APEX_SERVE_SCHED``)
+* ``lifecycle``  — stdlib-only request-lifecycle event log, scheduler
+                   gauges, and the validated ``slo`` ledger block
+                   (gated on ``APEX_SERVE_EVENTS`` /
+                   ``lifecycle.enable()`` — disabled mode is
+                   behavior-identical; ISSUE 11)
 * ``engine``     — the glue: one ServingEngine owning cache, params,
                    compiled steps and the scheduler loop
 """
 
+from apex_tpu.serving import lifecycle  # noqa: F401
 from apex_tpu.serving.kv_cache import (  # noqa: F401
     PageAllocator,
     init_cache,
@@ -32,6 +40,8 @@ from apex_tpu.serving.kv_cache import (  # noqa: F401
 from apex_tpu.serving.scheduler import (  # noqa: F401
     ContinuousBatchingScheduler,
     Request,
+    offered_load,
+    resolve_policy,
     synthetic_trace,
 )
 from apex_tpu.serving.engine import ServingEngine, detokenize  # noqa: F401

@@ -18,6 +18,19 @@ Knob resolution at engine build (the CLAUDE.md asymmetry):
 * ``decode_impl=`` / ``decode_block_h=`` ride per-call into the
   decode-attention family on every step (raising semantics live
   there); None defers to the family's setter/env/table resolution.
+* ``policy=`` per-call unknown policies RAISE
+  (``scheduler.resolve_policy``); None defers to ``APEX_SERVE_SCHED``.
+
+Observability (ISSUE 11): when ``lifecycle.enabled()`` the engine
+keeps a request-lifecycle :class:`~apex_tpu.serving.lifecycle.EventLog`
+(``self.events``) — submitted/admitted/prefill_done/first_token/
+finished/evicted events plus per-round scheduler gauges — appended
+strictly BETWEEN device dispatches, so the jitted programs (and
+``decode_cache_size()==1``) are untouched either way; disabled mode
+allocates no log and is behavior-identical. ``device_dispatch_s``
+accumulates the wall time spent inside device round trips (prefill +
+decode fetch), so a harness can attribute the host slice of the
+serving loop (``costs.overlap_bound`` — the ROADMAP 4c gap).
 """
 
 import time
@@ -26,6 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from apex_tpu.serving import lifecycle
 from apex_tpu.serving import model as smodel
 from apex_tpu.serving import quant as quant_mod
 from apex_tpu.serving.kv_cache import PageAllocator, init_cache
@@ -42,7 +56,7 @@ class ServingEngine:
                  num_pages=64, max_seq=None, prefill_len=64,
                  prefill_requests=None, weight_quant=None,
                  decode_impl=None, decode_block_h=None, interpret=None,
-                 seed=0):
+                 policy=None, seed=0):
         smodel.check_serving_config(cfg)
         self.cfg = cfg
         self.num_slots = int(num_slots)
@@ -77,7 +91,13 @@ class ServingEngine:
             page_size, cfg.head_dim, smodel.compute_dtype(cfg))
         self.allocator = PageAllocator(num_pages)
         self.scheduler = ContinuousBatchingScheduler(
-            num_slots, self.max_pages, page_size, self.allocator)
+            num_slots, self.max_pages, page_size, self.allocator,
+            policy=policy)
+        # lifecycle observability (gated, host-side only): None when
+        # collection is off — disabled mode appends nothing and reads
+        # no extra clocks beyond the per-round stamps below
+        self.events = lifecycle.EventLog() if lifecycle.enabled() \
+            else None
 
         def _prefill(cache, ids, positions, seg, token_rows,
                      page_table, last_idx):
@@ -99,6 +119,10 @@ class ServingEngine:
         self.tick = 0
         self.decode_steps = 0
         self.tokens_generated = 0
+        # wall seconds spent inside device round trips (prefill +
+        # decode dispatch-to-fetch): run wall minus this is the HOST
+        # slice of the serving loop — the overlap_bound input
+        self.device_dispatch_s = 0.0
 
     # ---------------------------------------------------------- plumbing
 
@@ -122,6 +146,9 @@ class ServingEngine:
                 f"tokens) exceeds prefill_len={self.prefill_len}")
         request.enqueue_wall = time.perf_counter()
         self.scheduler.submit(request)
+        if self.events is not None:
+            self.events.record("submitted", request.rid, tick=self.tick,
+                               wall=request.enqueue_wall)
 
     # ----------------------------------------------------------- prefill
 
@@ -171,6 +198,7 @@ class ServingEngine:
                 token_rows[cursor:cursor + n] = si
                 last_idx[r] = cursor + n - 1
                 cursor += n
+            t0 = time.perf_counter()
             self.cache, logits = self._prefill_fn(
                 self.cache, jnp.asarray(ids), jnp.asarray(positions),
                 jnp.asarray(seg), jnp.asarray(token_rows),
@@ -178,6 +206,7 @@ class ServingEngine:
             next_toks = np.asarray(
                 jnp.argmax(logits.astype(jnp.float32), axis=-1))
             wall = time.perf_counter()
+            self.device_dispatch_s += wall - t0
             for r, si in enumerate(batch):
                 slot = sch.slots[si]
                 slot.pos = len(slot.request.prompt)
@@ -185,8 +214,21 @@ class ServingEngine:
                 slot.request.out_tokens.append(tok)
                 slot.next_token = tok
                 self.tokens_generated += 1
+                # prefill always samples the request's FIRST token —
+                # this dispatch's fetch wall IS the TTFT stamp
+                if slot.request.first_token_wall is None:
+                    slot.request.first_token_wall = wall
                 if slot.request.done():
                     slot.request.finish_wall = wall
+                if self.events is not None:
+                    rid = slot.request.rid
+                    self.events.record("prefill_done", rid,
+                                       tick=self.tick, wall=wall)
+                    self.events.record("first_token", rid,
+                                       tick=self.tick, wall=wall)
+                    if slot.request.done():
+                        self.events.record("finished", rid,
+                                           tick=self.tick, wall=wall)
         return slot_indices
 
     # ------------------------------------------------------------- steps
@@ -202,18 +244,26 @@ class ServingEngine:
                 self.submit(req)
         wall = time.perf_counter()
         evicted = sch.evict_done(now, wall)
-        admitted = sch.admit(now)
+        admitted = sch.admit(now, wall)
+        if self.events is not None:
+            for r in evicted:
+                self.events.record("evicted", r.rid, tick=now, wall=wall)
+            for i in admitted:
+                self.events.record("admitted", sch.slots[i].request.rid,
+                                   tick=now, wall=wall)
         prefilled = self._run_prefill(admitted) if admitted else []
         active = sch.active_indices()
         decoded = 0
         if active:
             tokens, lengths = sch.decode_inputs()
             pt = np.asarray(sch.page_table_rows(), np.int32)
+            t0 = time.perf_counter()
             self.cache, next_toks, _ = self._decode_fn(
                 self.cache, jnp.asarray(tokens, dtype=jnp.int32),
                 jnp.asarray(lengths, dtype=jnp.int32), jnp.asarray(pt))
             next_toks = np.asarray(next_toks)
             wall2 = time.perf_counter()
+            self.device_dispatch_s += wall2 - t0
             for i in active:
                 slot = sch.slots[i]
                 slot.pos += 1
@@ -224,8 +274,25 @@ class ServingEngine:
                     self.tokens_generated += 1
                     if slot.request.done():
                         slot.request.finish_wall = wall2
+                        if self.events is not None:
+                            self.events.record("finished",
+                                               slot.request.rid,
+                                               tick=now, wall=wall2)
                 decoded += 1
             self.decode_steps += 1
+        if self.events is not None:
+            # one gauge sample per scheduler round, AFTER the round's
+            # device work (occupancy as the next round will see it)
+            wall3 = time.perf_counter()
+            self.events.sample_gauges(
+                tick=now, wall=wall3,
+                slots_active=len(sch.active_indices()),
+                num_slots=self.num_slots,
+                queue_depth=sch.queue_depth(),
+                kv_pages_live=(self.allocator.num_pages - 1
+                               - self.allocator.free_count),
+                kv_pages_total=self.allocator.num_pages,
+                hol_wait_s=sch.head_of_line_wait(wall3))
         # a slot whose LAST token was just produced frees at the next
         # round's evict — one round of slack, never a starved queue
         self.tick += 1

@@ -1,0 +1,315 @@
+"""Request-lifecycle event log + scheduler gauges + the SLO ledger block.
+
+PR 9's serving stack is observable only as one decode-throughput
+number; TPU serving comparisons live or die on TAIL latency under load
+(PAPERS.md arXiv:2605.25645), which needs a per-request view. This
+module is the host-side substrate (ROADMAP item 2e):
+
+* **EventLog** — per-request ``submitted / admitted / prefill_done /
+  first_token / finished / evicted`` events with wall-clock stamps,
+  appended by the engine strictly BETWEEN device steps (events are
+  plain host dicts; the jitted prefill/decode programs never see
+  them, so ``decode_cache_size()==1`` holds with the log on or off),
+  plus per-round scheduler/allocator **gauges** (slot occupancy,
+  queue depth, KV-page high-water, head-of-line wait).
+  ``validate_order`` is the mechanical event-ordering invariant
+  surface (tests + ``dryrun_serving`` both assert it).
+* **enabled()** — the collection gate, same trace-time discipline as
+  ``telemetry.metrics.enabled()``: a Python bool (``APEX_SERVE_EVENTS
+  =1`` unless :func:`enable`/:func:`disable` overrode it), branched on
+  in host code only. Disabled mode allocates no log and appends
+  nothing — behavior-identical serving (tests/test_serving_slo.py
+  asserts token-for-token identity and the one-compile contract).
+* **slo_block()** — the validated ledger block
+  ``{ttft_p50/p99_ms, per_token_p50/p99_ms, goodput_tok_s,
+  slo_attainment, arrival_process, offered_load, max_queue_depth,
+  kv_page_high_water}`` (schema teeth in ``ledger.validate_record``;
+  citation pins policed by ``tools/check_bench_labels.py`` check 9).
+  Definitions: TTFT = first-token wall − submit wall; per-token
+  (TPOT) = (finish − first token) / (tokens − 1) for requests with
+  ≥ 2 tokens; a request ATTAINS its SLO when TTFT and TPOT are both
+  under their thresholds (a request too short to have a TPOT is
+  judged on TTFT alone); goodput = tokens of attaining requests per
+  wall second — the honest line under the raw tokens/s
+  (arXiv:2605.25645's framing: throughput that violated its SLO is
+  not serving anyone).
+
+Stdlib-only (like ``scheduler``): the ledger's validators and
+``tools/window_report.py`` consume these blocks without touching jax.
+The SLO thresholds are knobs, not constants (``APEX_SERVE_SLO_TTFT_MS``
+/ ``APEX_SERVE_SLO_TPOT_MS``, parsed by :func:`env_ms` with
+warn-once-and-ignore preference semantics); the defaults below are
+starting points a cited row must PIN, never a committed envelope —
+measured dispatch, not asserted dispatch.
+"""
+
+import os
+
+# canonical per-request event order — the validate_order invariant
+EVENTS = ("submitted", "admitted", "prefill_done", "first_token",
+          "finished", "evicted")
+_EVENT_IDX = {e: i for i, e in enumerate(EVENTS)}
+
+# starting-point SLO thresholds (interactive-serving shaped); a cited
+# slo row pins the RESOLVED values (check 9), so these defaults can
+# move without orphaning any label
+DEFAULT_SLO_TTFT_MS = 1000.0
+DEFAULT_SLO_TPOT_MS = 100.0
+
+# --------------------------------------------------------------------------
+# collection gate (trace-time discipline; process-wide preference)
+
+_FORCED = None  # programmatic override; None defers to the env knob
+
+
+def enabled():
+    """True when lifecycle collection is on (``APEX_SERVE_EVENTS=1``,
+    unless :func:`enable`/:func:`disable` overrode it). Branch on it in
+    host code only — the jitted programs never depend on it."""
+    if _FORCED is not None:
+        return _FORCED
+    return os.environ.get("APEX_SERVE_EVENTS") == "1"
+
+
+def enable():
+    global _FORCED
+    _FORCED = True
+
+
+def disable():
+    global _FORCED
+    _FORCED = False
+
+
+def reset_enabled():
+    """Back to the env-var default (test hygiene)."""
+    global _FORCED
+    _FORCED = None
+
+
+def env_ms(name, default):
+    """Positive-float env preference (SLO thresholds, in ms): the
+    parsed value when valid, else ``default`` — an unparseable or
+    non-positive value warns ONCE per (knob, value) and is ignored.
+    Delegates to ``dispatch.tiles.env_float``: the warn-once
+    preference machinery has ONE home (next to ``env_int`` /
+    ``env_choice``), so its semantics cannot drift per module."""
+    from apex_tpu.dispatch import tiles
+
+    return tiles.env_float(name, default)
+
+
+# --------------------------------------------------------------------------
+# the event log
+
+
+class EventLog:
+    """Append-only per-request lifecycle events + per-round gauges.
+
+    Host-side and allocation-cheap: one dict per event, one per gauge
+    sample. The engine owns the append sites (strictly between device
+    dispatches); this class owns the ordering invariants and the
+    summary aggregation.
+    """
+
+    def __init__(self):
+        self.events = []          # [{event, rid, tick, wall, seq}]
+        self.gauges = []          # [{tick, wall, slots_active, ...}]
+        self._by_rid = {}         # rid -> [event dict]
+
+    # ------------------------------------------------------------ events
+
+    def record(self, event, rid, tick=None, wall=None):
+        """Append one lifecycle event. Unknown event names raise — the
+        vocabulary IS the schema, and a misspelled event would silently
+        break every ordering invariant downstream."""
+        if event not in _EVENT_IDX:
+            raise ValueError(f"unknown lifecycle event {event!r} "
+                             f"(vocabulary: {EVENTS})")
+        rec = {"event": event, "rid": rid, "tick": tick, "wall": wall,
+               "seq": len(self.events)}
+        self.events.append(rec)
+        self._by_rid.setdefault(rid, []).append(rec)
+        return rec
+
+    def request_events(self, rid):
+        return list(self._by_rid.get(rid, ()))
+
+    def rids(self):
+        return sorted(self._by_rid)
+
+    def validate_order(self, rid=None):
+        """Ordering problems (empty list = clean) for one request or
+        all of them: events must appear in the canonical order with no
+        duplicates, starting at ``submitted``, with non-decreasing
+        wall stamps and ticks — the invariant ``dryrun_serving`` and
+        the churn tests assert mechanically."""
+        problems = []
+        rids = [rid] if rid is not None else self.rids()
+        for r in rids:
+            evs = self._by_rid.get(r, [])
+            if not evs:
+                problems.append(f"rid {r}: no events")
+                continue
+            if evs[0]["event"] != "submitted":
+                problems.append(
+                    f"rid {r}: first event is {evs[0]['event']!r}, "
+                    f"not 'submitted'")
+            last_idx, last_wall, last_tick = -1, None, None
+            seen = set()
+            for e in evs:
+                idx = _EVENT_IDX[e["event"]]
+                if e["event"] in seen:
+                    problems.append(
+                        f"rid {r}: duplicate event {e['event']!r}")
+                seen.add(e["event"])
+                if idx < last_idx:
+                    problems.append(
+                        f"rid {r}: {e['event']!r} out of order "
+                        f"(after {EVENTS[last_idx]!r})")
+                last_idx = max(last_idx, idx)
+                w = e.get("wall")
+                if w is not None and last_wall is not None \
+                        and w < last_wall:
+                    problems.append(
+                        f"rid {r}: wall clock went backwards at "
+                        f"{e['event']!r}")
+                if w is not None:
+                    last_wall = w
+                t = e.get("tick")
+                if t is not None and last_tick is not None \
+                        and t < last_tick:
+                    problems.append(
+                        f"rid {r}: tick went backwards at "
+                        f"{e['event']!r}")
+                if t is not None:
+                    last_tick = t
+        return problems
+
+    # ------------------------------------------------------------ gauges
+
+    def sample_gauges(self, tick, wall, *, slots_active, num_slots,
+                      queue_depth, kv_pages_live, kv_pages_total,
+                      hol_wait_s):
+        """One per-scheduler-round gauge sample (engine calls this at
+        the end of each :meth:`ServingEngine.step`). Names mirror the
+        registered telemetry metric specs (``telemetry.metrics``), so
+        a ``MetricsWriter`` can sink :meth:`gauge_rows` directly."""
+        self.gauges.append({
+            "tick": tick, "wall": wall,
+            "serve_slots_active": int(slots_active),
+            "serve_num_slots": int(num_slots),
+            "serve_queue_depth": int(queue_depth),
+            "serve_kv_pages_live": int(kv_pages_live),
+            "serve_kv_pages_total": int(kv_pages_total),
+            "serve_hol_wait_ms": round(float(hol_wait_s) * 1e3, 4),
+        })
+
+    def gauge_rows(self, run=None):
+        """MetricsWriter-shaped rows (one per sample, ``step`` = tick)."""
+        rows = []
+        for g in self.gauges:
+            row = {"step": g["tick"]}
+            if run is not None:
+                row["run"] = run
+            row.update({k: v for k, v in g.items()
+                        if k not in ("tick", "wall")})
+            rows.append(row)
+        return rows
+
+    def summary(self):
+        """Aggregate gauge account: the slo block's occupancy fields."""
+        if not self.gauges:
+            return {"max_queue_depth": None, "kv_page_high_water": None,
+                    "max_slots_active": None, "max_hol_wait_ms": None,
+                    "samples": 0}
+        return {
+            "max_queue_depth": max(g["serve_queue_depth"]
+                                   for g in self.gauges),
+            "kv_page_high_water": max(g["serve_kv_pages_live"]
+                                      for g in self.gauges),
+            "max_slots_active": max(g["serve_slots_active"]
+                                    for g in self.gauges),
+            "max_hol_wait_ms": max(g["serve_hol_wait_ms"]
+                                   for g in self.gauges),
+            "samples": len(self.gauges),
+        }
+
+
+# --------------------------------------------------------------------------
+# per-request latency derivation + the slo block
+
+
+def request_latencies(requests):
+    """Per-request latency rows derived from the wall stamps the
+    engine threads through admit/prefill/decode (seconds, host clock):
+    ``{rid, ttft_s, tpot_s, n_out}`` — ``ttft_s`` None when either
+    stamp is missing, ``tpot_s`` None for requests with < 2 tokens
+    (no inter-token interval exists)."""
+    rows = []
+    for r in requests:
+        n_out = len(getattr(r, "out_tokens", ()) or ())
+        ttft = None
+        if r.enqueue_wall is not None and r.first_token_wall is not None:
+            ttft = max(0.0, r.first_token_wall - r.enqueue_wall)
+        tpot = None
+        if n_out >= 2 and r.first_token_wall is not None \
+                and r.finish_wall is not None:
+            tpot = max(0.0, (r.finish_wall - r.first_token_wall)
+                       / (n_out - 1))
+        rows.append({"rid": r.rid, "ttft_s": ttft, "tpot_s": tpot,
+                     "n_out": n_out})
+    return rows
+
+
+def percentile(values, q):
+    """Nearest-rank percentile of a list (None when empty) — the same
+    convention as profile_serving's p50/p99 so the two latency
+    surfaces can never disagree on method (at q=50 the index formula
+    IS profile_serving's ``vals[n // 2]``)."""
+    if not values:
+        return None
+    vals = sorted(values)
+    return vals[min(len(vals) - 1, int(len(vals) * q / 100.0))]
+
+
+def slo_block(requests, wall_s, *, ttft_ms, tpot_ms, arrival_process,
+              offered_load, log=None):
+    """Assemble the validated ``slo`` ledger block from completed
+    requests + the run's wall time (+ the EventLog's gauge summary
+    when collection was on — occupancy fields null-degrade without
+    it, never vanish)."""
+    lats = request_latencies(requests)
+    ttfts = [x["ttft_s"] * 1e3 for x in lats if x["ttft_s"] is not None]
+    tpots = [x["tpot_s"] * 1e3 for x in lats if x["tpot_s"] is not None]
+
+    def _attains(x):
+        if x["ttft_s"] is None or x["ttft_s"] * 1e3 > ttft_ms:
+            return False
+        # a 1-token request has no inter-token interval: TTFT decides
+        return x["tpot_s"] is None or x["tpot_s"] * 1e3 <= tpot_ms
+
+    attained = [x for x in lats if _attains(x)]
+    good_tokens = sum(x["n_out"] for x in attained)
+    summary = log.summary() if log is not None else {}
+
+    def _r(v, nd=2):
+        return None if v is None else round(v, nd)
+
+    return {
+        "ttft_p50_ms": _r(percentile(ttfts, 50)),
+        "ttft_p99_ms": _r(percentile(ttfts, 99)),
+        "per_token_p50_ms": _r(percentile(tpots, 50)),
+        "per_token_p99_ms": _r(percentile(tpots, 99)),
+        "goodput_tok_s": _r(good_tokens / wall_s if wall_s > 0 else None),
+        "slo_attainment": _r(len(attained) / len(lats) if lats else None,
+                             4),
+        "slo_ttft_ms": float(ttft_ms),
+        "slo_tpot_ms": float(tpot_ms),
+        "arrival_process": arrival_process,
+        "offered_load": _r(offered_load, 4),
+        "requests": len(lats),
+        "max_queue_depth": summary.get("max_queue_depth"),
+        "kv_page_high_water": summary.get("kv_page_high_water"),
+        "max_hol_wait_ms": summary.get("max_hol_wait_ms"),
+    }

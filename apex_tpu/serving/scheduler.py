@@ -19,16 +19,50 @@ The synthetic traffic trace (:func:`synthetic_trace`) is the
 deterministic workload every serving measurement pins: request
 arrival ticks, prompt lengths and output lengths from one seeded
 stdlib RNG, identified by a content hash (``trace_id``) that rides in
-the ledger's serving block.
+the ledger's serving block. Two ARRIVAL PROCESSES (the ISSUE 11
+open-loop load harness, ROADMAP 2e): ``"poisson"`` — exponential
+inter-arrivals at the constant offered rate (what the original trace
+already drew, now named) — and ``"diurnal"`` — a non-homogeneous
+Poisson process whose instantaneous rate swings sinusoidally around
+the base rate (the day/night traffic shape heavy-traffic serving is
+actually sized against). The process is a per-call argument of the
+trace (unknown values raise) and a pinned knob of the measuring
+harness (``APEX_SERVE_ARRIVALS``, check 9).
+
+Scheduler POLICY is a dispatch choice, not an architecture constant
+(ROADMAP 2e: FIFO vs priority vs chunked prefill as measured
+dispatch): :func:`resolve_policy` keeps the CLAUDE.md asymmetry —
+per-call unknown policies raise, the ``APEX_SERVE_SCHED`` env
+preference warns once and falls back. Today the vocabulary is
+``("fifo",)``; the knob exists so the first alternative policy lands
+as a pinned A/B row, not a silent default flip.
 """
 
 import dataclasses
 import hashlib
+import math
 import random
 from collections import deque
 from typing import List, Optional
 
+from apex_tpu.dispatch import tiles as _tiles
 from apex_tpu.serving.kv_cache import pages_needed
+
+ARRIVALS = ("poisson", "diurnal")
+POLICIES = ("fifo",)
+
+
+def resolve_policy(per_call=None):
+    """The effective scheduler policy: per-call (raises on unknown —
+    an explicit request is a demand) > ``APEX_SERVE_SCHED`` env
+    preference (warn-once-and-ignore on unknown) > built-in FIFO."""
+    if per_call is not None:
+        if per_call not in POLICIES:
+            raise ValueError(
+                f"unknown scheduler policy {per_call!r} "
+                f"(vocabulary: {POLICIES})")
+        return per_call
+    return _tiles.env_choice("APEX_SERVE_SCHED", POLICIES) or "fifo"
 
 
 @dataclasses.dataclass
@@ -41,6 +75,11 @@ class Request:
     out_tokens: List[int] = dataclasses.field(default_factory=list)
     enqueue_wall: Optional[float] = None
     finish_wall: Optional[float] = None
+    # lifecycle wall stamps (seconds, host clock — the engine threads
+    # them through admit/prefill so replay latencies are seconds, not
+    # tick counts; apex_tpu.serving.lifecycle derives TTFT/TPOT here)
+    admitted_wall: Optional[float] = None
+    first_token_wall: Optional[float] = None
     admitted_tick: Optional[int] = None
     finished_tick: Optional[int] = None
 
@@ -58,11 +97,12 @@ class Slot:
 
 class ContinuousBatchingScheduler:
     def __init__(self, num_slots, max_pages_per_slot, page_size,
-                 allocator):
+                 allocator, policy=None):
         self.num_slots = int(num_slots)
         self.max_pages = int(max_pages_per_slot)
         self.page_size = int(page_size)
         self.allocator = allocator
+        self.policy = resolve_policy(policy)
         self.slots = [None] * self.num_slots
         self.queue = deque()
         self.completed = []
@@ -95,10 +135,28 @@ class ContinuousBatchingScheduler:
         return pages_needed(len(req.prompt) + req.max_new_tokens,
                             self.page_size)
 
-    def admit(self, tick):
+    def queue_depth(self):
+        return len(self.queue)
+
+    def head_of_line_wait(self, wall_time):
+        """Seconds the oldest queued request has been waiting at
+        ``wall_time`` (0.0 with an empty queue or unstamped head) —
+        the gauge that names head-of-line blocking as a number."""
+        if not self.queue:
+            return 0.0
+        head = self.queue[0].enqueue_wall
+        if head is None:
+            return 0.0
+        return max(0.0, wall_time - head)
+
+    def admit(self, tick, wall_time=None):
         """FIFO admission of every queued request that fits, stopping
         at the first that does not (head-of-line blocking — the
-        no-starvation rule). Returns the newly filled slot indices."""
+        no-starvation rule). Returns the newly filled slot indices.
+        ``wall_time`` (the engine's host clock, one read per round)
+        stamps each admission's ``admitted_wall`` — the same wall
+        seam as :meth:`evict_done`, so replay latencies are seconds,
+        not tick counts."""
         admitted = []
         while self.queue:
             req = self.queue[0]
@@ -116,11 +174,16 @@ class ContinuousBatchingScheduler:
             idx = free[0]
             self.slots[idx] = Slot(request=req, pages=pages)
             req.admitted_tick = tick
+            if wall_time is not None:
+                req.admitted_wall = wall_time
             admitted.append(idx)
         return admitted
 
     def evict_done(self, tick, wall_time=None):
-        """Free slots/pages of completed requests; returns them."""
+        """Free slots/pages of completed requests; returns them.
+        ``wall_time`` backstops ``finish_wall`` for requests whose
+        finishing dispatch did not stamp it (the one wall-clock seam
+        shared with :meth:`admit`)."""
         done = []
         for i, slot in enumerate(self.slots):
             if slot is not None and slot.request.done():
@@ -160,17 +223,40 @@ class ContinuousBatchingScheduler:
 
 def synthetic_trace(seed=0, n_requests=16, vocab=256, prompt_lo=4,
                     prompt_hi=24, new_lo=4, new_hi=32,
-                    mean_interarrival=0.5):
+                    mean_interarrival=0.5, arrival="poisson",
+                    diurnal_period=32.0, diurnal_depth=0.8):
     """Deterministic request trace: ``(requests, trace_id)``. Arrival
     is in decode-step ticks; the id is a content hash of every
     request's (arrival, prompt, max_new) so a cited serving row names
-    exactly the workload it measured."""
+    exactly the workload it measured.
+
+    ``arrival`` selects the OPEN-LOOP arrival process (unknown values
+    raise — a per-call argument is a demand):
+
+    * ``"poisson"`` — exponential inter-arrivals at rate
+      ``1/mean_interarrival`` (the process the original trace always
+      drew; byte-identical stream and ``tr-`` id for existing seeds).
+    * ``"diurnal"`` — non-homogeneous Poisson: the instantaneous rate
+      swings sinusoidally around the base rate with period
+      ``diurnal_period`` ticks and relative amplitude
+      ``diurnal_depth`` in [0, 1) (floored at 5% of base so the
+      trough never stalls the trace) — peak-hour bursts and
+      night-trough droughts in one seeded, content-hashed trace.
+    """
+    if arrival not in ARRIVALS:
+        raise ValueError(f"unknown arrival process {arrival!r} "
+                         f"(vocabulary: {ARRIVALS})")
     rng = random.Random(seed)
     t = 0.0
     reqs = []
     for rid in range(n_requests):
-        t += rng.expovariate(1.0 / mean_interarrival) \
-            if mean_interarrival > 0 else 0.0
+        if mean_interarrival > 0:
+            rate = 1.0 / mean_interarrival
+            if arrival == "diurnal":
+                rate *= 1.0 + diurnal_depth * math.sin(
+                    2.0 * math.pi * t / diurnal_period)
+                rate = max(rate, 0.05 / mean_interarrival)
+            t += rng.expovariate(rate)
         plen = rng.randint(prompt_lo, prompt_hi)
         prompt = [rng.randrange(vocab) for _ in range(plen)]
         reqs.append(Request(
@@ -181,3 +267,14 @@ def synthetic_trace(seed=0, n_requests=16, vocab=256, prompt_lo=4,
         [(r.arrival, tuple(r.prompt), r.max_new_tokens)
          for r in reqs]).encode()).hexdigest()[:10]
     return reqs, f"tr-{h}"
+
+
+def offered_load(requests):
+    """Offered load of a trace in requests per tick: request count
+    over the arrival span (the open-loop intensity a cited slo row
+    names next to its arrival process). 0.0 for an empty trace; a
+    same-tick burst divides by the 1-tick floor."""
+    if not requests:
+        return 0.0
+    span = max(r.arrival for r in requests)
+    return len(requests) / max(span, 1.0)

@@ -27,6 +27,12 @@ into ``ledger.validate_record``)::
      "step_floor_ms": ...,         # max(compute, bandwidth) floor
      "mfu_bound": ...}             # model flops at the floor ÷ peak
 
+plus two OPTIONAL stamps (present only where they say something —
+legacy blocks stay valid without them, malformed is a finding):
+``comm_compression`` (the quantized-collectives claim, PR 8) and
+``overlap_bound`` (:func:`overlap_bound` — compute floor vs measured
+comm+host time, the ROADMAP 4d gap ``window_report`` prints).
+
 Every field degrades to None where the backend can't report (the
 ``_compat`` normalizers fold the per-version/backend shape differences:
 absent method, None return, flat dict, list-of-dicts, extension
@@ -150,9 +156,70 @@ def comm_compression_block(snapshot, uncompressed=None):
     return out
 
 
+def overlap_bound(compute_floor_ms, host_ms=None, comm_ms=None):
+    """The overlap upper bound (ROADMAP 4d seed): compute floor vs the
+    comm+host time a perfectly overlapped schedule would hide behind
+    it. ``host_ms`` is MEASURED non-device wall per step (e.g. the
+    serving loop's scheduler/staging slice — run wall minus device
+    dispatch time, per decode round); ``comm_ms`` a per-step
+    collective-time estimate where a caller has one. Returns None
+    when neither is known (the stamp only exists where it says
+    something); fields null-degrade individually::
+
+        {"compute_floor_ms": ...,  # the block's roofline floor
+         "host_ms": ..., "comm_ms": ...,
+         "comm_host_ms": ...,      # what overlap could hide
+         "hideable_ms": ...,       # min(floor, comm+host) — the win
+         "bound_step_ms": ...}     # max(floor, comm+host) — the best
+                                   # fully-overlapped step
+
+    ``bound_step_ms − compute_floor_ms`` is the gap every future
+    overlap/scheduler PR is chasing; ``window_report`` prints it as a
+    column so the gap has a name before anyone claims to have closed
+    it."""
+    if host_ms is None and comm_ms is None:
+        return None
+    comm_host = (host_ms or 0.0) + (comm_ms or 0.0)
+    out = {
+        "compute_floor_ms": None if compute_floor_ms is None
+        else round(float(compute_floor_ms), 6),
+        "host_ms": None if host_ms is None else round(float(host_ms), 6),
+        "comm_ms": None if comm_ms is None else round(float(comm_ms), 6),
+        "comm_host_ms": round(float(comm_host), 6),
+        "hideable_ms": None, "bound_step_ms": None,
+    }
+    if compute_floor_ms is not None:
+        out["hideable_ms"] = round(min(float(compute_floor_ms),
+                                       comm_host), 6)
+        out["bound_step_ms"] = round(max(float(compute_floor_ms),
+                                         comm_host), 6)
+    return out
+
+
+def attach_overlap(block, host_ms=None, comm_ms=None):
+    """Return ``block`` with an ``overlap_bound`` stamp derived from
+    its own ``compute_floor_ms`` (None-degrading: a null-degraded
+    block still carries the measured comm+host side). The sub-block
+    is OPTIONAL in the schema — legacy cost blocks stay valid without
+    it — but malformed is a finding (:func:`validate`)."""
+    ob = overlap_bound(
+        (block or {}).get("compute_floor_ms"), host_ms=host_ms,
+        comm_ms=comm_ms)
+    if ob is None:
+        return block
+    out = dict(block or null_block())
+    out["overlap_bound"] = ob
+    return out
+
+
+_OVERLAP_FIELDS = ("compute_floor_ms", "host_ms", "comm_ms",
+                   "comm_host_ms", "hideable_ms", "bound_step_ms")
+
+
 def build(xla_flops=None, hbm_bytes=None, memory=None, comm=None,
           steps=None, model_flops_per_step=None, platform=None,
-          source=None, comm_compression=None):
+          source=None, comm_compression=None, host_ms=None,
+          comm_ms=None):
     """Assemble a validated cost block from XLA's reported numbers.
 
     ``xla_flops`` / ``hbm_bytes`` are the analyses' reported counts,
@@ -212,6 +279,13 @@ def build(xla_flops=None, hbm_bytes=None, memory=None, comm=None,
         if mf and peak and block["step_floor_ms"] > 0:
             block["mfu_bound"] = round(
                 mf / (block["step_floor_ms"] / 1e3) / peak, 4)
+    ob = overlap_bound(block["compute_floor_ms"], host_ms=host_ms,
+                       comm_ms=comm_ms)
+    if ob is not None:
+        # the overlap upper bound (ROADMAP 4d): stamped only when a
+        # caller measured a comm/host side — optional, never omitted
+        # silently once known
+        block["overlap_bound"] = ob
     return block
 
 
@@ -401,6 +475,25 @@ def validate(block):
                     problems.append(
                         f"comm_bytes_per_axis[{k!r}] is not a "
                         f"non-negative number")
+    ob = block.get("overlap_bound")
+    if ob is not None:
+        # the overlap-bound stamp (ROADMAP 4d) — OPTIONAL (legacy
+        # blocks carry none), but malformed is a finding: a broken
+        # stamp could name a fake overlap gap for the next PR to
+        # "close"
+        if not isinstance(ob, dict):
+            problems.append("overlap_bound is not a dict")
+        else:
+            for field in _OVERLAP_FIELDS:
+                if field not in ob:
+                    problems.append(
+                        f"overlap_bound missing field {field!r}")
+                v = ob.get(field)
+                if v is not None and (not isinstance(v, (int, float))
+                                      or isinstance(v, bool) or v < 0):
+                    problems.append(
+                        f"overlap_bound.{field} is not a non-negative "
+                        f"number")
     cc = block.get("comm_compression")
     if cc is not None:
         # the quantized/hierarchical-collectives stamp — OPTIONAL

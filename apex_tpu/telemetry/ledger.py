@@ -199,6 +199,54 @@ def read_ledger(path=None):
     return records
 
 
+# the slo ledger block's schema (apex_tpu.serving.lifecycle builds it;
+# this module owns the validation teeth, like the serving block above,
+# so the stdlib-only validators never import the serving package)
+SLO_FIELDS = ("ttft_p50_ms", "ttft_p99_ms", "per_token_p50_ms",
+              "per_token_p99_ms", "goodput_tok_s", "slo_attainment",
+              "slo_ttft_ms", "slo_tpot_ms", "arrival_process",
+              "offered_load", "max_queue_depth", "kv_page_high_water")
+_SLO_NUMERIC = ("ttft_p50_ms", "ttft_p99_ms", "per_token_p50_ms",
+                "per_token_p99_ms", "goodput_tok_s", "slo_ttft_ms",
+                "slo_tpot_ms", "offered_load")
+_SLO_COUNTS = ("max_queue_depth", "kv_page_high_water")
+
+
+def _validate_slo(slo):
+    if not isinstance(slo, dict):
+        return ["not a dict"]
+    problems = []
+    for field in SLO_FIELDS:
+        if field not in slo:
+            problems.append(f"missing field {field!r}")
+    for field in _SLO_NUMERIC:
+        v = slo.get(field)
+        if v is not None and (not isinstance(v, (int, float))
+                              or isinstance(v, bool) or v < 0):
+            problems.append(f"{field} is not a non-negative number")
+    for field in _SLO_COUNTS:
+        v = slo.get(field)
+        if v is not None and (not isinstance(v, int)
+                              or isinstance(v, bool) or v < 0):
+            problems.append(f"{field} is not a non-negative int")
+    att = slo.get("slo_attainment")
+    if att is not None and (not isinstance(att, (int, float))
+                            or isinstance(att, bool)
+                            or not 0.0 <= att <= 1.0):
+        problems.append("slo_attainment is not in [0, 1]")
+    for lo, hi in (("ttft_p50_ms", "ttft_p99_ms"),
+                   ("per_token_p50_ms", "per_token_p99_ms")):
+        a, b = slo.get(lo), slo.get(hi)
+        if isinstance(a, (int, float)) and isinstance(b, (int, float)) \
+                and not isinstance(a, bool) and not isinstance(b, bool) \
+                and a > b:
+            problems.append(f"{lo} exceeds {hi}")
+    ap = slo.get("arrival_process")
+    if "arrival_process" in slo and not (isinstance(ap, str) and ap):
+        problems.append("arrival_process is not a non-empty string")
+    return problems
+
+
 def validate_record(rec):
     """Schema problems for one record (empty list = clean)."""
     problems = []
@@ -304,6 +352,15 @@ def validate_record(rec):
             if not (isinstance(kp, int) and not isinstance(kp, bool)
                     and kp > 0):
                 problems.append("serving.kv_pages is not a positive int")
+    slo = rec.get("slo")
+    if slo is not None:
+        # the SLO block (apex_tpu.serving.lifecycle.slo_block, ISSUE
+        # 11): per-request tail latency + goodput under a named
+        # arrival process. Malformed, it could claim an SLO attainment
+        # no trace produced — same teeth as the serving block. Fields
+        # may be null (a trace with no >=2-token request has no TPOT
+        # percentile) but must be PRESENT: degradation, not omission.
+        problems += [f"slo: {p}" for p in _validate_slo(slo)]
     rf = rec.get("resumed_from")
     if rf is not None:
         # resume provenance (bench.py --resume / profile_gpt): rides
@@ -360,6 +417,14 @@ def _summary_line(rec):
     spans = rec.get("spans")
     if isinstance(spans, list):
         marks.append(f"{len(spans)} span(s)")
+    slo = rec.get("slo")
+    if isinstance(slo, dict):
+        att = slo.get("slo_attainment")
+        # malformed attainment (a validator FINDING) must not crash
+        # the summary that would surface it
+        marks.append(f"slo={att:.0%}"
+                     if isinstance(att, (int, float))
+                     and not isinstance(att, bool) else "slo")
     cost = rec.get("cost")
     if isinstance(cost, dict) and cost.get("peak_hbm_bytes"):
         marks.append(f"peak_hbm={cost['peak_hbm_bytes'] / 2 ** 20:.0f}MiB")
@@ -409,6 +474,32 @@ def main(argv=None):
         for h in sorted(by_harness):
             print(f"  {h:24s} {by_harness[h]}")
         print(f"  schema findings: {problems}; fault-injected: {injected}")
+        # serving/slo account (ISSUE 11): a window operator asking
+        # "what did serving bank" gets the tail-latency story, not
+        # just a row count
+        sv_rows = [r for r in records
+                   if isinstance(r.get("serving"), dict)]
+        slo_rows = [r for r in records if isinstance(r.get("slo"), dict)]
+        if sv_rows or slo_rows:
+            print(f"  serving: {len(sv_rows)} row(s), "
+                  f"{len(slo_rows)} with slo block")
+            for r in slo_rows:
+                s = r["slo"]
+                att = s.get("slo_attainment")
+                # a malformed attainment is a schema FINDING above —
+                # the status line that reports it must not crash on it
+                att_s = (format(att, ".0%")
+                         if isinstance(att, (int, float))
+                         and not isinstance(att, bool) else "?")
+                sv = r.get("serving")  # may be malformed: a finding,
+                tid = (sv.get("trace_id", "?")  # never a crash here
+                       if isinstance(sv, dict) else "?")
+                print(f"    {r.get('id', '?')} "
+                      f"{s.get('arrival_process', '?')} "
+                      f"offered={s.get('offered_load')} req/tick "
+                      f"attainment={att_s} "
+                      f"goodput={s.get('goodput_tok_s')} tok/s "
+                      f"ttft_p99={s.get('ttft_p99_ms')}ms [{tid}]")
         return 1 if problems else 0
     if args.cmd == "tail":
         # n<=0 prints nothing (records[-0:] would be the WHOLE ledger)

@@ -109,6 +109,23 @@ register("grad_max", unit="", description="max |g| over the grad pytree")
 register("tokens_per_sec", unit="tokens/s",
          description="host-derived throughput for the run")
 
+# Serving-loop gauges (apex_tpu.serving.lifecycle.EventLog.sample_gauges
+# — one sample per scheduler round, ISSUE 11): registered here so the
+# registry stays the ONE schema and EventLog.gauge_rows() can sink
+# through a strict MetricsWriter without auto-registration.
+register("serve_slots_active", unit="slots",
+         description="decode slots holding a live request this round")
+register("serve_num_slots", unit="slots",
+         description="decode slot capacity of the engine")
+register("serve_queue_depth", unit="requests",
+         description="requests waiting for admission this round")
+register("serve_kv_pages_live", unit="pages",
+         description="KV cache pages allocated to live requests")
+register("serve_kv_pages_total", unit="pages",
+         description="KV cache page capacity (incl. reserved null page)")
+register("serve_hol_wait_ms", unit="ms",
+         description="age of the head-of-line queued request")
+
 
 # --------------------------------------------------------------------------
 # in-step collection

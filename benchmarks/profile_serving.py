@@ -15,12 +15,26 @@ Two evidence classes in one Tracer run (ISSUE 10):
   round trip — exactly the per-token cost a user sees), so its
   tokens/s is the honest lower line under the scan row's upper line.
 
+* **SLO replay** (ISSUE 11) — the same replay with the request
+  LIFECYCLE log on (``apex_tpu.serving.lifecycle``): the validated
+  ``slo`` ledger block — TTFT/per-token p50/p99, goodput (tokens of
+  SLO-attaining requests only), SLO attainment, arrival process +
+  offered load, queue/KV-page high-waters — under a seeded
+  Poisson/diurnal trace (``APEX_SERVE_ARRIVALS``), judged against
+  the pinned thresholds (``APEX_SERVE_SLO_TTFT_MS`` /
+  ``APEX_SERVE_SLO_TPOT_MS``) with the scheduler policy pinned too
+  (``APEX_SERVE_SCHED``). The replay's host slice (run wall minus
+  device dispatch time, per decode round) lands as the cost block's
+  ``overlap_bound`` stamp — the ROADMAP 4c/4d gap, measured.
+
 The ledger record carries the validated ``serving`` block
-``{tokens_per_s, p50_ms, p99_ms, trace_id, kv_pages}``
-(``ledger.validate_record``) and PINS both serving dispatch knobs —
-``APEX_SERVE_WEIGHT_QUANT`` and ``APEX_DECODE_ATTN_IMPL`` — at their
+``{tokens_per_s, p50_ms, p99_ms, trace_id, kv_pages}`` and the
+``slo`` block (``ledger.validate_record``) and PINS every shaping
+knob — ``APEX_SERVE_WEIGHT_QUANT``, ``APEX_DECODE_ATTN_IMPL``
+(check 8), ``APEX_SERVE_SLO_TTFT_MS``, ``APEX_SERVE_SLO_TPOT_MS``,
+``APEX_SERVE_ARRIVALS``, ``APEX_SERVE_SCHED`` (check 9) — at their
 RESOLVED values before the write, so every serving row is citable
-under ``tools/check_bench_labels.py`` check 8 by construction.
+under ``tools/check_bench_labels.py`` by construction.
 
 Run on the real TPU (dead-last in run_all_tpu.sh behind
 ``APEX_SERVE_BENCH=1`` — the still-owed training headlines outrank
@@ -47,12 +61,15 @@ SMOKE = smoke_mode("APEX_BENCH_SMOKE")
 from benchmarks._timing import Tracer  # noqa: E402
 
 from apex_tpu import compile_cache, dispatch  # noqa: E402
+from apex_tpu.dispatch import tiles as _tiles  # noqa: E402
 from apex_tpu.serving import (  # noqa: E402
     ServingEngine,
     synthetic_trace,
 )
+from apex_tpu.serving import lifecycle  # noqa: E402
 from apex_tpu.serving import model as smodel  # noqa: E402
 from apex_tpu.serving import quant as quant_mod  # noqa: E402
+from apex_tpu.serving import scheduler as sched_mod  # noqa: E402
 from apex_tpu.telemetry import costs as _costs  # noqa: E402
 from apex_tpu.telemetry.costs import V5E_PEAK_BF16_FLOPS as PEAK  # noqa: E402
 from apex_tpu.transformer.testing import TransformerConfig  # noqa: E402
@@ -101,6 +118,25 @@ if IMPL not in ("jnp", "pallas"):
         os.environ["APEX_DECODE_ATTN_BLOCK_H"] = str(
             tparams["block_h"])
 os.environ["APEX_DECODE_ATTN_IMPL"] = IMPL
+
+# ...and the SLO label's knobs (ISSUE 11, check 9): arrival process,
+# thresholds and scheduler policy resolved ONCE here and pinned back
+# into the env, so the record's knobs name exactly the workload and
+# the judgment the slo block carries — label and claim are one thing.
+ARRIVALS = _tiles.env_choice("APEX_SERVE_ARRIVALS",
+                             sched_mod.ARRIVALS) or "poisson"
+os.environ["APEX_SERVE_ARRIVALS"] = ARRIVALS
+POLICY = sched_mod.resolve_policy()
+os.environ["APEX_SERVE_SCHED"] = POLICY
+SLO_TTFT_MS = lifecycle.env_ms("APEX_SERVE_SLO_TTFT_MS",
+                               lifecycle.DEFAULT_SLO_TTFT_MS)
+SLO_TPOT_MS = lifecycle.env_ms("APEX_SERVE_SLO_TPOT_MS",
+                               lifecycle.DEFAULT_SLO_TPOT_MS)
+# repr round-trips a float exactly ("%g" truncates to 6 significant
+# digits — a 1000.125 threshold would pin as "1000.12" and check 9
+# would flag the harness's own record as label drift)
+os.environ["APEX_SERVE_SLO_TTFT_MS"] = repr(SLO_TTFT_MS)
+os.environ["APEX_SERVE_SLO_TPOT_MS"] = repr(SLO_TPOT_MS)
 
 engine = ServingEngine(cfg, num_slots=SLOTS, page_size=PS,
                        num_pages=PAGES, max_seq=MAX_SEQ,
@@ -158,8 +194,9 @@ if span.seconds:
     scan_tps = SLOTS / span.seconds
     print(f"{'':28s} -> {scan_tps:.0f} tok/s (scan upper line)")
 
-# ---------------------------------------------- row 2: trace replay
+# ----------------------------- row 2: trace replay + the slo block
 serving_block = None
+slo_block = None
 if not compile_cache.warm_only():
     import time
 
@@ -168,10 +205,18 @@ if not compile_cache.warm_only():
         seed=7, n_requests=n_req, vocab=cfg.vocab_size,
         prompt_lo=4, prompt_hi=min(24, PRE_LEN // 2),
         new_lo=4, new_hi=min(24, MAX_SEQ - 32),
-        mean_interarrival=0.5)
-    replay = ServingEngine(cfg, params=engine.params, num_slots=SLOTS,
-                           page_size=PS, num_pages=PAGES,
-                           max_seq=MAX_SEQ, prefill_len=PRE_LEN)
+        mean_interarrival=0.5, arrival=ARRIVALS)
+    # lifecycle collection ON for the replay engine only (the scan
+    # row above measured the device program, not host bookkeeping);
+    # reset to the env default right after the ctor captured the gate
+    lifecycle.enable()
+    try:
+        replay = ServingEngine(cfg, params=engine.params,
+                               num_slots=SLOTS, page_size=PS,
+                               num_pages=PAGES, max_seq=MAX_SEQ,
+                               prefill_len=PRE_LEN, policy=POLICY)
+    finally:
+        lifecycle.reset_enabled()
     t0 = time.perf_counter()
     done = replay.run_trace(trace)
     wall = time.perf_counter() - t0
@@ -196,12 +241,48 @@ if not compile_cache.warm_only():
     assert replay.decode_cache_size() == 1, (
         "decode step recompiled during the trace — the scheduler "
         "changed a shape (jaxpr-stability contract broken)")
+    order_problems = replay.events.validate_order()
+    assert not order_problems, (
+        "lifecycle event-order invariant broken", order_problems)
+    slo_block = lifecycle.slo_block(
+        done, wall, ttft_ms=SLO_TTFT_MS, tpot_ms=SLO_TPOT_MS,
+        arrival_process=ARRIVALS,
+        offered_load=sched_mod.offered_load(trace),
+        log=replay.events)
+    print(f"{'slo (' + ARRIVALS + ')':28s} "
+          f"ttft p50/p99 {slo_block['ttft_p50_ms']}/"
+          f"{slo_block['ttft_p99_ms']} ms, per-token p50/p99 "
+          f"{slo_block['per_token_p50_ms']}/"
+          f"{slo_block['per_token_p99_ms']} ms, goodput "
+          f"{slo_block['goodput_tok_s']} tok/s, attainment "
+          f"{slo_block['slo_attainment']:.0%} "
+          f"(ttft<={SLO_TTFT_MS:g}ms tpot<={SLO_TPOT_MS:g}ms), "
+          f"qmax={slo_block['max_queue_depth']} "
+          f"kv_hw={slo_block['kv_page_high_water']}/{PAGES}")
+    # the measured host slice of the serving loop, per decode round
+    # (run wall minus device dispatch time) -> the cost block's
+    # overlap_bound stamp: what perfect host/device overlap
+    # (ROADMAP 4c) could hide behind the decode dispatch
+    if replay.decode_steps:
+        host_ms = max(0.0, (wall - replay.device_dispatch_s)
+                      / replay.decode_steps * 1e3)
+        base = TRACER.cost if TRACER.cost is not None \
+            else _costs.null_block()
+        TRACER.cost = _costs.attach_overlap(base, host_ms=host_ms)
+        ob = TRACER.cost["overlap_bound"]
+        print(f"{'overlap bound':28s} host {ob['host_ms']:.2f} "
+              f"ms/step vs compute floor "
+              f"{'?' if ob['compute_floor_ms'] is None else ob['compute_floor_ms']} ms")
 
 rid = TRACER.flush_ledger("profile_serving", extra={
     "serving": serving_block,
+    "slo": slo_block,
     "config": {"slots": SLOTS, "page_size": PS, "pages": PAGES,
                "max_seq": MAX_SEQ, "prefill_len": PRE_LEN,
                "params_m": round(n_params / 1e6, 1),
-               "weight_quant": WQ, "decode_impl": IMPL}})
+               "weight_quant": WQ, "decode_impl": IMPL,
+               "arrivals": ARRIVALS, "policy": POLICY,
+               "slo_ttft_ms": SLO_TTFT_MS,
+               "slo_tpot_ms": SLO_TPOT_MS}})
 if rid:
     print(f"ledger: {rid}")

@@ -164,13 +164,18 @@ run bench_b32_remat  1500 env APEX_PROFILE_CAPTURE= APEX_CKPT_DIR="$CKPT_ROOT/be
 if [ "${APEX_PROFILE_CAPTURE:-}" = "1" ]; then
 run bench_profile    2400 env APEX_BENCH_ATTEMPTS=1 python bench.py
 fi
-# Serving bench DEAD LAST behind its own knob (ISSUE 10): the decode
-# path's tokens/s + p50/p99 row (benchmarks/profile_serving.py) is a
-# NEW evidence class, but the still-owed training headlines (BENCH_r06,
-# the step A/Bs, the tile sweep) outrank it — an unarmed pass must not
-# spend a minute of a short window here. warm_cache.py AOT-warms the
-# serving program set only when this same knob is set. Slot budget:
-# one prefill+decode compile set + the K-scan row + the trace replay.
+# Serving bench DEAD LAST behind its own knob (ISSUE 10/11): the
+# decode path's tokens/s + p50/p99 row (benchmarks/profile_serving.py)
+# is a NEW evidence class, but the still-owed training headlines
+# (BENCH_r06, the step A/Bs, the tile sweep) outrank it — an unarmed
+# pass must not spend a minute of a short window here. warm_cache.py
+# AOT-warms the serving program set only when this same knob is set.
+# The row also emits the validated `slo` block (TTFT/per-token tails,
+# goodput, attainment under the APEX_SERVE_ARRIVALS trace — thresholds
+# + policy pinned, check 9) and the overlap_bound host-slice stamp;
+# the end-of-round window_report below renders its serving-economics
+# section from the same ledger. Slot budget: one prefill+decode
+# compile set + the K-scan row + the lifecycle-logged trace replay.
 if [ "${APEX_SERVE_BENCH:-}" = "1" ]; then
 run serving          1800 python benchmarks/profile_serving.py
 fi

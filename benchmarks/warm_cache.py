@@ -227,7 +227,12 @@ def main():
     # serving program set (benchmarks/profile_serving.py) — ONLY when
     # its collection rung is armed (APEX_SERVE_BENCH=1 gates the
     # dead-last run_all_tpu.sh row): an unarmed round must not spend
-    # probe minutes AOT-compiling programs no row will dispatch
+    # probe minutes AOT-compiling programs no row will dispatch. The
+    # warm child inherits the operator's APEX_SERVE_* pins (arrivals /
+    # SLO thresholds / policy ride the env), so the warmed prefill +
+    # decode programs are the exact ones the measured replay
+    # dispatches; the SLO replay itself is host work the warm-only
+    # mode skips (it runs nothing, so there is nothing to warm there).
     if os.environ.get("APEX_SERVE_BENCH") == "1":
         if "serving" in cashed:
             print("warm profile_serving: skipped (row cashed in the "

@@ -541,3 +541,73 @@ def test_cost_capture_leaves_jaxpr_byte_identical():
     assert block["source"] is not None
     after = str(jax.make_jaxpr(step)(params, x))
     assert before == after
+
+
+# ------------------------------------------------- overlap_bound (ISSUE 11)
+
+
+def test_overlap_bound_arithmetic_and_degradation():
+    """compute floor vs comm+host: hideable = min, best overlapped
+    step = max; absent inputs degrade field-by-field and an all-absent
+    call returns None (the stamp only exists where it says
+    something)."""
+    assert costs.overlap_bound(1.0) is None
+    ob = costs.overlap_bound(2.0, host_ms=0.5, comm_ms=1.0)
+    assert ob["comm_host_ms"] == pytest.approx(1.5)
+    assert ob["hideable_ms"] == pytest.approx(1.5)   # min(2.0, 1.5)
+    assert ob["bound_step_ms"] == pytest.approx(2.0)  # max
+    ob = costs.overlap_bound(None, host_ms=0.5)
+    assert ob["compute_floor_ms"] is None
+    assert ob["comm_ms"] is None
+    assert ob["comm_host_ms"] == pytest.approx(0.5)
+    assert ob["hideable_ms"] is None and ob["bound_step_ms"] is None
+
+
+def test_build_stamps_overlap_bound_and_validates():
+    peak = costs.V5E_PEAK_BF16_FLOPS
+    block = costs.build(xla_flops=peak * 2e-3, steps=4, platform="tpu",
+                        source="compiled", host_ms=0.7, comm_ms=0.3)
+    ob = block["overlap_bound"]
+    assert ob["compute_floor_ms"] == pytest.approx(2.0)
+    assert ob["comm_host_ms"] == pytest.approx(1.0)
+    assert ob["hideable_ms"] == pytest.approx(1.0)
+    assert ob["bound_step_ms"] == pytest.approx(2.0)
+    assert costs.validate(block) == []
+    # a block WITHOUT the stamp stays clean (optional, like
+    # comm_compression — legacy records keep validating)
+    assert costs.validate(costs.build(steps=1)) == []
+
+
+def test_attach_overlap_onto_existing_block():
+    block = costs.build(xla_flops=costs.V5E_PEAK_BF16_FLOPS * 1e-3,
+                        steps=2, platform="tpu", source="compiled")
+    out = costs.attach_overlap(block, host_ms=2.5)
+    assert out["overlap_bound"]["hideable_ms"] == pytest.approx(1.0)
+    assert out["overlap_bound"]["bound_step_ms"] == pytest.approx(2.5)
+    assert "overlap_bound" not in block  # attach copies, never mutates
+    # null-degraded base (CPU smoke): the measured host side survives
+    out = costs.attach_overlap(costs.null_block(), host_ms=0.2)
+    assert out["overlap_bound"]["host_ms"] == pytest.approx(0.2)
+    assert out["overlap_bound"]["hideable_ms"] is None
+    assert costs.validate(out) == []
+    # nothing measured -> block returned untouched, no stamp
+    assert "overlap_bound" not in costs.attach_overlap(
+        costs.null_block())
+
+
+def test_overlap_bound_validate_teeth():
+    block = costs.build(steps=1, host_ms=1.0)
+    good = costs.validate(block)
+    assert good == []
+    bad = dict(block, overlap_bound="fast")
+    assert any("not a dict" in p for p in costs.validate(bad))
+    bad = dict(block, overlap_bound=dict(block["overlap_bound"],
+                                         host_ms=-1))
+    assert any("host_ms" in p for p in costs.validate(bad))
+    missing = dict(block["overlap_bound"])
+    del missing["comm_host_ms"]
+    bad = dict(block, overlap_bound=missing)
+    assert any("comm_host_ms" in p for p in costs.validate(bad))
+    # ledger.validate_record carries the same teeth via costs.validate
+    rec = ledger.make_record("x", "cpu", 0.1, 2, extra={"cost": bad})
+    assert any("comm_host_ms" in p for p in ledger.validate_record(rec))

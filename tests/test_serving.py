@@ -361,10 +361,13 @@ def test_dryrun_serving_contract():
 
 
 def test_profile_serving_smoke_emits_validated_row(tmp_path):
-    """CPU end-to-end proof (ISSUE 10 acceptance): one subprocess
-    ``profile_serving.py --smoke`` run emits a ledger record whose
-    serving block validates and whose knobs pin both serving dispatch
-    choices (check 8 clean by construction)."""
+    """CPU end-to-end proof (ISSUE 10 + ISSUE 11 acceptance): one
+    subprocess ``profile_serving.py --smoke`` run emits a ledger
+    record whose serving AND slo blocks validate, whose knobs pin the
+    dispatch choices (check 8) and the SLO thresholds / arrival
+    process / scheduler policy (check 9 clean by construction, run
+    against the produced ledger), and whose record renders the
+    window_report serving-economics section."""
     ledger = tmp_path / "ledger.jsonl"
     env = dict(os.environ, APEX_TELEMETRY_LEDGER=str(ledger),
                PALLAS_AXON_POOL_IPS="")
@@ -384,3 +387,44 @@ def test_profile_serving_smoke_emits_validated_row(tmp_path):
     assert rec["knobs"].get("APEX_SERVE_WEIGHT_QUANT") in ("0", "1")
     assert rec["knobs"].get("APEX_DECODE_ATTN_IMPL") in ("jnp",
                                                          "pallas")
+    # ISSUE 11: the slo block, its pins, and the overlap stamp
+    slo = rec["slo"]
+    assert slo["arrival_process"] == rec["knobs"]["APEX_SERVE_ARRIVALS"]
+    assert slo["goodput_tok_s"] is not None \
+        and 0 <= slo["slo_attainment"] <= 1
+    assert slo["max_queue_depth"] is not None \
+        and slo["kv_page_high_water"] is not None
+    assert float(rec["knobs"]["APEX_SERVE_SLO_TTFT_MS"]) \
+        == slo["slo_ttft_ms"]
+    assert float(rec["knobs"]["APEX_SERVE_SLO_TPOT_MS"]) \
+        == slo["slo_tpot_ms"]
+    assert rec["knobs"]["APEX_SERVE_SCHED"] == "fifo"
+    ob = rec["cost"]["overlap_bound"]
+    assert ob["host_ms"] is not None and ob["host_ms"] >= 0
+    # check 9 passes on the produced row (cited from a scratch PERF)
+    from tests.conftest import run_check_bench_labels
+
+    perf = tmp_path / "PERF.md"
+    perf.write_text(f"serving slo row cites ledger:{rec['id']}\n")
+    table = tmp_path / "table.jsonl"
+    table.write_text("")
+    out = run_check_bench_labels(
+        "--perf", str(perf), "--ledger", str(ledger),
+        "--table", str(table))
+    assert out.returncode == 0, out.stdout
+    # window_report renders the serving economics from the same ledger
+    import io
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "window_report", os.path.join(REPO, "tools",
+                                      "window_report.py"))
+    wr = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(wr)
+    report = wr.build_report(ledger_path=str(ledger))
+    buf = io.StringIO()
+    wr.print_report(report, out=buf)
+    text = buf.getvalue()
+    assert "serving economics:" in text
+    assert sv["trace_id"] in text and "attainment=" in text
+    assert "overlap" in text

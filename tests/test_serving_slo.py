@@ -1,0 +1,521 @@
+"""Serving SLO observability (apex_tpu.serving.lifecycle, ISSUE 11):
+lifecycle event-order invariants under admit/evict churn, gauge
+high-waters, seeded Poisson/diurnal trace determinism, disabled-mode
+no-op (behavior-identical serving + one-compile contract), the slo
+ledger block's arithmetic + validation teeth, and check-9 units in
+both directions."""
+
+import json
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from apex_tpu.serving import (
+    ContinuousBatchingScheduler,
+    PageAllocator,
+    Request,
+    ServingEngine,
+    lifecycle,
+    offered_load,
+    resolve_policy,
+    synthetic_trace,
+)
+from apex_tpu.telemetry import ledger as ledger_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cfg():
+    from apex_tpu.transformer.testing import TransformerConfig
+
+    return TransformerConfig(
+        hidden_size=64, num_layers=2, num_attention_heads=4,
+        vocab_size=128, max_position_embeddings=64,
+        hidden_dropout=0.0, attention_dropout=0.0,
+        apply_query_key_layer_scaling=False, bf16=False)
+
+
+TRACE_KW = dict(seed=5, n_requests=6, vocab=128, prompt_lo=2,
+                prompt_hi=8, new_lo=2, new_hi=8, mean_interarrival=0.5)
+
+
+@pytest.fixture(scope="module")
+def churn_run():
+    """ONE lifecycle-enabled engine run over a Poisson trace with more
+    requests than slots (admit/evict churn + queueing) — shared by the
+    event/gauge/latency tests so the module pays one compile set."""
+    import time
+
+    cfg = _cfg()
+    lifecycle.enable()
+    try:
+        eng = ServingEngine(cfg, num_slots=2, page_size=8, num_pages=24,
+                            max_seq=64, prefill_len=32)
+    finally:
+        lifecycle.reset_enabled()
+    reqs, trace_id = synthetic_trace(**TRACE_KW)
+    t0 = time.perf_counter()
+    done = eng.run_trace(reqs)
+    eng.step()  # final evict round -> the last 'evicted' events land
+    wall = time.perf_counter() - t0
+    return eng, done, wall, reqs, trace_id
+
+
+def test_event_order_invariants_under_churn(churn_run):
+    eng, done, _, reqs, _ = churn_run
+    log = eng.events
+    assert log is not None
+    assert log.validate_order() == []
+    # every completed request walked the FULL canonical chain
+    for r in done:
+        got = [e["event"] for e in log.request_events(r.rid)]
+        assert got == list(lifecycle.EVENTS), (r.rid, got)
+    # churn actually happened: with 2 slots and 6 requests somebody
+    # queued, and every request still completed (no starvation)
+    assert len(done) == len(reqs)
+    assert eng.decode_cache_size() == 1
+    eng.allocator.check_invariants()
+
+
+def test_wall_seam_is_seconds_not_ticks(churn_run):
+    """The admit/evict wall seam: every stamp is a host-clock float
+    and the per-request stamps are monotone — replay latencies are
+    seconds, not tick counts."""
+    _, done, wall, _, _ = churn_run
+    for r in done:
+        for f in (r.enqueue_wall, r.admitted_wall, r.first_token_wall,
+                  r.finish_wall):
+            assert isinstance(f, float), (r.rid, f)
+        assert r.enqueue_wall <= r.admitted_wall \
+            <= r.first_token_wall <= r.finish_wall
+        # a replayed request's life is bounded by the run wall — a
+        # tick count (integers 0..n) would not be
+        assert r.finish_wall - r.enqueue_wall <= wall + 1e-6
+
+
+def test_gauges_and_summary(churn_run):
+    eng, _, _, _, _ = churn_run
+    log = eng.events
+    assert log.gauges, "no gauge samples collected"
+    s = log.summary()
+    assert s["samples"] == len(log.gauges)
+    # 6 requests over 2 slots: the queue was non-empty at some round
+    assert s["max_queue_depth"] >= 1
+    assert s["max_hol_wait_ms"] > 0
+    assert 0 < s["kv_page_high_water"] <= eng.allocator.num_pages - 1
+    assert 0 < s["max_slots_active"] <= eng.num_slots
+    # per-sample invariants: live pages never exceed capacity, slots
+    # never exceed the engine's
+    for g in log.gauges:
+        assert 0 <= g["serve_kv_pages_live"] < g["serve_kv_pages_total"]
+        assert 0 <= g["serve_slots_active"] <= g["serve_num_slots"]
+
+
+def test_gauge_rows_sink_through_strict_writer(churn_run, tmp_path):
+    """The gauge names are REGISTERED metric specs: a strict
+    MetricsWriter (which refuses unregistered names) sinks
+    gauge_rows() as-is."""
+    from apex_tpu.telemetry import metrics
+
+    eng, _, _, _, _ = churn_run
+    w = metrics.MetricsWriter(path=str(tmp_path / "gauges.jsonl"),
+                              strict=True)
+    rows = eng.events.gauge_rows(run="lg-test")
+    for row in rows:
+        w.append(row)
+    back = metrics.read_metrics(str(tmp_path / "gauges.jsonl"))
+    assert len(back) == len(rows) and back[0]["run"] == "lg-test"
+
+
+def test_disabled_mode_is_behavior_identical(churn_run):
+    """With lifecycle collection OFF: no log exists, the decode
+    program still compiles exactly once, and the generated tokens are
+    IDENTICAL to the enabled run's — observability never perturbs
+    serving."""
+    eng, done, _, _, _ = churn_run
+    lifecycle.disable()
+    try:
+        eng2 = ServingEngine(_cfg(), params=eng.params, num_slots=2,
+                             page_size=8, num_pages=24, max_seq=64,
+                             prefill_len=32)
+        assert eng2.events is None
+        reqs2, _ = synthetic_trace(**TRACE_KW)
+        done2 = eng2.run_trace(reqs2)
+    finally:
+        lifecycle.reset_enabled()
+    assert eng2.decode_cache_size() == 1
+    by_rid = {r.rid: r.out_tokens for r in done}
+    assert {r.rid: r.out_tokens for r in done2} == by_rid
+
+
+def test_enabled_gate_env_and_override(monkeypatch):
+    monkeypatch.delenv("APEX_SERVE_EVENTS", raising=False)
+    lifecycle.reset_enabled()
+    assert not lifecycle.enabled()
+    monkeypatch.setenv("APEX_SERVE_EVENTS", "1")
+    assert lifecycle.enabled()
+    lifecycle.disable()
+    try:
+        assert not lifecycle.enabled()  # override beats env
+    finally:
+        lifecycle.reset_enabled()
+    assert lifecycle.enabled()
+
+
+def test_event_log_vocabulary_and_order_detection():
+    log = lifecycle.EventLog()
+    with pytest.raises(ValueError, match="unknown lifecycle event"):
+        log.record("teleported", 0)
+    # out-of-order, duplicate, wrong first event, backwards wall —
+    # each a named finding
+    log.record("admitted", 1, tick=0, wall=1.0)
+    log.record("submitted", 1, tick=0, wall=0.5)
+    log.record("submitted", 1, tick=0, wall=0.4)
+    probs = log.validate_order(1)
+    assert any("not 'submitted'" in p for p in probs)
+    assert any("out of order" in p for p in probs)
+    assert any("duplicate" in p for p in probs)
+    assert any("backwards" in p for p in probs)
+    assert log.validate_order(99) == ["rid 99: no events"]
+
+
+# ------------------------------------------------------- load harness
+
+
+def test_poisson_trace_seeded_determinism():
+    r1, t1 = synthetic_trace(**TRACE_KW)
+    r2, t2 = synthetic_trace(**TRACE_KW)
+    assert t1 == t2
+    assert [(r.arrival, r.prompt, r.max_new_tokens) for r in r1] \
+        == [(r.arrival, r.prompt, r.max_new_tokens) for r in r2]
+    _, t3 = synthetic_trace(**dict(TRACE_KW, seed=6))
+    assert t3 != t1
+
+
+def test_diurnal_trace_deterministic_and_distinct():
+    kw = dict(TRACE_KW, arrival="diurnal")
+    r1, t1 = synthetic_trace(**kw)
+    r2, t2 = synthetic_trace(**kw)
+    assert t1 == t2
+    _, tp = synthetic_trace(**TRACE_KW)
+    assert t1 != tp, "diurnal drew the poisson stream"
+    arr = [r.arrival for r in r1]
+    assert arr == sorted(arr) and all(a >= 0 for a in arr)
+    assert offered_load(r1) > 0
+
+
+def test_diurnal_rate_actually_modulates():
+    """Peak-phase arrivals are denser than trough-phase ones: folding
+    arrivals onto the period, the up-swing half (sin > 0, boosted
+    rate) must hold decisively more requests than the down-swing half
+    — the analytic ratio at depth 0.9 is ~3.7x."""
+    period = 100.0
+    reqs, _ = synthetic_trace(seed=0, n_requests=300, prompt_lo=2,
+                              prompt_hi=4, new_lo=2, new_hi=4,
+                              mean_interarrival=1.0, arrival="diurnal",
+                              diurnal_period=period, diurnal_depth=0.9)
+    phase = np.asarray([r.arrival for r in reqs]) % period
+    up = int(np.sum(phase < period / 2))
+    down = len(reqs) - up
+    assert up > 2 * max(down, 1), (up, down)
+
+
+def test_arrival_and_policy_asymmetry(monkeypatch):
+    """Per-call unknown arrival/policy RAISES; env preferences warn
+    once and fall back (the CLAUDE.md knob asymmetry)."""
+    with pytest.raises(ValueError, match="unknown arrival"):
+        synthetic_trace(arrival="bursty")
+    with pytest.raises(ValueError, match="unknown scheduler policy"):
+        resolve_policy("priority")
+    with pytest.raises(ValueError, match="unknown scheduler policy"):
+        ContinuousBatchingScheduler(2, 4, 8, PageAllocator(16),
+                                    policy="priority")
+    from apex_tpu.dispatch import tiles
+
+    tiles._warned_env.clear()
+    monkeypatch.setenv("APEX_SERVE_SCHED", "priority")
+    with pytest.warns(UserWarning, match="priority"):
+        assert resolve_policy() == "fifo"
+    monkeypatch.setenv("APEX_SERVE_SCHED", "fifo")
+    assert resolve_policy() == "fifo"
+    assert ContinuousBatchingScheduler(
+        2, 4, 8, PageAllocator(16)).policy == "fifo"
+
+
+def test_env_ms_preference_semantics(monkeypatch):
+    """env_ms delegates to tiles.env_float — the ONE warn-once
+    preference home (shared _warned_env with env_choice)."""
+    from apex_tpu.dispatch import tiles
+
+    monkeypatch.delenv("APEX_SERVE_SLO_TTFT_MS", raising=False)
+    assert lifecycle.env_ms("APEX_SERVE_SLO_TTFT_MS", 1000.0) == 1000.0
+    monkeypatch.setenv("APEX_SERVE_SLO_TTFT_MS", "250.5")
+    assert lifecycle.env_ms("APEX_SERVE_SLO_TTFT_MS", 1000.0) == 250.5
+    tiles._warned_env.clear()
+    monkeypatch.setenv("APEX_SERVE_SLO_TTFT_MS", "fast")
+    with pytest.warns(UserWarning, match="fast"):
+        assert lifecycle.env_ms("APEX_SERVE_SLO_TTFT_MS",
+                                1000.0) == 1000.0
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # warn ONCE per (knob, value)
+        assert lifecycle.env_ms("APEX_SERVE_SLO_TTFT_MS",
+                                1000.0) == 1000.0
+    monkeypatch.setenv("APEX_SERVE_SLO_TTFT_MS", "-3")
+    tiles._warned_env.clear()
+    with pytest.warns(UserWarning):
+        assert lifecycle.env_ms("APEX_SERVE_SLO_TTFT_MS",
+                                1000.0) == 1000.0
+
+
+# ------------------------------------------------------- the slo block
+
+
+def _req(rid, submit, first, finish, n_out):
+    r = Request(rid=rid, prompt=[1, 2], max_new_tokens=n_out,
+                out_tokens=[0] * n_out)
+    r.enqueue_wall, r.first_token_wall, r.finish_wall = \
+        submit, first, finish
+    return r
+
+
+def test_slo_block_arithmetic_exact():
+    """Hand-built walls -> exact percentiles, attainment and goodput:
+    req0 attains both, req1 misses TTFT, req2 misses TPOT, req3 is a
+    1-token request judged on TTFT alone."""
+    reqs = [
+        _req(0, 0.0, 0.050, 0.950, 10),   # ttft 50ms, tpot 100ms
+        _req(1, 0.0, 0.400, 0.490, 10),   # ttft 400ms (miss), tpot 10ms
+        _req(2, 0.0, 0.010, 2.010, 11),   # ttft 10ms, tpot 200ms (miss)
+        _req(3, 0.0, 0.020, 0.020, 1),    # ttft 20ms, no tpot
+    ]
+    blk = lifecycle.slo_block(reqs, wall_s=2.0, ttft_ms=100.0,
+                              tpot_ms=150.0, arrival_process="poisson",
+                              offered_load=2.0)
+    assert blk["requests"] == 4
+    assert blk["ttft_p50_ms"] == 50.0 and blk["ttft_p99_ms"] == 400.0
+    assert blk["per_token_p50_ms"] == 100.0
+    assert blk["per_token_p99_ms"] == 200.0
+    # attaining: req0 (50ms/100ms ok) + req3 (ttft only) = 2/4
+    assert blk["slo_attainment"] == 0.5
+    # goodput counts THEIR tokens only: (10 + 1) / 2.0 s
+    assert blk["goodput_tok_s"] == 5.5
+    assert blk["arrival_process"] == "poisson"
+    assert blk["offered_load"] == 2.0
+    # no log attached: occupancy fields degrade to None, never vanish
+    assert blk["max_queue_depth"] is None
+    assert blk["kv_page_high_water"] is None
+    # all schema fields present (degradation, not omission)
+    for f in ledger_mod.SLO_FIELDS:
+        assert f in blk, f
+
+
+def test_slo_block_from_churn_run(churn_run):
+    eng, done, wall, reqs, _ = churn_run
+    blk = lifecycle.slo_block(done, wall, ttft_ms=10000.0,
+                              tpot_ms=10000.0,
+                              arrival_process="poisson",
+                              offered_load=offered_load(reqs),
+                              log=eng.events)
+    rec = ledger_mod.make_record("profile_serving", "cpu", 0.1, 2,
+                                 extra={"slo": blk})
+    assert ledger_mod.validate_record(rec) == []
+    assert blk["slo_attainment"] == 1.0  # thresholds are generous
+    assert blk["goodput_tok_s"] > 0
+    assert blk["max_queue_depth"] >= 1
+    assert blk["kv_page_high_water"] > 0
+
+
+def _good_slo():
+    return {"ttft_p50_ms": 5.0, "ttft_p99_ms": 9.0,
+            "per_token_p50_ms": 1.0, "per_token_p99_ms": 2.0,
+            "goodput_tok_s": 100.0, "slo_attainment": 0.9,
+            "slo_ttft_ms": 1000.0, "slo_tpot_ms": 100.0,
+            "arrival_process": "poisson", "offered_load": 2.0,
+            "max_queue_depth": 3, "kv_page_high_water": 10}
+
+
+def test_slo_block_validation_teeth():
+    rec = ledger_mod.make_record("profile_serving", "cpu", 0.1, 2,
+                                 extra={"slo": _good_slo()})
+    assert ledger_mod.validate_record(rec) == []
+    cases = [
+        ({"ttft_p50_ms": -1}, "ttft_p50_ms"),
+        ({"goodput_tok_s": True}, "goodput_tok_s"),
+        ({"slo_attainment": 1.5}, "slo_attainment"),
+        ({"ttft_p50_ms": 10.0}, "exceeds"),            # p50 > p99
+        ({"per_token_p50_ms": 3.0}, "exceeds"),
+        ({"arrival_process": ""}, "arrival_process"),
+        ({"max_queue_depth": 2.5}, "max_queue_depth"),
+        ({"kv_page_high_water": -1}, "kv_page_high_water"),
+    ]
+    for mut, needle in cases:
+        r = ledger_mod.make_record(
+            "profile_serving", "cpu", 0.1, 2,
+            extra={"slo": dict(_good_slo(), **mut)})
+        probs = ledger_mod.validate_record(r)
+        assert any(needle in p for p in probs), (mut, probs)
+    # missing field = finding (degradation must be explicit None)
+    bad = _good_slo()
+    del bad["offered_load"]
+    r = ledger_mod.make_record("profile_serving", "cpu", 0.1, 2,
+                               extra={"slo": bad})
+    assert any("offered_load" in p for p in ledger_mod.validate_record(r))
+    # None values are legal degradation
+    r = ledger_mod.make_record(
+        "profile_serving", "cpu", 0.1, 2,
+        extra={"slo": dict(_good_slo(), per_token_p50_ms=None,
+                           per_token_p99_ms=None, max_queue_depth=None)})
+    assert ledger_mod.validate_record(r) == []
+
+
+# ------------------------------------------------------------- check 9
+
+SLO_PINS = {"APEX_SERVE_SLO_TTFT_MS": "1000", "APEX_SERVE_SLO_TPOT_MS":
+            "100", "APEX_SERVE_ARRIVALS": "poisson",
+            "APEX_SERVE_SCHED": "fifo"}
+
+
+def _check9_env(tmp_path, knobs, slo=None):
+    rec = ledger_mod.make_record(
+        "profile_serving", "cpu", 0.1, 2, knobs=knobs,
+        extra={"slo": slo or _good_slo()})
+    ledger = tmp_path / "ledger.jsonl"
+    ledger.write_text(json.dumps(rec) + "\n")
+    perf = tmp_path / "PERF.md"
+    perf.write_text(f"slo row cites ledger:{rec['id']}\n")
+    table = tmp_path / "table.jsonl"
+    table.write_text("")
+    return ["--perf", str(perf), "--ledger", str(ledger),
+            "--table", str(table)]
+
+
+def test_check9_unpinned_slo_row_fails(tmp_path):
+    from tests.conftest import run_check_bench_labels
+
+    out = run_check_bench_labels(*_check9_env(tmp_path, {}))
+    assert out.returncode == 1
+    for knob in SLO_PINS:
+        assert knob in out.stdout, knob
+
+
+def test_check9_pinned_slo_row_clean(tmp_path):
+    from tests.conftest import run_check_bench_labels
+
+    out = run_check_bench_labels(*_check9_env(tmp_path, dict(SLO_PINS)))
+    assert out.returncode == 0, out.stdout
+
+
+def test_check9_arrival_disagreement_fails(tmp_path):
+    from tests.conftest import run_check_bench_labels
+
+    out = run_check_bench_labels(*_check9_env(
+        tmp_path, dict(SLO_PINS, APEX_SERVE_ARRIVALS="diurnal")))
+    assert out.returncode == 1
+    assert "different workloads" in out.stdout
+
+
+def test_check9_threshold_disagreement_fails(tmp_path):
+    from tests.conftest import run_check_bench_labels
+
+    out = run_check_bench_labels(*_check9_env(
+        tmp_path, dict(SLO_PINS, APEX_SERVE_SLO_TTFT_MS="500")))
+    assert out.returncode == 1
+    assert "threshold the label does not name" in out.stdout
+
+
+# -------------------------------------------------------- ledger CLI
+
+
+def test_check9_full_precision_threshold_pin_round_trips(tmp_path):
+    """A threshold that needs more than 6 significant digits must
+    still pin check-9-clean: the harness writes the pin with repr()
+    (exact float round trip), where '%g' would truncate 1000.125 to
+    '1000.12' and manufacture a drift finding against its own
+    record."""
+    from tests.conftest import run_check_bench_labels
+
+    v = 1000.125
+    slo = dict(_good_slo(), slo_ttft_ms=v)
+    out = run_check_bench_labels(*_check9_env(
+        tmp_path, dict(SLO_PINS, APEX_SERVE_SLO_TTFT_MS=repr(v)),
+        slo=slo))
+    assert out.returncode == 0, out.stdout
+    out = run_check_bench_labels(*_check9_env(
+        tmp_path, dict(SLO_PINS, APEX_SERVE_SLO_TTFT_MS=f"{v:g}"),
+        slo=slo))
+    assert out.returncode == 1  # the truncated pin IS drift
+
+
+def test_ledger_cli_survives_malformed_serving_block(tmp_path, capsys):
+    """slo dict + serving NON-dict (both schema findings): status must
+    report the findings, not crash on the malformed serving block."""
+    rec = ledger_mod.make_record(
+        "profile_serving", "cpu", 0.1, 2,
+        extra={"slo": _good_slo(), "serving": ["oops"]})
+    path = tmp_path / "ledger.jsonl"
+    path.write_text(json.dumps(rec) + "\n")
+    rc = ledger_mod.main(["--ledger", str(path), "status"])
+    out = capsys.readouterr().out
+    assert rc == 1 and "schema findings: 1" in out
+    assert "[?]" in out  # the slo summary line still prints
+
+
+def test_percentile_nearest_rank_all_q():
+    vals = list(range(1, 11))  # 1..10
+    assert lifecycle.percentile([], 50) is None
+    assert lifecycle.percentile(vals, 50) == 6      # vals[10 // 2]
+    assert lifecycle.percentile(vals, 99) == 10
+    assert lifecycle.percentile(vals, 10) == 2      # NOT the median
+    assert lifecycle.percentile([7.0], 50) == 7.0
+    assert lifecycle.percentile([7.0], 99) == 7.0
+
+
+def test_check9_malformed_pin_value_is_finding_not_crash(tmp_path):
+    """A corrupt knob value (JSON list) in a cited slo row is a DRIFT
+    finding, never a checker crash — the tool whose job is reporting
+    label problems must survive exactly this input."""
+    from tests.conftest import run_check_bench_labels
+
+    out = run_check_bench_labels(*_check9_env(
+        tmp_path, dict(SLO_PINS, APEX_SERVE_SLO_TTFT_MS=[1000])))
+    assert out.returncode == 1
+    assert "is not a number" in out.stdout
+    assert "checker error" not in out.stdout
+
+
+def test_ledger_cli_survives_malformed_attainment(tmp_path, capsys):
+    """A record whose slo_attainment is malformed (a validator
+    finding) must still be summarizable by status/tail — the surface
+    that reports the finding cannot crash on it."""
+    rec = ledger_mod.make_record(
+        "profile_serving", "cpu", 0.1, 2,
+        extra={"slo": dict(_good_slo(), slo_attainment="0.9")})
+    path = tmp_path / "ledger.jsonl"
+    path.write_text(json.dumps(rec) + "\n")
+    rc = ledger_mod.main(["--ledger", str(path), "status"])
+    out = capsys.readouterr().out
+    assert rc == 1  # the schema finding IS reported
+    assert "schema findings: 1" in out and "attainment=?" in out
+    assert ledger_mod.main(["--ledger", str(path), "tail", "1"]) == 0
+    assert "slo" in capsys.readouterr().out
+
+
+def test_ledger_cli_status_summarizes_slo_rows(tmp_path, capsys):
+    rec = ledger_mod.make_record(
+        "profile_serving", "cpu", 0.1, 2, knobs=dict(SLO_PINS),
+        extra={"slo": _good_slo(),
+               "serving": {"tokens_per_s": 50.0, "p50_ms": 1.0,
+                           "p99_ms": 2.0, "trace_id": "tr-0123456789",
+                           "kv_pages": 24}})
+    path = tmp_path / "ledger.jsonl"
+    path.write_text(json.dumps(rec) + "\n")
+    rc = ledger_mod.main(["--ledger", str(path), "status"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "serving: 1 row(s), 1 with slo block" in out
+    assert "attainment=90%" in out and "tr-0123456789" in out
+    rc = ledger_mod.main(["--ledger", str(path), "tail", "1"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "slo=90%" in out

@@ -193,3 +193,60 @@ def test_empty_round_is_a_report_not_an_error(tmp_path):
         rc = wr.main(["--ledger", str(tmp_path / "none.jsonl")])
     assert rc == 0
     assert "nothing to report" in buf.getvalue()
+
+
+# ------------------------------- serving economics + overlap (ISSUE 11)
+
+
+def test_serving_economics_and_overlap_sections(tmp_path):
+    """A ledger carrying serving/slo blocks and an overlap_bound stamp
+    renders the serving-economics section: trace + arrival process,
+    goodput vs the decode-scan line, attainment, occupancy
+    high-waters, and the overlap column."""
+    slo = {"ttft_p50_ms": 5.0, "ttft_p99_ms": 9.0,
+           "per_token_p50_ms": 1.0, "per_token_p99_ms": 2.0,
+           "goodput_tok_s": 90.0, "slo_attainment": 0.75,
+           "slo_ttft_ms": 1000.0, "slo_tpot_ms": 100.0,
+           "arrival_process": "diurnal", "offered_load": 2.0,
+           "max_queue_depth": 3, "kv_page_high_water": 10}
+    cost = costs.attach_overlap(costs.null_block(), host_ms=0.25)
+    rec = ledger.make_record(
+        "profile_serving", "cpu", 0.1, 2,
+        extra={"serving": {"tokens_per_s": 100.0,
+                           "scan_tokens_per_s": 900.0, "p50_ms": 1.0,
+                           "p99_ms": 2.0, "trace_id": "tr-abcdef1234",
+                           "kv_pages": 24},
+               "slo": slo, "cost": cost})
+    path = tmp_path / "ledger.jsonl"
+    path.write_text(json.dumps(rec) + "\n")
+    report = wr.build_report(ledger_path=str(path))
+    led = report["ledger"]
+    assert len(led["serving"]) == 1
+    row = led["serving"][0]
+    assert row["trace_id"] == "tr-abcdef1234"
+    assert row["slo"]["slo_attainment"] == 0.75
+    assert len(led["overlap"]) == 1
+    assert led["overlap"][0]["host_ms"] == 0.25
+
+    buf = io.StringIO()
+    wr.print_report(report, out=buf)
+    text = buf.getvalue()
+    assert "serving economics:" in text
+    assert "tr-abcdef1234" in text
+    assert "arrival=diurnal" in text and "attainment=75%" in text
+    # goodput 90 vs scan 900 -> 90% under the scan line
+    assert "90% under the scan line" in text
+    assert "max queue 3, kv high-water 10/24 pages" in text
+    assert "overlap" in text and "comm+host 0.25 ms" in text
+
+
+def test_serving_section_absent_without_serving_rows(tmp_path):
+    rec = ledger.make_record("bench", "cpu", 0.1, 2)
+    path = tmp_path / "ledger.jsonl"
+    path.write_text(json.dumps(rec) + "\n")
+    report = wr.build_report(ledger_path=str(path))
+    assert report["ledger"]["serving"] == []
+    assert report["ledger"]["overlap"] == []
+    buf = io.StringIO()
+    wr.print_report(report, out=buf)
+    assert "serving economics" not in buf.getvalue()

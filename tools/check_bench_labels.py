@@ -87,6 +87,20 @@ Checks:
    same teeth as checks 6-7. The harness stamps the RESOLVED values
    into its environment before the ledger write, so an unpinned run
    cannot produce a citable serving row.
+9. **SLO pin-match** — a cited record carrying an ``slo`` block
+   (``apex_tpu.serving.lifecycle.slo_block``: TTFT/per-token
+   percentiles, goodput, SLO attainment under a named arrival
+   process) must PIN the knobs that shaped the claim in its recorded
+   ``knobs``: the SLO thresholds (``APEX_SERVE_SLO_TTFT_MS`` /
+   ``APEX_SERVE_SLO_TPOT_MS`` — attainment and goodput are FUNCTIONS
+   of the thresholds), the arrival process (``APEX_SERVE_ARRIVALS``
+   — offered load means nothing without it), and the scheduler
+   policy (``APEX_SERVE_SCHED`` — the dispatch choice every
+   tail-latency number depends on). And the block's own
+   ``arrival_process`` / ``slo_ttft_ms`` / ``slo_tpot_ms`` fields
+   must AGREE with the pinned values — a block claiming a diurnal
+   trace under a poisson pin (or a 1000 ms attainment under a
+   500 ms pin) is the same label-drift class as a wrong caption.
 
 New PERF.md table rows must cite their ledger record id in the caption
 (``ledger:<id>``) — uncited legacy paragraphs are not flagged, but they
@@ -233,6 +247,55 @@ def serving_problems(rec, rid):
     return problems
 
 
+def slo_pin_problems(rec, rid):
+    """Check-9 pin-match for one cited record; [] when clean or when
+    the record carries no slo block. Presence teeth first (an
+    unpinned slo row cannot be audited at all), then agreement teeth
+    (the pinned value must be what the block claims — the knob and
+    the block ride the same content-hashed record, so neither can be
+    edited to fit the other without breaking the id)."""
+    slo = rec.get("slo")
+    if not isinstance(slo, dict):
+        return []
+    knobs = rec.get("knobs") if isinstance(rec.get("knobs"), dict) else {}
+    problems = []
+    for knob in ("APEX_SERVE_SLO_TTFT_MS", "APEX_SERVE_SLO_TPOT_MS",
+                 "APEX_SERVE_ARRIVALS", "APEX_SERVE_SCHED"):
+        if knob not in knobs:
+            problems.append(
+                f"record {rid} carries an slo block but does not pin "
+                f"{knob} in its knobs — an unpinned slo row cannot be "
+                f"cited")
+    arr = knobs.get("APEX_SERVE_ARRIVALS")
+    ap = slo.get("arrival_process")
+    if arr is not None and ap is not None and ap != arr:
+        problems.append(
+            f"record {rid} slo.arrival_process={ap!r} disagrees with "
+            f"its pinned APEX_SERVE_ARRIVALS={arr!r} — the block and "
+            f"the label name different workloads")
+    for knob, field in (("APEX_SERVE_SLO_TTFT_MS", "slo_ttft_ms"),
+                        ("APEX_SERVE_SLO_TPOT_MS", "slo_tpot_ms")):
+        pin, val = knobs.get(knob), slo.get(field)
+        if pin is None or not isinstance(val, (int, float)) \
+                or isinstance(val, bool):
+            continue
+        try:
+            pinned = float(pin)
+        except (TypeError, ValueError):
+            # a corrupt knob value (list, dict, unparseable string) is
+            # a FINDING, never a checker crash
+            problems.append(
+                f"record {rid} pins {knob}={pin!r}, which is not a "
+                f"number")
+            continue
+        if abs(pinned - val) > 1e-6:
+            problems.append(
+                f"record {rid} slo.{field}={val:g} disagrees with its "
+                f"pinned {knob}={pinned:g} — the attainment was judged "
+                f"against a threshold the label does not name")
+    return problems
+
+
 def _paragraphs(text):
     """(start_lineno, paragraph_text) blocks of consecutive non-blank
     lines — the unit a caption and its numbers share."""
@@ -304,6 +367,9 @@ def check_captions(perf_text, perf_path, records):
                 problems.append(f"{perf_path}:{lineno}: {p}")
             # check 8: serving-block pin-match
             for p in serving_problems(rec, rid):
+                problems.append(f"{perf_path}:{lineno}: {p}")
+            # check 9: slo-block pin-match + threshold/arrival agreement
+            for p in slo_pin_problems(rec, rid):
                 problems.append(f"{perf_path}:{lineno}: {p}")
             if rec.get("resumed_from") is not None \
                     and COLD_RE.search(para):
@@ -397,6 +463,9 @@ def check_dispatch_table(path, records):
                 # check 8 on the table side: a decode_attention entry
                 # decided by a serving row must cite a knob-pinned one
                 for p in serving_problems(rec, rid):
+                    problems.append(f"{tag}: {p}")
+                # check 9 on the table side: same slo teeth
+                for p in slo_pin_problems(rec, rid):
                     problems.append(f"{tag}: {p}")
     return problems, len(entries)
 

@@ -8,7 +8,11 @@ aggregates the round's durable artifacts into one account:
 
 * the **run ledger** (``benchmarks/ledger.jsonl``) — per-record verdicts,
   compile-cache hit/miss totals (the warm-start proof-of-work), cost-block
-  coverage and the measured-MFU vs MFU-bound attribution gap;
+  coverage, the measured-MFU vs MFU-bound attribution gap, the
+  ``overlap_bound`` column (compute floor vs comm+host — ROADMAP 4d),
+  and the SERVING ECONOMICS section (ISSUE 11): per-trace SLO
+  attainment, goodput vs the decode-scan throughput line, and
+  queue/KV-page occupancy from the ``serving``/``slo`` blocks;
 * a **raw log directory** (e.g. ``benchmarks/device_logs_r05``) — every
   harness log's dated backend-init banner(s) anchor the timeline: starts,
   attempt counts, per-log verdicts (via the shared resilience classifier)
@@ -116,6 +120,8 @@ def ledger_summary(records):
     injected = 0
     attribution = []
     comm_rows = []
+    serving_rows = []
+    overlap_rows = []
     for rec in records:
         by_harness[rec.get("harness", "?")] = \
             by_harness.get(rec.get("harness", "?"), 0) + 1
@@ -159,6 +165,29 @@ def ledger_summary(records):
                     "uncompressed_bytes_per_axis":
                         stamp.get("uncompressed_bytes_per_axis"),
                 })
+            # the overlap column (ROADMAP 4d, costs.overlap_bound):
+            # compute floor vs measured comm+host — the gap every
+            # future overlap/scheduler PR is chasing, named per record
+            ob = cost.get("overlap_bound")
+            if isinstance(ob, dict):
+                overlap_rows.append(dict(
+                    ob, id=rec.get("id"), harness=rec.get("harness")))
+        # serving economics (ISSUE 11): per-trace SLO attainment,
+        # goodput vs decode-throughput gap, occupancy high-waters —
+        # one row per record carrying a serving and/or slo block
+        sv = rec.get("serving")
+        slo = rec.get("slo")
+        if isinstance(sv, dict) or isinstance(slo, dict):
+            sv = sv if isinstance(sv, dict) else {}
+            slo = slo if isinstance(slo, dict) else None
+            serving_rows.append({
+                "id": rec.get("id"), "harness": rec.get("harness"),
+                "trace_id": sv.get("trace_id"),
+                "tokens_per_s": sv.get("tokens_per_s"),
+                "scan_tokens_per_s": sv.get("scan_tokens_per_s"),
+                "kv_pages": sv.get("kv_pages"),
+                "slo": slo,
+            })
     ts = [r["ts"] for r in records
           if isinstance(r.get("ts"), (int, float))]
     return {
@@ -173,6 +202,8 @@ def ledger_summary(records):
         "injected": injected,
         "attribution": attribution,
         "comm": comm_rows,
+        "overlap": overlap_rows,
+        "serving": serving_rows,
     }
 
 
@@ -287,6 +318,54 @@ def print_report(report, out=None):
                          + (f" uncompressed: {unc_s}" if unc_s else "")
                          + "]")
             p(line)
+        for o in led.get("overlap", []):
+            def _ms(v):
+                return "?" if v is None else f"{v:g} ms"
+            line = (f"  overlap {o['id']} ({o['harness']}): compute "
+                    f"floor {_ms(o.get('compute_floor_ms'))} vs "
+                    f"comm+host {_ms(o.get('comm_host_ms'))}")
+            if o.get("hideable_ms") is not None:
+                line += (f" -> hideable {_ms(o['hideable_ms'])}, best "
+                         f"overlapped step {_ms(o.get('bound_step_ms'))}")
+            p(line)
+        if led.get("serving"):
+            p("  serving economics:")
+            for s in led["serving"]:
+                tps = s.get("tokens_per_s")
+                scan = s.get("scan_tokens_per_s")
+                line = (f"    {s['id']} ({s['harness']}) "
+                        f"[{s.get('trace_id') or '?'}]: "
+                        f"{'?' if tps is None else format(tps, 'g')} "
+                        f"tok/s replay")
+                if scan:
+                    line += f" vs {scan:g} tok/s decode-scan upper line"
+                p(line)
+                slo = s.get("slo")
+                if slo:
+                    att = slo.get("slo_attainment")
+                    good = slo.get("goodput_tok_s")
+                    gap = None
+                    if good is not None and scan:
+                        gap = 1.0 - good / scan
+                    p(f"      slo: arrival={slo.get('arrival_process')} "
+                      f"offered={slo.get('offered_load')} req/tick, "
+                      f"attainment="
+                      f"{'?' if att is None else format(att, '.0%')} "
+                      f"(ttft<={slo.get('slo_ttft_ms')}ms "
+                      f"tpot<={slo.get('slo_tpot_ms')}ms), goodput "
+                      f"{'?' if good is None else format(good, 'g')} "
+                      f"tok/s"
+                      + ("" if gap is None else
+                         f" ({gap:.0%} under the scan line)"))
+                    p(f"      tails: ttft p50/p99 "
+                      f"{slo.get('ttft_p50_ms')}/"
+                      f"{slo.get('ttft_p99_ms')} ms, per-token p50/p99 "
+                      f"{slo.get('per_token_p50_ms')}/"
+                      f"{slo.get('per_token_p99_ms')} ms; max queue "
+                      f"{slo.get('max_queue_depth')}, kv high-water "
+                      f"{slo.get('kv_page_high_water')}"
+                      + (f"/{s['kv_pages']} pages"
+                         if s.get("kv_pages") else ""))
     logs = report.get("logs")
     if logs:
         p(f"logs: {logs['dir']}")
