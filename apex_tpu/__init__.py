@@ -36,6 +36,9 @@ class RankInfoFormatter(_logging.Formatter):
 
 
 _logger = _logging.getLogger(__name__)
+# apexlint: disable=APX001,APX002 — logging handlers must be installed
+# before any import-time log line; a one-time package-init read, not a
+# trace-time knob (the only sanctioned import-time env read)
 if not _logger.handlers and _os.environ.get("APEX_TPU_VERBOSE_LOGGING", "0") == "1":
     _handler = _logging.StreamHandler()
     _handler.setFormatter(

@@ -42,7 +42,8 @@ SUITES = {
     "serving": ["test_serving.py", "test_serving_slo.py"],
     "api_parity": ["test_api_parity_round3.py"],
     "harness": ["test_run_tests.py", "test_bench_contract.py",
-                "test_compile_cache.py", "test_resilience.py"],
+                "test_compile_cache.py", "test_resilience.py",
+                "test_apexlint.py"],
     "telemetry": ["test_telemetry.py", "test_bench_labels.py",
                   "test_dispatch.py", "test_dispatch_tiles.py",
                   "test_costs.py", "test_window_report.py"],

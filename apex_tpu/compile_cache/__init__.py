@@ -83,7 +83,9 @@ def warm_only():
     """True when this invocation should only COMPILE the measured
     programs (populating the cache), never run/time them
     (``APEX_WARM_ONLY=1`` — set by ``benchmarks/warm_cache.py``)."""
-    return os.environ.get("APEX_WARM_ONLY") == "1"
+    from apex_tpu.dispatch.tiles import env_flag
+
+    return env_flag("APEX_WARM_ONLY")
 
 
 def _listen():

@@ -26,14 +26,20 @@ import numpy as np
 from flax import linen as nn
 from jax import lax
 
-# Dropout-training kernel preference: "fused" (in-kernel hash dropout —
-# the default, on the memory-capability argument documented at the call
-# site) or "dense" (materialized probs + jax.random dropout — the
-# escape hatch while the device speed A/B is queued).
-DROPOUT_IMPL = os.environ.get("APEX_FMHA_DROPOUT", "fused")
-if DROPOUT_IMPL not in ("fused", "dense"):
-    raise ValueError(f"APEX_FMHA_DROPOUT={DROPOUT_IMPL!r} "
-                     "(expected 'fused' or 'dense')")
+def dropout_impl():
+    """Dropout-training kernel preference, read at TRACE time (the
+    APX001 rule — the import-time read this replaced froze the knob
+    before a test or autotune subprocess could vary it): "fused"
+    (in-kernel hash dropout — the default, on the memory-capability
+    argument documented at the call site) or "dense" (materialized
+    probs + jax.random dropout — the escape hatch while the device
+    speed A/B is queued). An invalid value still raises, at first
+    use: the escape hatch is an explicit request, not a preference."""
+    impl = os.environ.get("APEX_FMHA_DROPOUT", "fused")
+    if impl not in ("fused", "dense"):
+        raise ValueError(f"APEX_FMHA_DROPOUT={impl!r} "
+                         "(expected 'fused' or 'dense')")
+    return impl
 
 
 def _segment_ids_from_cu_seqlens(cu_seqlens, total):
@@ -77,7 +83,7 @@ def fmha_varlen(qkv, cu_seqlens, p_dropout=0.0, max_s=512,
 
     if rng is None:
         raise ValueError("dropout requires an rng key")
-    if (DROPOUT_IMPL == "fused"
+    if (dropout_impl() == "fused"
             and attention_pallas.supported(total, total, d, dropout=True)):
         # fused dropout-training path: probability dropout happens INSIDE
         # the VMEM-row kernel (counter-hash mask, replayed in backward),

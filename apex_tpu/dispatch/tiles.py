@@ -121,14 +121,25 @@ def itemsize(dtype):
 
 def env_int(name):
     """Positive-int env tile knob, read at TRACE time (None when unset
-    or garbage — an env knob is a preference, never a raise). The one
-    parser behind APEX_ATTN_BLOCK_Q / APEX_LN_BLOCK_ROWS /
+    or garbage — an env knob is a preference, never a raise; a
+    set-but-unparseable value warns ONCE per (knob, value) like
+    env_choice/env_float, so a mistyped pin on a scarce collection
+    window is loud, not silently the default shape). The one parser
+    behind APEX_ATTN_BLOCK_Q / APEX_LN_BLOCK_ROWS /
     APEX_SOFTMAX_BLOCK_ROWS / APEX_XENT_ROW_BLOCK /
-    APEX_DECODE_ATTN_BLOCK_H, so the kernels' knob-parsing semantics
-    cannot drift apart."""
+    APEX_DECODE_ATTN_BLOCK_H / APEX_BENCH_BATCH / APEX_ATTN_SEQ, so
+    the knob-parsing semantics cannot drift apart."""
     v = os.environ.get(name)
-    if v and v.isdigit() and int(v) > 0:
+    if v in (None, ""):
+        return None
+    if v.isdigit() and int(v) > 0:
         return int(v)
+    if (name, v) not in _warned_env:
+        import warnings
+
+        warnings.warn(f"{name}={v!r} is not a positive integer — "
+                      f"ignored (preference semantics)")
+        _warned_env.add((name, v))
     return None
 
 
@@ -180,6 +191,16 @@ def env_float(name, default):
                       f"{float(default):g})")
         _warned_env.add((name, v))
     return float(default)
+
+
+def env_flag(name):
+    """Boolean env gate: True iff the var is exactly ``"1"`` — the
+    parse every ``=1`` collection/arming knob in the repo uses
+    (APEX_TELEMETRY, APEX_SERVE_EVENTS, APEX_BENCH_SMOKE,
+    APEX_PROFILE_CAPTURE, ...). One home next to env_int/env_choice/
+    env_float so the gates cannot drift to ``bool(v)``-style parses
+    per module (tools/apexlint APX002 polices raw reads)."""
+    return os.environ.get(name) == "1"
 
 
 def check_setter_value(value, knob):

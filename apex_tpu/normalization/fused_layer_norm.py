@@ -116,7 +116,9 @@ def _resolve_pallas(x_shape, n_norm_axes, use_pallas, dtype=None):
     on_tpu = _tpu_available()
     if from_table:
         return True, not on_tpu, tile_pref
-    if not on_tpu and os.environ.get("APEX_PALLAS_INTERPRET") == "1":
+    from apex_tpu.dispatch import tiles
+
+    if not on_tpu and tiles.env_flag("APEX_PALLAS_INTERPRET"):
         # the CPU leg of a pinned pallas A/B (autotune_steps --smoke):
         # run the kernel in interpret mode instead of silently falling
         # back to jnp — a "pallas" label over a jnp run is label drift

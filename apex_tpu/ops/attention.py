@@ -170,8 +170,7 @@ def fused_attention(q, k, v, *, causal=False, sm_scale=None,
         ("flash", False, None) if force_dense
         else _effective_impl_params(impl, q, k))
     if eff_impl == "rows" and not force_dense:
-        import os
-
+        from apex_tpu.dispatch import tiles
         from apex_tpu.ops import attention_pallas as ap
 
         # the *default* dispatch caps the rows kernel at the fmha-style
@@ -186,7 +185,7 @@ def fused_attention(q, k, v, *, causal=False, sm_scale=None,
         # never silently: a "rows" label over a dense run is label drift
         interp = (not _tpu_available()
                   and (from_table
-                       or os.environ.get("APEX_PALLAS_INTERPRET") == "1"))
+                       or tiles.env_flag("APEX_PALLAS_INTERPRET")))
         if ((_tpu_available() or interp) and seq_ok
                 and ap.supported(sq, sk, q.shape[-1])):
             # table tile params ride as a PREFERENCE tuple (hashable —

@@ -40,8 +40,14 @@ import time
 
 from apex_tpu import resilience
 
-DEFAULT_STATE = os.environ.get("APEX_PROBE_STATE",
-                               "/tmp/apex_tpu_probe_state")
+
+def default_state():
+    """Probe-state path (``APEX_PROBE_STATE``), read when the CLI
+    builds its parser — not at import (the APX001 trace-time rule:
+    probe_and_collect.sh exports the override per round, and a
+    module-level read would freeze the first round's path into any
+    long-lived process)."""
+    return os.environ.get("APEX_PROBE_STATE", "/tmp/apex_tpu_probe_state")
 
 
 def classify_probe(rc, detail=""):
@@ -146,11 +152,11 @@ def main(argv=None):
     p = sub.add_parser("stamp", help="classify a probe run; write state")
     p.add_argument("--rc", type=int, required=True)
     p.add_argument("--detail", default="")
-    p.add_argument("--out", default=DEFAULT_STATE)
+    p.add_argument("--out", default=default_state())
     p.set_defaults(fn=cmd_stamp)
 
     p = sub.add_parser("status", help="verdict + age of the last probe")
-    p.add_argument("--state", default=DEFAULT_STATE)
+    p.add_argument("--state", default=default_state())
     p.add_argument("--bench", default=None,
                    help="bench log to cross-classify (large-HBM mode)")
     p.set_defaults(fn=cmd_status)

@@ -68,7 +68,9 @@ def enabled():
     host code only — the jitted programs never depend on it."""
     if _FORCED is not None:
         return _FORCED
-    return os.environ.get("APEX_SERVE_EVENTS") == "1"
+    from apex_tpu.dispatch import tiles
+
+    return tiles.env_flag("APEX_SERVE_EVENTS")
 
 
 def enable():

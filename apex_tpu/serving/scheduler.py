@@ -46,7 +46,6 @@ from collections import deque
 from typing import List, Optional
 
 from apex_tpu.dispatch import tiles as _tiles
-from apex_tpu.serving.kv_cache import pages_needed
 
 ARRIVALS = ("poisson", "diurnal")
 POLICIES = ("fifo",)
@@ -132,6 +131,11 @@ class ContinuousBatchingScheduler:
         return [i for i, s in enumerate(self.slots) if s is not None]
 
     def _request_pages(self, req):
+        # deferred: kv_cache imports jax.numpy at module level for the
+        # cache arrays, and this module's stdlib-only claim is
+        # mechanically checked over the import graph (apexlint APX006)
+        from apex_tpu.serving.kv_cache import pages_needed
+
         return pages_needed(len(req.prompt) + req.max_new_tokens,
                             self.page_size)
 

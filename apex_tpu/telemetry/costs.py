@@ -407,10 +407,9 @@ def starve_threshold():
     (``APEX_STARVE_HBM_BYTES``; None = no committed threshold yet —
     the §6 mode's boundary is unmeasured, so nothing is flagged by
     default: measured dispatch, not asserted dispatch)."""
-    v = os.environ.get("APEX_STARVE_HBM_BYTES")
-    if v and v.isdigit() and int(v) > 0:
-        return int(v)
-    return None
+    from apex_tpu.dispatch.tiles import env_int
+
+    return env_int("APEX_STARVE_HBM_BYTES")
 
 
 def starvation(peak_hbm_bytes, platform=None):

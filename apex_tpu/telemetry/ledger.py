@@ -159,7 +159,9 @@ def append_record(harness, platform, dispatch_overhead_ms, k, relay=None,
     raises — see module docstring)."""
     try:
         if path is None:
-            if (os.environ.get("APEX_BENCH_SMOKE") == "1"
+            from apex_tpu.dispatch.tiles import env_flag
+
+            if (env_flag("APEX_BENCH_SMOKE")
                     and not os.environ.get("APEX_TELEMETRY_LEDGER")):
                 return None
             path = ledger_path()

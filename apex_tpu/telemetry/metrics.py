@@ -41,7 +41,9 @@ def enabled():
     time only — branch on it in Python, never inside traced code."""
     if _FORCED is not None:
         return _FORCED
-    return os.environ.get("APEX_TELEMETRY") == "1"
+    from apex_tpu.dispatch.tiles import env_flag
+
+    return env_flag("APEX_TELEMETRY")
 
 
 def enable():

@@ -44,14 +44,18 @@ DEFAULT_TIMEOUT_S = 900  # matches the resilience wedge cap: a capture
 
 def requested():
     """True when the operator armed the watchdog's capture hook."""
-    return os.environ.get("APEX_PROFILE_CAPTURE") == "1"
+    from apex_tpu.dispatch.tiles import env_flag
+
+    return env_flag("APEX_PROFILE_CAPTURE")
 
 
 def capture_active():
     """True inside the capture CHILD (``APEX_PROFILE_INNER=1`` — set
     only by the watchdog hook; the scored inner attempts never see
     it)."""
-    return os.environ.get("APEX_PROFILE_INNER") == "1"
+    from apex_tpu.dispatch.tiles import env_flag
+
+    return env_flag("APEX_PROFILE_INNER")
 
 
 def refusal():
@@ -74,10 +78,9 @@ def timeout_s():
     """The capture subprocess budget (the resilience timeout envelope:
     ``APEX_PROFILE_TIMEOUT`` override, :data:`DEFAULT_TIMEOUT_S`
     default)."""
-    v = os.environ.get("APEX_PROFILE_TIMEOUT")
-    if v and v.isdigit() and int(v) > 0:
-        return int(v)
-    return DEFAULT_TIMEOUT_S
+    from apex_tpu.dispatch.tiles import env_int
+
+    return env_int("APEX_PROFILE_TIMEOUT") or DEFAULT_TIMEOUT_S
 
 
 def profile_root():

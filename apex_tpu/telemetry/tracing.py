@@ -73,9 +73,9 @@ def bench_k(smoke, default=128):
     (profile_gpt etc.) keep their own smaller fixed K — their rows are
     10–100 ms, where K=16–32 noise is already <5%.
     """
-    import os
+    from apex_tpu.dispatch.tiles import env_int
 
-    return 2 if smoke else int(os.environ.get("APEX_BENCH_K", str(default)))
+    return 2 if smoke else (env_int("APEX_BENCH_K") or default)
 
 
 @dataclasses.dataclass

@@ -191,11 +191,12 @@ class FusedScaleMaskSoftmax:
                 tile_pref = params.get("block_rows")
         interpret = self._pallas_interpret
         if use and not interpret:
+            from apex_tpu.dispatch import tiles as _tiles
             from apex_tpu.ops.attention import _tpu_available
 
             if from_table:
                 interpret = not _tpu_available()
-            elif os.environ.get("APEX_PALLAS_INTERPRET") == "1":
+            elif _tiles.env_flag("APEX_PALLAS_INTERPRET"):
                 # CPU leg of a pinned pallas A/B (autotune --smoke):
                 # interpret mode instead of a silent jnp fallback
                 interpret = not _tpu_available()

@@ -44,6 +44,16 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 # cannot update one and orphan the other.
 DEFAULT_TPU_BATCH = 8
 
+
+def env_flag(name):
+    """``=1`` knob gate via the one-home parser
+    (apex_tpu.dispatch.tiles.env_flag), imported lazily: bench.py keeps
+    apex_tpu out of module import time (the watchdog parses its
+    environment before touching jax)."""
+    from apex_tpu.dispatch.tiles import env_flag as _impl
+
+    return _impl(name)
+
 # Emergency-save staging (durability layer, ISSUE 6): after each scan
 # boundary the inner run parks a HOST copy of the newest training state
 # here — host copies, because the jit donates the device buffers into
@@ -98,10 +108,12 @@ def _default_batch(cfg, builtin, s):
     """The bench batch: APEX_BENCH_BATCH pins; else a dispatch-table
     "bench_batch" entry for this (s, hidden, layers) bucket — the cashed
     b-ladder A/B (benchmarks/autotune_steps.py) — else ``builtin``."""
-    v = os.environ.get("APEX_BENCH_BATCH")
-    if v:
-        return int(v)
     from apex_tpu import dispatch
+    from apex_tpu.dispatch.tiles import env_int
+
+    v = env_int("APEX_BENCH_BATCH")
+    if v:
+        return v
 
     choice = dispatch.lookup("bench_batch", dtype="bfloat16", s=s,
                              h=cfg.hidden_size, layers=cfg.num_layers)
@@ -481,7 +493,7 @@ def main():
             "step_scan_timed_rebind": timed_rebind,
         }, platform=platform, cost_ctx={
             "steps": iters,
-            "smoke": os.environ.get("APEX_BENCH_SMOKE") == "1",
+            "smoke": env_flag("APEX_BENCH_SMOKE"),
             "model_flops": {"step_scan": step_flops,
                             "step_scan_timed_rebind": step_flops},
         }))
@@ -502,7 +514,7 @@ def main():
 
         ckpt_writer = ckpt_mod.DurableCheckpointer(
             os.environ["APEX_CKPT_DIR"])
-        if os.environ.get("APEX_CKPT_RESUME") == "1":
+        if env_flag("APEX_CKPT_RESUME"):
             tmpl = {"params": params, "opt": opt_state,
                     "scaler": scaler_state, "rng": rng}
             # the batch/seq guard matters because the state TREE is
@@ -553,7 +565,7 @@ def main():
         step, run, (params, opt_state, scaler_state, jnp.float32(0.0),
                     ids, pos, labels),
         iters, model_flops_per_step, platform,
-        smoke=os.environ.get("APEX_BENCH_SMOKE") == "1")
+        smoke=env_flag("APEX_BENCH_SMOKE"))
 
     # compile + warm + drain (donated inputs: rebind the carried state)
     print(f"# compiling {iters}-step scan at b={b} s={s} ...",
@@ -915,8 +927,7 @@ def _attempt_once(state, extra_env=None, timeout_cap=None, attempt=0):
         else:
             env[k] = v
     timeout = resilience.attempt_timeout(timeout_cap)
-    label = ("cpu" if os.environ.get("APEX_BENCH_SMOKE") == "1"
-             else "tpu")
+    label = "cpu" if env_flag("APEX_BENCH_SMOKE") else "tpu"
 
     # capture stdout (the JSON line) only; stderr is inherited so the
     # '# compiling ...' liveness prints stream during the slow compile
@@ -1064,7 +1075,7 @@ def _watchdog():
 
     policy = resilience.RetryPolicy()
     attempts = policy.attempts
-    smoke = os.environ.get("APEX_BENCH_SMOKE") == "1"
+    smoke = env_flag("APEX_BENCH_SMOKE")
     # "best"/"fallback" hold (line, record) pairs; best_rank orders
     # candidates as (healthy?, value) so a healthy measurement always
     # beats a degraded/implausible one regardless of its (possibly
@@ -1306,11 +1317,13 @@ if __name__ == "__main__":
                   file=sys.stderr)
             sys.exit(2)
         os.environ["APEX_CKPT_RESUME"] = "1"
-    if os.environ.get("APEX_WARM_ONLY") == "1":
+    from apex_tpu.compile_cache import warm_only as _warm_only
+
+    if _warm_only():
         # warm-start pass (benchmarks/warm_cache.py): compile-only, no
         # measurement — the retrying watchdog has nothing to rank
         main()
-    elif os.environ.get("APEX_BENCH_INNER") == "1":
+    elif env_flag("APEX_BENCH_INNER"):
         main()
     else:
         sys.exit(_watchdog())

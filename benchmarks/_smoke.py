@@ -27,8 +27,9 @@ def smoke_mode(env_var):
     "1"; also forces the CPU backend in that case, and activates the
     persistent compile cache (default ON for real runs, OFF for smoke —
     see module docstring)."""
-    on = (os.environ.get(env_var) == "1"
-          or os.environ.get("APEX_BENCH_SMOKE") == "1")
+    from apex_tpu.dispatch.tiles import env_flag
+
+    on = env_flag(env_var) or env_flag("APEX_BENCH_SMOKE")
     if on:
         jax.config.update("jax_platforms", "cpu")
     compile_cache.activate(default_on=not on)

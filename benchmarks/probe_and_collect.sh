@@ -167,6 +167,29 @@ if loop_alive; then
          "a second loop would put two TPU clients in contention" >&2
     exit 3
 fi
+# invariant preflight (tools/apexlint, ISSUE 12): refuse to ARM on a
+# dirty lint — a broken convention (knob registry, env hygiene,
+# stdlib-only claim) must be fixed before an unattended loop runs on
+# it (same refusal pattern as APEX_FAULT_PLAN / the disarm marker).
+# Relay-proof like the other preflight CLIs; APEX_APEXLINT_ROOT is the
+# tier-1 test hook (point the gate at a fixture tree).
+lint_out="$(timeout 120 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    python -m tools.apexlint \
+    ${APEX_APEXLINT_ROOT:+--root "$APEX_APEXLINT_ROOT"} 2>&1)"
+if [ $? -ne 0 ]; then
+    echo "REFUSING TO ARM: apexlint found invariant violations:" >&2
+    printf '%s\n' "$lint_out" | tail -25 >&2
+    exit 2
+fi
+# a PASSING redirected lint may proceed only into the DRYRUN hook
+# below (the tier-1 refusal tests): a leftover APEX_APEXLINT_ROOT
+# export must never arm a live loop on a fixture tree's verdict
+if [ -n "${APEX_APEXLINT_ROOT:-}" ] && [ -z "${APEX_PROBE_DRYRUN:-}" ]; then
+    echo "REFUSING TO ARM: APEX_APEXLINT_ROOT is set (test-only lint" >&2
+    echo "redirect) without APEX_PROBE_DRYRUN — a fixture tree's" >&2
+    echo "verdict must not arm a live loop" >&2
+    exit 2
+fi
 # chaos-test hook: validate the arm path (guards passed) without
 # starting a live probe loop against the relay
 if [ -n "${APEX_PROBE_DRYRUN:-}" ]; then

@@ -19,6 +19,7 @@ from benchmarks._smoke import smoke_mode  # noqa: E402
 
 SMOKE = smoke_mode("APEX_BENCH_SMOKE")  # force-CPU tiny sanity mode
 
+from apex_tpu.dispatch import tiles  # noqa: E402
 from benchmarks._timing import Tracer, bench_k  # noqa: E402
 
 B, H, S, D = (2, 2, 128, 32) if SMOKE else (8, 12, 1024, 64)
@@ -27,9 +28,10 @@ B, H, S, D = (2, 2, 128, 32) if SMOKE else (8, 12, 1024, 64)
 # dispatch rule (rows kernel capped at sk<=2048 by default). The full
 # 9-config flash block sweep is trimmed to the two known-good configs so
 # the crossover decision rows (which run last) fit the window budget.
-LONG_SEQ = not SMOKE and bool(os.environ.get("APEX_ATTN_SEQ"))
+_ATTN_SEQ = tiles.env_int("APEX_ATTN_SEQ")
+LONG_SEQ = not SMOKE and _ATTN_SEQ is not None
 if LONG_SEQ:
-    S = int(os.environ["APEX_ATTN_SEQ"])
+    S = _ATTN_SEQ
     B = max(1, 8 * 1024 // S)
     if B * S != 8 * 1024:
         print(f"note: b*s = {B * S} tokens (baseline rows used 8192) — "

@@ -33,7 +33,10 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
 
 # virtual devices BEFORE backend init: the smoke A/B drives a real dp>1
-# mesh (same mechanism as tests/conftest.py's 8-device CPU mesh)
+# mesh (same mechanism as tests/conftest.py's 8-device CPU mesh).
+# apexlint: disable=APX002 — raw on purpose: XLA_FLAGS must be staged
+# before ANY apex_tpu import loads jax, so the env_flag helper (whose
+# import executes the package __init__) is not usable yet
 if os.environ.get("APEX_BENCH_SMOKE") == "1":
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                                + " --xla_force_host_platform_device_count=8")
@@ -49,6 +52,7 @@ from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
 
 from benchmarks._timing import Tracer, bench_k  # noqa: E402
 
+from apex_tpu.dispatch.tiles import env_flag  # noqa: E402
 from apex_tpu.parallel import collectives  # noqa: E402
 from apex_tpu.telemetry import costs  # noqa: E402
 from apex_tpu.telemetry.costs import V5E_PEAK_BF16_FLOPS as PEAK  # noqa: E402
@@ -86,7 +90,7 @@ cfg = TransformerConfig(
 
 # a hierarchical request factors dp as (2, N//2); below 4 ranks there
 # is no inner slice to stage over — the preference falls back (printed)
-hier_req = os.environ.get("APEX_HIER_ALLREDUCE") == "1"
+hier_req = env_flag("APEX_HIER_ALLREDUCE")
 dp_decl = (2, N // 2) if hier_req and N >= 4 else N
 if hier_req and N < 4:
     print(f"profile_comm: APEX_HIER_ALLREDUCE=1 with dp={N} < 4 — "

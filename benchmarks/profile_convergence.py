@@ -37,6 +37,7 @@ Traces are written to ``benchmarks/curves/`` for committing.
 Run:  PYTHONPATH=/root/repo python benchmarks/profile_convergence.py [steps]
 Smoke: APEX_BENCH_SMOKE=1 ... (tiny shapes, CPU)
 """
+# apexlint: disable-file=APX004 — wall prints and value fetches around the loss-trajectory run; the trajectory, not time, is the scored quantity (BASELINE convergence rows)
 
 import json
 import os

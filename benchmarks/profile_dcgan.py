@@ -9,6 +9,7 @@ chained in one ``lax.scan`` dispatch; reports steps/s and images/s.
 Run on TPU: PYTHONPATH=/root/repo python benchmarks/profile_dcgan.py
 Smoke on CPU: APEX_DCGAN_SMOKE=1 python benchmarks/profile_dcgan.py
 """
+# apexlint: disable-file=APX004 — pre-Tracer inline PERF.md §0 protocol (scan-chain + traced eps + 1-element sync + overhead subtract); Tracer migration queued — the BASELINE rows' stdout format is pinned by committed captions
 
 import os
 import sys

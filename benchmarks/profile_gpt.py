@@ -33,6 +33,7 @@ SMOKE = smoke_mode("APEX_BENCH_SMOKE")  # force-CPU tiny sanity mode
 from benchmarks._timing import Tracer  # noqa: E402
 
 from apex_tpu.amp.scaler import LossScaler
+from apex_tpu.dispatch import tiles as _tiles
 from apex_tpu.optimizers.fused_adam import fused_adam
 from apex_tpu.transformer.parallel_state import TENSOR_AXIS
 from apex_tpu.transformer.testing import GPTModel, TransformerConfig
@@ -49,7 +50,7 @@ REMAT = remat_granularity()
 # Autotune rung mode (benchmarks/autotune_steps.py): measure ONLY the
 # FULL-train-step row — an A/B pass pays for one number per rung inside
 # a budgeted window, not the whole component table.
-ONLY_STEP = os.environ.get("APEX_GPT_ONLY_STEP") == "1"
+ONLY_STEP = _tiles.env_flag("APEX_GPT_ONLY_STEP")
 
 B, S = (2, 128) if SMOKE else (8, 1024)
 K = 2 if SMOKE else 32  # scan length
@@ -220,7 +221,7 @@ if os.environ.get("APEX_CKPT_DIR") and not _cc.warm_only():
 
     _ckpt_writer = _ckpt_mod.DurableCheckpointer(
         os.environ["APEX_CKPT_DIR"])
-    if os.environ.get("APEX_CKPT_RESUME") == "1":
+    if _tiles.env_flag("APEX_CKPT_RESUME"):
         _tmpl = {"params": step_carry0[0], "opt": step_carry0[1],
                  "scaler": step_carry0[2], "rng": _ckpt_rng}
         # checkpoint.resume_provenance: the ONE restore+provenance
@@ -358,7 +359,7 @@ scan_time("flash attn fwd+bwd (1 lyr)", make_fa, q0, (k0, v0),
 # per row (fused_attention_dropout), same shapes/optimizer as row 5.
 # (APEX_BENCH_DROPOUT_SMOKE=1 exercises the rows at smoke shapes too —
 # a CPU validity check; smoke's s=128, h=32 keeps both paths traceable)
-if not SMOKE or os.environ.get("APEX_BENCH_DROPOUT_SMOKE") == "1":
+if not SMOKE or _tiles.env_flag("APEX_BENCH_DROPOUT_SMOKE"):
     import dataclasses as _dc
 
     for _label, _fused in (("drop0.1 rows-kernel", True),

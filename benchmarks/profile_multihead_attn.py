@@ -13,6 +13,7 @@ both sides are XLA-fused dense programs and the ratio hovers near 1.
 
 Run on TPU: PYTHONPATH=/root/repo python benchmarks/profile_multihead_attn.py
 """
+# apexlint: disable-file=APX004 — pre-Tracer inline PERF.md §0 protocol (scan-chain + traced eps + 1-element sync + overhead subtract); Tracer migration queued — the BASELINE rows' stdout format is pinned by committed captions
 
 import os
 import sys

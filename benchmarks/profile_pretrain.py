@@ -16,6 +16,7 @@ dryrun — one real chip can't measure it). Results go to PERF.md.
 
 Run:  python benchmarks/profile_pretrain.py [bert_batch] [gpt_batch]
 """
+# apexlint: disable-file=APX004 — pre-Tracer inline PERF.md §0 protocol (scan-chain + traced eps + 1-element sync + overhead subtract); Tracer migration queued — the BASELINE rows' stdout format is pinned by committed captions
 
 import os
 import sys

@@ -8,6 +8,7 @@ on one chip), fused SGD momentum + weight decay, CE loss — on synthetic
 
 Run:  PYTHONPATH=/root/repo:$PYTHONPATH python benchmarks/profile_resnet.py [batch]
 """
+# apexlint: disable-file=APX004 — pre-Tracer inline PERF.md §0 protocol (scan-chain + traced eps + 1-element sync + overhead subtract); Tracer migration queued — the BASELINE rows' stdout format is pinned by committed captions
 
 import os
 import sys

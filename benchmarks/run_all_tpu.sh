@@ -11,6 +11,32 @@ if [ -n "${APEX_FAULT_PLAN:-}" ]; then
     echo "REFUSING TO COLLECT: APEX_FAULT_PLAN is set (test-only)" >&2
     exit 2
 fi
+# invariant preflight (tools/apexlint, ISSUE 12): a dirty lint means a
+# committed convention (knob registry, env/trace hygiene, stdlib-only
+# claim, citations) broke — refuse to collect, same pattern as the
+# fault-plan refusal above. The linter is stdlib+AST (imports nothing
+# from apex_tpu), but interpreter start alone dials the relay without
+# the empty pool var (CLAUDE.md), so it runs relay-proof like the
+# other preflight CLIs. APEX_APEXLINT_ROOT is the test hook (points
+# the gate at a fixture tree so tier-1 can assert the refusal).
+lint_out="$(timeout 120 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    python -m tools.apexlint \
+    ${APEX_APEXLINT_ROOT:+--root "$APEX_APEXLINT_ROOT"} 2>&1)"
+if [ $? -ne 0 ]; then
+    echo "REFUSING TO COLLECT: apexlint found invariant violations:" >&2
+    printf '%s\n' "$lint_out" | tail -25 >&2
+    exit 2
+fi
+# a PASSING redirected lint must not arm a real pass either: the
+# redirect is a tier-1 fixture hook, and a leftover export would
+# otherwise neuter the gate exactly when it matters (same
+# stale-test-env class as APEX_FAULT_PLAN above)
+if [ -n "${APEX_APEXLINT_ROOT:-}" ]; then
+    echo "REFUSING TO COLLECT: APEX_APEXLINT_ROOT is set (test-only" >&2
+    echo "lint redirect — a fixture tree's verdict must not arm a" >&2
+    echo "real collection pass)" >&2
+    exit 2
+fi
 OUT="${1:-/tmp/apex_tpu_bench_$(date +%Y%m%d_%H%M)}"
 mkdir -p "$OUT"
 echo "collecting into $OUT"

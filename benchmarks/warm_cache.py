@@ -46,6 +46,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from apex_tpu import resilience  # noqa: E402
+from apex_tpu.dispatch.tiles import env_flag  # noqa: E402
 from bench import _last_json  # noqa: E402  (the ONE driver-line parser)
 
 
@@ -67,6 +68,7 @@ def warm_target(name, cmd, extra_env, timeout):
     # warming REQUIRES the cache on (that is its entire job) — but the
     # escape hatch stays honored: an explicit APEX_COMPILE_CACHE=0 wins
     env.setdefault("APEX_COMPILE_CACHE", "1")
+    # apexlint: disable=APX004 — warm-subprocess wall for the echo line, not a measurement (the warm pass times nothing, PERF.md §6)
     t0 = time.perf_counter()
     timed_out = False
     try:
@@ -81,6 +83,7 @@ def warm_target(name, cmd, extra_env, timeout):
     # warm is the §6 wedge signature, a non-zero exit is relay-bound
     verdict = resilience.classify_subprocess(
         proc.returncode if proc is not None else None, timed_out)
+    # apexlint: disable=APX004 — warm-subprocess wall for the echo line, not a measurement (the warm pass times nothing, PERF.md §6)
     dt = time.perf_counter() - t0
     detail, rec = "", None
     if proc is not None:
@@ -114,12 +117,14 @@ def warm_target(name, cmd, extra_env, timeout):
 
 
 def main():
-    if os.environ.get("APEX_COMPILE_CACHE") == "0":
+    from apex_tpu import compile_cache as _cc
+    from apex_tpu.dispatch.tiles import env_int
+
+    if _cc.requested() is False:
         print("warm_cache: APEX_COMPILE_CACHE=0 — nothing to warm",
               flush=True)
         return 0
-    timeout = int(os.environ.get("APEX_WARM_TIMEOUT",
-                                 str(resilience.WARM_TIMEOUT_S)))
+    timeout = env_int("APEX_WARM_TIMEOUT") or resilience.WARM_TIMEOUT_S
     bench = os.path.join(REPO, "bench.py")
     gpt = os.path.join(REPO, "benchmarks", "profile_gpt.py")
     # the durable collection manifest (apex_tpu.resilience.manifest):
@@ -233,7 +238,7 @@ def main():
     # decode programs are the exact ones the measured replay
     # dispatches; the SLO replay itself is host work the warm-only
     # mode skips (it runs nothing, so there is nothing to warm there).
-    if os.environ.get("APEX_SERVE_BENCH") == "1":
+    if env_flag("APEX_SERVE_BENCH"):
         if "serving" in cashed:
             print("warm profile_serving: skipped (row cashed in the "
                   "round manifest)", flush=True)

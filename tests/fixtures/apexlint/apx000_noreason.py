@@ -1,0 +1,6 @@
+"""APX000 fixture: a pragma without a reason."""
+import time
+
+
+def f():
+    return time.time()  # apexlint: disable=APX004

@@ -1,0 +1,4 @@
+"""APX000 fixture: a pragma naming an unknown rule."""
+
+# apexlint: disable=APX999 — no such rule
+X = 1

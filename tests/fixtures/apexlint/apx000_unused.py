@@ -1,0 +1,5 @@
+"""APX000 fixture: a reasoned pragma that suppresses nothing —
+reported as unused, never a failure."""
+
+# apexlint: disable=APX004 — fixture: nothing to suppress here
+X = 1

@@ -1,0 +1,6 @@
+"""APX001 clean twin: the same knobs read inside function bodies."""
+import os
+
+
+def trace_time():
+    return os.getenv("APEX_FIX_DEFAULT") or os.environ.get("APEX_FIX_IMPORT")

@@ -1,0 +1,5 @@
+"""APX001 pragma twin: the violation survives, visibly."""
+import os
+
+# apexlint: disable=APX001,APX002 — fixture: demonstrates a reasoned suppression
+MODULE_LEVEL = os.environ.get("APEX_FIX_IMPORT")

@@ -1,0 +1,18 @@
+"""APX001 fixture: import-time env reads — module level, decorator
+argument, and a default-argument expression (all run at import)."""
+import os
+
+MODULE_LEVEL = os.environ.get("APEX_FIX_IMPORT")
+
+
+def at_call_time(default=os.getenv("APEX_FIX_DEFAULT")):
+    return default
+
+
+def _env_helpers_also_count():
+    pass
+
+
+from apex_tpu.dispatch.tiles import env_flag  # noqa: E402
+
+HELPER_AT_IMPORT = env_flag("APEX_FIX_HELPER")

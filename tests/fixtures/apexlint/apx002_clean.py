@@ -1,0 +1,14 @@
+"""APX002 clean twin: reads through the one-home parsers, plus env
+WRITES (which are pins, not reads)."""
+import os
+
+from apex_tpu.dispatch.tiles import env_flag, env_int
+
+
+def helper_reads():
+    return env_flag("APEX_DOCED") or env_int("APEX_INFRA_X")
+
+
+def pins_for_child():
+    os.environ["APEX_FIX_RAW"] = "1"
+    return dict(os.environ, APEX_FIX_CHILD="1")

@@ -1,0 +1,7 @@
+"""APX002 pragma twin."""
+import os
+
+
+def raw_read():
+    # apexlint: disable=APX002 — fixture: this module is the knob's one home
+    return os.environ.get("APEX_FIX_RAW")

@@ -1,0 +1,11 @@
+"""APX002 fixture: raw APEX_* read outside any designated reader."""
+import os as _renamed_os
+
+NAME = "APEX_FIX_CONST"
+
+
+def raw_reads():
+    a = _renamed_os.environ.get("APEX_FIX_RAW")
+    b = _renamed_os.environ[NAME]          # module-constant resolution
+    c = "APEX_FIX_PRESENT" in _renamed_os.environ
+    return a, b, c

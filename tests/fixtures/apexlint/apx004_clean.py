@@ -1,0 +1,6 @@
+"""APX004 clean twin: no naked timing (a real harness would use
+telemetry.tracing.Tracer/Span)."""
+
+
+def measure(tracer, f, x):
+    return tracer.time_call("row", f, x)

@@ -1,0 +1,11 @@
+"""APX004 file-level pragma twin."""
+# apexlint: disable-file=APX004 — fixture: whole file is pre-Tracer legacy
+import time
+
+
+def a():
+    return time.time()
+
+
+def b():
+    return time.perf_counter()

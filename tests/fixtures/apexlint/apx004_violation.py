@@ -1,0 +1,10 @@
+"""APX004 fixture: naked timing in a harness."""
+import time
+from time import perf_counter
+
+
+def measure(x, f):
+    t0 = time.time()
+    t1 = perf_counter()
+    f(x).block_until_ready()
+    return t0, t1

@@ -1,0 +1,6 @@
+"""APX005 clean twin.
+
+reference: ok.py:3 resolves (file exists, line in range), and a range
+citation reference: sub/deep.py:1-4 resolves too. A repo-internal
+mention like ledger.py:1 is a self-citation, not a reference one.
+"""

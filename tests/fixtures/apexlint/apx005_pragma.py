@@ -1,0 +1,5 @@
+"""APX005 pragma twin.
+
+# apexlint: disable=APX005 — fixture: upstream file renamed; citation kept for history
+reference: missing_file.py:5 stays cited on purpose here.
+"""

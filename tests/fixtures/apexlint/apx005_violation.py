@@ -1,0 +1,5 @@
+"""APX005 fixture with stale citations.
+
+reference: missing_file.py:5 — the file does not exist; and
+reference: ok.py:999 is far out of range.
+"""

@@ -1,0 +1,8 @@
+"""APX006 clean twin: the jax import is deferred to call time (the
+documented lazy pattern)."""
+
+
+def f():
+    import jax
+
+    return jax.devices()

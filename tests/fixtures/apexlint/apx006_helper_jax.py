@@ -1,0 +1,6 @@
+"""The transitive hop: imports jax at module level."""
+import jax
+
+
+def helper():
+    return jax.devices()

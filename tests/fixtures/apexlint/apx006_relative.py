@@ -1,0 +1,7 @@
+"""APX006 fixture: a RELATIVE module-level import reaching jax — the
+walk must resolve it against the module's own package."""
+from .helper_rel import helper
+
+
+def f():
+    return helper()

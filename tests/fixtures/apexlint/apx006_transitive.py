@@ -1,0 +1,7 @@
+"""APX006 fixture: clean itself, but reaches jax through an explicit
+in-package module-level import."""
+from apex_tpu.helper_mod import helper
+
+
+def f():
+    return helper()

@@ -1,0 +1,7 @@
+"""APX006 fixture: a stdlib-only claimant importing numpy at module
+level (placed at a claimed path by the test)."""
+import numpy as np
+
+
+def f():
+    return np.zeros(1)

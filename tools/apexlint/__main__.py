@@ -1,0 +1,3 @@
+from tools.apexlint.cli import cli
+
+cli()
