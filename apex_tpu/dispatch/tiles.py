@@ -146,6 +146,28 @@ def env_int(name):
 _warned_env = set()
 
 
+def env_nonneg_int(name):
+    """Non-negative-int env preference: like :func:`env_int` but 0 is
+    a LEGAL value — the explicit off-pin of count knobs
+    (APEX_SPEC_DECODE: a measuring harness stamps the resolved draft
+    length, and 0 means "speculation off", which the positive-only
+    parser cannot express). None when unset/empty; garbage warns ONCE
+    per (knob, value) and is ignored — the same preference semantics,
+    one home."""
+    v = os.environ.get(name)
+    if v in (None, ""):
+        return None
+    if v.isdigit():
+        return int(v)
+    if (name, v) not in _warned_env:
+        import warnings
+
+        warnings.warn(f"{name}={v!r} is not a non-negative integer — "
+                      f"ignored (preference semantics)")
+        _warned_env.add((name, v))
+    return None
+
+
 def env_choice(name, allowed):
     """Enumerated env preference: the value when it is in ``allowed``,
     else None — an unknown value warns ONCE per (knob, value) and is

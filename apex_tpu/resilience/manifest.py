@@ -51,6 +51,7 @@ PASS_ROWS = (
     "convergence", "gpt_rows", "gpt_fused_head", "gpt_ln_pallas",
     "gpt_remat_sel", "attn_seq4096", "bench", "bench_b32",
     "bench_b32_remat", "bench_profile", "serving",
+    "serving_sampling", "serving_spec", "serving_prefix",
 )
 
 

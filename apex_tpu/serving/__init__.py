@@ -22,7 +22,18 @@ Layering:
 * ``scheduler``  — stdlib-only continuous batching: admit/evict
                    between decode steps against a synthetic trace
                    (seeded Poisson/diurnal arrival processes; policy
-                   knob ``APEX_SERVE_SCHED``)
+                   knob ``APEX_SERVE_SCHED`` — ``fifo`` | aged
+                   ``priority``)
+* ``sampling``   — batched temperature/top-k/top-p with per-request
+                   threefry lanes as array-value ops inside the one
+                   decode program (``APEX_SERVE_SAMPLING``; ISSUE 13)
+* ``speculative``— stdlib-only self-drafting n-gram speculation:
+                   drafts verified through the SAME packed prefill
+                   program, rollback as index arithmetic
+                   (``APEX_SPEC_DECODE``)
+* ``prefix_cache``— stdlib-only content-hashed refcounted
+                   copy-on-write page sharing over the allocator
+                   (``APEX_SERVE_PREFIX_CACHE``)
 * ``lifecycle``  — stdlib-only request-lifecycle event log, scheduler
                    gauges, and the validated ``slo`` ledger block
                    (gated on ``APEX_SERVE_EVENTS`` /
@@ -33,10 +44,13 @@ Layering:
 """
 
 from apex_tpu.serving import lifecycle  # noqa: F401
+from apex_tpu.serving import speculative  # noqa: F401
 from apex_tpu.serving.kv_cache import (  # noqa: F401
     PageAllocator,
     init_cache,
 )
+from apex_tpu.serving.prefix_cache import PrefixCache  # noqa: F401
+from apex_tpu.serving.sampling import SamplingParams  # noqa: F401
 from apex_tpu.serving.scheduler import (  # noqa: F401
     ContinuousBatchingScheduler,
     Request,

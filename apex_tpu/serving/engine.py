@@ -7,25 +7,72 @@ and the device programs themselves are compiled exactly once each
 (prefill at one packed bucket shape, decode at the slot shape), so
 the steady-state loop is dispatch → host bookkeeping → dispatch with
 no recompiles on the critical path. Scheduler events change array
-VALUES only; ``decode_cache_size()`` exposes the jit cache size so
-tests (and ``dryrun_serving``) can assert the contract mechanically.
+VALUES only; ``decode_cache_size()`` / ``prefill_cache_size()``
+expose the jit cache sizes so tests (and ``dryrun_serving``) can
+assert the contract mechanically — ONE prefill + ONE decode program,
+with sampling, speculative decode and the prefix cache all enabled.
+
+Generation subsystem (ISSUE 13), three cooperating layers:
+
+* **sampling** (``serving.sampling``) — per-request temperature /
+  top-k / top-p with private threefry lanes. Enabled at engine build
+  (``sampling=`` > ``set_sampling`` > ``APEX_SERVE_SAMPLING``); the
+  per-request params ride the decode program as ``[B]`` ARRAYS
+  restaged each round, so admit/evict/re-seed never recompiles.
+  Temperature-0 lanes take the exact greedy argmax.
+* **speculative decode** (``serving.speculative``) — self-drafting
+  n-gram drafts of up to K tokens (``spec_decode=`` >
+  ``APEX_SPEC_DECODE``), verified in ONE dispatch of the SAME packed
+  varlen prefill program: the slot's full sequence + draft is one
+  segment, already-cached context positions route their K/V writes to
+  the null spare row (the cache keeps its decode-written values
+  bit-exact), and the flat logits-gather (``prefill_requests *
+  (K + 1)`` indices — the generalized ``last_idx``) reads the verify
+  chain. Acceptance/rollback is pure page/length arithmetic
+  (``speculative.accept``); rejected positions' K/V are never read
+  (length-masked) and get overwritten as the sequence advances.
+* **prefix cache** (``serving.prefix_cache``) — content-hashed
+  refcounted page sharing (``prefix_cache=`` >
+  ``APEX_SERVE_PREFIX_CACHE``): the scheduler admits cache hits by
+  reference + admission-time copy-on-write of the partial tail page
+  (:meth:`_copy_page` — a tiny donated jitted page copy, dispatched
+  only at admission/registration, never on the per-token path; the
+  VERIFY path adds no program — the prefill program serves it); the
+  covered suffix replays through the decode program (which attends
+  the shared pages — correct by construction), so a shared system
+  prompt is PREFILLED ONCE per engine.
+
+All three default OFF per the measured-dispatch rule — the device
+A/Bs are queued in PERF.md §2 behind ``APEX_SERVE_BENCH=1``;
+correctness (greedy parity, per-request determinism, refcount/COW
+invariants, two-program stability) is pinned on CPU by
+tests/test_serving_generation.py.
 
 Knob resolution at engine build (the CLAUDE.md asymmetry):
 
 * ``weight_quant=`` per-call True RAISES when the params cannot take
   the int8 path; None defers to ``quant.set_weight_quant`` /
   ``APEX_SERVE_WEIGHT_QUANT`` (preferences), default OFF.
+* ``sampling=`` / ``prefix_cache=`` per-call non-bools RAISE; a
+  stochastic request submitted to a sampling-OFF engine RAISES at
+  ``submit`` (explicit request ≠ preference); None defers to
+  setter/env.
+* ``spec_decode=`` per-call RAISES on an un-honorable draft length
+  (< 1, or deeper than the prefill bucket); the env preference falls
+  back per shape.
 * ``decode_impl=`` / ``decode_block_h=`` ride per-call into the
   decode-attention family on every step (raising semantics live
   there); None defers to the family's setter/env/table resolution.
 * ``policy=`` per-call unknown policies RAISE
-  (``scheduler.resolve_policy``); None defers to ``APEX_SERVE_SCHED``.
+  (``scheduler.resolve_policy``); None defers to ``APEX_SERVE_SCHED``
+  (vocabulary ``fifo`` | ``priority``).
 
 Observability (ISSUE 11): when ``lifecycle.enabled()`` the engine
 keeps a request-lifecycle :class:`~apex_tpu.serving.lifecycle.EventLog`
 (``self.events``) — submitted/admitted/prefill_done/first_token/
-finished/evicted events plus per-round scheduler gauges — appended
-strictly BETWEEN device dispatches, so the jitted programs (and
+finished/evicted events plus per-round scheduler gauges (now incl.
+cumulative draft/accept/prefix-hit counts) — appended strictly
+BETWEEN device dispatches, so the jitted programs (and
 ``decode_cache_size()==1``) are untouched either way; disabled mode
 allocates no log and is behavior-identical. ``device_dispatch_s``
 accumulates the wall time spent inside device round trips (prefill +
@@ -41,7 +88,10 @@ import numpy as np
 
 from apex_tpu.serving import lifecycle
 from apex_tpu.serving import model as smodel
+from apex_tpu.serving import prefix_cache as prefix_mod
 from apex_tpu.serving import quant as quant_mod
+from apex_tpu.serving import sampling as sampling_mod
+from apex_tpu.serving import speculative as spec_mod
 from apex_tpu.serving.kv_cache import PageAllocator, init_cache
 from apex_tpu.serving.scheduler import ContinuousBatchingScheduler
 
@@ -56,7 +106,8 @@ class ServingEngine:
                  num_pages=64, max_seq=None, prefill_len=64,
                  prefill_requests=None, weight_quant=None,
                  decode_impl=None, decode_block_h=None, interpret=None,
-                 policy=None, seed=0):
+                 policy=None, sampling=None, spec_decode=None,
+                 prefix_cache=None, seed=0):
         smodel.check_serving_config(cfg)
         self.cfg = cfg
         self.num_slots = int(num_slots)
@@ -86,13 +137,35 @@ class ServingEngine:
         self.decode_block_h = decode_block_h
         self.interpret = interpret
 
+        # generation knobs (ISSUE 13): sampling / speculative decode /
+        # prefix cache, each defaulting OFF (measured-dispatch rule)
+        self.sampling = sampling_mod.resolve(sampling)
+        k = spec_mod.resolve_k(spec_decode)
+        if spec_decode is not None and k and k + 1 > self.prefill_len:
+            raise ValueError(
+                f"spec_decode={k} cannot be honored: the verify window "
+                f"(K+1 = {k + 1} tokens) exceeds "
+                f"prefill_len={self.prefill_len}")
+        if k and k + 1 > self.prefill_len:
+            k = 0  # env preference: falls back per shape
+        self.spec_k = k
+        self.spec_stats = spec_mod.SpecStats() if self.spec_k else None
+        self.prefix_enabled = prefix_mod.resolve(prefix_cache)
+        self.prefix = prefix_mod.PrefixCache(
+            PageAllocator(num_pages), self.page_size) \
+            if self.prefix_enabled else None
+        # width of the flat logits gather per packed request: the
+        # verify chain needs K+1 rows; plain prefill reads row r*w
+        self._gather_w = self.spec_k + 1
+
         self.cache = init_cache(
             cfg.num_layers, cfg.num_attention_heads, num_pages,
             page_size, cfg.head_dim, smodel.compute_dtype(cfg))
-        self.allocator = PageAllocator(num_pages)
+        self.allocator = self.prefix.allocator if self.prefix \
+            is not None else PageAllocator(num_pages)
         self.scheduler = ContinuousBatchingScheduler(
             num_slots, self.max_pages, page_size, self.allocator,
-            policy=policy)
+            policy=policy, prefix=self.prefix)
         # lifecycle observability (gated, host-side only): None when
         # collection is off — disabled mode appends nothing and reads
         # no extra clocks beyond the per-round stamps below
@@ -105,23 +178,55 @@ class ServingEngine:
                                   seg, token_rows, page_table,
                                   last_idx, cfg=cfg)
 
-        def _decode(cache, tokens, lengths, page_table):
-            return smodel.decode_step(
-                self.params, cache, tokens, lengths, page_table,
-                cfg=cfg, qparams=self.qparams,
-                decode_impl=self.decode_impl,
-                decode_block_h=self.decode_block_h,
-                interpret=self.interpret)
+        if self.sampling:
+            def _decode(cache, tokens, lengths, page_table, temps,
+                        top_ks, top_ps, keys, counters):
+                cache, _, logits = smodel.decode_step(
+                    self.params, cache, tokens, lengths, page_table,
+                    cfg=cfg, qparams=self.qparams,
+                    decode_impl=self.decode_impl,
+                    decode_block_h=self.decode_block_h,
+                    interpret=self.interpret)
+                toks = sampling_mod.sample_tokens(
+                    logits, temps, top_ks, top_ps, keys, counters,
+                    lengths > 0)
+                return cache, toks, logits
+        else:
+            def _decode(cache, tokens, lengths, page_table):
+                return smodel.decode_step(
+                    self.params, cache, tokens, lengths, page_table,
+                    cfg=cfg, qparams=self.qparams,
+                    decode_impl=self.decode_impl,
+                    decode_block_h=self.decode_block_h,
+                    interpret=self.interpret)
+
+        def _copy(cache, src, dst):
+            # one K/V page src -> dst across all layers/heads; src/dst
+            # are traced scalars, so every COW/snapshot hop reuses ONE
+            # compiled copy and the donated cache updates in place —
+            # an eager .at[].set here would materialize the ENTIRE
+            # cache per copied page
+            for part in ("k", "v"):
+                page = jax.lax.dynamic_index_in_dim(
+                    cache[part], src, axis=2, keepdims=False)
+                cache[part] = cache[part].at[:, :, dst].set(page)
+            return cache
 
         # donate the cache: the scatter-updated pages stay in place
         self._prefill_fn = jax.jit(_prefill, donate_argnums=(0,))
         self._decode_fn = jax.jit(_decode, donate_argnums=(0,))
+        # the prefix cache's page-copy hop (admission/registration
+        # only — never on the per-token path; the TWO serving
+        # programs above stay the jaxpr-stability surfaces)
+        self._copy_fn = jax.jit(_copy, donate_argnums=(0,))
         self.tick = 0
         self.decode_steps = 0
+        self.verify_calls = 0
+        self.prefill_batches = 0
         self.tokens_generated = 0
         # wall seconds spent inside device round trips (prefill +
-        # decode dispatch-to-fetch): run wall minus this is the HOST
-        # slice of the serving loop — the overlap_bound input
+        # decode fetch): run wall minus this is the HOST slice of the
+        # serving loop — the overlap_bound input
         self.device_dispatch_s = 0.0
 
     # ---------------------------------------------------------- plumbing
@@ -132,6 +237,29 @@ class ServingEngine:
         scheduler admits or evicts)."""
         return self._decode_fn._cache_size()
 
+    def prefill_cache_size(self):
+        """jit-cache entry count of the packed prefill program — with
+        speculative decode on, admission prefills AND verify batches
+        dispatch THIS one program (the no-third-program proof next to
+        :meth:`decode_cache_size`)."""
+        return self._prefill_fn._cache_size()
+
+    def generation_stats(self):
+        """The ledger-facing generation account (None-when-disabled,
+        the degradation-not-omission rule): speculative acceptance
+        rate + mean draft length, prefix-cache hit rate."""
+        st = self.spec_stats
+        pf = self.prefix
+        return {
+            "spec_acceptance_rate":
+                st.acceptance_rate() if st is not None else None,
+            "draft_len":
+                st.mean_draft_len() if st is not None else None,
+            "prefix_hit_rate":
+                (pf.hit_tokens / pf.lookup_tokens)
+                if pf is not None and pf.lookup_tokens else None,
+        }
+
     def submit(self, request):
         """Enqueue one request; impossible requests raise HERE, before
         anything is enqueued or allocated. The scheduler validates the
@@ -139,72 +267,158 @@ class ServingEngine:
         prefill bucket, so the prompt-vs-prefill_len bound — which
         would otherwise crash _run_prefill mid-round AFTER admission
         had already filled a slot and allocated pages — is checked at
-        the same front door."""
+        the same front door. Sampling demands are validated here too:
+        stochastic params against a sampling-OFF engine raise (an
+        explicit request is a demand, not a preference)."""
         if len(request.prompt) > self.prefill_len:
             raise ValueError(
                 f"request {request.rid}: prompt ({len(request.prompt)} "
                 f"tokens) exceeds prefill_len={self.prefill_len}")
+        sp = getattr(request, "sampling", None)
+        if sp is not None:
+            sp.validate()
+            if not sp.greedy and not self.sampling:
+                raise ValueError(
+                    f"request {request.rid} demands stochastic "
+                    f"sampling (temperature={sp.temperature}) but the "
+                    f"engine was built without sampling "
+                    f"(sampling=True / APEX_SERVE_SAMPLING=1)")
+            if request.rng_key is None:
+                request.rng_key = sampling_mod.request_key(sp.seed)
         request.enqueue_wall = time.perf_counter()
-        self.scheduler.submit(request)
+        self.scheduler.submit(request, tick=self.tick)
         if self.events is not None:
             self.events.record("submitted", request.rid, tick=self.tick,
                                wall=request.enqueue_wall)
 
+    # -------------------------------------------------- page-level hops
+
+    def _copy_page(self, src, dst):
+        """Device copy of one K/V page (the prefix cache's COW hop and
+        tail-snapshot registration): one tiny donated jitted helper,
+        compiled once for any (src, dst) pair, dispatched BETWEEN the
+        serving programs' steps — the prefill/decode jaxpr-stability
+        surfaces are untouched and the copy moves one page, not the
+        cache."""
+        self.cache = self._copy_fn(self.cache, jnp.int32(src),
+                                   jnp.int32(dst))
+
+    def _assert_writable(self, slot, first_pos, last_pos):
+        """Design guard: after admission-time COW, no write of any
+        slot may land on a cache-shared page. Cheap host check; a
+        failure here is a prefix-cache invariant bug, not a runtime
+        condition."""
+        if self.prefix is None:
+            return
+        ps = self.page_size
+        for j in range(first_pos // ps, last_pos // ps + 1):
+            if j < len(slot.pages):
+                assert not self.prefix.is_shared(slot.pages[j]), (
+                    f"rid {slot.request.rid}: write at positions "
+                    f"[{first_pos}, {last_pos}] would hit shared page "
+                    f"{slot.pages[j]} (COW failed)")
+
     # ----------------------------------------------------------- prefill
 
-    def _run_prefill(self, slot_indices):
-        """Pack the newly admitted slots' prompts into [prefill_len]
-        batches (segment ids 1..R per batch; padding 0 -> null page
-        row) and fill the cache. Greedy packing: a batch closes when
-        the next prompt would overflow the bucket or the per-batch
-        request cap — further admissions start a new packed dispatch
-        of the SAME compiled program. Sets each slot's first decode
-        token."""
+    def _sample_first_tokens(self, logits_rows, slot_indices):
+        """First-token selection off prefill logits ``[R, vocab]`` for
+        the admitted slots — the SAME lane semantics as the decode
+        program's in-graph sampling (counter 0, the request's own
+        key), run eagerly between dispatches."""
         sch = self.scheduler
+        if not self.sampling:
+            return np.asarray(jnp.argmax(
+                logits_rows.astype(jnp.float32), axis=-1))
+        temps, top_ks, top_ps, keys, counters = \
+            sampling_mod.batch_lanes(
+                [sch.slots[si].request for si in slot_indices])
+        toks = sampling_mod.sample_tokens(
+            logits_rows, jnp.asarray(temps), jnp.asarray(top_ks),
+            jnp.asarray(top_ps), jnp.asarray(keys),
+            jnp.asarray(counters),
+            jnp.ones((len(slot_indices),), bool))
+        return np.asarray(toks)
+
+    def _pack_greedy(self, items, sizes):
+        """Greedy bucket split shared by admission prefill and the
+        speculative verify: a batch closes when the next packed
+        sequence would overflow the [prefill_len] bucket or the
+        per-batch request cap — further items start another dispatch
+        of the SAME compiled program."""
         S, R = self.prefill_len, self.prefill_requests
         batches, cur, used = [], [], 0
-        for si in slot_indices:
-            n = len(sch.slots[si].request.prompt)
-            if n > S:
-                raise ValueError(
-                    f"prompt of request "
-                    f"{sch.slots[si].request.rid} ({n} tokens) exceeds "
-                    f"prefill_len={S}")
+        for item, n in zip(items, sizes):
             if cur and (used + n > S or len(cur) >= R):
                 batches.append(cur)
                 cur, used = [], 0
-            cur.append(si)
+            cur.append(item)
             used += n
         if cur:
             batches.append(cur)
-        # page table rows [num_slots + 1, max_pages]: the spare row is
-        # the padding tokens' all-null destination
+        return batches
+
+    def _packed_call(self, rows):
+        """ONE dispatch of the packed prefill program for pre-split
+        ``rows = [(slot_idx, fed_tokens, write_from, gather_pos)]`` —
+        the single assembly both admission prefill and speculative
+        verify go through, so the packing contract (segment ids 1..R,
+        padding -> the all-null spare row, positions below
+        ``write_from`` routing their K/V writes to that spare row,
+        within-sequence ``gather_pos`` filling the flat logits gather
+        at stride ``_gather_w``) cannot drift between the two callers.
+        Returns ``(logits, t0)`` — the caller fetches what it needs
+        and closes the ``device_dispatch_s`` timing seam."""
+        S, R, W = self.prefill_len, self.prefill_requests, self._gather_w
+        ids = np.zeros((S,), np.int32)
+        positions = np.zeros((S,), np.int32)
+        seg = np.zeros((S,), np.int32)
+        token_rows = np.full((S,), self.num_slots, np.int32)
+        gather_idx = np.zeros((R * W,), np.int32)
         pt = np.zeros((self.num_slots + 1, self.max_pages), np.int32)
-        pt[:self.num_slots] = sch.page_table_rows()
-        wall = None
+        pt[:self.num_slots] = self.scheduler.page_table_rows()
+        cursor = 0
+        for r, (si, fed, write_from, gathers) in enumerate(rows):
+            n = len(fed)
+            ids[cursor:cursor + n] = fed
+            positions[cursor:cursor + n] = np.arange(n)
+            seg[cursor:cursor + n] = r + 1
+            token_rows[cursor + write_from:cursor + n] = si
+            for j, gp in enumerate(gathers):
+                gather_idx[r * W + j] = cursor + gp
+            cursor += n
+        t0 = time.perf_counter()
+        self.cache, logits = self._prefill_fn(
+            self.cache, jnp.asarray(ids), jnp.asarray(positions),
+            jnp.asarray(seg), jnp.asarray(token_rows),
+            jnp.asarray(pt), jnp.asarray(gather_idx))
+        return logits, t0
+
+    def _run_prefill(self, slot_indices):
+        """Pack the newly admitted slots' prompts into [prefill_len]
+        batches and fill the cache (every prompt position writes its
+        slot's pages; the one logits gather per request reads the last
+        prompt token). Sets each slot's first decode token, and
+        registers fresh prompts with the prefix cache."""
+        sch = self.scheduler
+        for si in slot_indices:
+            n = len(sch.slots[si].request.prompt)
+            if n > self.prefill_len:
+                raise ValueError(
+                    f"prompt of request "
+                    f"{sch.slots[si].request.rid} ({n} tokens) exceeds "
+                    f"prefill_len={self.prefill_len}")
+        batches = self._pack_greedy(
+            slot_indices,
+            [len(sch.slots[si].request.prompt) for si in slot_indices])
         for batch in batches:
-            ids = np.zeros((S,), np.int32)
-            positions = np.zeros((S,), np.int32)
-            seg = np.zeros((S,), np.int32)
-            token_rows = np.full((S,), self.num_slots, np.int32)
-            last_idx = np.zeros((R,), np.int32)
-            cursor = 0
-            for r, si in enumerate(batch):
-                prompt = sch.slots[si].request.prompt
-                n = len(prompt)
-                ids[cursor:cursor + n] = prompt
-                positions[cursor:cursor + n] = np.arange(n)
-                seg[cursor:cursor + n] = r + 1
-                token_rows[cursor:cursor + n] = si
-                last_idx[r] = cursor + n - 1
-                cursor += n
-            t0 = time.perf_counter()
-            self.cache, logits = self._prefill_fn(
-                self.cache, jnp.asarray(ids), jnp.asarray(positions),
-                jnp.asarray(seg), jnp.asarray(token_rows),
-                jnp.asarray(pt), jnp.asarray(last_idx))
-            next_toks = np.asarray(
-                jnp.argmax(logits.astype(jnp.float32), axis=-1))
+            rows = [(si, sch.slots[si].request.prompt, 0,
+                     [len(sch.slots[si].request.prompt) - 1])
+                    for si in batch]
+            logits, t0 = self._packed_call(rows)
+            self.prefill_batches += 1
+            # rows r*W hold each request's last-prompt-token logits
+            sel = logits[np.arange(len(batch)) * self._gather_w]
+            next_toks = self._sample_first_tokens(sel, batch)
             wall = time.perf_counter()
             self.device_dispatch_s += wall - t0
             for r, si in enumerate(batch):
@@ -229,14 +443,116 @@ class ServingEngine:
                     if slot.request.done():
                         self.events.record("finished", rid,
                                            tick=self.tick, wall=wall)
+                # register the fresh prompt's pages with the prefix
+                # cache (between dispatches; tail snapshots copy here)
+                if self.prefix is not None:
+                    adopted, copies = self.prefix.register(
+                        slot.request.prompt, slot.pages,
+                        ("req", slot.request.rid))
+                    if adopted:
+                        self.prefix.acquire(adopted)
+                        slot.shared_pages.extend(adopted)
+                    for src, dst in copies:
+                        self._copy_page(src, dst)
         return slot_indices
+
+    # ------------------------------------------------------- speculative
+
+    def _propose_drafts(self, active):
+        """Draft proposals for this round: ``[(slot_idx, draft)]`` for
+        every greedy slot past its prompt whose n-gram draft exists,
+        fits the remaining token budget AND the verify window fits
+        the prefill bucket. Sampled (stochastic) slots never draft —
+        speculation is a greedy-path optimization."""
+        sch = self.scheduler
+        out = []
+        for i in active:
+            slot = sch.slots[i]
+            req = slot.request
+            if req.done() or slot.pos < len(req.prompt):
+                continue
+            sp = getattr(req, "sampling", None)
+            if sp is not None and not sp.greedy:
+                continue
+            remaining = req.max_new_tokens - len(req.out_tokens)
+            k = min(self.spec_k, remaining - 1,
+                    self.prefill_len - slot.pos - 1,
+                    self.max_seq - slot.pos - 1)
+            if k < 1:
+                continue
+            draft = spec_mod.propose(req.prompt + req.out_tokens, k)
+            if draft:
+                out.append((i, draft))
+        return out
+
+    def _run_verify(self, drafts):
+        """Verify drafted slots in dispatches of the SAME packed
+        prefill program: each slot's full sequence (prompt + generated
+        + draft) is one segment — context positions write the null
+        spare row (the cache keeps its decode-written K/V bit-exact),
+        pending+draft positions write the slot's pages, and the flat
+        gather reads the K+1 verify logits per slot. Acceptance and
+        rollback are pure length/index arithmetic
+        (``speculative.accept``); a slot gains 1..K+1 tokens."""
+        sch = self.scheduler
+        W = self._gather_w
+        batches = self._pack_greedy(
+            drafts,
+            [sch.slots[i].pos + 1 + len(d) for i, d in drafts])
+        verified = []
+        for batch in batches:
+            rows = []
+            for i, draft in batch:
+                slot = sch.slots[i]
+                req = slot.request
+                fed = req.prompt + req.out_tokens + draft
+                pos = slot.pos
+                assert len(fed) == pos + 1 + len(draft), (
+                    len(fed), pos, len(draft))
+                # context positions -> the all-null spare row (their
+                # decode-written K/V must survive bit-exact); only the
+                # pending token + draft positions write real pages
+                self._assert_writable(slot, pos, len(fed) - 1)
+                rows.append((i, fed, pos,
+                             list(range(pos, pos + len(draft) + 1))))
+            logits, t0 = self._packed_call(rows)
+            self.verify_calls += 1
+            greedy = np.asarray(jnp.argmax(
+                logits.astype(jnp.float32), axis=-1))
+            wall = time.perf_counter()
+            self.device_dispatch_s += wall - t0
+            for r, (i, draft) in enumerate(batch):
+                slot = sch.slots[i]
+                req = slot.request
+                chain = [int(t) for t in
+                         greedy[r * W:r * W + len(draft) + 1]]
+                added = spec_mod.accept(draft, chain)
+                # _propose_drafts capped k <= remaining - 1, so the
+                # round can never overshoot the token budget — named
+                # here so the stats line below stays honest by
+                # construction (it counts only produced tokens)
+                assert len(added) <= req.max_new_tokens \
+                    - len(req.out_tokens), (req.rid, added)
+                self.spec_stats.record(len(draft), len(added) - 1)
+                req.out_tokens.extend(added)
+                slot.pos = len(req.prompt) + len(req.out_tokens) - 1
+                slot.next_token = req.out_tokens[-1]
+                self.tokens_generated += len(added)
+                if req.done():
+                    req.finish_wall = wall
+                    if self.events is not None:
+                        self.events.record("finished", req.rid,
+                                           tick=self.tick, wall=wall)
+                verified.append(i)
+        return verified
 
     # ------------------------------------------------------------- steps
 
     def step(self, arrivals=None):
         """One scheduler round: enqueue due arrivals, evict, admit (+
-        prefill), decode every active slot. Returns a dict of what
-        happened (the dryrun/trace-replay surface)."""
+        prefill + prefix-hit COW), speculative verify, decode every
+        remaining active slot. Returns a dict of what happened (the
+        dryrun/trace-replay surface)."""
         sch = self.scheduler
         now = self.tick
         if arrivals:
@@ -251,27 +567,79 @@ class ServingEngine:
             for i in admitted:
                 self.events.record("admitted", sch.slots[i].request.rid,
                                    tick=now, wall=wall)
-        prefilled = self._run_prefill(admitted) if admitted else []
+        # prefix-cache hits skip the packed prefill: their COW copies
+        # run here (between dispatches) and their covered suffix
+        # replays through the decode program below
+        to_prefill = []
+        for i in admitted:
+            slot = sch.slots[i]
+            if slot.prefix_hit:
+                for src, dst in slot.cow_copies:
+                    self._copy_page(src, dst)
+                slot.cow_copies = []
+            else:
+                to_prefill.append(i)
+        prefilled = self._run_prefill(to_prefill) if to_prefill else []
         active = sch.active_indices()
+        verified = []
+        if self.spec_k and active:
+            drafts = self._propose_drafts(active)
+            if drafts:
+                verified = self._run_verify(drafts)
+        decode_lanes = [i for i in active if i not in verified]
         decoded = 0
-        if active:
+        if decode_lanes:
             tokens, lengths = sch.decode_inputs()
+            for i in verified:
+                lengths[i] = 0  # this round's tokens came via verify
             pt = np.asarray(sch.page_table_rows(), np.int32)
+            for i in decode_lanes:
+                self._assert_writable(sch.slots[i], sch.slots[i].pos,
+                                      sch.slots[i].pos)
+            args = [self.cache, jnp.asarray(tokens, dtype=jnp.int32),
+                    jnp.asarray(lengths, dtype=jnp.int32),
+                    jnp.asarray(pt)]
+            if self.sampling:
+                temps, top_ks, top_ps, keys, counters = \
+                    sampling_mod.lane_arrays(sch.slots, self.num_slots)
+                args += [jnp.asarray(temps), jnp.asarray(top_ks),
+                         jnp.asarray(top_ps), jnp.asarray(keys),
+                         jnp.asarray(counters)]
             t0 = time.perf_counter()
-            self.cache, next_toks, _ = self._decode_fn(
-                self.cache, jnp.asarray(tokens, dtype=jnp.int32),
-                jnp.asarray(lengths, dtype=jnp.int32), jnp.asarray(pt))
+            self.cache, next_toks, _ = self._decode_fn(*args)
             next_toks = np.asarray(next_toks)
             wall2 = time.perf_counter()
             self.device_dispatch_s += wall2 - t0
-            for i in active:
+            for i in decode_lanes:
                 slot = sch.slots[i]
+                p_len = len(slot.request.prompt)
+                consumed_pos = slot.pos
                 slot.pos += 1
+                if consumed_pos < p_len - 1:
+                    # prefix-hit warmup: the consumed token was a
+                    # prompt token with more to come — feed the next
+                    # one, discard the lane's output
+                    slot.next_token = slot.request.prompt[
+                        consumed_pos + 1]
+                    decoded += 1
+                    continue
                 if not slot.request.done():
                     tok = int(next_toks[i])
                     slot.request.out_tokens.append(tok)
                     slot.next_token = tok
                     self.tokens_generated += 1
+                    if consumed_pos == p_len - 1:
+                        # a prefix-hit slot's FIRST output token: its
+                        # warmup ended this round — the prefill-done /
+                        # first-token seam of the cached path
+                        if slot.request.first_token_wall is None:
+                            slot.request.first_token_wall = wall2
+                        if self.events is not None:
+                            rid = slot.request.rid
+                            self.events.record("prefill_done", rid,
+                                               tick=now, wall=wall2)
+                            self.events.record("first_token", rid,
+                                               tick=now, wall=wall2)
                     if slot.request.done():
                         slot.request.finish_wall = wall2
                         if self.events is not None:
@@ -284,6 +652,7 @@ class ServingEngine:
             # one gauge sample per scheduler round, AFTER the round's
             # device work (occupancy as the next round will see it)
             wall3 = time.perf_counter()
+            st, pf = self.spec_stats, self.prefix
             self.events.sample_gauges(
                 tick=now, wall=wall3,
                 slots_active=len(sch.active_indices()),
@@ -292,13 +661,17 @@ class ServingEngine:
                 kv_pages_live=(self.allocator.num_pages - 1
                                - self.allocator.free_count),
                 kv_pages_total=self.allocator.num_pages,
-                hol_wait_s=sch.head_of_line_wait(wall3))
+                hol_wait_s=sch.head_of_line_wait(wall3, tick=now),
+                spec_drafted=st.drafted if st is not None else 0,
+                spec_accepted=st.accepted if st is not None else 0,
+                prefix_hit_tokens=pf.hit_tokens
+                if pf is not None else 0)
         # a slot whose LAST token was just produced frees at the next
         # round's evict — one round of slack, never a starved queue
         self.tick += 1
         return {"tick": now, "evicted": [r.rid for r in evicted],
                 "admitted": admitted, "prefilled": prefilled,
-                "decoded_slots": decoded}
+                "verified": verified, "decoded_slots": decoded}
 
     def run_trace(self, requests, max_ticks=10000):
         """Replay a synthetic trace to completion: requests are

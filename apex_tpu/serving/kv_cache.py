@@ -85,6 +85,24 @@ class PageAllocator:
         for p in self._owned.pop(owner, ()):
             self._free.append(p)
 
+    def transfer(self, owner_from, owner_to, pages):
+        """Move specific ``pages`` between owners — the prefix-cache
+        adoption hop (ISSUE 13: a registering request's prompt pages
+        become cache-owned without round-tripping the free list, so
+        their K/V content is never up for reallocation mid-transfer).
+        Accounting only; the live set is unchanged. Raises when
+        ``owner_from`` does not own every page (state unchanged)."""
+        have = self._owned.get(owner_from, [])
+        missing = [p for p in pages if p not in have]
+        if missing:
+            raise ValueError(
+                f"pages {missing} are not owned by {owner_from!r}")
+        for p in pages:
+            have.remove(p)
+            self._owned.setdefault(owner_to, []).append(p)
+        if not have:
+            self._owned.pop(owner_from, None)
+
     def check_invariants(self):
         """Raise AssertionError on aliasing or accounting drift — the
         test surface for the paged-allocator invariants (ISSUE 10):

@@ -192,11 +192,15 @@ class EventLog:
 
     def sample_gauges(self, tick, wall, *, slots_active, num_slots,
                       queue_depth, kv_pages_live, kv_pages_total,
-                      hol_wait_s):
+                      hol_wait_s, spec_drafted=0, spec_accepted=0,
+                      prefix_hit_tokens=0):
         """One per-scheduler-round gauge sample (engine calls this at
         the end of each :meth:`ServingEngine.step`). Names mirror the
         registered telemetry metric specs (``telemetry.metrics``), so
-        a ``MetricsWriter`` can sink :meth:`gauge_rows` directly."""
+        a ``MetricsWriter`` can sink :meth:`gauge_rows` directly. The
+        generation counters (ISSUE 13) are CUMULATIVE: drafted /
+        accepted speculative tokens and prefix-cache hit tokens as of
+        this round — 0 whenever the feature is off."""
         self.gauges.append({
             "tick": tick, "wall": wall,
             "serve_slots_active": int(slots_active),
@@ -205,6 +209,9 @@ class EventLog:
             "serve_kv_pages_live": int(kv_pages_live),
             "serve_kv_pages_total": int(kv_pages_total),
             "serve_hol_wait_ms": round(float(hol_wait_s) * 1e3, 4),
+            "serve_spec_drafted": int(spec_drafted),
+            "serve_spec_accepted": int(spec_accepted),
+            "serve_prefix_hit_tokens": int(prefix_hit_tokens),
         })
 
     def gauge_rows(self, run=None):

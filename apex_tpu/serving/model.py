@@ -189,9 +189,12 @@ def prefill(params, cache, ids, positions, seg, token_rows, page_table,
     within-request positions, segment ids (0 = padding, 1..R real),
     and each token's row into ``page_table`` (padding rows point at
     the all-null spare row). page_table: ``[R_rows, max_pages]``.
-    last_idx: ``[R_max]`` pack index of each request's last prompt
-    token (inactive entries 0 — callers mask). Returns ``(cache,
-    logits_last [R_max, vocab])``.
+    last_idx: ``[G]`` flat pack indices to gather logits at (inactive
+    entries 0 — callers mask). Plain prefill gathers one index per
+    request (its last prompt token); the SPECULATIVE VERIFY dispatch
+    of this same program (ISSUE 13) gathers K+1 indices per request —
+    the pending-token + draft positions whose greedy chain decides
+    acceptance. Returns ``(cache, logits [G, vocab])``.
     """
     dtype = compute_dtype(cfg)
     hd, n_heads = cfg.head_dim, cfg.num_attention_heads

@@ -1,0 +1,314 @@
+"""Content-hashed, refcounted copy-on-write prefix cache (ISSUE 13).
+
+Stdlib-only host-side bookkeeping layered on the existing null-page-0
+:class:`~apex_tpu.serving.kv_cache.PageAllocator`: a page of K/V is a
+pure function of the token prefix that produced it, so two requests
+whose prompts share a page-aligned prefix can share the PAGES — the
+shared system prompt of ROADMAP 2c is prefilled once per engine, and
+every later request's page table simply points at the cached pages.
+
+Ownership model (the refcount/aliasing invariants
+``check_invariants`` extends):
+
+* **Full chain pages** are transferred from the registering request to
+  the cache (allocator owner ``("prefix", page)``) and FROZEN: holders
+  only ever write at positions past their prompt, which lie beyond a
+  full prefix page, so a shared full page is never written. Each page
+  carries a refcount = number of live slots whose table includes it;
+  eviction/reclaim refuses to free a page with live refs.
+* **The partial tail page** (a prompt whose length is not
+  page-aligned) IS written by every holder — its free rows are where
+  the first generated/suffix K/V land. It is therefore shared by COPY,
+  not by reference: registration snapshots it into a cache-owned page
+  (the engine performs the device copy — this module is stdlib-only
+  index bookkeeping), and every hit schedules a copy-on-write of that
+  snapshot into the hitting request's own private page at admission,
+  BEFORE any write can alias another request's stream. Tail snapshots
+  hold no refs and are reclaimable at any time.
+
+A hit never covers the full prompt: at least the LAST prompt token is
+left for the engine to run (its logits produce the request's first
+output token — logits are not cached, pages are). The covered suffix
+is consumed through the decode program one token per round (decode
+attends the cached pages — correct by construction), so no new
+compiled program exists for cache-hit warmup.
+
+Reclaim walks chains least-recently-used and frees ref-0 pages from
+each chain's TAIL backward (a chain stays prefix-valid — an interior
+page is never freed under a live descendant), stopping at the first
+referenced page. ``reclaim`` is called by the scheduler when admission
+runs short of free pages; pages with live refs are NEVER freed — the
+eviction-refusal invariant the churn tests pin.
+
+Knob: engine ``prefix_cache=`` per-call bool (non-bool raises) >
+``set_prefix_cache`` setter > ``APEX_SERVE_PREFIX_CACHE`` env
+preference > built-in OFF (measured-dispatch rule: the shared-prefill
+win is an expectation until the device A/B queued in PERF.md §2 runs).
+"""
+
+import hashlib
+
+from apex_tpu.dispatch import tiles as _tiles
+
+_PREFIX = None  # process-wide tri-state preference
+
+
+def set_prefix_cache(value):
+    """Pin the process-wide prefix-cache preference (True/False), or
+    un-pin with None. A setter CALL with a non-bool raises."""
+    global _PREFIX
+    if value is not None and not isinstance(value, bool):
+        raise ValueError(
+            f"set_prefix_cache wants True/False/None, got {value!r}")
+    _PREFIX = value
+
+
+def resolve(per_call=None):
+    """The effective prefix-cache decision: per-call (non-bool raises —
+    an explicit request is a demand) > setter >
+    ``APEX_SERVE_PREFIX_CACHE`` env (warn-once-and-ignore on unknown)
+    > built-in OFF."""
+    if per_call is not None:
+        if not isinstance(per_call, bool):
+            raise ValueError(
+                f"prefix_cache= wants True/False/None, got {per_call!r}")
+        return per_call
+    if _PREFIX is not None:
+        return _PREFIX
+    v = _tiles.env_choice("APEX_SERVE_PREFIX_CACHE", ("1", "0"))
+    if v is not None:
+        return v == "1"
+    return False
+
+
+def _page_hash(parent_hash, tokens):
+    """Chain hash of one page: sha1 over the parent chain hash + this
+    page's token content — a page is addressable only through the
+    exact prefix that produced its K/V."""
+    h = hashlib.sha1(parent_hash.encode())
+    h.update(repr(tuple(int(t) for t in tokens)).encode())
+    return h.hexdigest()
+
+
+ROOT = "prefix-root"
+
+
+class PrefixCache:
+    """Host-side chain store + refcounts over cache pages. The
+    allocator passed in is the engine's ONE allocator — cached pages
+    live in its accounting (owner ``("prefix", page)``), so the
+    existing aliasing/accounting invariants cover them too."""
+
+    def __init__(self, allocator, page_size):
+        self.allocator = allocator
+        self.page_size = int(page_size)
+        # chain hash -> {"page": int, "parent": hash, "ntok": int}
+        self.nodes = {}
+        # parent chain hash -> tail snapshot {"page": int,
+        # "tokens": tuple} (one per prefix; first registrant wins)
+        self.tails = {}
+        self.refs = {}           # page -> live slot reference count
+        self._lru = []           # chain-leaf hashes, oldest first
+        # accounting for the ledger's prefix_hit_rate
+        self.hit_tokens = 0
+        self.lookup_tokens = 0
+
+    # ------------------------------------------------------------ lookup
+
+    def lookup(self, prompt):
+        """Longest cached cover of ``prompt``: ``(full_pages, covered,
+        tail)`` where ``full_pages`` are shared-by-reference full chain
+        pages (covering ``len(full_pages) * page_size`` tokens),
+        ``covered`` counts ALL covered tokens and ``tail`` is the
+        ``(snapshot_page, ntok)`` copy-on-write source extending the
+        cover past the last full page (None when no tail matched).
+        Never covers the full prompt — the last token is always left
+        for the engine. Does NOT take references or count hit-rate
+        stats (``acquire`` / ``count`` do, once admission succeeds —
+        a head-of-line-blocked request re-looked-up every round must
+        not inflate the rate's denominator)."""
+        ps = self.page_size
+        full_pages, h, covered = [], ROOT, 0
+        while covered + ps < len(prompt):  # strict: keep >= 1 token
+            page_tokens = prompt[covered:covered + ps]
+            if len(page_tokens) < ps:
+                break
+            nh = _page_hash(h, page_tokens)
+            node = self.nodes.get(nh)
+            if node is None:
+                break
+            full_pages.append(node["page"])
+            h, covered = nh, covered + ps
+        tail = None
+        snap = self.tails.get(h)
+        if snap is not None:
+            ntok = len(snap["tokens"])
+            if 0 < ntok < ps and covered + ntok < len(prompt) \
+                    and tuple(prompt[covered:covered + ntok]) \
+                    == snap["tokens"]:
+                tail = (snap["page"], ntok)
+                covered += ntok
+        if covered and h != ROOT:
+            self._touch(h)
+        return full_pages, covered, tail
+
+    def count(self, prompt_tokens, covered):
+        """Bank one ADMITTED request's hit-rate sample (the ledger's
+        ``prefix_hit_rate`` = hit_tokens / lookup_tokens)."""
+        self.lookup_tokens += int(prompt_tokens)
+        self.hit_tokens += int(covered)
+
+    def acquire(self, pages):
+        """Take one reference per shared full page (admission
+        succeeded; the slot's table now includes them)."""
+        for p in pages:
+            self.refs[p] = self.refs.get(p, 0) + 1
+
+    def release(self, pages):
+        """Drop one reference per shared full page (the slot evicted).
+        Pages stay cached at ref 0 for future hits; ``reclaim`` frees
+        them under pressure."""
+        for p in pages:
+            n = self.refs.get(p, 0) - 1
+            assert n >= 0, f"prefix page {p} released below zero refs"
+            self.refs[p] = n
+
+    # ---------------------------------------------------------- register
+
+    def register(self, prompt, pages, owner):
+        """Adopt a freshly prefilled prompt's pages into the cache.
+        ``pages`` is the request's page list (its prompt-covering
+        prefix is what registers); ``owner`` is its allocator owner.
+        Genuinely NEW full chain pages are TRANSFERRED to cache
+        ownership (the registrant's table still reads them — the
+        caller must ``acquire`` the returned pages and release them at
+        eviction); chain pages that already exist leave this request's
+        private duplicates alone (first registrant wins). The partial
+        tail page (if any, and if a snapshot page can be allocated) is
+        shared by COPY: the returned ``copies`` are ``(src_page,
+        dst_page)`` device copies the ENGINE must perform (this module
+        never touches jax). Returns ``(adopted_pages, copies)``."""
+        ps = self.page_size
+        nfull = len(prompt) // ps
+        adopted, copies = [], []
+        h = ROOT
+        for i in range(nfull):
+            page_tokens = prompt[i * ps:(i + 1) * ps]
+            nh = _page_hash(h, page_tokens)
+            if nh not in self.nodes:
+                page = pages[i]
+                self.allocator.transfer(owner, ("prefix", page), [page])
+                self.nodes[nh] = {"page": page, "parent": h, "ntok": ps}
+                self.refs.setdefault(page, 0)
+                adopted.append(page)
+            h = nh
+        tail_tokens = tuple(int(t) for t in prompt[nfull * ps:])
+        if tail_tokens and h not in self.tails:
+            snap = self.allocator.alloc(("prefix-tail", h), 1)
+            if snap is not None:
+                self.tails[h] = {"page": snap[0], "tokens": tail_tokens}
+                copies.append((pages[nfull], snap[0]))
+        if h != ROOT:
+            self._touch(h)
+        return adopted, copies
+
+    def _touch(self, leaf_hash):
+        if leaf_hash in self._lru:
+            self._lru.remove(leaf_hash)
+        self._lru.append(leaf_hash)
+
+    # ----------------------------------------------------------- reclaim
+
+    def reclaim(self, n_pages, protect=()):
+        """Free up to ``n_pages`` cached pages back to the allocator,
+        least-recently-used chains first, each chain from its TAIL
+        backward, refusing any page with live references (the
+        eviction invariant) and any page in ``protect`` — the
+        scheduler passes the cover a pending admission just MATCHED,
+        so relieving page pressure can never free the very pages (or
+        COW tail source) that admission is about to reference.
+        Returns the number actually freed."""
+        freed = 0
+        protect = set(protect)
+        # tail snapshots first: they hold no refs by construction
+        for h in list(self.tails):
+            if freed >= n_pages:
+                break
+            snap = self.tails[h]
+            if snap["page"] in protect:
+                continue
+            del self.tails[h]
+            self.allocator.free(("prefix-tail", h))
+            self.refs.pop(snap["page"], None)
+            freed += 1
+        if freed >= n_pages:
+            return freed
+        children = {}
+        for nh, node in self.nodes.items():
+            children.setdefault(node["parent"], []).append(nh)
+        for leaf in list(self._lru):
+            h = leaf
+            while freed < n_pages and h != ROOT and h in self.nodes:
+                if children.get(h):
+                    break  # interior page under a live descendant
+                node = self.nodes[h]
+                if self.refs.get(node["page"], 0) > 0:
+                    break  # NEVER free a page with live refs
+                if node["page"] in protect:
+                    break  # matched by the admission in flight
+                page, parent = node["page"], node["parent"]
+                self.allocator.free(("prefix", page))
+                self.refs.pop(page, None)
+                del self.nodes[h]
+                if parent in children and h in children[parent]:
+                    children[parent].remove(h)
+                self.tails.pop(h, None)
+                freed += 1
+                h = parent
+            if h != leaf:
+                self._lru.remove(leaf)
+                if h != ROOT and h in self.nodes:
+                    self._touch(h)
+            if freed >= n_pages:
+                break
+        return freed
+
+    # -------------------------------------------------------- invariants
+
+    def cached_pages(self):
+        pages = [n["page"] for n in self.nodes.values()]
+        pages += [t["page"] for t in self.tails.values()]
+        return pages
+
+    def is_shared(self, page):
+        """Whether ``page`` is cache-owned (a write to it must COW)."""
+        return page in self.refs \
+            or any(t["page"] == page for t in self.tails.values())
+
+    def check_invariants(self):
+        """Raise AssertionError on refcount/aliasing drift — the
+        ISSUE 13 extension of the allocator's own check (which still
+        covers the global free/live accounting): every cached page is
+        allocator-live under a cache owner, refcounts are non-negative
+        and keyed only by cached full pages, chains are
+        parent-connected, and no page appears in two nodes."""
+        pages = self.cached_pages()
+        assert len(pages) == len(set(pages)), (
+            f"prefix page aliased across chain nodes: {sorted(pages)}")
+        live = set(self.allocator.live_pages())
+        for nh, node in self.nodes.items():
+            p = node["page"]
+            assert p in live, f"cached page {p} not allocator-live"
+            assert self.allocator.live_pages(("prefix", p)) == [p], (
+                f"cached page {p} not owned by the prefix cache")
+            parent = node["parent"]
+            assert parent == ROOT or parent in self.nodes, (
+                f"chain node {nh} orphaned (parent missing)")
+        for h, t in self.tails.items():
+            assert t["page"] in live, (
+                f"tail snapshot page {t['page']} not allocator-live")
+            assert 0 < len(t["tokens"]) < self.page_size
+        full = {n["page"] for n in self.nodes.values()}
+        for p, n in self.refs.items():
+            assert n >= 0, f"negative refcount on page {p}"
+            assert p in full, f"refcount on non-cached page {p}"

@@ -33,9 +33,26 @@ Scheduler POLICY is a dispatch choice, not an architecture constant
 (ROADMAP 2e: FIFO vs priority vs chunked prefill as measured
 dispatch): :func:`resolve_policy` keeps the CLAUDE.md asymmetry —
 per-call unknown policies raise, the ``APEX_SERVE_SCHED`` env
-preference warns once and falls back. Today the vocabulary is
-``("fifo",)``; the knob exists so the first alternative policy lands
-as a pinned A/B row, not a silent default flip.
+preference warns once and falls back. The vocabulary is ``("fifo",
+"priority")`` (ISSUE 13 — the PR 10 remainder): ``priority`` admits
+the queued request with the highest EFFECTIVE priority
+``request.priority + waiting_ticks / AGING_TICKS`` — the aging term
+is the no-starvation rule (any waiter eventually outranks every fixed
+priority; completion-of-everything is pinned by test) — with
+head-of-line blocking ON THE SELECTED request, so an urgent large
+request is never starved by smaller queue-jumpers either. The
+priority-vs-fifo tail-latency A/B under the diurnal trace is queued
+in PERF.md §2 (defaults stay ``fifo`` per the measured-dispatch
+rule).
+
+Prefix-cache hop (ISSUE 13): when the engine passes a
+:class:`~apex_tpu.serving.prefix_cache.PrefixCache`, admission looks
+the prompt up first — shared full pages enter the slot's table by
+REFERENCE (refcounted; only the uncovered remainder allocates), a
+matched partial tail page schedules a copy-on-write into the slot's
+first private page (``Slot.cow_copies`` — the ENGINE performs device
+copies), and a short free list asks the cache to ``reclaim``
+unreferenced pages before blocking.
 """
 
 import dataclasses
@@ -43,12 +60,15 @@ import hashlib
 import math
 import random
 from collections import deque
-from typing import List, Optional
+from typing import Any, List, Optional, Tuple
 
 from apex_tpu.dispatch import tiles as _tiles
 
 ARRIVALS = ("poisson", "diurnal")
-POLICIES = ("fifo",)
+POLICIES = ("fifo", "priority")
+# priority aging: one effective-priority level per this many waiting
+# ticks — the no-starvation clock of the priority policy
+AGING_TICKS = 8.0
 
 
 def resolve_policy(per_call=None):
@@ -70,6 +90,22 @@ class Request:
     prompt: List[int]
     max_new_tokens: int
     arrival: float = 0.0          # logical tick the request appears at
+    # scheduling priority (ISSUE 13, policy "priority": higher admits
+    # first, aged by waiting time; ignored under "fifo")
+    priority: int = 0
+    # per-request sampling controls (apex_tpu.serving.sampling
+    # .SamplingParams; None = greedy). Typed loosely: this module is
+    # stdlib-only and never imports the jax-backed sampling module —
+    # the ENGINE validates the params at submit.
+    sampling: Optional[Any] = None
+    # the request's private threefry key lane (uint32[2] host bytes,
+    # stamped by engine.submit so per-round lane staging is numpy-only)
+    rng_key: Optional[Any] = None
+    # tick the request actually ENTERED the queue (stamped by
+    # submit(tick=...) — the engine passes its round tick): the
+    # priority policy's aging base. None falls back to ``arrival``,
+    # so bare-scheduler callers keep today's semantics
+    queued_tick: Optional[float] = None
     # filled in by the engine/scheduler:
     out_tokens: List[int] = dataclasses.field(default_factory=list)
     enqueue_wall: Optional[float] = None
@@ -92,29 +128,41 @@ class Slot:
     pages: List[int]
     pos: int = 0                  # context length held in the cache
     next_token: int = 0           # token the next decode step consumes
+    # prefix-cache bookkeeping (ISSUE 13; all empty/zero when the
+    # cache is off or the prompt missed):
+    shared_pages: List[int] = dataclasses.field(default_factory=list)
+    prefix_hit: int = 0           # prompt tokens covered by the cache
+    # (src, dst) page copies the ENGINE must perform before the slot's
+    # first write — the copy-on-write of a matched partial tail page
+    cow_copies: List[Tuple[int, int]] = dataclasses.field(
+        default_factory=list)
 
 
 class ContinuousBatchingScheduler:
     def __init__(self, num_slots, max_pages_per_slot, page_size,
-                 allocator, policy=None):
+                 allocator, policy=None, prefix=None):
         self.num_slots = int(num_slots)
         self.max_pages = int(max_pages_per_slot)
         self.page_size = int(page_size)
         self.allocator = allocator
         self.policy = resolve_policy(policy)
+        self.prefix = prefix      # PrefixCache or None (engine-owned)
         self.slots = [None] * self.num_slots
         self.queue = deque()
         self.completed = []
 
     # ------------------------------------------------------- bookkeeping
 
-    def submit(self, request):
+    def submit(self, request, tick=None):
         """Enqueue one request. An impossible request (prompt +
         max_new_tokens over the per-slot page table, i.e. over
         max_seq) raises HERE — before anything is enqueued — so one
         malformed submission can never crash a later scheduler round
         mid-step and take the whole serving loop (and every other
-        queued request) down with it."""
+        queued request) down with it. ``tick`` stamps
+        ``queued_tick`` — the priority policy ages WAITING time, not
+        absolute tick, so a late direct submission gets no spurious
+        boost."""
         if request.max_new_tokens < 1:
             raise ValueError(
                 f"request {request.rid}: max_new_tokens must be >= 1 "
@@ -125,6 +173,8 @@ class ContinuousBatchingScheduler:
                 f"request {request.rid}: {need} pages exceed the "
                 f"per-slot table ({self.max_pages}) — prompt + "
                 f"max_new_tokens over max_seq")
+        if tick is not None and request.queued_tick is None:
+            request.queued_tick = tick
         self.queue.append(request)
 
     def active_indices(self):
@@ -142,28 +192,70 @@ class ContinuousBatchingScheduler:
     def queue_depth(self):
         return len(self.queue)
 
-    def head_of_line_wait(self, wall_time):
-        """Seconds the oldest queued request has been waiting at
-        ``wall_time`` (0.0 with an empty queue or unstamped head) —
-        the gauge that names head-of-line blocking as a number."""
+    def head_of_line_wait(self, wall_time, tick=None):
+        """Seconds the BLOCKING request has been waiting at
+        ``wall_time`` (0.0 with an empty queue or an unstamped head)
+        — the gauge that names head-of-line blocking as a number.
+        Under ``fifo`` that is the oldest queued request; under
+        ``priority`` admission blocks on :meth:`_select`'s pick, so
+        the gauge follows it (``tick`` feeds the aging term — the
+        engine passes its round tick)."""
         if not self.queue:
             return 0.0
-        head = self.queue[0].enqueue_wall
-        if head is None:
+        head = self._select(tick if tick is not None else 0)
+        if head.enqueue_wall is None:
             return 0.0
-        return max(0.0, wall_time - head)
+        return max(0.0, wall_time - head.enqueue_wall)
+
+    def _select(self, tick):
+        """The admission candidate under the active policy: the queue
+        head under ``fifo``; under ``priority`` the request with the
+        highest EFFECTIVE priority (``priority + waiting_ticks /
+        AGING_TICKS`` — the aging term is the no-starvation rule),
+        oldest-first on ties. Head-of-line blocking applies to the
+        SELECTED request either way."""
+        if self.policy == "fifo" or len(self.queue) == 1:
+            return self.queue[0]
+        best, best_key = None, None
+        for pos, r in enumerate(self.queue):
+            queued = r.queued_tick if r.queued_tick is not None \
+                else r.arrival
+            eff = r.priority + max(0.0, tick - queued) / AGING_TICKS
+            key = (-eff, pos)     # pos = submit order (FIFO tie-break)
+            if best_key is None or key < best_key:
+                best, best_key = r, key
+        return best
+
+    def _alloc_with_reclaim(self, owner, n, protect=()):
+        """Allocator grant with prefix-cache pressure relief: a short
+        free list asks the cache to reclaim unreferenced pages first
+        (pages with live refs are NEVER freed — the cache refuses;
+        ``protect`` additionally fences the cover THIS admission just
+        matched, so reclaim can never free-and-rehand the pages its
+        own request is about to share), then retries once."""
+        pages = self.allocator.alloc(owner, n)
+        if pages is None and self.prefix is not None:
+            shortfall = n - self.allocator.free_count
+            if self.prefix.reclaim(shortfall,
+                                   protect=protect) >= shortfall:
+                pages = self.allocator.alloc(owner, n)
+        return pages
 
     def admit(self, tick, wall_time=None):
-        """FIFO admission of every queued request that fits, stopping
-        at the first that does not (head-of-line blocking — the
-        no-starvation rule). Returns the newly filled slot indices.
-        ``wall_time`` (the engine's host clock, one read per round)
-        stamps each admission's ``admitted_wall`` — the same wall
-        seam as :meth:`evict_done`, so replay latencies are seconds,
-        not tick counts."""
+        """Admission of every queued request that fits under the
+        active policy, stopping at the first selected candidate that
+        does not (head-of-line blocking — the no-starvation rule).
+        Returns the newly filled slot indices. ``wall_time`` (the
+        engine's host clock, one read per round) stamps each
+        admission's ``admitted_wall`` — the same wall seam as
+        :meth:`evict_done`, so replay latencies are seconds, not tick
+        counts. With a prefix cache attached, the prompt's cached
+        cover enters the slot by reference (full pages) and
+        copy-on-write (partial tail), and only the remainder
+        allocates."""
         admitted = []
         while self.queue:
-            req = self.queue[0]
+            req = self._select(tick)
             free = [i for i, s in enumerate(self.slots) if s is None]
             need = self._request_pages(req)
             # submit() already refused impossible requests; anything
@@ -171,12 +263,34 @@ class ContinuousBatchingScheduler:
             assert need <= self.max_pages, (req.rid, need)
             if not free:
                 break
-            pages = self.allocator.alloc(("req", req.rid), need)
+            shared, covered, tail = [], 0, None
+            if self.prefix is not None:
+                shared, covered, tail = self.prefix.lookup(req.prompt)
+            matched = list(shared) + ([tail[0]] if tail else [])
+            pages = self._alloc_with_reclaim(("req", req.rid),
+                                             need - len(shared),
+                                             protect=matched)
             if pages is None:
                 break
-            self.queue.popleft()
+            self.queue.remove(req)
             idx = free[0]
-            self.slots[idx] = Slot(request=req, pages=pages)
+            slot = Slot(request=req, pages=shared + pages,
+                        shared_pages=list(shared), prefix_hit=covered)
+            if covered:
+                # the covered suffix replays through decode: position
+                # `covered` is the first token the engine feeds
+                slot.pos = covered
+                slot.next_token = req.prompt[covered]
+                if tail is not None:
+                    # COW: the snapshot's content lands in the slot's
+                    # first private page (same page index) before any
+                    # write can alias another request's stream
+                    slot.cow_copies.append((tail[0], pages[0]))
+            if shared:
+                self.prefix.acquire(shared)
+            if self.prefix is not None:
+                self.prefix.count(len(req.prompt), covered)
+            self.slots[idx] = slot
             req.admitted_tick = tick
             if wall_time is not None:
                 req.admitted_wall = wall_time
@@ -185,13 +299,18 @@ class ContinuousBatchingScheduler:
 
     def evict_done(self, tick, wall_time=None):
         """Free slots/pages of completed requests; returns them.
-        ``wall_time`` backstops ``finish_wall`` for requests whose
-        finishing dispatch did not stamp it (the one wall-clock seam
-        shared with :meth:`admit`)."""
+        Private pages return to the free list; shared prefix pages
+        only DECREF (the cache refuses to free referenced pages — a
+        completed request's shared system prompt stays warm for the
+        next arrival). ``wall_time`` backstops ``finish_wall`` for
+        requests whose finishing dispatch did not stamp it (the one
+        wall-clock seam shared with :meth:`admit`)."""
         done = []
         for i, slot in enumerate(self.slots):
             if slot is not None and slot.request.done():
                 self.allocator.free(("req", slot.request.rid))
+                if slot.shared_pages and self.prefix is not None:
+                    self.prefix.release(slot.shared_pages)
                 slot.request.finished_tick = tick
                 if wall_time is not None \
                         and slot.request.finish_wall is None:
@@ -228,7 +347,8 @@ class ContinuousBatchingScheduler:
 def synthetic_trace(seed=0, n_requests=16, vocab=256, prompt_lo=4,
                     prompt_hi=24, new_lo=4, new_hi=32,
                     mean_interarrival=0.5, arrival="poisson",
-                    diurnal_period=32.0, diurnal_depth=0.8):
+                    diurnal_period=32.0, diurnal_depth=0.8,
+                    system_prompt=None):
     """Deterministic request trace: ``(requests, trace_id)``. Arrival
     is in decode-step ticks; the id is a content hash of every
     request's (arrival, prompt, max_new) so a cited serving row names
@@ -246,6 +366,12 @@ def synthetic_trace(seed=0, n_requests=16, vocab=256, prompt_lo=4,
       ``diurnal_depth`` in [0, 1) (floored at 5% of base so the
       trough never stalls the trace) — peak-hour bursts and
       night-trough droughts in one seeded, content-hashed trace.
+
+    ``system_prompt`` (ISSUE 13): an optional shared token prefix
+    prepended to EVERY request's prompt — the shared-system-prompt
+    workload the prefix cache exists for. The content hash covers the
+    final (prepended) prompts, so a trace with a system prompt never
+    shares a ``tr-`` id with one without.
     """
     if arrival not in ARRIVALS:
         raise ValueError(f"unknown arrival process {arrival!r} "
@@ -263,6 +389,8 @@ def synthetic_trace(seed=0, n_requests=16, vocab=256, prompt_lo=4,
             t += rng.expovariate(rate)
         plen = rng.randint(prompt_lo, prompt_hi)
         prompt = [rng.randrange(vocab) for _ in range(plen)]
+        if system_prompt:
+            prompt = [int(t) for t in system_prompt] + prompt
         reqs.append(Request(
             rid=rid, prompt=prompt,
             max_new_tokens=rng.randint(new_lo, new_hi),

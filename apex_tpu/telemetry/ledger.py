@@ -354,6 +354,22 @@ def validate_record(rec):
             if not (isinstance(kp, int) and not isinstance(kp, bool)
                     and kp > 0):
                 problems.append("serving.kv_pages is not a positive int")
+            # generation fields (ISSUE 13): None-when-disabled is the
+            # legal degradation; a present value must be a sane number
+            # — a malformed rate could claim a speculation win no
+            # verify chain produced. Absent fields are legacy rows.
+            for field in ("spec_acceptance_rate", "prefix_hit_rate"):
+                v = sv.get(field)
+                if v is not None and (not isinstance(v, (int, float))
+                                      or isinstance(v, bool)
+                                      or not 0.0 <= v <= 1.0):
+                    problems.append(
+                        f"serving.{field} is not in [0, 1]")
+            dl = sv.get("draft_len")
+            if dl is not None and (not isinstance(dl, (int, float))
+                                   or isinstance(dl, bool) or dl < 0):
+                problems.append(
+                    "serving.draft_len is not a non-negative number")
     slo = rec.get("slo")
     if slo is not None:
         # the SLO block (apex_tpu.serving.lifecycle.slo_block, ISSUE

@@ -127,6 +127,15 @@ register("serve_kv_pages_total", unit="pages",
          description="KV cache page capacity (incl. reserved null page)")
 register("serve_hol_wait_ms", unit="ms",
          description="age of the head-of-line queued request")
+register("serve_spec_drafted", unit="tokens",
+         description="cumulative speculative draft tokens proposed "
+                     "(ISSUE 13; 0 with spec decode off)")
+register("serve_spec_accepted", unit="tokens",
+         description="cumulative speculative draft tokens accepted "
+                     "by the verify program")
+register("serve_prefix_hit_tokens", unit="tokens",
+         description="cumulative prompt tokens served from the "
+                     "prefix cache (0 with the cache off)")
 
 
 # --------------------------------------------------------------------------

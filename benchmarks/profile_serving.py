@@ -68,8 +68,11 @@ from apex_tpu.serving import (  # noqa: E402
 )
 from apex_tpu.serving import lifecycle  # noqa: E402
 from apex_tpu.serving import model as smodel  # noqa: E402
+from apex_tpu.serving import prefix_cache as prefix_mod  # noqa: E402
 from apex_tpu.serving import quant as quant_mod  # noqa: E402
+from apex_tpu.serving import sampling as sampling_mod  # noqa: E402
 from apex_tpu.serving import scheduler as sched_mod  # noqa: E402
+from apex_tpu.serving import speculative as spec_mod  # noqa: E402
 from apex_tpu.telemetry import costs as _costs  # noqa: E402
 from apex_tpu.telemetry.costs import V5E_PEAK_BF16_FLOPS as PEAK  # noqa: E402
 from apex_tpu.transformer.testing import TransformerConfig  # noqa: E402
@@ -128,6 +131,20 @@ ARRIVALS = _tiles.env_choice("APEX_SERVE_ARRIVALS",
 os.environ["APEX_SERVE_ARRIVALS"] = ARRIVALS
 POLICY = sched_mod.resolve_policy()
 os.environ["APEX_SERVE_SCHED"] = POLICY
+
+# ...and the GENERATION knobs (ISSUE 13, check 8 teeth): speculative
+# draft length, sampling, prefix cache — resolved once, pinned back
+# into the env BEFORE the engines build (they re-resolve from these
+# very pins), so the record's knobs name exactly the programs the
+# replay ran. The rungs ride run_all_tpu.sh's dead-last serving rows
+# (serving_sampling / serving_spec / serving_prefix) and their A/Bs
+# are queued in PERF.md §2.
+SPEC_K = spec_mod.resolve_k()
+os.environ["APEX_SPEC_DECODE"] = str(SPEC_K)
+SAMPLING = sampling_mod.resolve()
+os.environ["APEX_SERVE_SAMPLING"] = "1" if SAMPLING else "0"
+PREFIX = prefix_mod.resolve()
+os.environ["APEX_SERVE_PREFIX_CACHE"] = "1" if PREFIX else "0"
 SLO_TTFT_MS = lifecycle.env_ms("APEX_SERVE_SLO_TTFT_MS",
                                lifecycle.DEFAULT_SLO_TTFT_MS)
 SLO_TPOT_MS = lifecycle.env_ms("APEX_SERVE_SLO_TPOT_MS",
@@ -145,8 +162,10 @@ n_params = sum(x.size for x in jax.tree_util.tree_leaves(engine.params))
 TRACER = Tracer(K, peak_flops=PEAK)
 print(f"serving: {n_params / 1e6:.1f}M params, {SLOTS} slots, "
       f"{PAGES} pages x {PS}, quant={'int8' if WQ else 'off'}, "
-      f"decode-attn={IMPL}   (method: {K}-step decode scan, "
-      f"dispatch overhead {TRACER.overhead_ms:.1f} ms subtracted)")
+      f"decode-attn={IMPL}, sampling={'on' if SAMPLING else 'off'}, "
+      f"spec={SPEC_K or 'off'}, "
+      f"prefix={'on' if PREFIX else 'off'}   (method: {K}-step decode "
+      f"scan, dispatch overhead {TRACER.overhead_ms:.1f} ms subtracted)")
 
 # ------------------------------------------- row 1: decode scan (full)
 # Fill every slot (prompt + one engine step), then harvest the cache /
@@ -174,9 +193,20 @@ def make_decode_scan(eps, pt):
         # consume eps so warm and timed dispatches differ in a traced
         # value (the §0 result-caching rule); semantically zero
         tokens = tokens + (eps * 0.0).astype(jnp.int32)
-        cache, nxt, _ = smodel.decode_step(
+        cache, nxt, logits = smodel.decode_step(
             engine.params, cache, tokens, lengths, pt, cfg=cfg,
             qparams=qparams, interpret=engine.interpret)
+        if SAMPLING:
+            # the pinned program includes the sampling ops (greedy
+            # lane params — exact argmax) so the scan row times the
+            # SAME decode program the sampling-on replay dispatches;
+            # label and program stay one thing (check 8)
+            nxt = sampling_mod.sample_tokens(
+                logits, jnp.zeros((SLOTS,), jnp.float32),
+                jnp.zeros((SLOTS,), jnp.int32),
+                jnp.ones((SLOTS,), jnp.float32),
+                jnp.zeros((SLOTS, 2), jnp.uint32),
+                jnp.zeros((SLOTS,), jnp.int32), lengths > 0)
         return (cache, nxt, lengths + 1), nxt[0]
     return body
 
@@ -201,11 +231,31 @@ if not compile_cache.warm_only():
     import time
 
     n_req = 6 if SMOKE else 32
+    # with the prefix cache armed, the trace models the workload the
+    # cache exists for: one shared system prompt per fleet (content-
+    # hashed into the tr- id, so the label names the prepended trace)
+    sys_prompt = None
+    if PREFIX:
+        # span one full page + a partial tail so BOTH sharing modes
+        # (by-reference full pages, copy-on-write tail) are measured
+        sys_len = PS + PS // 2
+        sys_prompt = [int(t) for t in np.random.RandomState(123)
+                      .randint(0, cfg.vocab_size, sys_len)]
+    new_hi = min(24, MAX_SEQ - 32)
+    prompt_hi = min(24, PRE_LEN // 2)
+    if sys_prompt:
+        # the prepended system prompt rides inside the same max_seq /
+        # prefill_len budgets — shrink the drawn part so no request
+        # can overflow the per-slot page table
+        prompt_hi = max(4, min(prompt_hi,
+                               MAX_SEQ - new_hi - len(sys_prompt),
+                               PRE_LEN - len(sys_prompt)))
     trace, trace_id = synthetic_trace(
         seed=7, n_requests=n_req, vocab=cfg.vocab_size,
-        prompt_lo=4, prompt_hi=min(24, PRE_LEN // 2),
-        new_lo=4, new_hi=min(24, MAX_SEQ - 32),
-        mean_interarrival=0.5, arrival=ARRIVALS)
+        prompt_lo=4, prompt_hi=prompt_hi,
+        new_lo=4, new_hi=new_hi,
+        mean_interarrival=0.5, arrival=ARRIVALS,
+        system_prompt=sys_prompt)
     # lifecycle collection ON for the replay engine only (the scan
     # row above measured the device program, not host bookkeeping);
     # reset to the env default right after the ctor captured the gate
@@ -227,6 +277,11 @@ if not compile_cache.warm_only():
     p50 = lats[len(lats) // 2]
     p99 = lats[min(len(lats) - 1, int(len(lats) * 0.99))]
     replay_tps = replay.tokens_generated / wall
+    gen = replay.generation_stats()
+
+    def _r4(v):
+        return None if v is None else round(v, 4)
+
     serving_block = {
         "tokens_per_s": round(replay_tps, 2),
         "scan_tokens_per_s": None if scan_tps is None
@@ -235,14 +290,35 @@ if not compile_cache.warm_only():
         "trace_id": trace_id, "kv_pages": PAGES,
         "requests": len(done),
         "decode_steps": replay.decode_steps,
+        # generation economics (ISSUE 13): None-when-disabled —
+        # degradation, never omission (check 8 refuses a non-None
+        # rate whose selecting knob is unpinned or off)
+        "spec_acceptance_rate": _r4(gen["spec_acceptance_rate"]),
+        "draft_len": _r4(gen["draft_len"]),
+        "prefix_hit_rate": _r4(gen["prefix_hit_rate"]),
     }
     print(f"{'trace replay':28s} {len(done)} req, "
           f"{replay.tokens_generated} tok in {wall:.2f}s -> "
           f"{replay_tps:.0f} tok/s, p50 {p50:.1f} ms, p99 {p99:.1f} ms "
           f"[{trace_id}]")
+    gen_bits = []
+    if serving_block["spec_acceptance_rate"] is not None:
+        gen_bits.append(
+            f"spec acceptance {serving_block['spec_acceptance_rate']:.0%}"
+            f" over {replay.verify_calls} verify call(s), mean draft "
+            f"{serving_block['draft_len']:g}")
+    if serving_block["prefix_hit_rate"] is not None:
+        gen_bits.append(
+            f"prefix hit {serving_block['prefix_hit_rate']:.0%}")
+    if gen_bits:
+        print(f"{'generation':28s} {', '.join(gen_bits)}")
     assert replay.decode_cache_size() == 1, (
         "decode step recompiled during the trace — the scheduler "
         "changed a shape (jaxpr-stability contract broken)")
+    assert replay.prefill_cache_size() <= 1, (
+        "prefill program compiled more than once — a speculative "
+        "verify batch took a third compiled program (ISSUE 13 "
+        "contract broken)")
     order_problems = replay.events.validate_order()
     assert not order_problems, (
         "lifecycle event-order invariant broken", order_problems)
@@ -284,6 +360,8 @@ rid = TRACER.flush_ledger("profile_serving", extra={
                "params_m": round(n_params / 1e6, 1),
                "weight_quant": WQ, "decode_impl": IMPL,
                "arrivals": ARRIVALS, "policy": POLICY,
+               "sampling": SAMPLING, "spec_decode": SPEC_K,
+               "prefix_cache": PREFIX,
                "slo_ttft_ms": SLO_TTFT_MS,
                "slo_tpot_ms": SLO_TPOT_MS}})
 if rid:

@@ -204,6 +204,16 @@ fi
 # compile set + the K-scan row + the lifecycle-logged trace replay.
 if [ "${APEX_SERVE_BENCH:-}" = "1" ]; then
 run serving          1800 python benchmarks/profile_serving.py
+# Generation A/B rungs (ISSUE 13), each pinned against the base row
+# above: batched sampling compiled into the decode program (greedy
+# lanes — the pure program-cost delta), self-drafting speculative
+# decode (verify through the SAME prefill program; acceptance rate in
+# the serving block), and the refcounted prefix cache over a shared
+# system prompt (hit rate in the serving block). Defaults stay OFF
+# until these rows land (measured-dispatch rule, PERF.md §2).
+run serving_sampling 1800 env APEX_SERVE_SAMPLING=1 python benchmarks/profile_serving.py
+run serving_spec     1800 env APEX_SPEC_DECODE=4 python benchmarks/profile_serving.py
+run serving_prefix   1800 env APEX_SERVE_PREFIX_CACHE=1 python benchmarks/profile_serving.py
 fi
 
 echo "=== done; feed the logs into PERF.md"

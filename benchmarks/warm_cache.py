@@ -239,15 +239,25 @@ def main():
     # dispatches; the SLO replay itself is host work the warm-only
     # mode skips (it runs nothing, so there is nothing to warm there).
     if env_flag("APEX_SERVE_BENCH"):
-        if "serving" in cashed:
-            print("warm profile_serving: skipped (row cashed in the "
-                  "round manifest)", flush=True)
-        else:
-            warm_target(
-                "profile_serving",
-                [sys.executable,
-                 os.path.join(REPO, "benchmarks", "profile_serving.py")],
-                {}, timeout)
+        serving_py = os.path.join(REPO, "benchmarks",
+                                  "profile_serving.py")
+        # the generation rungs (ISSUE 13) ride the same armed knob:
+        # each pins its generation knob the way the measured row will
+        # (sampling changes the decode program; spec changes the
+        # prefill gather width; prefix changes nothing compiled but
+        # rides along so the cache key set matches the measured env)
+        for row, extra in (("serving", {}),
+                           ("serving_sampling",
+                            {"APEX_SERVE_SAMPLING": "1"}),
+                           ("serving_spec", {"APEX_SPEC_DECODE": "4"}),
+                           ("serving_prefix",
+                            {"APEX_SERVE_PREFIX_CACHE": "1"})):
+            if row in cashed:
+                print(f"warm {row}: skipped (row cashed in the round "
+                      f"manifest)", flush=True)
+                continue
+            warm_target(row, [sys.executable, serving_py], extra,
+                        timeout)
 
     from apex_tpu import compile_cache
 

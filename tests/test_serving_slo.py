@@ -224,24 +224,32 @@ def test_diurnal_rate_actually_modulates():
 
 def test_arrival_and_policy_asymmetry(monkeypatch):
     """Per-call unknown arrival/policy RAISES; env preferences warn
-    once and fall back (the CLAUDE.md knob asymmetry)."""
+    once and fall back (the CLAUDE.md knob asymmetry). ``priority``
+    entered the vocabulary in ISSUE 13 — it now resolves both ways."""
     with pytest.raises(ValueError, match="unknown arrival"):
         synthetic_trace(arrival="bursty")
     with pytest.raises(ValueError, match="unknown scheduler policy"):
-        resolve_policy("priority")
+        resolve_policy("lifo")
     with pytest.raises(ValueError, match="unknown scheduler policy"):
         ContinuousBatchingScheduler(2, 4, 8, PageAllocator(16),
-                                    policy="priority")
+                                    policy="lifo")
     from apex_tpu.dispatch import tiles
 
     tiles._warned_env.clear()
-    monkeypatch.setenv("APEX_SERVE_SCHED", "priority")
-    with pytest.warns(UserWarning, match="priority"):
+    monkeypatch.setenv("APEX_SERVE_SCHED", "lifo")
+    with pytest.warns(UserWarning, match="lifo"):
         assert resolve_policy() == "fifo"
     monkeypatch.setenv("APEX_SERVE_SCHED", "fifo")
     assert resolve_policy() == "fifo"
+    monkeypatch.setenv("APEX_SERVE_SCHED", "priority")
+    assert resolve_policy() == "priority"
+    assert resolve_policy("fifo") == "fifo"  # per-call beats env
+    monkeypatch.delenv("APEX_SERVE_SCHED")
     assert ContinuousBatchingScheduler(
         2, 4, 8, PageAllocator(16)).policy == "fifo"
+    assert ContinuousBatchingScheduler(
+        2, 4, 8, PageAllocator(16), policy="priority").policy \
+        == "priority"
 
 
 def test_env_ms_preference_semantics(monkeypatch):

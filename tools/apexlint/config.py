@@ -29,7 +29,8 @@ REFERENCE_ROOT = "/root/reference"
 # the one-home env parsers (dispatch/tiles.py + the lifecycle delegate)
 # — a knob read THROUGH these is never a raw read, wherever it happens
 ENV_HELPERS = frozenset(
-    {"env_int", "env_choice", "env_float", "env_flag", "env_ms"})
+    {"env_int", "env_nonneg_int", "env_choice", "env_float",
+     "env_flag", "env_ms"})
 
 # ---------------------------------------------------------------------------
 # APX002 — designated readers: (file, knob-or-prefix*, why this file is
@@ -133,6 +134,8 @@ STDLIB_ONLY_CLAIMED = (
     "apex_tpu/dispatch/__init__.py",
     "apex_tpu/serving/scheduler.py",
     "apex_tpu/serving/lifecycle.py",
+    "apex_tpu/serving/speculative.py",
+    "apex_tpu/serving/prefix_cache.py",
     "apex_tpu/compile_cache/__init__.py",
     "apex_tpu/telemetry/ledger.py",
     "apex_tpu/telemetry/costs.py",

@@ -86,7 +86,12 @@ Checks:
    setter alone carries no pin the label can be checked against —
    same teeth as checks 6-7. The harness stamps the RESOLVED values
    into its environment before the ledger write, so an unpinned run
-   cannot produce a citable serving row.
+   cannot produce a citable serving row. Generation fields (ISSUE
+   13): a block with a non-None ``spec_acceptance_rate`` /
+   ``prefix_hit_rate`` was measured with speculative decode / the
+   prefix cache ENGAGED and must pin ``APEX_SPEC_DECODE`` /
+   ``APEX_SERVE_PREFIX_CACHE`` at a non-off value — a rate under an
+   off (or missing) pin names a program the label did not run.
 9. **SLO pin-match** — a cited record carrying an ``slo`` block
    (``apex_tpu.serving.lifecycle.slo_block``: TTFT/per-token
    percentiles, goodput, SLO attainment under a named arrival
@@ -232,7 +237,13 @@ def serving_problems(rec, rid):
     the record carries no serving block. Both serving dispatch knobs
     must be PRESENT in the record's knobs — the resolved value is what
     the label pins; absence means the choice came from a setter or a
-    default the citation cannot be audited against."""
+    default the citation cannot be audited against. Generation teeth
+    (ISSUE 13): a block whose ``spec_acceptance_rate`` is non-None was
+    measured with speculative decode ENGAGED, so it must pin
+    ``APEX_SPEC_DECODE`` (and its pin must not be the off value 0 —
+    an acceptance rate under a spec-off pin names a program the label
+    did not run); same for ``prefix_hit_rate`` and
+    ``APEX_SERVE_PREFIX_CACHE``."""
     sv = rec.get("serving")
     if not isinstance(sv, dict):
         return []
@@ -244,6 +255,22 @@ def serving_problems(rec, rid):
                 f"record {rid} carries a serving block but does not pin "
                 f"{knob} in its knobs — an unpinned serving row cannot "
                 f"be cited")
+    for field, knob, off in (
+            ("spec_acceptance_rate", "APEX_SPEC_DECODE", "0"),
+            ("prefix_hit_rate", "APEX_SERVE_PREFIX_CACHE", "0")):
+        if sv.get(field) is None:
+            continue
+        pin = knobs.get(knob)
+        if pin is None:
+            problems.append(
+                f"record {rid} carries serving.{field}="
+                f"{sv[field]!r} but does not pin {knob} in its knobs "
+                f"— an unpinned speculative/prefix row cannot be cited")
+        elif str(pin) == off:
+            problems.append(
+                f"record {rid} carries serving.{field}={sv[field]!r} "
+                f"but pins {knob}={pin!r} (off) — the block and the "
+                f"label name different programs")
     return problems
 
 

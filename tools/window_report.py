@@ -186,6 +186,10 @@ def ledger_summary(records):
                 "tokens_per_s": sv.get("tokens_per_s"),
                 "scan_tokens_per_s": sv.get("scan_tokens_per_s"),
                 "kv_pages": sv.get("kv_pages"),
+                # generation economics (ISSUE 13): None-when-disabled
+                "spec_acceptance_rate": sv.get("spec_acceptance_rate"),
+                "draft_len": sv.get("draft_len"),
+                "prefix_hit_rate": sv.get("prefix_hit_rate"),
                 "slo": slo,
             })
     ts = [r["ts"] for r in records
@@ -340,6 +344,21 @@ def print_report(report, out=None):
                 if scan:
                     line += f" vs {scan:g} tok/s decode-scan upper line"
                 p(line)
+                # generation economics (ISSUE 13): the speculation and
+                # prefix-sharing levers, printed only when measured —
+                # None-when-disabled never renders a phantom rate
+                gen = []
+                if s.get("spec_acceptance_rate") is not None:
+                    gen.append(
+                        f"spec acceptance="
+                        f"{s['spec_acceptance_rate']:.0%}"
+                        + (f" (draft len {s['draft_len']:g})"
+                           if s.get("draft_len") is not None else ""))
+                if s.get("prefix_hit_rate") is not None:
+                    gen.append(
+                        f"prefix hit={s['prefix_hit_rate']:.0%}")
+                if gen:
+                    p(f"      generation: {', '.join(gen)}")
                 slo = s.get("slo")
                 if slo:
                     att = slo.get("slo_attainment")
