@@ -27,7 +27,7 @@ SUITES = {
     "mlp": ["test_mlp_dense.py"],
     "rnn": ["test_rnn.py"],
     "parallel": ["test_parallel.py", "test_multiproc.py",
-                 "test_collectives.py"],
+                 "test_collectives.py", "test_overlap.py"],
     "transformer": ["test_tensor_parallel.py", "test_pipeline_parallel.py",
                     "test_transformer_models.py", "test_moe.py",
                     "test_context_parallel.py", "test_arguments.py",

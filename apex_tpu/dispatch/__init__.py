@@ -102,6 +102,11 @@ OP_CHOICES = {
     # paged-KV kernel (ops/decode_attention_pallas.py) vs the XLA
     # gather-attention reference path
     "decode_attention": ("jnp", "pallas"),
+    # bucket count of the bucket-interleaved gradient reduction
+    # (apex_tpu.overlap, ISSUE 14), keyed on the flat grad payload
+    # like "grad_comm" — choice is the count as a string, the
+    # bench_batch convention for integer-valued ops
+    "overlap_buckets": None,
 }
 
 REQUIRED_FIELDS = ("op", "bucket", "dtype", "backend", "choice", "ledger")
@@ -245,7 +250,9 @@ def lookup_params(op, dtype, backend=None, path=None, **dims):
         allowed = OP_CHOICES.get(op)
         if allowed is not None and choice not in allowed:
             choice = None
-        elif op == "bench_batch" and not str(choice).isdigit():
+        elif allowed is None and not str(choice).isdigit():
+            # integer-valued ops (bench_batch, overlap_buckets): a
+            # non-int choice is a miss, not a crash
             choice = None
         if "params" in e:
             params = tiles.runtime_value(op, e["params"])

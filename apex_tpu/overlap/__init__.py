@@ -1,0 +1,211 @@
+"""apex_tpu.overlap — hide comm, host, and scheduler work behind compute.
+
+ROADMAP item 4, the last named hot-path lever whose apparatus (PR 10's
+``costs.overlap_bound`` gap stamp) was already built: three cooperating
+overlap paths, each behind a default-OFF knob per the measured-dispatch
+rule, each with its disabled mode jaxpr-byte-identical to the pre-PR
+program (the PR 8 discipline, asserted by tests/test_overlap.py):
+
+* **bucket-interleaved gradient reduction** (:mod:`~apex_tpu.overlap.
+  bucketed`) — gradients reduced in layer-group buckets INSIDE the
+  backward: each bucket's ``psum`` is issued as its cotangents
+  complete, so the collective interleaves with the remaining-backward
+  compute instead of forming one terminal block (the apex-DDP
+  hook-per-bucket overlap, re-designed for XLA — PAPERS.md
+  arXiv:1909.09756's pod wins are mostly this). Proof surface:
+  ``telemetry.costs.collective_schedule`` walks the jaxpr and names
+  the schedule ``interleaved`` vs ``terminal``.
+* **double-buffered host input pipeline** (:mod:`~apex_tpu.overlap.
+  prefetch`) — ``jax.device_put`` of batch t+1 overlapped with the
+  donated step t over a bounded queue, deterministic order (the
+  ``data.imagefolder`` threaded-decode pattern generalized into a
+  device-staging stage for token pipelines).
+* **serving host/device overlap** (``serving.engine`` ``overlap=``) —
+  the engine dispatches the decode step, runs the scheduler's
+  admit/evict/prefix-cache planning for round t+1 while the device
+  executes, and syncs only at the result fetch.
+
+This module is the ONE knob home (CLAUDE.md asymmetry — per-call
+arguments raise on un-honorable requests; setters/env are preferences
+that fall back):
+
+* ``APEX_OVERLAP_GRAD=off|bucketed`` (:func:`resolve_grad_overlap` /
+  :func:`set_grad_overlap`) — the gradient-reduction schedule.
+* ``APEX_OVERLAP_BUCKETS=N`` (:func:`resolve_buckets` /
+  :func:`set_overlap_buckets`) — bucket count, a tile-style knob:
+  per-call > setter > env > dispatch table (op ``overlap_buckets``,
+  keyed on the flat grad payload) > built-in ``DEFAULT_BUCKETS``.
+* ``APEX_PREFETCH=0|depth`` (:func:`resolve_prefetch`) — input
+  pipeline depth; 0/unset = synchronous baseline.
+* ``APEX_SERVE_OVERLAP={1|0}`` (:func:`resolve_serve_overlap`) — the
+  serving engine's deferred-fetch pipelined step.
+
+Every default is OFF: the device A/Bs are queued in PERF.md §2 and run
+via ``benchmarks/profile_overlap.py``.
+"""
+
+from apex_tpu.dispatch import tiles as _tiles
+
+GRAD_OVERLAP_MODES = ("off", "bucketed")
+DEFAULT_BUCKETS = 4
+
+_GRAD_OVERLAP = None   # setter pin: None (consult env) | "off" | "bucketed"
+_BUCKETS = None        # setter pin: None (consult env/table) | int
+
+
+def set_grad_overlap(mode):
+    """Pin the process-wide gradient-overlap preference (``"off"`` /
+    ``"bucketed"``), or un-pin with None. A setter CALL is explicit,
+    so an unknown mode raises — but the pinned preference still falls
+    back where the bucketed schedule cannot apply (e.g. a pipelined
+    pp>1 step)."""
+    global _GRAD_OVERLAP
+    if mode is not None and mode not in GRAD_OVERLAP_MODES:
+        raise ValueError(f"unknown grad-overlap mode {mode!r} "
+                         f"(vocabulary: {GRAD_OVERLAP_MODES})")
+    _GRAD_OVERLAP = mode
+
+
+def resolve_grad_overlap(per_call=None):
+    """The effective gradient-reduction schedule: per-call (raises on
+    unknown — an explicit request is a demand) > ``set_grad_overlap``
+    > ``APEX_OVERLAP_GRAD`` env preference (warn-once-and-ignore on
+    unknown) > built-in ``"off"`` (measured-dispatch rule: the
+    bucketed A/B is queued in PERF.md §2)."""
+    if per_call is not None:
+        if per_call not in GRAD_OVERLAP_MODES:
+            raise ValueError(f"unknown grad-overlap mode {per_call!r} "
+                             f"(vocabulary: {GRAD_OVERLAP_MODES})")
+        return per_call
+    if _GRAD_OVERLAP is not None:
+        return _GRAD_OVERLAP
+    return _tiles.env_choice("APEX_OVERLAP_GRAD",
+                             GRAD_OVERLAP_MODES) or "off"
+
+
+def set_overlap_buckets(value):
+    """Pin the process-wide bucket-count preference (positive int), or
+    un-pin with None — the shared tile-setter validation
+    (``tiles.check_setter_value``): a setter call is explicit, so a
+    non-positive value raises."""
+    global _BUCKETS
+    _tiles.check_setter_value(value, "overlap buckets")
+    _BUCKETS = value
+
+
+def resolve_buckets(per_call=None, *, nelems=None):
+    """The effective bucket count for the bucketed schedule: per-call
+    (raises on non-positive — a demand) > ``set_overlap_buckets`` >
+    ``APEX_OVERLAP_BUCKETS`` env preference > dispatch-table entry for
+    op ``overlap_buckets`` at this flat grad payload (the tile-style
+    tier — only call sites that know their payload consult) > built-in
+    ``DEFAULT_BUCKETS``."""
+    if per_call is not None:
+        if isinstance(per_call, bool) or not isinstance(per_call, int) \
+                or per_call < 1:
+            raise ValueError(f"overlap buckets must be a positive int, "
+                             f"got {per_call!r}")
+        return per_call
+    if _BUCKETS is not None:
+        return _BUCKETS
+    env = _tiles.env_int("APEX_OVERLAP_BUCKETS")
+    if env:
+        return env
+    if nelems is not None:
+        from apex_tpu import dispatch
+
+        choice = dispatch.lookup("overlap_buckets", "float32",
+                                 n=int(nelems))
+        if choice is not None and str(choice).isdigit() \
+                and int(choice) > 0:
+            return int(choice)
+    return DEFAULT_BUCKETS
+
+
+def resolve_prefetch(per_call=None):
+    """The effective input-pipeline depth (0 = synchronous baseline):
+    per-call (raises on a negative/non-int — a demand; 0 is the
+    explicit off) > ``APEX_PREFETCH`` env preference (non-negative
+    int; garbage warns once and is ignored) > built-in 0 (the
+    measured-dispatch rule: the prefetch A/B is queued in
+    PERF.md §2)."""
+    if per_call is not None:
+        if isinstance(per_call, bool) or not isinstance(per_call, int) \
+                or per_call < 0:
+            raise ValueError(f"prefetch depth must be a non-negative "
+                             f"int, got {per_call!r}")
+        return per_call
+    return _tiles.env_nonneg_int("APEX_PREFETCH") or 0
+
+
+def resolve_serve_overlap(per_call=None, *, spec_k=0):
+    """Whether the serving engine runs the deferred-fetch pipelined
+    step. Per-call True RAISES when speculative decode is engaged
+    (``spec_k`` > 0): the overlapped scheduler plans round t+1 from
+    COUNT transitions alone, and speculation's acceptance length is a
+    token-VALUE function — the demand cannot be honored. The
+    ``APEX_SERVE_OVERLAP=1`` env preference falls back to the serial
+    step in that case (preference semantics, never a raise). The
+    ENGINE decides which ``spec_k`` to pass: an env-preference spec
+    is dropped before an explicit ``overlap=True`` demand (the demand
+    is honorable — speculation is token-identical to plain decode),
+    so only a per-call spec demand reaches this raise."""
+    if per_call is not None:
+        if not isinstance(per_call, bool):
+            raise ValueError(f"overlap= must be True/False/None, "
+                             f"got {per_call!r}")
+        if per_call and spec_k:
+            raise ValueError(
+                f"overlap=True cannot be honored with speculative "
+                f"decode engaged (spec_decode={spec_k}): acceptance "
+                f"length depends on token values, which the overlapped "
+                f"round-t+1 planner must never observe early")
+        return per_call
+    return _tiles.env_flag("APEX_SERVE_OVERLAP") and not spec_k
+
+
+def pin_grad_overlap_env(per_call=None):
+    """Harness label discipline, step 1 (the ONE implementation —
+    profile_comm and profile_overlap must not drift): resolve the
+    gradient-overlap mode and pin it back into the environment so the
+    ledger record's knobs name exactly the schedule the measured
+    program traced under (check 10). Returns the resolved mode."""
+    import os
+
+    mode = resolve_grad_overlap(per_call)
+    os.environ["APEX_OVERLAP_GRAD"] = mode
+    return mode
+
+
+def pin_overlap_buckets_env(mode, nelems=None):
+    """Harness label discipline, step 2: resolve the bucket count AT
+    THE PAYLOAD (``nelems`` — without it the dispatch-table tier is
+    unreachable) and pin it, or POP the pin when the schedule is off
+    (an off record must not pin a count the program never used).
+    Returns the resolved count or None."""
+    import os
+
+    if mode != "bucketed":
+        os.environ.pop("APEX_OVERLAP_BUCKETS", None)
+        return None
+    buckets = resolve_buckets(nelems=nelems)
+    os.environ["APEX_OVERLAP_BUCKETS"] = str(buckets)
+    return buckets
+
+
+def _reset_for_tests():
+    global _GRAD_OVERLAP, _BUCKETS
+    _GRAD_OVERLAP = None
+    _BUCKETS = None
+
+
+from apex_tpu.overlap.bucketed import (  # noqa: E402,F401
+    bucketed_value_and_grad,
+    tag_tree,
+)
+# NB: the prefetch ENTRY POINTS stay on the submodule
+# (``overlap.prefetch.prefetch`` / ``overlap.prefetch.staging_seconds``)
+# — re-exporting the function here would shadow the module attribute
+# with the callable and break ``from apex_tpu.overlap import prefetch``
+# module imports.
+from apex_tpu.overlap import prefetch  # noqa: E402,F401

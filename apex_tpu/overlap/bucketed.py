@@ -1,0 +1,176 @@
+"""Bucket-interleaved gradient reduction — comm inside the backward.
+
+The terminal schedule (``parallel.distributed.allreduce_gradients``
+called on the finished grad tree) emits every collective AFTER the last
+backward compute equation: the jaxpr ends in one psum block, and the
+only overlap available is whatever XLA's latency-hiding scheduler
+recovers on its own. The reference DDP hides NCCL latency differently —
+per-param backward hooks fire an allreduce per greedy bucket the moment
+its grads are ready (apex/parallel/distributed.py:425-475), so the
+reduction of layer L+1 rides under the backward of layer L.
+
+This module re-creates that schedule at the JAXPR level: each bucket of
+parameter leaves passes through a ``jax.custom_vjp`` identity **tag**
+whose backward rule IS the bucket's allreduce. When the transpose pass
+pulls a bucket's cotangents, the collective is emitted right there —
+interleaved with the remaining-backward compute — instead of being
+appended after the grad tree is complete. The proof is mechanical:
+``telemetry.costs.collective_schedule`` walks the traced jaxpr in
+equation order and returns ``"interleaved"`` for this schedule vs
+``"terminal"`` for the historical one (asserted by
+tests/test_overlap.py; the later-layer buckets reduce first, exactly
+the reference's hook order).
+
+Composition: the tag's backward routes through
+``parallel.distributed.allreduce_gradients``, so PR 8's int8
+block-quantized and hierarchical two-stage collectives apply per
+bucket unchanged (``compress=``/``hierarchical=`` ride through; the
+error-feedback residual is NOT threaded — EF state lives with the
+ZeRO optimizers whose state can carry it, and the stateless bucketed
+sync matches ``gpt_train_step_fn``'s existing contract).
+
+Knobs (the ONE home: :mod:`apex_tpu.overlap`): with
+``resolve_grad_overlap`` off, :func:`bucketed_value_and_grad` emits
+the exact historical program — ``jax.value_and_grad`` followed by one
+terminal ``allreduce_gradients`` — byte-identical jaxpr, asserted.
+"""
+
+import math
+
+import jax
+
+
+def _partition(leaves, num_buckets):
+    """Contiguous leaf-index bucket boundaries, greedily balanced by
+    element count: ``[(lo, hi), ...]`` covering ``range(len(leaves))``
+    in order. Leaf order IS layer order for the repo's param trees
+    (flax FrozenDict traversal follows module structure), so contiguous
+    buckets are layer groups — the reference's greedy bucket assembly
+    (apex/parallel/distributed.py:360-398) without the byte-size knob:
+    count is the dispatch axis here."""
+    n = len(leaves)
+    num_buckets = max(1, min(int(num_buckets), n))
+    sizes = []
+    for leaf in leaves:
+        size = 1
+        for d in leaf.shape:
+            size *= d
+        sizes.append(size)
+    bounds, lo = [], 0
+    rest = sum(sizes)
+    for b in range(num_buckets):
+        if b == num_buckets - 1:
+            bounds.append((lo, n))
+            break
+        # each bucket takes at least one leaf and greedily fills to
+        # its fair share of what's left, capped so every later bucket
+        # still gets a leaf (exact bucket count, always)
+        hi_max = n - (num_buckets - b - 1)
+        target = rest / (num_buckets - b)
+        hi, acc = lo, 0
+        while hi < hi_max and (hi == lo or acc < target):
+            acc += sizes[hi]
+            hi += 1
+        bounds.append((lo, hi))
+        rest -= acc
+        lo = hi
+    return bounds
+
+
+def _make_tag(allreduce_kwargs):
+    """A custom_vjp identity over one bucket's leaves whose backward
+    rule all-reduces the cotangents — the in-backward reduction point.
+    One tag per bucket: jax emits the bwd call where the transpose
+    pass pulls this bucket's cotangents, which is what interleaves the
+    collective with the remaining backward."""
+
+    @jax.custom_vjp
+    def tag(*leaves):
+        return leaves
+
+    def fwd(*leaves):
+        return leaves, None
+
+    def bwd(_, cts):
+        # deferred import: overlap.bucketed <- distributed would be a
+        # cycle at module level (distributed consults the overlap knob
+        # home for its ctor)
+        from apex_tpu.parallel.distributed import allreduce_gradients
+
+        return tuple(allreduce_gradients(list(cts), **allreduce_kwargs))
+
+    tag.defvjp(fwd, bwd)
+    return tag
+
+
+def tag_tree(params, axis_name, num_buckets, *, gradient_average=True,
+             allreduce_always_fp32=False, gradient_predivide_factor=1.0,
+             compress=None, hierarchical=None):
+    """Return ``params`` with every leaf routed through its bucket's
+    reduction tag. Call INSIDE the differentiated function (at the top
+    of the loss closure): the forward is the identity, and the
+    backward all-reduces each bucket's cotangents as they complete —
+    grads then come out of ``jax.grad`` already reduced, so the caller
+    must NOT reduce again."""
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    if not leaves:
+        return params
+    kwargs = dict(axis_name=axis_name, gradient_average=gradient_average,
+                  allreduce_always_fp32=allreduce_always_fp32,
+                  gradient_predivide_factor=gradient_predivide_factor,
+                  compress=compress, hierarchical=hierarchical)
+    out = list(leaves)
+    for lo, hi in _partition(leaves, num_buckets):
+        out[lo:hi] = _make_tag(kwargs)(*leaves[lo:hi])
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def bucketed_value_and_grad(loss_fn, axis_name="data", *, overlap=None,
+                            buckets=None, gradient_average=True,
+                            allreduce_always_fp32=False,
+                            gradient_predivide_factor=1.0,
+                            compress=None, hierarchical=None):
+    """``fn(params, *args) -> (loss, reduced_grads)`` with the
+    gradient reduction scheduled by the resolved overlap knob.
+
+    ``overlap`` per-call (``"off"``/``"bucketed"``, raises on unknown)
+    > ``set_grad_overlap`` > ``APEX_OVERLAP_GRAD`` > off. ``buckets``
+    rides to ``resolve_buckets`` (per-call > setter > env > the
+    ``overlap_buckets`` dispatch-table entry at this payload >
+    built-in). Resolved off, the emitted program is byte-identical to
+    the historical ``jax.value_and_grad`` + terminal
+    ``allreduce_gradients`` pair (asserted by tests/test_overlap.py);
+    resolved bucketed, each bucket's collective interleaves with the
+    remaining backward (``costs.collective_schedule`` verdict).
+
+    Call inside ``shard_map`` over a mesh carrying ``axis_name`` (a
+    name or a declared ``(inner, outer)`` pair — the hierarchical
+    collectives compose per bucket)."""
+    from apex_tpu import overlap as _knobs
+    from apex_tpu.parallel.distributed import allreduce_gradients
+
+    mode = _knobs.resolve_grad_overlap(overlap)
+    reduce_kw = dict(gradient_average=gradient_average,
+                     allreduce_always_fp32=allreduce_always_fp32,
+                     gradient_predivide_factor=gradient_predivide_factor,
+                     compress=compress, hierarchical=hierarchical)
+
+    if mode == "off":
+        def terminal(params, *args):
+            loss, grads = jax.value_and_grad(loss_fn)(params, *args)
+            return loss, allreduce_gradients(grads, axis_name,
+                                             **reduce_kw)
+
+        return terminal
+
+    def bucketed(params, *args):
+        leaves = jax.tree_util.tree_leaves(params)
+        nelems = sum(int(math.prod(leaf.shape)) for leaf in leaves)
+        nb = _knobs.resolve_buckets(buckets, nelems=nelems)
+
+        def tagged_loss(p, *a):
+            return loss_fn(tag_tree(p, axis_name, nb, **reduce_kw), *a)
+
+        return jax.value_and_grad(tagged_loss)(params, *args)
+
+    return bucketed

@@ -1,0 +1,140 @@
+"""Double-buffered host→device input staging (ROADMAP 4a).
+
+The ``data.imagefolder`` loader already hides DECODE latency behind a
+thread pool; this module generalizes the last hop — the host→device
+transfer itself — into a staging stage any per-dispatch token pipeline
+can wrap (the bench/profile_gpt feed shape: one batch per dispatch,
+donated step). A producer thread ``jax.device_put``\\ s batch t+1 over
+a bounded queue while the device executes step t; jax transfers are
+async, so the enqueue returns immediately and the copy rides under the
+step. Order is deterministic (one producer, FIFO queue — batch i is
+always consumed i-th), the queue bound is backpressure (a slow
+consumer blocks the producer at ``depth`` staged batches, it never
+drops or reorders), and a producer error surfaces at the consumer's
+next ``next()`` instead of leaving it blocked (the
+``data.imagefolder.prefetch`` sentinel discipline).
+
+Knob: ``APEX_PREFETCH=0|depth`` (``overlap.resolve_prefetch`` — the
+one home; per-call depth raises on garbage, env is a preference).
+Depth 0 is the synchronous baseline: the SAME generator shape with the
+``device_put`` inline, so an A/B flips only the staging schedule.
+Default OFF per the measured-dispatch rule — the device A/B is queued
+in PERF.md §2 (``benchmarks/profile_overlap.py``).
+
+:func:`staging_seconds` is the attribution side (ROADMAP 4d): the
+measured per-batch host→device staging wall a SYNCHRONOUS feed would
+serialize with every step — the ``host_ms`` input of
+``costs.overlap_bound`` that bench.py / profile_gpt stamp into their
+records, measured strictly OFF the timed path.
+"""
+
+import queue
+import threading
+import time
+
+_SENTINEL = object()
+
+
+class _ProducerError:
+    def __init__(self, exc):
+        self.exc = exc
+
+
+def prefetch(batches, depth=None, device=None):
+    """Yield ``batches`` (an iterable of pytrees) staged to ``device``.
+
+    ``depth`` resolves through ``overlap.resolve_prefetch`` (per-call >
+    ``APEX_PREFETCH`` > 0). Depth 0 — the default — is the synchronous
+    baseline: each batch is ``device_put`` when the consumer asks for
+    it. Depth N stages up to N batches ahead on a producer thread;
+    order is the input order exactly, the bounded queue blocks the
+    producer (backpressure, never a drop), and a producer exception
+    re-raises at the consumer."""
+    import jax
+
+    from apex_tpu import overlap as _knobs
+
+    depth = _knobs.resolve_prefetch(depth)
+
+    def put(batch):
+        return jax.device_put(batch, device) if device is not None \
+            else jax.device_put(batch)
+
+    if depth == 0:
+        def sync_gen():
+            for batch in batches:
+                yield put(batch)
+
+        return sync_gen()
+
+    q = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+
+    def producer():
+        # the sentinel/error put lives in finally: a staging error must
+        # surface in the consumer, never leave it blocked on q.get()
+        err = None
+        try:
+            for batch in batches:
+                if stop.is_set():
+                    return
+                q.put(put(batch))
+        except Exception as e:  # noqa: BLE001 — re-raised at consumer
+            err = e
+        finally:
+            if not stop.is_set():
+                q.put(_ProducerError(err) if err is not None
+                      else _SENTINEL)
+
+    thread = threading.Thread(target=producer, daemon=True,
+                              name="apex-prefetch")
+    thread.start()
+
+    def gen():
+        try:
+            while True:
+                item = q.get()
+                if item is _SENTINEL:
+                    return
+                if isinstance(item, _ProducerError):
+                    raise item.exc
+                yield item
+        finally:
+            # a consumer that stops early must release the producer
+            # (which may be blocked on a full queue) and let it exit
+            stop.set()
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+
+    return gen()
+
+
+def staging_seconds(batch, device=None, reps=3):
+    """Measured host→device staging wall for one batch pytree: the
+    per-step host cost a SYNCHRONOUS feed pays and a depth>0 pipeline
+    hides — the ``host_ms`` input of ``costs.overlap_bound``
+    (``/ 1e-3`` at the stamp site). Median of ``reps`` full
+    put-and-confirm round trips; run strictly OUTSIDE any timed region
+    (bench.py stamps it before its warm dispatch). This is a host
+    transfer measurement, not a device-kernel row, so the §0 K-scan
+    protocol does not apply — but the §0 SYNC rule does:
+    ``block_until_ready`` lies on the tunneled backend, so arrival is
+    confirmed with the 1-element fetch (``telemetry.tracing.sync``),
+    whose round trip is part of what a synchronous feed serializes
+    anyway (the number is the sync-feed cost, honestly inclusive)."""
+    import jax
+
+    from apex_tpu.telemetry.tracing import sync
+
+    walls = []
+    for _ in range(max(1, int(reps))):
+        t0 = time.perf_counter()
+        staged = jax.device_put(batch, device) if device is not None \
+            else jax.device_put(batch)
+        sync(staged)
+        walls.append(time.perf_counter() - t0)
+    walls.sort()
+    return walls[len(walls) // 2]

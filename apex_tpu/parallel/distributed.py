@@ -167,7 +167,8 @@ class DistributedDataParallel:
                  allreduce_communicators=None, gradient_average=True,
                  gradient_predivide_factor=1.0, gradient_average_split_factor=None,
                  prof=False, axis_name="data", compress=None,
-                 hierarchical=None):
+                 hierarchical=None, overlap_grad=None,
+                 overlap_buckets=None):
         if shared_param is not None:
             raise ValueError(
                 "shared_param is no longer supported as an option.")
@@ -185,6 +186,21 @@ class DistributedDataParallel:
         if hierarchical:
             collectives.resolve_hier(
                 hierarchical, collectives.axes_tuple(axis_name))
+        # overlap knobs (ISSUE 14, apex_tpu.overlap — the one home):
+        # the in-backward bucket-interleaved reduction is the TPU
+        # rebirth of the reference DDP's per-bucket backward hooks.
+        # Ctor values are per-call demands (unknown mode / bad count
+        # raise HERE); None defers to setter > env > dispatch table.
+        # They shape value_and_grad() only — average_gradients stays
+        # the terminal reduction whatever the knobs say, because grads
+        # handed in post-backward have no backward left to hide under.
+        from apex_tpu import overlap as overlap_mod
+
+        self.overlap_grad = overlap_grad
+        self.overlap_buckets = overlap_buckets
+        overlap_mod.resolve_grad_overlap(overlap_grad)
+        if overlap_buckets is not None:
+            overlap_mod.resolve_buckets(overlap_buckets)
         for name, val, default in (
             ("message_size", message_size, 10000000),
             ("delay_allreduce", delay_allreduce, False),
@@ -210,6 +226,30 @@ class DistributedDataParallel:
             gradient_predivide_factor=self.gradient_predivide_factor,
             compress=self.compress, hierarchical=self.hierarchical,
             ef_state=ef_state)
+
+    def value_and_grad(self, loss_fn):
+        """``fn(params, *args) -> (loss, reduced_grads)`` under this
+        config's resolved overlap schedule
+        (``apex_tpu.overlap.bucketed_value_and_grad``): with the knobs
+        off, the exact historical program — ``jax.value_and_grad``
+        then one terminal :func:`allreduce_gradients` (byte-identical
+        jaxpr); with ``overlap_grad="bucketed"`` (ctor demand, or the
+        ``APEX_OVERLAP_GRAD`` preference), each layer-group bucket's
+        collective is issued inside the backward as its cotangents
+        complete — the reference's per-bucket backward hooks
+        (apex/parallel/distributed.py:425-475), scheduled at the jaxpr
+        level (``costs.collective_schedule``). Call inside your
+        shard_map'd step; do NOT also call :meth:`average_gradients`
+        on the result (the grads come back reduced)."""
+        from apex_tpu.overlap import bucketed_value_and_grad
+
+        return bucketed_value_and_grad(
+            loss_fn, self.axis_name, overlap=self.overlap_grad,
+            buckets=self.overlap_buckets,
+            gradient_average=self.gradient_average,
+            allreduce_always_fp32=self.allreduce_always_fp32,
+            gradient_predivide_factor=self.gradient_predivide_factor,
+            compress=self.compress, hierarchical=self.hierarchical)
 
     def init_ef_state(self, grads):
         """Zero error-feedback residual for ``average_gradients``

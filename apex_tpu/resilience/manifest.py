@@ -49,7 +49,8 @@ PASS_ROWS = (
     "multihead_attn", "dcgan", "xent", "xent_rb256",
     "resnet", "pretrain", "pretrain_bert", "pretrain_gpt345",
     "convergence", "gpt_rows", "gpt_fused_head", "gpt_ln_pallas",
-    "gpt_remat_sel", "attn_seq4096", "bench", "bench_b32",
+    "gpt_remat_sel", "attn_seq4096", "overlap_base", "overlap_on",
+    "bench", "bench_b32",
     "bench_b32_remat", "bench_profile", "serving",
     "serving_sampling", "serving_spec", "serving_prefix",
 )

@@ -67,6 +67,35 @@ Knob resolution at engine build (the CLAUDE.md asymmetry):
   (``scheduler.resolve_policy``); None defers to ``APEX_SERVE_SCHED``
   (vocabulary ``fifo`` | ``priority``).
 
+Host/device overlap (ISSUE 14, ``overlap=`` > ``APEX_SERVE_OVERLAP``,
+knob home :mod:`apex_tpu.overlap`): the serial round serializes
+dispatch → fetch → host bookkeeping → next round's planning, leaving
+the device idle for the whole host slice ``profile_serving`` measures
+into ``costs.overlap_bound``. The overlapped step DEFERS the decode
+fetch one round: round t's decode is dispatched and the engine
+returns; round t+1 runs the scheduler's admit/evict/prefix-cache
+planning FIRST — while the device executes — and syncs only at the
+result fetch, where round t's token values land. The contract making
+this exact (token-for-token parity with the serial engine, pinned by
+test): scheduler state transitions are COUNT functions — ``done()``
+is ``len(out_tokens) >= max_new_tokens``, positions advance by one
+per decode lane — so round t+1's planning never needs round t's token
+VALUES, only its counts, which are advanced at dispatch time with
+placeholder tokens the fetch later fills in. Token values are
+consumed only where the serial engine consumes them (the next decode
+round's input staging, after the fetch). Speculative decode breaks
+the contract (acceptance length is a value function): per-call
+``overlap=True`` with ``spec_decode`` RAISES; the env preference
+falls back to the serial step. Lifecycle events keep their canonical
+per-request order (``validate_order`` stays green): finished events
+are recorded at the fetch that produced the token, and evicted events
+are recorded after that fetch. ``decode_cache_size()==1`` is
+untouched — the overlapped mode dispatches the SAME compiled
+programs, only the host schedule moves. ``flush()`` resolves an
+in-flight round for callers that stop stepping (``run_trace`` flushes
+for you); until then the newest token per live request is a
+placeholder.
+
 Observability (ISSUE 11): when ``lifecycle.enabled()`` the engine
 keeps a request-lifecycle :class:`~apex_tpu.serving.lifecycle.EventLog`
 (``self.events``) — submitted/admitted/prefill_done/first_token/
@@ -107,7 +136,7 @@ class ServingEngine:
                  prefill_requests=None, weight_quant=None,
                  decode_impl=None, decode_block_h=None, interpret=None,
                  policy=None, sampling=None, spec_decode=None,
-                 prefix_cache=None, seed=0):
+                 prefix_cache=None, overlap=None, seed=0):
         smodel.check_serving_config(cfg)
         self.cfg = cfg
         self.num_slots = int(num_slots)
@@ -150,6 +179,23 @@ class ServingEngine:
             k = 0  # env preference: falls back per shape
         self.spec_k = k
         self.spec_stats = spec_mod.SpecStats() if self.spec_k else None
+        # host/device overlap (ISSUE 14): the deferred-fetch contract
+        # cannot run under speculation (value-dependent counts — see
+        # the module docstring). Knob asymmetry across the pair: an
+        # explicit overlap=True DEMAND against an env-PREFERENCE spec
+        # drops the preference (speculation falls back to plain decode
+        # — token-identical, so the demand IS honorable); against a
+        # per-call spec_decode= DEMAND it raises (two demands, no
+        # honorable order); the APEX_SERVE_OVERLAP preference falls
+        # back to the serial step either way.
+        from apex_tpu import overlap as overlap_mod
+
+        if overlap is True and self.spec_k and spec_decode is None:
+            self.spec_k = 0
+            self.spec_stats = None
+        self.overlap = overlap_mod.resolve_serve_overlap(
+            overlap, spec_k=self.spec_k)
+        self._pending = None  # in-flight decode round (overlap mode)
         self.prefix_enabled = prefix_mod.resolve(prefix_cache)
         self.prefix = prefix_mod.PrefixCache(
             PageAllocator(num_pages), self.page_size) \
@@ -548,11 +594,71 @@ class ServingEngine:
 
     # ------------------------------------------------------------- steps
 
+    def _dispatch_decode(self, assert_lanes, zero_length_lanes=()):
+        """Stage + dispatch ONE decode step for the current slots —
+        the SHARED assembly of the serial and overlapped rounds, so
+        their token-for-token parity is structural (one staging path)
+        rather than maintained across twin code. ``zero_length_lanes``
+        are this round's verify-satisfied lanes (serial speculative
+        path). Returns ``(next_toks, t0)`` with the fetch left to the
+        caller (the serial round fetches immediately; the overlapped
+        round defers it)."""
+        sch = self.scheduler
+        tokens, lengths = sch.decode_inputs()
+        for i in zero_length_lanes:
+            lengths[i] = 0  # this round's tokens came via verify
+        pt = np.asarray(sch.page_table_rows(), np.int32)
+        for i in assert_lanes:
+            self._assert_writable(sch.slots[i], sch.slots[i].pos,
+                                  sch.slots[i].pos)
+        args = [self.cache, jnp.asarray(tokens, dtype=jnp.int32),
+                jnp.asarray(lengths, dtype=jnp.int32),
+                jnp.asarray(pt)]
+        if self.sampling:
+            temps, top_ks, top_ps, keys, counters = \
+                sampling_mod.lane_arrays(sch.slots, self.num_slots)
+            args += [jnp.asarray(temps), jnp.asarray(top_ks),
+                     jnp.asarray(top_ps), jnp.asarray(keys),
+                     jnp.asarray(counters)]
+        t0 = time.perf_counter()
+        self.cache, next_toks, _ = self._decode_fn(*args)
+        return next_toks, t0
+
+    def _sample_gauges(self, tick):
+        """One gauge sample per scheduler round, AFTER the round's
+        device work (occupancy as the next round will see it) — shared
+        by the serial and overlapped rounds."""
+        if self.events is None:
+            return
+        sch = self.scheduler
+        wall = time.perf_counter()
+        st, pf = self.spec_stats, self.prefix
+        self.events.sample_gauges(
+            tick=tick, wall=wall,
+            slots_active=len(sch.active_indices()),
+            num_slots=self.num_slots,
+            queue_depth=sch.queue_depth(),
+            kv_pages_live=(self.allocator.num_pages - 1
+                           - self.allocator.free_count),
+            kv_pages_total=self.allocator.num_pages,
+            hol_wait_s=sch.head_of_line_wait(wall, tick=tick),
+            spec_drafted=st.drafted if st is not None else 0,
+            spec_accepted=st.accepted if st is not None else 0,
+            prefix_hit_tokens=pf.hit_tokens if pf is not None else 0)
+
     def step(self, arrivals=None):
         """One scheduler round: enqueue due arrivals, evict, admit (+
         prefill + prefix-hit COW), speculative verify, decode every
         remaining active slot. Returns a dict of what happened (the
-        dryrun/trace-replay surface)."""
+        dryrun/trace-replay surface). In overlap mode
+        (``overlap=`` / ``APEX_SERVE_OVERLAP``) the round is the
+        deferred-fetch pipelined variant — same schedule, same tokens
+        (see the module docstring); the serial body is untouched."""
+        if self.overlap:
+            return self._step_overlap(arrivals)
+        return self._step_serial(arrivals)
+
+    def _step_serial(self, arrivals=None):
         sch = self.scheduler
         now = self.tick
         if arrivals:
@@ -589,24 +695,8 @@ class ServingEngine:
         decode_lanes = [i for i in active if i not in verified]
         decoded = 0
         if decode_lanes:
-            tokens, lengths = sch.decode_inputs()
-            for i in verified:
-                lengths[i] = 0  # this round's tokens came via verify
-            pt = np.asarray(sch.page_table_rows(), np.int32)
-            for i in decode_lanes:
-                self._assert_writable(sch.slots[i], sch.slots[i].pos,
-                                      sch.slots[i].pos)
-            args = [self.cache, jnp.asarray(tokens, dtype=jnp.int32),
-                    jnp.asarray(lengths, dtype=jnp.int32),
-                    jnp.asarray(pt)]
-            if self.sampling:
-                temps, top_ks, top_ps, keys, counters = \
-                    sampling_mod.lane_arrays(sch.slots, self.num_slots)
-                args += [jnp.asarray(temps), jnp.asarray(top_ks),
-                         jnp.asarray(top_ps), jnp.asarray(keys),
-                         jnp.asarray(counters)]
-            t0 = time.perf_counter()
-            self.cache, next_toks, _ = self._decode_fn(*args)
+            next_toks, t0 = self._dispatch_decode(
+                decode_lanes, zero_length_lanes=verified)
             next_toks = np.asarray(next_toks)
             wall2 = time.perf_counter()
             self.device_dispatch_s += wall2 - t0
@@ -648,24 +738,7 @@ class ServingEngine:
                                                tick=now, wall=wall2)
                 decoded += 1
             self.decode_steps += 1
-        if self.events is not None:
-            # one gauge sample per scheduler round, AFTER the round's
-            # device work (occupancy as the next round will see it)
-            wall3 = time.perf_counter()
-            st, pf = self.spec_stats, self.prefix
-            self.events.sample_gauges(
-                tick=now, wall=wall3,
-                slots_active=len(sch.active_indices()),
-                num_slots=self.num_slots,
-                queue_depth=sch.queue_depth(),
-                kv_pages_live=(self.allocator.num_pages - 1
-                               - self.allocator.free_count),
-                kv_pages_total=self.allocator.num_pages,
-                hol_wait_s=sch.head_of_line_wait(wall3, tick=now),
-                spec_drafted=st.drafted if st is not None else 0,
-                spec_accepted=st.accepted if st is not None else 0,
-                prefix_hit_tokens=pf.hit_tokens
-                if pf is not None else 0)
+        self._sample_gauges(now)
         # a slot whose LAST token was just produced frees at the next
         # round's evict — one round of slack, never a starved queue
         self.tick += 1
@@ -673,10 +746,159 @@ class ServingEngine:
                 "admitted": admitted, "prefilled": prefilled,
                 "verified": verified, "decoded_slots": decoded}
 
+    # ----------------------------------- overlapped round (ISSUE 14)
+
+    def _advance_counts(self, decode_lanes):
+        """Post-dispatch COUNT bookkeeping of one decode round: the
+        serial fetch loop's position/length/done transitions, with a
+        placeholder where the token VALUE would land (the fetch fills
+        it in ``_resolve_pending``). This is the seam that keeps the
+        overlapped schedule exact: every transition here is a count
+        function — round t+1's planner never observes round-t token
+        values early. Returns ``(plan, decoded)``; plan entries hold
+        the slot/request REFS (eviction between dispatch and fetch
+        detaches the slot, the refs stay valid)."""
+        sch = self.scheduler
+        plan = []
+        decoded = 0
+        for i in decode_lanes:
+            slot = sch.slots[i]
+            p_len = len(slot.request.prompt)
+            consumed_pos = slot.pos
+            slot.pos += 1
+            if consumed_pos < p_len - 1:
+                # prefix-hit warmup: next prompt token fed, lane
+                # output discarded — value-free either way
+                slot.next_token = slot.request.prompt[consumed_pos + 1]
+                decoded += 1
+                continue
+            if not slot.request.done():
+                req = slot.request
+                req.out_tokens.append(None)  # value lands at the fetch
+                self.tokens_generated += 1
+                plan.append({
+                    "lane": i, "slot": slot, "req": req,
+                    "out_idx": len(req.out_tokens) - 1,
+                    # a prefix-hit slot's FIRST output token: warmup
+                    # ended this round (the serial first-token seam)
+                    "first": consumed_pos == p_len - 1,
+                    "done": req.done(),
+                })
+            decoded += 1
+        self.decode_steps += 1
+        return plan, decoded
+
+    def _resolve_pending(self):
+        """The sync point of the overlapped round: fetch the in-flight
+        decode's tokens, fill every placeholder, stamp first-token /
+        finish walls and record their lifecycle events (with the
+        dispatching round's tick — the round the serial engine would
+        have recorded them at)."""
+        p = self._pending
+        if p is None:
+            return
+        self._pending = None
+        next_toks = np.asarray(p["next_toks"])   # blocks until ready
+        wall = time.perf_counter()
+        # planning time between dispatch and this fetch ran INSIDE the
+        # device window — counting it as dispatch wall is the measured
+        # claim (run wall minus this = the host slice overlap removed)
+        self.device_dispatch_s += wall - p["t0"]
+        for e in p["plan"]:
+            tok = int(next_toks[e["lane"]])
+            e["req"].out_tokens[e["out_idx"]] = tok
+            e["slot"].next_token = tok
+            rid = e["req"].rid
+            if e["first"]:
+                if e["req"].first_token_wall is None:
+                    e["req"].first_token_wall = wall
+                if self.events is not None:
+                    self.events.record("prefill_done", rid,
+                                       tick=p["tick"], wall=wall)
+                    self.events.record("first_token", rid,
+                                       tick=p["tick"], wall=wall)
+            if e["done"]:
+                if e["req"].finish_wall is None:
+                    e["req"].finish_wall = wall
+                if self.events is not None:
+                    self.events.record("finished", rid,
+                                       tick=p["tick"], wall=wall)
+
+    def flush(self):
+        """Resolve the in-flight decode round (overlap mode): fill the
+        placeholder tokens and land their lifecycle events. A no-op on
+        the serial engine or with nothing in flight; ``run_trace``
+        calls it for you — direct ``step()`` drivers call it before
+        reading ``out_tokens``."""
+        self._resolve_pending()
+
+    def _step_overlap(self, arrivals=None):
+        """The deferred-fetch pipelined round: PLAN round t+1 (evict/
+        admit/prefix-COW — count state only) while the device executes
+        round t, sync at the fetch, then prefill + dispatch round
+        t+1's decode and return with IT in flight. Same admissions,
+        evictions and tokens per round as the serial engine (pinned by
+        test); only the host schedule moves."""
+        sch = self.scheduler
+        now = self.tick
+        if arrivals:
+            for req in arrivals:
+                self.submit(req)
+        wall = time.perf_counter()
+        # ---- the overlap window: host planning under the in-flight
+        # decode. wall_time=None on evict: finish_wall belongs to the
+        # fetch that produced the finishing token (_resolve_pending).
+        evicted = sch.evict_done(now, None)
+        admitted = sch.admit(now, wall)
+        if self.events is not None:
+            for i in admitted:
+                self.events.record("admitted", sch.slots[i].request.rid,
+                                   tick=now, wall=wall)
+        to_prefill = []
+        for i in admitted:
+            slot = sch.slots[i]
+            if slot.prefix_hit:
+                # COW copies are device work: they queue behind the
+                # in-flight decode and run before any dependent read
+                for src, dst in slot.cow_copies:
+                    self._copy_page(src, dst)
+                slot.cow_copies = []
+            else:
+                to_prefill.append(i)
+        # ---- sync point: round t's values land (finished /
+        # first-token events), then the evictions planned above are
+        # RECORDED — after the finished events they must follow
+        self._resolve_pending()
+        for r in evicted:
+            if r.finish_wall is None:
+                r.finish_wall = wall  # the evict_done backstop seam
+        if self.events is not None and evicted:
+            wall_e = time.perf_counter()
+            for r in evicted:
+                self.events.record("evicted", r.rid, tick=now,
+                                   wall=wall_e)
+        prefilled = self._run_prefill(to_prefill) if to_prefill else []
+        decode_lanes = sch.active_indices()
+        decoded = 0
+        if decode_lanes:
+            next_toks, t0 = self._dispatch_decode(decode_lanes)
+            # NO fetch: the round returns with the decode in flight;
+            # counts advance now so the next round can plan
+            plan, decoded = self._advance_counts(decode_lanes)
+            self._pending = {"next_toks": next_toks, "plan": plan,
+                             "t0": t0, "tick": now}
+        self._sample_gauges(now)
+        self.tick += 1
+        return {"tick": now, "evicted": [r.rid for r in evicted],
+                "admitted": admitted, "prefilled": prefilled,
+                "verified": [], "decoded_slots": decoded}
+
     def run_trace(self, requests, max_ticks=10000):
         """Replay a synthetic trace to completion: requests are
         submitted when their arrival tick is due; returns the
-        completed Request list (latency fields filled)."""
+        completed Request list (latency fields filled). Flushes the
+        overlapped engine's in-flight round before returning, so the
+        completed list never holds a placeholder token."""
         pending = sorted(requests, key=lambda r: (r.arrival, r.rid))
         n_total = len(pending)
         while len(self.scheduler.completed) < n_total:
@@ -687,4 +909,5 @@ class ServingEngine:
             due = [r for r in pending if r.arrival <= self.tick]
             pending = [r for r in pending if r.arrival > self.tick]
             self.step(arrivals=due)
+        self.flush()
         return list(self.scheduler.completed)

@@ -68,6 +68,15 @@ import os
 V5E_PEAK_BF16_FLOPS = 197e12
 V5E_HBM_BYTES_PER_S = 819e9       # v5e HBM bandwidth
 V5E_HBM_CAPACITY_BYTES = 16 * 2 ** 30
+# Inter-chip interconnect ENVELOPE (ROADMAP 4d: the training comm_ms
+# input of overlap_bound). Datasheet-derived — v5e carries 1600 Gbps of
+# ICI per chip — and HONESTLY AN ENVELOPE, not a measurement: the
+# single-chip window has no second chip to move bytes to, so every
+# comm_ms stamped from it is a best-case lower bound on collective time
+# (payload ÷ peak ICI, no ring factor, no launch latency) until the
+# pod-slice window measures the real curve (PERF.md §2, the same
+# measured-not-asserted ladder the roofline constants climbed).
+V5E_ICI_BYTES_PER_S_ENVELOPE = 200e9
 
 _NUMERIC_FIELDS = (
     "xla_flops_per_step", "model_flops_per_step", "hbm_bytes_per_step",
@@ -102,6 +111,53 @@ def hbm_bw_for(platform):
 
 def hbm_capacity_for(platform):
     return V5E_HBM_CAPACITY_BYTES if platform == "tpu" else None
+
+
+def ici_bw_for(platform):
+    """The ICI bandwidth ENVELOPE an overlap_bound ``comm_ms`` divides
+    by (None off-TPU — a CPU smoke's collective bytes carry no
+    interconnect claim, same rule as :func:`peak_flops_for`)."""
+    return V5E_ICI_BYTES_PER_S_ENVELOPE if platform == "tpu" else None
+
+
+def wire_bytes(comm, axis_sizes):
+    """The per-axis payload that actually MOVES: drop size-1 axes (a
+    single-participant collective is traced but free on the wire —
+    counting it would overstate every degenerate topology). Axes not
+    named in ``axis_sizes`` are kept (unknown means "assume it
+    moves"). The ONE home of the claim-shaping filter every harness
+    applies before :func:`comm_ms_from_axis_bytes` — five private
+    copies of the idiom could silently disagree about what counts as
+    wire payload."""
+    if not isinstance(comm, dict):
+        return comm
+    sizes = axis_sizes or {}
+    return {ax: v for ax, v in comm.items() if sizes.get(ax, 2) > 1}
+
+
+def comm_ms_from_axis_bytes(comm, platform):
+    """Predicted per-step collective milliseconds from a
+    :func:`comm_from_jaxpr` per-axis payload dict over the measured-
+    interconnect envelope — the TRAINING ``comm_ms`` input of
+    :func:`overlap_bound` (ROADMAP 4d: bench/profile_gpt records get
+    the same gap attribution serving records already carry).
+
+    Returns 0.0 for a traced-but-collective-free program (an empty
+    dict is a real answer: nothing to hide), and None when ``comm``
+    is None (untraced — no claim) or the platform has no committed
+    envelope. Payload over peak-ICI is an ENVELOPE lower bound (see
+    ``V5E_ICI_BYTES_PER_S_ENVELOPE``); the stamp is still honest —
+    a gap it names can only be larger on the real wire."""
+    if not isinstance(comm, dict):
+        return None
+    bw = ici_bw_for(platform)
+    if bw is None:
+        return None
+    total = 0.0
+    for v in comm.values():
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            total += float(v)
+    return round(total / bw * 1e3, 6)
 
 
 def requested():
@@ -291,7 +347,7 @@ def build(xla_flops=None, hbm_bytes=None, memory=None, comm=None,
 
 def capture(lowered=None, compiled=None, steps=1, comm=None,
             model_flops_per_step=None, platform=None,
-            comm_compression=None):
+            comm_compression=None, host_ms=None, comm_ms=None):
     """The capture path: feature-detected ``cost_analysis`` /
     ``memory_analysis`` off an AOT stage pair, folded into one block.
 
@@ -303,7 +359,8 @@ def capture(lowered=None, compiled=None, steps=1, comm=None,
         return build(comm=comm, steps=steps,
                      model_flops_per_step=model_flops_per_step,
                      platform=platform, source=None,
-                     comm_compression=comm_compression)
+                     comm_compression=comm_compression,
+                     host_ms=host_ms, comm_ms=comm_ms)
     try:
         from apex_tpu import _compat
     except Exception:
@@ -324,7 +381,8 @@ def capture(lowered=None, compiled=None, steps=1, comm=None,
         hbm_bytes=ca.get("bytes accessed") if ca else None,
         memory=ma, comm=comm, steps=steps,
         model_flops_per_step=model_flops_per_step, platform=platform,
-        source=source, comm_compression=comm_compression)
+        source=source, comm_compression=comm_compression,
+        host_ms=host_ms, comm_ms=comm_ms)
 
 
 # --------------------------------------------------------- comm accounting
@@ -398,6 +456,91 @@ def comm_from_jaxpr(jaxpr):
     except Exception:
         return {}
     return {k: int(v) for k, v in totals.items()}
+
+
+# -------------------------------------------- collective scheduling
+
+# the backward-compute primitives a collective can hide behind: matmul
+# and convolution carry the step's MXU work (elementwise tails are
+# bandwidth noise a psum cannot meaningfully overlap)
+_COMPUTE_PRIMS = ("dot_general", "conv_general_dilated")
+
+
+def collective_schedule(jaxpr, axes=None):
+    """The jaxpr-level overlap verdict (ROADMAP 4b, the ISSUE 14 proof
+    surface): walk every equation IN ORDER (recursing into
+    pjit/shard_map/custom-vjp/scan sub-jaxprs at their position, the
+    same traversal as :func:`comm_from_jaxpr`) and judge whether the
+    collectives interleave with remaining compute or form one terminal
+    block::
+
+        {"verdict": "interleaved" | "terminal" | "no-collectives",
+         "collectives": n,            # counted collective eqns
+         "compute": n,                # dot_general/conv eqn count
+         "compute_after_first_collective": n}
+
+    ``axes`` restricts WHICH collectives are judged (an iterable of
+    mesh-axis names — e.g. the dp axes of a grad sync): a real
+    training program carries forward collectives too (tp psums in the
+    parallel CE, pp ppermutes — traced even over size-1 axes), and
+    those interleave with backward compute by construction, which
+    would drown the grad-sync schedule the claim is about. With
+    ``axes=None`` every collective counts (the profile_comm dp-only
+    shape needs no filter).
+
+    ``interleaved`` iff at least one compute equation appears AFTER
+    the first counted collective — the bucket-interleaved schedule
+    (``overlap.bucketed``) emits each bucket's psum as its cotangents
+    complete, so later-bucket collectives precede earlier-layer
+    backward matmuls; the historical terminal reduction emits every
+    collective after the last backward matmul. Equation order is the
+    claim surface: XLA's latency-hiding scheduler may still recover
+    overlap from a terminal block, but only the interleaved jaxpr
+    GUARANTEES the operands are ready early — which is why the verdict
+    (not a hope about the scheduler) is what tests pin. Never raises;
+    an unwalkable jaxpr returns the no-collectives verdict with zero
+    counts (same degradation rule as :func:`comm_from_jaxpr`)."""
+    axes = None if axes is None else {str(a) for a in axes}
+    order = []
+
+    def visit(jxp):
+        eqns = getattr(jxp, "eqns", None)
+        if eqns is None:  # ClosedJaxpr
+            inner = getattr(jxp, "jaxpr", None)
+            if inner is None:
+                return
+            return visit(inner)
+        for eqn in eqns:
+            name = getattr(eqn.primitive, "name", "")
+            if name in _COLLECTIVES:
+                eqn_axes = {str(a) for a in _eqn_axes(eqn.params)}
+                if axes is None or (eqn_axes & axes):
+                    order.append("coll")
+            elif name in _COMPUTE_PRIMS:
+                order.append("comp")
+            for p in eqn.params.values():
+                if hasattr(p, "eqns") or hasattr(p, "jaxpr"):
+                    visit(p)
+                elif isinstance(p, (tuple, list)):
+                    for item in p:
+                        if hasattr(item, "eqns") or hasattr(item, "jaxpr"):
+                            visit(item)
+
+    try:
+        visit(jaxpr)
+    except Exception:
+        order = []
+    n_coll = order.count("coll")
+    n_comp = order.count("comp")
+    out = {"verdict": "no-collectives", "collectives": n_coll,
+           "compute": n_comp, "compute_after_first_collective": 0}
+    if not n_coll:
+        return out
+    first_coll = order.index("coll")
+    after = order[first_coll + 1:].count("comp")
+    out["compute_after_first_collective"] = after
+    out["verdict"] = "interleaved" if after else "terminal"
+    return out
 
 
 # --------------------------------------------------- starvation economics

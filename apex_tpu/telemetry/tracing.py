@@ -182,7 +182,8 @@ class Tracer:
         return self.overhead * 1e3
 
     def _capture_cost(self, call, args, flops_per_iter, compiled=None,
-                      comm=None, comm_compression=None):
+                      comm=None, comm_compression=None, host_ms=None,
+                      comm_ms=None):
         """Attribution block for one measured program (cost_analysis /
         memory_analysis via apex_tpu.telemetry.costs): ``compiled`` is
         the free-harvest path (the warm mode already paid for the AOT
@@ -206,7 +207,8 @@ class Tracer:
                               steps=self.k,
                               model_flops_per_step=flops_per_iter,
                               platform=platform, comm=comm,
-                              comm_compression=comm_compression)
+                              comm_compression=comm_compression,
+                              host_ms=host_ms, comm_ms=comm_ms)
         if self.cost is None:
             self.cost = block
         return block
@@ -214,7 +216,7 @@ class Tracer:
     def time_call(self, name, call, warm_args, timed_args,
                   flops_per_iter=None, extra=None, on_fail="raise",
                   sync_out=sync, capture_cost=False, comm=None,
-                  comm_compression=None):
+                  comm_compression=None, host_ms=None, comm_ms=None):
         """Warm (compile + drain) with ``warm_args``, then time one
         dispatch of ``call(*timed_args)``; per-iteration time = (wall -
         overhead) / K. The two argument tuples must differ in a traced
@@ -243,7 +245,8 @@ class Tracer:
                         warm_cost = self._capture_cost(
                             call, warm_args, flops_per_iter,
                             compiled=compiled, comm=comm,
-                            comm_compression=comm_compression)
+                            comm_compression=comm_compression,
+                            host_ms=host_ms, comm_ms=comm_ms)
                 else:
                     sync_out(call(*warm_args))
                     info = {"executed": True}
@@ -282,7 +285,8 @@ class Tracer:
             # that must never straddle t0 (the calibration-flap class)
             span_extra["cost"] = self._capture_cost(
                 call, warm_args, flops_per_iter, comm=comm,
-                comm_compression=comm_compression)
+                comm_compression=comm_compression, host_ms=host_ms,
+                comm_ms=comm_ms)
         span = Span(name, (total - self.overhead) / self.k, total, self.k,
                     self.overhead, flops_per_iter=flops_per_iter,
                     extra=span_extra)
@@ -291,7 +295,8 @@ class Tracer:
 
     def scan_time(self, name, make_body, carry0, ops, wrap=None,
                   flops_per_iter=None, extra=None, on_fail="raise",
-                  capture_cost=False, comm=None, comm_compression=None):
+                  capture_cost=False, comm=None, comm_compression=None,
+                  host_ms=None, comm_ms=None):
         """The §0 protocol in one call. ``make_body(eps, *ops)`` returns
         ``body(carry, t) -> (carry, metric)``; ``ops`` (big arrays) are
         jit ARGUMENTS — closure-captured constants would be inlined into
@@ -309,7 +314,8 @@ class Tracer:
             (carry0, jnp.float32(1e-30)) + tuple(ops),
             flops_per_iter=flops_per_iter, extra=extra, on_fail=on_fail,
             capture_cost=capture_cost, comm=comm,
-            comm_compression=comm_compression)
+            comm_compression=comm_compression, host_ms=host_ms,
+            comm_ms=comm_ms)
 
     def flush_ledger(self, harness, platform=None, relay=None, extra=None,
                      path=None):

@@ -206,7 +206,8 @@ def global_grad_norm(grads):
 
 def gpt_train_step_fn(cfg, pp, num_microbatches, lr=1e-4,
                       checkpoint_stages=True, with_grad_norm=False,
-                      dp_axes=DATA_AXIS, compress=None, hierarchical=None):
+                      dp_axes=DATA_AXIS, compress=None, hierarchical=None,
+                      overlap_grad=None, overlap_buckets=None):
     """Returns ``(step, tx, scaler)`` where ``step(params, opt_state,
     scaler_state, batch) -> (params, opt_state, scaler_state, loss)`` — to
     be called INSIDE shard_map over the (pp, dp, tp) mesh; ``tx``/``scaler``
@@ -226,11 +227,25 @@ def gpt_train_step_fn(cfg, pp, num_microbatches, lr=1e-4,
     threaded — the step signature stays fixed); EF-carried compression
     lives in the ZeRO optimizers, whose state holds the residual.
 
+    ``overlap_grad``/``overlap_buckets`` (ISSUE 14, knob home
+    :mod:`apex_tpu.overlap`): per-call ``"bucketed"`` restructures the
+    dp grad sync into layer-group buckets reduced INSIDE each
+    microbatch backward (``overlap.bucketed.tag_tree`` — the reference
+    DDP's hook-per-backward schedule, apex delay_allreduce=False; one
+    collective set per microbatch, interleaved with the remaining
+    backward per ``costs.collective_schedule``). Honored for pp == 1
+    only — over a pp > 1 pipeline the 1F1B scan owns the backward, so
+    a per-call demand RAISES while the env/setter preference falls
+    back to the terminal reduction. Resolved off, the step is the
+    historical program byte-for-byte.
+
     The full apex training semantics: forward/backward through the 1F1B
     schedule with loss scaling, DP gradient allreduce (the DDP
     reduction), found_inf-gated fused-Adam update (the skip-step of
     apex/amp/handle.py:128-154), dynamic scale update.
     """
+    from apex_tpu import overlap as overlap_mod
+    from apex_tpu.overlap.bucketed import tag_tree
     from apex_tpu.parallel.distributed import allreduce_gradients
 
     fns, _ = make_gpt_fns(cfg, pp)
@@ -240,21 +255,64 @@ def gpt_train_step_fn(cfg, pp, num_microbatches, lr=1e-4,
     fwd_bwd = (forward_backward_pipelining_without_interleaving if pp > 1
                else forward_backward_no_pipelining)
 
+    overlap_mode = overlap_mod.resolve_grad_overlap(overlap_grad)
+    if overlap_mode == "bucketed" and pp > 1:
+        if overlap_grad == "bucketed":
+            raise ValueError(
+                f"overlap_grad='bucketed' cannot be honored at pp={pp}: "
+                f"the pipeline schedule owns the backward (the stage "
+                f"grads complete inside the 1F1B scan) — use the env "
+                f"preference for a silent fallback, or pp=1")
+        overlap_mode = "off"  # preference semantics: fall back
+    if overlap_buckets is not None:
+        overlap_mod.resolve_buckets(overlap_buckets)  # demand check
+
     def scaled_loss_fns(scale):
         def scaled(hp, hidden, mb):
             return loss_fn(hp, hidden, mb) * scale
         return (stage_fn, embed_fn, scaled)
 
+    def bucketed_fwd_bwd(params, scaler_state, batch):
+        """The bucketed route (pp == 1): the SAME microbatch
+        accumulation as the tuple form of
+        ``forward_backward_no_pipelining``, with the params routed
+        through their bucket reduction tags INSIDE the per-microbatch
+        loss — each bucket's collective is emitted in the backward as
+        its cotangents complete, so grads come back already
+        dp-averaged and the terminal allreduce below is skipped."""
+        scale = scaler.scale(jnp.float32(1.0), scaler_state)
+        nelems = sum(
+            int(np.prod(leaf.shape)) for leaf in
+            jax.tree_util.tree_leaves(params))
+        nb = overlap_mod.resolve_buckets(overlap_buckets, nelems=nelems)
+
+        def composed(params3, mb):
+            sp, ep, hp = tag_tree(params3, dp_axes, nb,
+                                  compress=compress,
+                                  hierarchical=hierarchical)
+            h = embed_fn(ep, mb)
+            h = stage_fn(sp, h, 0)
+            return loss_fn(hp, h, mb) * scale
+
+        losses, grads = forward_backward_no_pipelining(
+            composed, batch, params)
+        return jnp.mean(losses), grads
+
     def step(params, opt_state, scaler_state, batch):
-        loss, grads = fwd_bwd(
-            scaled_loss_fns(scaler.scale(jnp.float32(1.0), scaler_state)),
-            batch, params, num_microbatches=num_microbatches,
-            checkpoint_stages=checkpoint_stages)
-        # DDP: data-parallel gradient averaging (reference
-        # apex/parallel/distributed.py:425-475) through the ONE
-        # collectives layer — psum+mean when the knobs are off
-        grads = allreduce_gradients(
-            grads, dp_axes, compress=compress, hierarchical=hierarchical)
+        if overlap_mode == "bucketed":
+            loss, grads = bucketed_fwd_bwd(params, scaler_state, batch)
+        else:
+            loss, grads = fwd_bwd(
+                scaled_loss_fns(scaler.scale(jnp.float32(1.0),
+                                             scaler_state)),
+                batch, params, num_microbatches=num_microbatches,
+                checkpoint_stages=checkpoint_stages)
+            # DDP: data-parallel gradient averaging (reference
+            # apex/parallel/distributed.py:425-475) through the ONE
+            # collectives layer — psum+mean when the knobs are off
+            grads = allreduce_gradients(
+                grads, dp_axes, compress=compress,
+                hierarchical=hierarchical)
         # unscale + overflow detect; found_inf is synced over pp/tp like
         # transformer.amp.GradScaler (grad_scaler.py:38-49)
         grads, found_inf = scaler.unscale(grads, scaler_state)
@@ -439,21 +497,15 @@ def reference_training(cfg, pp, batch, num_steps, lr=1e-4, device=None):
             [float(x) for x in np.asarray(gnorms)])
 
 
-def training_comm_bytes(devices, cfg, topology, num_microbatches=4,
-                        micro_batch_size=2, seq_len=16, compress=None,
-                        hierarchical=None):
-    """Per-mesh-axis collective payload bytes of ONE (pp, dp, tp)
-    training step — init + 1 full step traced to a jaxpr and counted by
-    ``apex_tpu.telemetry.costs.comm_from_jaxpr`` (psum/all_gather/
-    ppermute/all_to_all operand bytes; microbatch scan bodies
-    multiplied by their trip count). Pure host tracing: nothing is
-    compiled or executed, so the dryrun can print the counts for every
-    topology at jaxpr cost. Returns ``{axis: bytes}`` — the checkable
-    claim surface for the quantized/hierarchical collectives (ROADMAP
-    item 3): ``compress``/``hierarchical`` ride per-call into the dp
-    grad sync (None = the APEX_GRAD_COMPRESS / APEX_HIER_ALLREDUCE
-    preferences), and the topology's dp entry may be a declared
-    ``(inner, outer)`` pair (axes ``dp_in``/``dp_out``)."""
+def _traced_training_jaxpr(devices, cfg, topology, num_microbatches=4,
+                           micro_batch_size=2, seq_len=16, compress=None,
+                           hierarchical=None, overlap_grad=None,
+                           overlap_buckets=None):
+    """``(jaxpr, axis_sizes)`` of ONE (pp, dp, tp) training step (init
+    + 1 full step) — pure host tracing, nothing compiled or executed.
+    The shared front end of :func:`training_comm_bytes` and
+    :func:`training_collective_schedule`, so the payload count and the
+    schedule verdict can never be taken from different programs."""
     pp, dp, tp = topology
     dp_size, dp_names, dp_sizes = dp_axes_of(dp)
     assert pp * dp_size * tp == len(devices), (topology, len(devices))
@@ -463,7 +515,8 @@ def training_comm_bytes(devices, cfg, topology, num_microbatches=4,
     _, init_params = make_gpt_fns(cfg, pp)
     step, tx, scaler = gpt_train_step_fn(
         cfg, pp, num_microbatches, dp_axes=dp_axes, compress=compress,
-        hierarchical=hierarchical)
+        hierarchical=hierarchical, overlap_grad=overlap_grad,
+        overlap_buckets=overlap_buckets)
     global_mb = micro_batch_size * dp_size
     batch = toy_batch(cfg.vocab_size, num_microbatches, global_mb,
                       seq_len)
@@ -481,15 +534,116 @@ def training_comm_bytes(devices, cfg, topology, num_microbatches=4,
         one, mesh=mesh,
         in_specs=({"ids": P(None, spec), "labels": P(None, spec)},),
         out_specs=P(), check_vma=False)
-    from apex_tpu.telemetry import costs
-
-    comm = costs.comm_from_jaxpr(jax.make_jaxpr(f)(batch))
-    # a size-1 axis's collectives are no-ops on the wire: the payload
-    # is traced (the jaxpr still carries the psum) but nothing moves —
-    # reporting it as comm would overstate every degenerate topology
     sizes = {PIPELINE_AXIS: pp, TENSOR_AXIS: tp}
     sizes.update(dict(zip(dp_names, dp_sizes)))
-    return {ax: v for ax, v in comm.items() if sizes.get(ax, 2) > 1}
+    return jax.make_jaxpr(f)(batch), sizes, f, batch
+
+
+def training_comm_bytes(devices, cfg, topology, num_microbatches=4,
+                        micro_batch_size=2, seq_len=16, compress=None,
+                        hierarchical=None, overlap_grad=None,
+                        overlap_buckets=None):
+    """Per-mesh-axis collective payload bytes of ONE (pp, dp, tp)
+    training step — init + 1 full step traced to a jaxpr and counted by
+    ``apex_tpu.telemetry.costs.comm_from_jaxpr`` (psum/all_gather/
+    ppermute/all_to_all operand bytes; microbatch scan bodies
+    multiplied by their trip count). Pure host tracing: nothing is
+    compiled or executed, so the dryrun can print the counts for every
+    topology at jaxpr cost. Returns ``{axis: bytes}`` — the checkable
+    claim surface for the quantized/hierarchical collectives (ROADMAP
+    item 3): ``compress``/``hierarchical`` ride per-call into the dp
+    grad sync (None = the APEX_GRAD_COMPRESS / APEX_HIER_ALLREDUCE
+    preferences), and the topology's dp entry may be a declared
+    ``(inner, outer)`` pair (axes ``dp_in``/``dp_out``).
+    ``overlap_grad``/``overlap_buckets`` ride to ``gpt_train_step_fn``
+    (ISSUE 14): the bucketed schedule's per-microbatch reduction is
+    visible here as an M× dp payload — the honest cost side of the
+    hook-per-backward semantics the A/B weighs."""
+    jaxpr, sizes, _, _ = _traced_training_jaxpr(
+        devices, cfg, topology, num_microbatches=num_microbatches,
+        micro_batch_size=micro_batch_size, seq_len=seq_len,
+        compress=compress, hierarchical=hierarchical,
+        overlap_grad=overlap_grad, overlap_buckets=overlap_buckets)
+    from apex_tpu.telemetry import costs
+
+    # size-1 axes move nothing on the wire (costs.wire_bytes — the
+    # one home of the filter every claim applies)
+    return costs.wire_bytes(costs.comm_from_jaxpr(jaxpr), sizes)
+
+
+def training_collective_schedule(devices, cfg, topology,
+                                 num_microbatches=4, micro_batch_size=2,
+                                 seq_len=16, compress=None,
+                                 hierarchical=None, overlap_grad=None,
+                                 overlap_buckets=None):
+    """``costs.collective_schedule`` verdict of the SAME traced
+    training step :func:`training_comm_bytes` counts, judged on the
+    DP AXES ONLY (``collective_schedule(axes=...)`` — the forward tp
+    psums and pp ppermutes interleave by construction and are not the
+    claim) — the jaxpr-level proof surface of the bucket-interleaved
+    grad sync (ISSUE 14): with ``overlap_grad="bucketed"`` the
+    per-bucket dp collectives interleave with remaining-backward
+    compute; with it off the grad sync reads terminal. The MULTICHIP
+    dryrun prints both twins per topology."""
+    pp, dp, tp = topology
+    _, dp_names, _ = dp_axes_of(dp)
+    jaxpr, _, _, _ = _traced_training_jaxpr(
+        devices, cfg, topology, num_microbatches=num_microbatches,
+        micro_batch_size=micro_batch_size, seq_len=seq_len,
+        compress=compress, hierarchical=hierarchical,
+        overlap_grad=overlap_grad, overlap_buckets=overlap_buckets)
+    from apex_tpu.telemetry import costs
+
+    return costs.collective_schedule(jaxpr, axes=dp_names)
+
+
+def training_overlap_profile(devices, cfg, topology, num_microbatches=4,
+                             micro_batch_size=2, seq_len=16,
+                             compress=None, hierarchical=None,
+                             overlap_grad=None, overlap_buckets=None,
+                             include_floor=True):
+    """The MULTICHIP tail's per-topology overlap account (ISSUE 14):
+    the dp-axes collective-schedule verdict plus an ENVELOPE
+    ``costs.overlap_bound`` of the traced (init + 1 step) program —
+    XLA-counted flops over the v5e bf16 peak as the compute floor,
+    per-axis collective payload over the ICI envelope as ``comm_ms``
+    (size-1 axes filtered; both honestly envelopes, the virtual-CPU
+    dryrun measures nothing). ``hideable_ms`` is the per-mesh-shape
+    upper bound on what the overlap paths could hide. ONE trace feeds
+    everything — ``comm`` rides in the result so the dryrun never
+    re-traces the same program for the payload count, and the twin of
+    an already-floored profile can pass ``include_floor=False`` to
+    skip the jit-lowering (the flops are schedule-independent).
+    Returns ``{"schedule": {...}, "overlap_bound": {...}|None,
+    "comm": {axis: bytes}}``; the compute floor degrades to None
+    where the backend reports no flops."""
+    pp, dp, tp = topology
+    _, dp_names, _ = dp_axes_of(dp)
+    jaxpr, sizes, f, batch = _traced_training_jaxpr(
+        devices, cfg, topology, num_microbatches=num_microbatches,
+        micro_batch_size=micro_batch_size, seq_len=seq_len,
+        compress=compress, hierarchical=hierarchical,
+        overlap_grad=overlap_grad, overlap_buckets=overlap_buckets)
+    from apex_tpu.telemetry import costs
+
+    comm = costs.wire_bytes(costs.comm_from_jaxpr(jaxpr), sizes)
+    comm_ms = costs.comm_ms_from_axis_bytes(comm, "tpu")
+    floor_ms = None
+    if include_floor:
+        try:
+            from apex_tpu import _compat
+
+            ca = _compat.cost_analysis_dict(jax.jit(f).lower(batch))
+            flops = ca.get("flops") if ca else None
+            if flops:
+                floor_ms = round(
+                    float(flops) / costs.V5E_PEAK_BF16_FLOPS * 1e3, 6)
+        except Exception:
+            floor_ms = None
+    return {"schedule": costs.collective_schedule(jaxpr, axes=dp_names),
+            "overlap_bound": costs.overlap_bound(floor_ms,
+                                                 comm_ms=comm_ms),
+            "comm": comm}
 
 
 def run_minimal_gpt_training(n_devices=None, cfg=None, num_microbatches=4,

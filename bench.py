@@ -127,7 +127,8 @@ def _dispatch_snapshot():
 
 
 def _capture_step_cost(step, run, step_args, iters, model_flops_per_step,
-                       platform, smoke=False):
+                       platform, smoke=False, host_ms=None,
+                       axis_sizes=None):
     """The attribution block for the measured K-step scan
     (apex_tpu.telemetry.costs): XLA-counted flops / HBM bytes / peak
     HBM + analytic floors, stamped into the JSON line and the ledger
@@ -162,6 +163,7 @@ def _capture_step_cost(step, run, step_args, iters, model_flops_per_step,
     except Exception:
         pass
     comm_compression = None
+    comm_ms = None
     try:
         import jax
 
@@ -170,6 +172,13 @@ def _capture_step_cost(step, run, step_args, iters, model_flops_per_step,
         # length (comm_from_jaxpr multiplies scan bodies by length)
         total = costs.comm_from_jaxpr(jax.make_jaxpr(run)(*step_args))
         comm = {k: v / iters for k, v in total.items()}
+        # the overlap_bound comm side (ROADMAP 4d, ISSUE 14): the
+        # per-step payload over the measured-interconnect ENVELOPE —
+        # size-1 axes move nothing on the wire (the single-chip tp
+        # psums are traced but free), so they are filtered before the
+        # claim, the same rule as minimal.training_comm_bytes
+        comm_ms = costs.comm_ms_from_axis_bytes(
+            costs.wire_bytes(comm, axis_sizes), platform)
         # comm-compression stamp (apex_tpu.parallel.collectives): when
         # the process-wide comm knobs are on, the measured program's
         # payload above is the COMPRESSED one — trace the uncompressed
@@ -193,7 +202,8 @@ def _capture_step_cost(step, run, step_args, iters, model_flops_per_step,
                          comm=comm,
                          model_flops_per_step=model_flops_per_step,
                          platform=platform,
-                         comm_compression=comm_compression)
+                         comm_compression=comm_compression,
+                         host_ms=host_ms, comm_ms=comm_ms)
 
 
 def make_one_step(model, scaler, tx):
@@ -561,11 +571,33 @@ def main():
     # into the timed region
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
     model_flops_per_step = 6.0 * n_params * b * s
+    # the overlap_bound host side (ROADMAP 4a/4d, ISSUE 14): the
+    # measured host→device staging wall of ONE batch — the per-step
+    # cost a synchronous feed serializes and APEX_PREFETCH hides
+    # (apex_tpu.overlap.prefetch). Measured HERE, strictly before the
+    # warm dispatch and t0, so the extra round trips can never leak
+    # into the timed region; smoke runs skip it with the rest of the
+    # capture (the ledger smoke rule).
+    host_stage_ms = None
+    if costs.enabled(default=not env_flag("APEX_BENCH_SMOKE")):
+        from apex_tpu.overlap import prefetch as prefetch_mod
+
+        try:
+            # stage exactly what a per-step feed moves: the int32
+            # ids/labels tensors (rs.randint yields int64 — staging
+            # those would claim ~2x the real bytes; pos is
+            # loop-invariant, a feed never re-stages it)
+            host_stage_ms = prefetch_mod.staging_seconds(
+                (ids_np.astype(np.int32),
+                 labels_np.astype(np.int32))) * 1e3
+        except Exception:
+            host_stage_ms = None
     cost_block = _capture_step_cost(
         step, run, (params, opt_state, scaler_state, jnp.float32(0.0),
                     ids, pos, labels),
         iters, model_flops_per_step, platform,
-        smoke=env_flag("APEX_BENCH_SMOKE"))
+        smoke=env_flag("APEX_BENCH_SMOKE"), host_ms=host_stage_ms,
+        axis_sizes=dict(zip(mesh.axis_names, mesh.devices.shape)))
 
     # compile + warm + drain (donated inputs: rebind the carried state)
     print(f"# compiling {iters}-step scan at b={b} s={s} ...",

@@ -103,6 +103,17 @@ mesh = Mesh(np.asarray(devices).reshape(1, *dp_sizes, 1),
 dp_axes = dp_axis_arg(dp_names)
 spec = P(None, dp_axes)
 
+# the grad-overlap knob now shapes this step too (ISSUE 14:
+# gpt_train_step_fn consults APEX_OVERLAP_GRAD like any measured
+# dispatch) — resolve ONCE, pin the resolved values back into the env
+# so the record's knobs name exactly the schedule the row measured
+# (the same label discipline as the serving pins in profile_serving;
+# an exported =bucketed must never reshape a row labeled terminal
+# without a pin the checker can see)
+from apex_tpu import overlap as overlap_mod  # noqa: E402
+
+GRAD_OVERLAP = overlap_mod.pin_grad_overlap_env()
+
 _, init_params = make_gpt_fns(cfg, 1)
 step, tx, scaler = gpt_train_step_fn(cfg, 1, M, dp_axes=dp_axes)
 
@@ -121,6 +132,12 @@ params, opt_state, scaler_state = jax.jit(jax.shard_map(
     _init_all, mesh=mesh, in_specs=(spec, spec),
     out_specs=(P(), P(), P()), check_vma=False))(ids, labels)
 n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+
+# bucket count resolved AT THE PAYLOAD and pinned (or popped) via
+# the one-home helper — the same discipline as profile_overlap, one
+# implementation (apex_tpu.overlap.pin_overlap_buckets_env)
+OVERLAP_BUCKETS = overlap_mod.pin_overlap_buckets_env(
+    GRAD_OVERLAP, nelems=n_params)
 
 TRACER = Tracer(K, peak_flops=PEAK)
 # nelems: the table tier resolves in the stamp exactly as it does at
@@ -158,7 +175,7 @@ def _comm_bytes():
                             out_specs=P(), check_vma=False)
     raw = costs.comm_from_jaxpr(jax.make_jaxpr(wrapped)(
         params, opt_state, scaler_state, ids, labels))
-    return {ax: v for ax, v in raw.items() if _axis_sizes.get(ax, 2) > 1}
+    return costs.wire_bytes(raw, _axis_sizes)
 
 
 comm = comm_compression = None
@@ -213,4 +230,8 @@ if span.seconds:
     print(f"{'':24s} -> {toks/span.seconds:.0f} tok/s")
 
 TRACER.flush_ledger("profile_comm",
-                    extra={"n_params": n_params, "dp": str(dp_decl)})
+                    extra={"n_params": n_params, "dp": str(dp_decl),
+                           # the overlap claim block (check 10): the
+                           # grad schedule this row's step ran under
+                           "overlap": {"grad": GRAD_OVERLAP,
+                                       "buckets": OVERLAP_BUCKETS}})

@@ -91,17 +91,19 @@ print(f"params: {n_params/1e6:.1f}M   (method: {K}-step lax.scan, 1 dispatch,"
 
 
 def scan_time(name, make_body, carry0, ops, flops_per_iter=None,
-              capture_cost=False):
+              capture_cost=False, **capture_kw):
     """make_body(eps, *ops) -> body(carry, _) -> (carry, metric); the §0
     protocol (K-scan, traced eps, overhead subtraction) via the shared
     Tracer — every row lands in the run's ledger record with its
     calibration metadata. ``ops`` (big arrays) are jit ARGUMENTS —
     closure-captured constants would be inlined into the HLO payload
-    and overflow the remote-compile tunnel."""
+    and overflow the remote-compile tunnel. ``capture_kw`` rides to
+    ``Tracer.scan_time`` (comm / host_ms / comm_ms of the headline
+    row's overlap_bound stamp, ISSUE 14)."""
     span = TRACER.scan_time(name, make_body, carry0, ops,
                             wrap=lambda run: shmap(run, 2 + len(ops)),
                             flops_per_iter=flops_per_iter,
-                            capture_cost=capture_cost)
+                            capture_cost=capture_cost, **capture_kw)
     print(span.format_row(PEAK))
     return span.seconds
 
@@ -242,10 +244,49 @@ if os.environ.get("APEX_CKPT_DIR") and not _cc.warm_only():
 # timed region, free in warm mode, smoke-off like the ledger
 from apex_tpu.telemetry import costs as _costs  # noqa: E402
 
+# ...and its TRAINING overlap_bound inputs (ROADMAP 4d, ISSUE 14):
+# host_ms = the measured host→device staging wall of one batch (what a
+# synchronous feed pays per step and APEX_PREFETCH hides), comm_ms =
+# the per-step collective payload over the ICI envelope (the size-1
+# single-chip tp axis moves nothing and is filtered, the
+# training_comm_bytes rule). Both strictly OUTSIDE the Tracer's timed
+# region; skipped in warm mode (nothing measured there).
+OVERLAP_HOST_MS = OVERLAP_COMM = OVERLAP_COMM_MS = None
+if _costs.enabled(default=not SMOKE) and not _cc.warm_only():
+    from jax import lax as _olax
+
+    from apex_tpu.overlap import prefetch as _prefetch
+
+    try:
+        # exactly what a per-step feed moves: the int32 ids/labels
+        # (pos is loop-invariant — never re-staged; same rule as
+        # bench.py so the two headline harnesses stamp one claim)
+        OVERLAP_HOST_MS = _prefetch.staging_seconds(
+            (np.asarray(ids), np.asarray(labels))) * 1e3
+    except Exception:
+        OVERLAP_HOST_MS = None
+    try:
+        def _full_step_run(c, eps, ids, pos, labels):
+            return _olax.scan(make_step(eps, ids, pos, labels), c,
+                              jnp.arange(K))
+
+        _total = _costs.comm_from_jaxpr(jax.make_jaxpr(
+            shmap(_full_step_run, 5))(step_carry0, jnp.float32(0.0),
+                                      ids, pos, labels))
+        OVERLAP_COMM = {ax: v / K for ax, v in _total.items()}
+        _sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        OVERLAP_COMM_MS = _costs.comm_ms_from_axis_bytes(
+            _costs.wire_bytes(OVERLAP_COMM, _sizes),
+            jax.devices()[0].platform)
+    except Exception:
+        OVERLAP_COMM = OVERLAP_COMM_MS = None
+
 t_step = scan_time("FULL train step", make_step,
                    step_carry0, (ids, pos, labels),
                    flops_per_iter=model_flops_fb,
-                   capture_cost=_costs.enabled(default=not SMOKE))
+                   capture_cost=_costs.enabled(default=not SMOKE),
+                   comm=OVERLAP_COMM, host_ms=OVERLAP_HOST_MS,
+                   comm_ms=OVERLAP_COMM_MS)
 if t_step:  # None under APEX_WARM_ONLY (compile-only, nothing timed)
     print(f"{'':28s} -> {B*S/t_step:.0f} tok/s")
 

@@ -145,6 +145,16 @@ SAMPLING = sampling_mod.resolve()
 os.environ["APEX_SERVE_SAMPLING"] = "1" if SAMPLING else "0"
 PREFIX = prefix_mod.resolve()
 os.environ["APEX_SERVE_PREFIX_CACHE"] = "1" if PREFIX else "0"
+# ...and the host/device overlap knob (ISSUE 14, check 10): the
+# replay's host slice — the overlap_bound stamp below — is a FUNCTION
+# of the engine schedule (serial vs deferred-fetch pipelined), so the
+# resolved value is pinned and claimed like every other shaping knob.
+# Resolution mirrors the engine's (spec engaged -> preference falls
+# back to serial).
+from apex_tpu import overlap as overlap_mod  # noqa: E402
+
+SERVE_OVERLAP = overlap_mod.resolve_serve_overlap(spec_k=SPEC_K)
+os.environ["APEX_SERVE_OVERLAP"] = "1" if SERVE_OVERLAP else "0"
 SLO_TTFT_MS = lifecycle.env_ms("APEX_SERVE_SLO_TTFT_MS",
                                lifecycle.DEFAULT_SLO_TTFT_MS)
 SLO_TPOT_MS = lifecycle.env_ms("APEX_SERVE_SLO_TPOT_MS",
@@ -355,6 +365,10 @@ if not compile_cache.warm_only():
 rid = TRACER.flush_ledger("profile_serving", extra={
     "serving": serving_block,
     "slo": slo_block,
+    # the overlap claim block (ISSUE 14): which engine schedule the
+    # replay's host slice was measured under — check 10 pin-matches
+    # it against the record's knobs
+    "overlap": {"serve": "1" if SERVE_OVERLAP else "0"},
     "config": {"slots": SLOTS, "page_size": PS, "pages": PAGES,
                "max_seq": MAX_SEQ, "prefill_len": PRE_LEN,
                "params_m": round(n_params / 1e6, 1),

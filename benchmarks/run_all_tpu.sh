@@ -149,6 +149,14 @@ run gpt_ln_pallas     900 env APEX_LN_PALLAS=1 python benchmarks/profile_gpt.py
 run gpt_remat_sel     900 env APEX_REMAT=selective python benchmarks/profile_gpt.py
 # long-sequence crossover behind the rows-vs-flash dispatch rule
 run attn_seq4096      900 env APEX_ATTN_SEQ=4096 python benchmarks/profile_attention.py
+# Overlap A/B rungs (ISSUE 14, PERF.md §2): the three overlap paths —
+# bucket-interleaved grad sync, prefetched input pipeline, pipelined
+# serving loop — measured under one harness, baseline vs everything-on
+# (one knob set per record; check 10 pin-matches the claim). The
+# single-chip grad row bounds the schedule overhead only (dp=1 — the
+# overlap win needs the pod-slice window; the row says so).
+run overlap_base      900 python benchmarks/profile_overlap.py
+run overlap_on        900 env APEX_OVERLAP_GRAD=bucketed APEX_PREFETCH=2 APEX_SERVE_OVERLAP=1 python benchmarks/profile_overlap.py
 # full-ladder bench retry: if bench_first already landed healthy this is
 # one cached-compile re-measurement plus the b=16 upside attempt.
 # The END-of-queue bench rows run with the DURABILITY layer armed

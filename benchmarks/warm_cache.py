@@ -229,6 +229,23 @@ def main():
                         [sys.executable, tiles_py, "--child", spec],
                         {"APEX_DISPATCH": "off"}, timeout)
 
+    # overlap A/B program set (benchmarks/profile_overlap.py, ISSUE
+    # 14): both rungs' Tracer rows AOT-warm under APEX_WARM_ONLY=1
+    # (the host-clocked feed/replay loops run nothing in warm mode) —
+    # each under the exact knob env its run_all_tpu.sh row measures
+    # with, so the bucketed and terminal step programs both land in
+    # the cache before the window's rungs dispatch them.
+    overlap_py = os.path.join(REPO, "benchmarks", "profile_overlap.py")
+    for row, extra in (("overlap_base", {}),
+                       ("overlap_on", {"APEX_OVERLAP_GRAD": "bucketed",
+                                       "APEX_PREFETCH": "2",
+                                       "APEX_SERVE_OVERLAP": "1"})):
+        if row in cashed:
+            print(f"warm {row}: skipped (row cashed in the round "
+                  f"manifest)", flush=True)
+            continue
+        warm_target(row, [sys.executable, overlap_py], extra, timeout)
+
     # serving program set (benchmarks/profile_serving.py) — ONLY when
     # its collection rung is armed (APEX_SERVE_BENCH=1 gates the
     # dead-last run_all_tpu.sh row): an unarmed round must not spend

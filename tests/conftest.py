@@ -43,10 +43,12 @@ import pytest  # noqa: E402
 
 @pytest.fixture(scope="session")
 def shared_smoke_cache_dir(tmp_path_factory):
-    """ONE persistent compile cache for every subprocess smoke-bench
+    """ONE persistent compile cache for every subprocess smoke-harness
     deep path in the suite (test_compile_cache's scored-line test seeds
-    it; test_resilience's chaos deep-path tests reuse it) — the smoke
-    bench program is identical across them, so each re-compile after
+    it; test_resilience's chaos deep-path tests reuse it; ISSUE 14
+    extended it to test_overlap's profile_overlap smoke CLI — the PR 6
+    fast-tier rule: deeper cache sharing, not demotion) — each smoke
+    program is identical across its users, so each re-compile after
     the first was pure fast-tier wall time (CLAUDE.md ~5 min budget).
     Tests that assert cold-vs-warm cache SEMANTICS keep their own
     fresh dirs."""

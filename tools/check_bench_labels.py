@@ -106,6 +106,22 @@ Checks:
    must AGREE with the pinned values — a block claiming a diurnal
    trace under a poisson pin (or a 1000 ms attainment under a
    500 ms pin) is the same label-drift class as a wrong caption.
+10. **Overlap pin-match** — a cited record whose cost block (run-level
+    or any span's) carries an ``overlap_bound`` with a non-null
+    ``host_ms``/``comm_ms`` alongside an ``overlap`` claim block
+    (``benchmarks/profile_overlap.py`` / ``profile_serving.py``:
+    ``{grad, buckets, prefetch, serve}`` — which overlap schedules
+    the measured program ran under, ISSUE 14) must PIN the claimed
+    knobs in its recorded ``knobs`` at the claimed values
+    (``APEX_OVERLAP_GRAD`` / ``APEX_OVERLAP_BUCKETS`` /
+    ``APEX_PREFETCH`` / ``APEX_SERVE_OVERLAP``), and — the other
+    direction — a non-off pin of any of those knobs on such a record
+    must appear in the claim block: a host-slice number measured
+    under the pipelined engine but labeled serial (or vice versa) is
+    the same drift class as checks 7-9. Records with an
+    overlap_bound but no claim block (the pre-ISSUE-14 serving rows)
+    predate the knobs and are skipped. Applies to PERF.md citations
+    AND dispatch-table-cited records.
 
 New PERF.md table rows must cite their ledger record id in the caption
 (``ledger:<id>``) — uncited legacy paragraphs are not flagged, but they
@@ -323,6 +339,70 @@ def slo_pin_problems(rec, rid):
     return problems
 
 
+# check 10: the overlap claim fields and the knobs that select them
+# (the "off" value is what an engaged claim must not pin — and what an
+# omitted claim field must not be pinned past; APEX_OVERLAP_BUCKETS
+# has NO off value, so any pinned count at all is "engaged")
+_OVERLAP_CLAIM_KNOBS = (
+    ("grad", "APEX_OVERLAP_GRAD", "off"),
+    ("buckets", "APEX_OVERLAP_BUCKETS", None),
+    ("prefetch", "APEX_PREFETCH", "0"),
+    ("serve", "APEX_SERVE_OVERLAP", "0"),
+)
+
+
+def overlap_problems(rec, rid):
+    """Check-10 pin-match for one cited record; [] when clean, when no
+    cost block carries a non-null overlap_bound host/comm side, or
+    when the record carries no ``overlap`` claim block (the
+    pre-ISSUE-14 rows predate the knobs — no claim, no teeth). Both
+    directions: every non-None claim field must be pinned at the
+    claimed value, and every non-off pin of an overlap knob must
+    appear in the claim — a measured host/comm slice is a FUNCTION of
+    the overlap schedules, so an unpinned or contradicted claim names
+    a program the label did not run."""
+    blocks = [rec.get("cost")]
+    for s in rec.get("spans") or []:
+        if isinstance(s, dict):
+            blocks.append(s.get("cost"))
+            extra = s.get("extra")
+            if isinstance(extra, dict):
+                blocks.append(extra.get("cost"))
+    has_ob = False
+    for b in blocks:
+        ob = b.get("overlap_bound") if isinstance(b, dict) else None
+        if isinstance(ob, dict) and (ob.get("host_ms") is not None
+                                     or ob.get("comm_ms") is not None):
+            has_ob = True
+            break
+    claim = rec.get("overlap")
+    if not has_ob or not isinstance(claim, dict):
+        return []
+    knobs = rec.get("knobs") if isinstance(rec.get("knobs"), dict) else {}
+    problems = []
+    for field, knob, off in _OVERLAP_CLAIM_KNOBS:
+        val = claim.get(field)
+        pin = knobs.get(knob)
+        if val is not None:
+            if pin is None:
+                problems.append(
+                    f"record {rid} claims overlap.{field}={val!r} but "
+                    f"does not pin {knob} in its knobs — an unpinned "
+                    f"overlap row cannot be cited")
+            elif str(pin) != str(val):
+                problems.append(
+                    f"record {rid} claims overlap.{field}={val!r} but "
+                    f"pins {knob}={pin!r} — the claim and the label "
+                    f"name different schedules")
+        elif pin is not None and (off is None or str(pin) != off):
+            problems.append(
+                f"record {rid} pins {knob}={pin!r} (engaged) but its "
+                f"overlap claim omits {field!r} — the measured "
+                f"host/comm slice ran a schedule the claim does not "
+                f"name")
+    return problems
+
+
 def _paragraphs(text):
     """(start_lineno, paragraph_text) blocks of consecutive non-blank
     lines — the unit a caption and its numbers share."""
@@ -397,6 +477,9 @@ def check_captions(perf_text, perf_path, records):
                 problems.append(f"{perf_path}:{lineno}: {p}")
             # check 9: slo-block pin-match + threshold/arrival agreement
             for p in slo_pin_problems(rec, rid):
+                problems.append(f"{perf_path}:{lineno}: {p}")
+            # check 10: overlap-schedule pin-match (both directions)
+            for p in overlap_problems(rec, rid):
                 problems.append(f"{perf_path}:{lineno}: {p}")
             if rec.get("resumed_from") is not None \
                     and COLD_RE.search(para):
@@ -493,6 +576,11 @@ def check_dispatch_table(path, records):
                     problems.append(f"{tag}: {p}")
                 # check 9 on the table side: same slo teeth
                 for p in slo_pin_problems(rec, rid):
+                    problems.append(f"{tag}: {p}")
+                # check 10 on the table side: an overlap_buckets (or
+                # any) entry decided by an overlap-measured row must
+                # cite a knob-pinned, claim-consistent record
+                for p in overlap_problems(rec, rid):
                     problems.append(f"{tag}: {p}")
     return problems, len(entries)
 

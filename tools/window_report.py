@@ -170,8 +170,18 @@ def ledger_summary(records):
             # future overlap/scheduler PR is chasing, named per record
             ob = cost.get("overlap_bound")
             if isinstance(ob, dict):
-                overlap_rows.append(dict(
-                    ob, id=rec.get("id"), harness=rec.get("harness")))
+                row = dict(ob, id=rec.get("id"),
+                           harness=rec.get("harness"))
+                # the ISSUE 14 columns: which overlap schedules the
+                # record claims it measured under, and the jaxpr-level
+                # collective-schedule verdict (interleaved/terminal)
+                cs = rec.get("collective_schedule")
+                if isinstance(cs, dict):
+                    row["schedule_verdict"] = cs.get("verdict")
+                claim = rec.get("overlap")
+                if isinstance(claim, dict):
+                    row["claim"] = claim
+                overlap_rows.append(row)
         # serving economics (ISSUE 11): per-trace SLO attainment,
         # goodput vs decode-throughput gap, occupancy high-waters —
         # one row per record carrying a serving and/or slo block
@@ -331,6 +341,14 @@ def print_report(report, out=None):
             if o.get("hideable_ms") is not None:
                 line += (f" -> hideable {_ms(o['hideable_ms'])}, best "
                          f"overlapped step {_ms(o.get('bound_step_ms'))}")
+            if o.get("schedule_verdict"):
+                line += f" [schedule={o['schedule_verdict']}]"
+            claim = o.get("claim")
+            if isinstance(claim, dict):
+                bits = " ".join(f"{k}={v}" for k, v in
+                                sorted(claim.items()) if v is not None)
+                if bits:
+                    line += f" [{bits}]"
             p(line)
         if led.get("serving"):
             p("  serving economics:")
