@@ -40,7 +40,9 @@ SUITES = {
             "test_mosaic_block_rules.py", "test_tile_params.py",
             "test_decode_attention_pallas.py"],
     "serving": ["test_serving.py", "test_serving_slo.py",
-                "test_serving_generation.py"],
+                "test_serving_generation.py",
+                "test_serving_resilience.py",
+                "test_serving_chaos.py"],
     "api_parity": ["test_api_parity_round3.py"],
     "harness": ["test_run_tests.py", "test_bench_contract.py",
                 "test_compile_cache.py", "test_resilience.py",

@@ -77,6 +77,19 @@ AUTOTUNE_BUDGET_S = 3600   # autotune global pass budget
 AUTOTUNE_BUDGET_SMOKE_S = 600
 WARM_TIMEOUT_S = 1500      # warm_cache per-target subprocess cap
 PROBE_TIMEOUT_S = 300      # marginal-rate matmul probe cap
+# Serving entries of the §6 envelope (ISSUE 15): the ServingEngine's
+# per-round dispatch watchdog (apex_tpu/serving/resilience.py) reads
+# its defaults from here — a decode/prefill round that rides this long
+# without producing its fetch is the relay wedge signature, not a slow
+# step (the real-config decode round is O(100 ms); the budget covers a
+# relay-degraded-but-live round with compile headroom).
+SERVE_DISPATCH_TIMEOUT_S = 300   # per-round device-dispatch budget
+SERVE_ROUND_ATTEMPTS = 3         # consecutive failed rounds before the
+#                                  engine gives up (bounded recovery —
+#                                  a dead device must not spin forever)
+SERVE_ROUND_RETRY_WAIT_S = 5     # pause before re-driving a failed
+#                                  round (relay-flap pacing; chaos
+#                                  tests pin 0)
 
 # Exit statuses that mean "the budget killed it" (the wedge signature):
 # timeout(1)'s 124/137, shell-reported SIGTERM (143 = 128+15), and the

@@ -23,11 +23,12 @@ fault::
     {"site":  "backend_init" | "mid_attempt" | "large_program" |
               "compile" | "calibration_overhead" | "emit" | "verdict" |
               "autotune_budget" | "ckpt_commit" | "ckpt_manifest" |
-              "ckpt_data" | "final_save",
+              "ckpt_data" | "final_save" | "serve_alloc" |
+              "serve_prefill" | "serve_decode" | "serve_burst",
      "kind":  "hang" | "raise" | "exit" | "fabricate" |
               "sigterm_parent" | "sigkill" | "inflate" | "truncate" |
               "degraded" | "set_budget" | "set_field" |
-              "truncate_file" | "corrupt_file",
+              "truncate_file" | "corrupt_file" | "deny" | "burst",
      "match_env": {"VAR": "value" | null},   # null = must be unset
      "match_ctx": {"step": 2, "phase": "data_visible"},  # hook kwargs
      ... kind-specific fields ...}
@@ -61,6 +62,17 @@ truncated/corrupt checkpoint file         ckpt_data/truncate_file or
   (disk rot, torn write)                    corrupt_file
 stale-step restore (tampered manifest)    ckpt_manifest/set_field
 SIGTERM during the final save             final_save/hang + outer kill
+KV-page exhaustion at a chosen round      serve_alloc/deny with
+  (serving, ISSUE 15)                       match_ctx tick/phase + times
+decode dispatch hang / exception          serve_decode/hang or raise
+  (relay wedge mid-serving-round)           with match_ctx step
+prefill failure mid-admission             serve_prefill/raise or hang
+  (also fired by speculative VERIFY         (one site — verify rides
+  dispatches of the same program)           the same compiled program)
+trace burst overload (submit storm)       serve_burst/burst with
+                                            match_ctx tick (the engine
+                                            fabricates + submits the
+                                            scripted burst)
 =======================================  ================================
 
 Kind-specific fields: ``seconds`` (hang: sleep N then continue; absent
@@ -70,7 +82,9 @@ Kind-specific fields: ``seconds`` (hang: sleep N then continue; absent
 ``budget_s`` (set_budget), ``min_batch`` (large_program matcher),
 ``field``/``value`` (set_field: tamper one JSON field pre-write),
 ``keep_bytes`` (truncate_file), ``offset`` (corrupt_file: XOR one
-byte).
+byte), ``times`` (deny: fire at most N times — one scripted refusal
+forces exactly one preemption), ``count``/``prompt_len``/``max_new``/
+``rid_base`` (burst: the fabricated submit storm's shape).
 
 Stdlib-only, and every check is a no-op dict lookup when the env var is
 unset — the hooks cost nothing on the scored path.
@@ -85,7 +99,7 @@ import time
 
 ENV = "APEX_FAULT_PLAN"
 
-_cache = {"raw": None, "plan": None, "hash": None}
+_cache = {"raw": None, "plan": None, "hash": None, "fired": {}}
 
 
 def active():
@@ -112,7 +126,7 @@ def plan():
         raise ValueError(f"{ENV}: fault plan must be a list of faults")
     canon = json.dumps(faults, sort_keys=True)
     _cache.update(
-        raw=raw, plan=faults,
+        raw=raw, plan=faults, fired={},
         hash="fp-" + hashlib.sha1(canon.encode()).hexdigest()[:10])
     return faults
 
@@ -271,6 +285,56 @@ def damage_file(site, path, **ctx):
                 b = f.read(1)
                 f.seek(off)
                 f.write(bytes([b[0] ^ 0xFF]) if b else b"\xff")
+
+
+def _spend(idx, fault):
+    """True when *fault* (at plan index *idx*) still has budget under
+    its optional ``times`` cap, consuming one firing. Unbounded faults
+    always fire — the cap exists so a scripted refusal (``deny``) can
+    force exactly N preemptions instead of denying every retry of the
+    same round."""
+    if "times" not in fault:
+        return True
+    n = _cache["fired"].get(idx, 0)
+    if n >= int(fault["times"]):
+        return False
+    _cache["fired"][idx] = n + 1
+    return True
+
+
+def denied(site, **ctx):
+    """``deny``-kind faults (serving KV-pressure chaos, ISSUE 15):
+    True when a matching fault refuses this allocation — the scheduler
+    treats it exactly like an empty free list, so the preemption path
+    runs under scripted page pressure without shrinking the pool."""
+    if not active():
+        return False
+    for idx, fault in enumerate(plan()):
+        if fault.get("site") != site or fault.get("kind") != "deny" \
+                or not _match(fault, ctx):
+            continue
+        if _spend(idx, fault):
+            _say(fault, f" (alloc refused, ctx={ctx})")
+            return True
+    return False
+
+
+def burst(site, **ctx):
+    """``burst``-kind faults (serving overload chaos, ISSUE 15): the
+    matching fault dict — the ENGINE fabricates and submits the
+    scripted request storm (count/prompt_len/max_new/rid_base fields)
+    so admission control is exercised through the real submit path —
+    or None."""
+    if not active():
+        return None
+    for idx, fault in enumerate(plan()):
+        if fault.get("site") != site or fault.get("kind") != "burst" \
+                or not _match(fault, ctx):
+            continue
+        if _spend(idx, fault):
+            _say(fault, f" (burst ctx={ctx})")
+            return fault
+    return None
 
 
 def injected_degraded():

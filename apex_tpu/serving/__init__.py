@@ -39,12 +39,24 @@ Layering:
                    (gated on ``APEX_SERVE_EVENTS`` /
                    ``lifecycle.enable()`` — disabled mode is
                    behavior-identical; ISSUE 11)
+* ``resilience`` — stdlib-only serving failure story (ISSUE 15):
+                   admission control's structured ``Rejected``,
+                   deadline shedding, KV-pressure preemption
+                   plumbing, and the per-round dispatch watchdog
+                   (``APEX_SERVE_ADMIT`` / ``APEX_SERVE_SHED`` /
+                   ``APEX_SERVE_PREEMPT`` / ``APEX_SERVE_RECOVER``,
+                   all default OFF)
 * ``engine``     — the glue: one ServingEngine owning cache, params,
                    compiled steps and the scheduler loop
 """
 
 from apex_tpu.serving import lifecycle  # noqa: F401
+from apex_tpu.serving import resilience  # noqa: F401
 from apex_tpu.serving import speculative  # noqa: F401
+from apex_tpu.serving.resilience import (  # noqa: F401
+    DispatchFailure,
+    Rejected,
+)
 from apex_tpu.serving.kv_cache import (  # noqa: F401
     PageAllocator,
     init_cache,

@@ -96,6 +96,43 @@ in-flight round for callers that stop stepping (``run_trace`` flushes
 for you); until then the newest token per live request is a
 placeholder.
 
+Serving resilience (ISSUE 15, :mod:`apex_tpu.serving.resilience`) —
+four default-OFF layers, disabled mode token-for-token identical:
+
+* **admission control** (``admit=`` > ``APEX_SERVE_ADMIT``): a full
+  submit queue returns a structured ``Rejected(reason,
+  retry_after_ticks)`` instead of enqueueing — overload is load, not
+  an exception, and the queue is bounded.
+* **deadline shedding** (``shed=`` > ``APEX_SERVE_SHED``): queued
+  requests whose TTFT SLO is already blown (waited past the
+  threshold — attainment impossible) are dropped with a ``shed``
+  lifecycle event before admission.
+* **KV-pressure preemption** (``preempt=`` > ``APEX_SERVE_PREEMPT``):
+  admission reserves PROMPT pages only and decode grows the table
+  mid-stream; a refused grant preempts the lowest-effective-priority
+  running slot (pages freed, prefix refcounts respected, stream
+  requeued) and re-admission REPLAYS the preempted stream through
+  the same packed prefill program (``_replay_prefill`` — no third
+  program, token-for-token parity with the never-preempted stream).
+  Per-call True raises when the pool cannot guarantee a lone
+  survivor's progress; the env preference falls back.
+* **dispatch watchdog + round recovery** (``recover=`` >
+  ``APEX_SERVE_RECOVER``): every dispatch runs under the
+  ``guarded_dispatch`` timeout (``resilience.
+  SERVE_DISPATCH_TIMEOUT_S``); a wedged/crashed round requeues every
+  in-flight request with ``degraded_round`` events, rebuilds the
+  cache, and continues — bounded by ``SERVE_ROUND_ATTEMPTS``
+  consecutive failures with ``RetryPolicy`` pacing.
+
+Preemption/recovery demand the serial round (the deferred-fetch
+step's placeholder tokens must never reach a requeued stream): the
+pairing with ``overlap=`` follows the spec-decode precedent — two
+demands raise, a demand drops the other side's env preference,
+env-vs-env falls back to serial. The ``serve_*`` chaos sites
+(``apex_tpu.resilience.faults``) fire inside the dispatch closures,
+so ``tests/test_serving_chaos.py`` drives every recovery path through
+the real engine.
+
 Observability (ISSUE 11): when ``lifecycle.enabled()`` the engine
 keeps a request-lifecycle :class:`~apex_tpu.serving.lifecycle.EventLog`
 (``self.events``) — submitted/admitted/prefill_done/first_token/
@@ -115,14 +152,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from apex_tpu import resilience as res_mod
+from apex_tpu.resilience import faults as faults_mod
 from apex_tpu.serving import lifecycle
 from apex_tpu.serving import model as smodel
 from apex_tpu.serving import prefix_cache as prefix_mod
 from apex_tpu.serving import quant as quant_mod
+from apex_tpu.serving import resilience as serve_res
 from apex_tpu.serving import sampling as sampling_mod
 from apex_tpu.serving import speculative as spec_mod
 from apex_tpu.serving.kv_cache import PageAllocator, init_cache
-from apex_tpu.serving.scheduler import ContinuousBatchingScheduler
+from apex_tpu.serving.scheduler import ContinuousBatchingScheduler, Request
 
 
 def detokenize(tokens):
@@ -136,11 +176,15 @@ class ServingEngine:
                  prefill_requests=None, weight_quant=None,
                  decode_impl=None, decode_block_h=None, interpret=None,
                  policy=None, sampling=None, spec_decode=None,
-                 prefix_cache=None, overlap=None, seed=0):
+                 prefix_cache=None, overlap=None, admit=None,
+                 shed=None, preempt=None, recover=None,
+                 shed_ttft_ms=None, dispatch_timeout_s=None,
+                 round_attempts=None, round_retry_wait_s=None, seed=0):
         smodel.check_serving_config(cfg)
         self.cfg = cfg
         self.num_slots = int(num_slots)
         self.page_size = int(page_size)
+        self.num_pages = int(num_pages)
         self.max_seq = int(max_seq or cfg.max_position_embeddings)
         if self.max_seq > cfg.max_position_embeddings:
             raise ValueError("max_seq exceeds the position table")
@@ -193,8 +237,77 @@ class ServingEngine:
         if overlap is True and self.spec_k and spec_decode is None:
             self.spec_k = 0
             self.spec_stats = None
+        # serving resilience (ISSUE 15): four default-OFF layers.
+        # Preemption and round recovery need the serial round (the
+        # deferred-fetch step's placeholder tokens must never reach a
+        # requeued stream), so the pairing follows the spec-decode
+        # precedent: two per-call demands raise, a demand drops the
+        # other side's env preference, env-vs-env falls back to the
+        # serial step. Admission control and shedding are queue-side
+        # and compose with every schedule.
+        if overlap is True and (preempt is True or recover is True):
+            raise ValueError(
+                "overlap=True cannot be honored with preempt=True/"
+                "recover=True: the deferred-fetch round holds "
+                "placeholder tokens a preempted/requeued stream would "
+                "replay as values (two demands, no honorable order)")
+        self.preempt = serve_res.resolve_preempt(preempt)
+        self.recover = serve_res.resolve_recover(recover)
+        if overlap is True:
+            # env resilience preferences drop before the explicit
+            # overlap demand (preference semantics, never a raise)
+            if preempt is None:
+                self.preempt = False
+            if recover is None:
+                self.recover = False
+        if self.preempt and self.num_pages - 1 < self.max_pages:
+            # the progress guarantee of overcommit admission: with
+            # everything else preempted, a lone request must still be
+            # able to grow to max_seq pages — otherwise preemption
+            # trades a head-of-line block for a genuine livelock
+            if preempt is True:
+                raise ValueError(
+                    f"preempt=True cannot be honored: the page pool "
+                    f"({self.num_pages - 1} allocatable) cannot cover "
+                    f"one request's max_seq table ({self.max_pages} "
+                    f"pages) — a lone preemption survivor could wedge")
+            self.preempt = False  # env preference: falls back per shape
+        self.admit_limit = serve_res.resolve_admit(admit)
+        self.shed = serve_res.resolve_shed(shed)
+        if shed_ttft_ms is not None:
+            if not isinstance(shed_ttft_ms, (int, float)) \
+                    or isinstance(shed_ttft_ms, bool) or shed_ttft_ms <= 0:
+                raise ValueError(
+                    f"shed_ttft_ms= wants a positive number, got "
+                    f"{shed_ttft_ms!r}")
+            self.shed_ttft_ms = float(shed_ttft_ms)
+        else:
+            self.shed_ttft_ms = lifecycle.env_ms(
+                "APEX_SERVE_SLO_TTFT_MS", lifecycle.DEFAULT_SLO_TTFT_MS)
+        self.dispatch_timeout_s = float(
+            dispatch_timeout_s if dispatch_timeout_s is not None
+            else res_mod.SERVE_DISPATCH_TIMEOUT_S)
+        self.round_attempts = int(
+            round_attempts if round_attempts is not None
+            else res_mod.SERVE_ROUND_ATTEMPTS)
+        # RetryPolicy pacing between failed rounds (the §6 serving
+        # envelope); explicit args so the bench-attempt env knobs
+        # never leak into the serving loop
+        self._round_retry = res_mod.RetryPolicy(
+            attempts=self.round_attempts,
+            retry_wait_s=round_retry_wait_s
+            if round_retry_wait_s is not None
+            else res_mod.SERVE_ROUND_RETRY_WAIT_S)
+        self._round_failures = 0   # consecutive; reset on any clean round
+        self.resilience = serve_res.ResilienceStats()
+        self.rejected = []         # [(request, Rejected)] at submit
         self.overlap = overlap_mod.resolve_serve_overlap(
             overlap, spec_k=self.spec_k)
+        if self.overlap and (self.preempt or self.recover):
+            # the APEX_SERVE_OVERLAP preference falls back to serial
+            # when a resilience layer is engaged (same fall-back the
+            # spec-decode pairing takes)
+            self.overlap = False
         self._pending = None  # in-flight decode round (overlap mode)
         self.prefix_enabled = prefix_mod.resolve(prefix_cache)
         self.prefix = prefix_mod.PrefixCache(
@@ -204,14 +317,15 @@ class ServingEngine:
         # verify chain needs K+1 rows; plain prefill reads row r*w
         self._gather_w = self.spec_k + 1
 
+        self._cache_dtype = smodel.compute_dtype(cfg)
         self.cache = init_cache(
             cfg.num_layers, cfg.num_attention_heads, num_pages,
-            page_size, cfg.head_dim, smodel.compute_dtype(cfg))
+            page_size, cfg.head_dim, self._cache_dtype)
         self.allocator = self.prefix.allocator if self.prefix \
             is not None else PageAllocator(num_pages)
         self.scheduler = ContinuousBatchingScheduler(
             num_slots, self.max_pages, page_size, self.allocator,
-            policy=policy, prefix=self.prefix)
+            policy=policy, prefix=self.prefix, preempt=self.preempt)
         # lifecycle observability (gated, host-side only): None when
         # collection is off — disabled mode appends nothing and reads
         # no extra clocks beyond the per-round stamps below
@@ -306,6 +420,45 @@ class ServingEngine:
                 if pf is not None and pf.lookup_tokens else None,
         }
 
+    def resilience_rates(self):
+        """The ledger-facing resilience account (ISSUE 15), shaped for
+        ``lifecycle.slo_block(resilience=)``: shed / preempt rates and
+        the degraded-round count, each None when its layer is off —
+        degradation, never omission (check 9 teeth)."""
+        return self.resilience.rates(
+            shed_on=self.shed, preempt_on=self.preempt,
+            recover_on=self.recover)
+
+    def _dispatch(self, phase, fn):
+        """One device dispatch (call + fetch, no engine-state writes
+        inside) under the resilience layer: the ``serve_*`` chaos
+        sites fire inside the dispatched closure (so an injected hang
+        blocks exactly where the live relay wedges), and with
+        ``recover`` on the whole closure runs under the
+        :func:`~apex_tpu.serving.resilience.guarded_dispatch`
+        watchdog — a timeout or crash surfaces as a classified
+        :class:`~apex_tpu.serving.resilience.DispatchFailure` the
+        round-recovery path catches. Without the knob the failure
+        propagates (and a watchdog-less engine dies with it — the A/B
+        the chaos suite pins). The ``verify`` phase dispatches the
+        SAME compiled program as admission prefill, so it shares the
+        ``serve_prefill`` chaos site — but keeps its own failure
+        label, so a degraded round's verdict names the dispatch that
+        actually wedged."""
+        site = "serve_prefill" if phase == "verify" \
+            else f"serve_{phase}"
+
+        def call():
+            faults_mod.fire(site, tick=self.tick,
+                            step=self.decode_steps,
+                            call=self.prefill_batches)
+            return fn()
+
+        if not self.recover:
+            return call()
+        return serve_res.guarded_dispatch(
+            call, self.dispatch_timeout_s, phase)
+
     def submit(self, request):
         """Enqueue one request; impossible requests raise HERE, before
         anything is enqueued or allocated. The scheduler validates the
@@ -315,7 +468,20 @@ class ServingEngine:
         had already filled a slot and allocated pages — is checked at
         the same front door. Sampling demands are validated here too:
         stochastic params against a sampling-OFF engine raise (an
-        explicit request is a demand, not a preference)."""
+        explicit request is a demand, not a preference).
+
+        Under admission control (ISSUE 15, ``admit=`` /
+        ``APEX_SERVE_ADMIT``) a FULL queue is load, not a programming
+        error: submit returns a structured
+        :class:`~apex_tpu.serving.resilience.Rejected` (reason +
+        retry-after estimate in ticks) instead of enqueueing — an
+        exception never escapes the serving loop for overload, and
+        the queue can never grow without bound. Returns None when the
+        request was enqueued."""
+        self.resilience.submit_attempts += 1
+        # impossible-request teeth FIRST: a full queue rejects load,
+        # it must never mask a malformed request as a Rejected
+        self.scheduler.validate(request)
         if len(request.prompt) > self.prefill_len:
             raise ValueError(
                 f"request {request.rid}: prompt ({len(request.prompt)} "
@@ -331,11 +497,31 @@ class ServingEngine:
                     f"(sampling=True / APEX_SERVE_SAMPLING=1)")
             if request.rng_key is None:
                 request.rng_key = sampling_mod.request_key(sp.seed)
+        if self.admit_limit \
+                and self.scheduler.queue_depth() >= self.admit_limit:
+            # explicit reject at the front door: nothing enqueued,
+            # nothing allocated. The retry-after estimate is the
+            # queued-ahead count over the slot drain width — a pacing
+            # hint, not a promise.
+            rej = serve_res.Rejected(
+                "queue_full",
+                max(1, -(-self.scheduler.queue_depth()
+                         // self.num_slots)))
+            self.resilience.rejected += 1
+            self.rejected.append((request, rej))
+            if self.events is not None:
+                wall = time.perf_counter()
+                self.events.record("submitted", request.rid,
+                                   tick=self.tick, wall=wall)
+                self.events.record("rejected", request.rid,
+                                   tick=self.tick, wall=wall)
+            return rej
         request.enqueue_wall = time.perf_counter()
         self.scheduler.submit(request, tick=self.tick)
         if self.events is not None:
             self.events.record("submitted", request.rid, tick=self.tick,
                                wall=request.enqueue_wall)
+        return None
 
     # -------------------------------------------------- page-level hops
 
@@ -403,7 +589,7 @@ class ServingEngine:
             batches.append(cur)
         return batches
 
-    def _packed_call(self, rows):
+    def _packed_call(self, rows, phase="prefill"):
         """ONE dispatch of the packed prefill program for pre-split
         ``rows = [(slot_idx, fed_tokens, write_from, gather_pos)]`` —
         the single assembly both admission prefill and speculative
@@ -433,19 +619,74 @@ class ServingEngine:
                 gather_idx[r * W + j] = cursor + gp
             cursor += n
         t0 = time.perf_counter()
-        self.cache, logits = self._prefill_fn(
-            self.cache, jnp.asarray(ids), jnp.asarray(positions),
-            jnp.asarray(seg), jnp.asarray(token_rows),
-            jnp.asarray(pt), jnp.asarray(gather_idx))
+
+        def call():
+            cache, logits = self._prefill_fn(
+                self.cache, jnp.asarray(ids), jnp.asarray(positions),
+                jnp.asarray(seg), jnp.asarray(token_rows),
+                jnp.asarray(pt), jnp.asarray(gather_idx))
+            if self.recover:
+                # fetch INSIDE the watchdog: the sync on the gathered
+                # logits is where a wedged round actually blocks
+                logits = np.asarray(logits)
+            return cache, logits
+
+        # state adopted only after a clean return: a timed-out round's
+        # late result can never overwrite the recovered engine
+        self.cache, logits = self._dispatch(phase, call)
         return logits, t0
+
+    def _replay_prefill(self, resumed):
+        """Re-admission replay of preempted/requeued slots (ISSUE 15)
+        through the SAME packed prefill program: each slot's known
+        stream (minus the still-pending last token) is one segment
+        writing its fresh pages — the re-prefilled K/V is the same
+        computation the decode path originally wrote, so the resumed
+        greedy stream is token-for-token the never-preempted stream.
+        No token is sampled and no first-token seam fires (the stream
+        is already known; the gathered logits row is fixed-shape
+        dispatch ballast). A stream longer than the prefill bucket
+        replays its overflow through the decode warmup path (the
+        ``slot.known`` bookkeeping), one token per round."""
+        sch = self.scheduler
+        items = []
+        for si in resumed:
+            slot = sch.slots[si]
+            fed = slot.request.resume_tokens[:-1][:self.prefill_len]
+            items.append((si, fed))
+        for batch in self._pack_greedy(items,
+                                       [len(f) for _, f in items]):
+            rows = []
+            for si, fed in batch:
+                self._assert_writable(sch.slots[si], 0, len(fed) - 1)
+                rows.append((si, fed, 0, [len(fed) - 1]))
+            logits, t0 = self._packed_call(rows)
+            self.prefill_batches += 1
+            _ = np.asarray(logits[:1, :1])  # close the dispatch seam
+            wall = time.perf_counter()
+            self.device_dispatch_s += wall - t0
+            for si, fed in batch:
+                slot = sch.slots[si]
+                slot.pos = len(fed)
+                slot.next_token = int(slot.known[len(fed)])
 
     def _run_prefill(self, slot_indices):
         """Pack the newly admitted slots' prompts into [prefill_len]
         batches and fill the cache (every prompt position writes its
         slot's pages; the one logits gather per request reads the last
         prompt token). Sets each slot's first decode token, and
-        registers fresh prompts with the prefix cache."""
+        registers fresh prompts with the prefix cache. Resumed slots
+        (a preempted stream re-admitted, ISSUE 15) replay through
+        :meth:`_replay_prefill` first — same compiled program, no
+        sampling."""
         sch = self.scheduler
+        resumed = [si for si in slot_indices
+                   if sch.slots[si].request.resume_tokens]
+        slot_indices = [si for si in slot_indices if si not in resumed]
+        if resumed:
+            self._replay_prefill(resumed)
+        if not slot_indices:
+            return resumed
         for si in slot_indices:
             n = len(sch.slots[si].request.prompt)
             if n > self.prefill_len:
@@ -500,7 +741,7 @@ class ServingEngine:
                         slot.shared_pages.extend(adopted)
                     for src, dst in copies:
                         self._copy_page(src, dst)
-        return slot_indices
+        return resumed + slot_indices
 
     # ------------------------------------------------------- speculative
 
@@ -515,7 +756,10 @@ class ServingEngine:
         for i in active:
             slot = sch.slots[i]
             req = slot.request
-            if req.done() or slot.pos < len(req.prompt):
+            # known covers the prompt AND a resumed stream's warmup
+            # (ISSUE 15): a slot still consuming known tokens never
+            # drafts — the verify arithmetic assumes pos is past them
+            if req.done() or slot.pos < len(slot.known):
                 continue
             sp = getattr(req, "sampling", None)
             if sp is not None and not sp.greedy:
@@ -561,7 +805,7 @@ class ServingEngine:
                 self._assert_writable(slot, pos, len(fed) - 1)
                 rows.append((i, fed, pos,
                              list(range(pos, pos + len(draft) + 1))))
-            logits, t0 = self._packed_call(rows)
+            logits, t0 = self._packed_call(rows, phase="verify")
             self.verify_calls += 1
             greedy = np.asarray(jnp.argmax(
                 logits.astype(jnp.float32), axis=-1))
@@ -621,7 +865,18 @@ class ServingEngine:
                      jnp.asarray(top_ps), jnp.asarray(keys),
                      jnp.asarray(counters)]
         t0 = time.perf_counter()
-        self.cache, next_toks, _ = self._decode_fn(*args)
+
+        def call():
+            cache, toks, _ = self._decode_fn(*args)
+            if self.recover:
+                # fetch INSIDE the watchdog — the token sync is where
+                # a wedged decode round actually blocks
+                toks = np.asarray(toks)
+            return cache, toks
+
+        # state adopted only after a clean return (a timed-out
+        # round's late result never overwrites the recovered engine)
+        self.cache, next_toks = self._dispatch("decode", call)
         return next_toks, t0
 
     def _sample_gauges(self, tick):
@@ -644,7 +899,12 @@ class ServingEngine:
             hol_wait_s=sch.head_of_line_wait(wall, tick=tick),
             spec_drafted=st.drafted if st is not None else 0,
             spec_accepted=st.accepted if st is not None else 0,
-            prefix_hit_tokens=pf.hit_tokens if pf is not None else 0)
+            prefix_hit_tokens=pf.hit_tokens if pf is not None else 0,
+            rejected=self.resilience.rejected,
+            shed=self.resilience.shed,
+            preempted=self.resilience.preempted,
+            resubmitted=self.resilience.resubmitted,
+            degraded_rounds=self.resilience.degraded_rounds)
 
     def step(self, arrivals=None):
         """One scheduler round: enqueue due arrivals, evict, admit (+
@@ -658,14 +918,103 @@ class ServingEngine:
             return self._step_overlap(arrivals)
         return self._step_serial(arrivals)
 
-    def _step_serial(self, arrivals=None):
+    def _fire_burst(self, tick):
+        """Chaos: the ``serve_burst`` site (ISSUE 15) — fabricate and
+        submit a scripted request storm through the REAL submit path,
+        so admission control's structured rejections (and the shedder
+        behind them) are exercised by an actual overload, not a
+        mocked queue."""
+        spec = faults_mod.burst("serve_burst", tick=tick)
+        if not spec:
+            return
+        base = int(spec.get("rid_base", 9_000_000))
+        plen = int(spec.get("prompt_len", 4))
+        for j in range(int(spec.get("count", 8))):
+            self.submit(Request(
+                rid=base + j, prompt=[1 + (j % 7)] * plen,
+                max_new_tokens=int(spec.get("max_new", 4)),
+                arrival=float(tick)))
+
+    def _shed_queue(self, tick, wall):
+        """The deadline shedder (ISSUE 15): drop queued requests whose
+        SLO attainment is already IMPOSSIBLE — one that has waited
+        past the TTFT threshold cannot attain whatever happens next
+        (its TTFT is at least its wait), so decoding it would burn
+        rounds on a lost cause while attainable requests queue behind
+        it. Conservative by construction: a request with a first
+        token already (a requeued preemption victim mid-stream) has
+        its TTFT fixed and is never shed."""
         sch = self.scheduler
+        dropped = []
+        for req in list(sch.queue):
+            if req.first_token_wall is not None \
+                    or req.enqueue_wall is None:
+                continue
+            if (wall - req.enqueue_wall) * 1e3 > self.shed_ttft_ms:
+                sch.queue.remove(req)
+                req.shed_tick = tick
+                sch.shed.append(req)
+                self.resilience.shed += 1
+                dropped.append(req)
+                if self.events is not None:
+                    self.events.record("shed", req.rid, tick=tick,
+                                       wall=wall)
+        return dropped
+
+    def _drain_preempted(self, tick):
+        """Record lifecycle events + counters for requests the
+        scheduler preempted since the last drain (page-pressure
+        growth, :meth:`ContinuousBatchingScheduler.grow`)."""
+        preempted = self.scheduler.take_preempted()
+        for req in preempted:
+            self.resilience.preempted += 1
+            self.resilience.resubmitted += 1
+            if self.events is not None:
+                wall = time.perf_counter()
+                self.events.record("preempted", req.rid, tick=tick,
+                                   wall=wall)
+                self.events.record("resubmitted", req.rid, tick=tick,
+                                   wall=wall)
+        return preempted
+
+    def _ensure_pages(self, lanes_pos, tick):
+        """Mid-stream page growth (preemption mode): make every
+        lane's table cover its highest write position this round,
+        preempting the lowest-effective-priority slot when a grant is
+        refused. Returns the lanes still alive — a lane preempted to
+        make room (possibly by its own growth) drops out of the
+        round."""
+        sch = self.scheduler
+        alive = []
+        for i, hi in lanes_pos:
+            if sch.slots[i] is None:
+                continue  # preempted by an earlier lane's growth
+            if sch.grow(i, hi // self.page_size + 1, tick):
+                alive.append(i)
+        self._drain_preempted(tick)
+        return [i for i in alive if sch.slots[i] is not None]
+
+    def _step_serial(self, arrivals=None):
         now = self.tick
+        self._fire_burst(now)
         if arrivals:
             for req in arrivals:
                 self.submit(req)
+        try:
+            result = self._round_serial(now)
+        except serve_res.DispatchFailure as failure:
+            # only the guarded (recover=on) dispatch raises this —
+            # without the watchdog the raw failure propagates and the
+            # engine dies with it (the A/B the chaos suite pins)
+            return self._recover_round(now, failure)
+        self._round_failures = 0
+        return result
+
+    def _round_serial(self, now):
+        sch = self.scheduler
         wall = time.perf_counter()
         evicted = sch.evict_done(now, wall)
+        shed = self._shed_queue(now, wall) if self.shed else []
         admitted = sch.admit(now, wall)
         if self.events is not None:
             for r in evicted:
@@ -673,6 +1022,7 @@ class ServingEngine:
             for i in admitted:
                 self.events.record("admitted", sch.slots[i].request.rid,
                                    tick=now, wall=wall)
+        self.resilience.admissions += len(admitted)
         # prefix-cache hits skip the packed prefill: their COW copies
         # run here (between dispatches) and their covered suffix
         # replays through the decode program below
@@ -690,9 +1040,33 @@ class ServingEngine:
         verified = []
         if self.spec_k and active:
             drafts = self._propose_drafts(active)
+            if self.preempt and drafts:
+                # the verify window writes pos..pos+|draft| — grow the
+                # tables first (a grown-out lane drops its draft)
+                alive = set(self._ensure_pages(
+                    [(i, sch.slots[i].pos + len(d)) for i, d in drafts],
+                    now))
+                drafts = [(i, d) for i, d in drafts if i in alive]
             if drafts:
                 verified = self._run_verify(drafts)
+            active = sch.active_indices()  # growth may have preempted
         decode_lanes = [i for i in active if i not in verified]
+        if self.preempt and decode_lanes:
+            # the decode step writes each lane's pending position —
+            # grow under pressure, preempting the lowest-priority slot
+            # on a refused grant instead of crashing the round. DONE
+            # lanes (finished at this round's prefill, riding the
+            # dispatch as ballast) are skipped: their write lands on
+            # the absorbing null page and their output is discarded —
+            # growing (let alone preempting a live stream) for them
+            # would spend pages on a dead write
+            grown = set(self._ensure_pages(
+                [(i, sch.slots[i].pos) for i in decode_lanes
+                 if not sch.slots[i].request.done()], now))
+            decode_lanes = [i for i in decode_lanes
+                            if sch.slots[i] is not None
+                            and (sch.slots[i].request.done()
+                                 or i in grown)]
         decoded = 0
         if decode_lanes:
             next_toks, t0 = self._dispatch_decode(
@@ -702,15 +1076,15 @@ class ServingEngine:
             self.device_dispatch_s += wall2 - t0
             for i in decode_lanes:
                 slot = sch.slots[i]
-                p_len = len(slot.request.prompt)
+                k_len = len(slot.known)
                 consumed_pos = slot.pos
                 slot.pos += 1
-                if consumed_pos < p_len - 1:
-                    # prefix-hit warmup: the consumed token was a
-                    # prompt token with more to come — feed the next
-                    # one, discard the lane's output
-                    slot.next_token = slot.request.prompt[
-                        consumed_pos + 1]
+                if consumed_pos < k_len - 1:
+                    # warmup: the consumed token was a KNOWN token
+                    # (prefix-hit prompt or a resumed stream's replay
+                    # overflow) with more to come — feed the next one,
+                    # discard the lane's output
+                    slot.next_token = int(slot.known[consumed_pos + 1])
                     decoded += 1
                     continue
                 if not slot.request.done():
@@ -718,12 +1092,15 @@ class ServingEngine:
                     slot.request.out_tokens.append(tok)
                     slot.next_token = tok
                     self.tokens_generated += 1
-                    if consumed_pos == p_len - 1:
-                        # a prefix-hit slot's FIRST output token: its
-                        # warmup ended this round — the prefill-done /
-                        # first-token seam of the cached path
-                        if slot.request.first_token_wall is None:
-                            slot.request.first_token_wall = wall2
+                    if consumed_pos == k_len - 1 \
+                            and slot.request.first_token_wall is None:
+                        # the slot's FIRST output token: its warmup
+                        # ended this round — the prefill-done /
+                        # first-token seam of the cached path. A
+                        # resumed stream's warmup end is NOT a first
+                        # token (its seam fired in an earlier cycle —
+                        # the wall guard keeps the chain single-shot)
+                        slot.request.first_token_wall = wall2
                         if self.events is not None:
                             rid = slot.request.rid
                             self.events.record("prefill_done", rid,
@@ -744,7 +1121,83 @@ class ServingEngine:
         self.tick += 1
         return {"tick": now, "evicted": [r.rid for r in evicted],
                 "admitted": admitted, "prefilled": prefilled,
-                "verified": verified, "decoded_slots": decoded}
+                "verified": verified, "decoded_slots": decoded,
+                "shed": [r.rid for r in shed]}
+
+    def _recover_round(self, now, failure):
+        """Round recovery (ISSUE 15): a dispatch the watchdog timed
+        out or caught crashing does NOT kill the engine — every
+        in-flight request is requeued (pages freed, known stream
+        stashed for the prefill replay), a ``degraded_round``
+        lifecycle event is stamped per request with the classifier's
+        verdict on the engine, the device cache is rebuilt (the
+        wedged dispatch may have consumed the donated buffer — and a
+        timed-out round's LATE result is never adopted, so a zeroed
+        cache is the only sound state) and the prefix cache is
+        flushed (its chains pointed into the abandoned buffer). The
+        next rounds re-admit and replay; ``SERVE_ROUND_ATTEMPTS``
+        consecutive failures exhaust the budget and raise — bounded
+        recovery, a dead device still fails loudly."""
+        sch = self.scheduler
+        self._round_failures += 1
+        self.resilience.degraded_rounds += 1
+        self.resilience.last_verdict = failure.verdict
+        # requeue every UNFINISHED active slot: whatever the failed
+        # program was, the cache buffer's contents are no longer
+        # trustworthy. A request that already finished this round
+        # needs no further compute — it stays seated for the next
+        # round's evict (requeuing it would stamp degraded_round
+        # after finished, which the lifecycle machine forbids, and
+        # replay a completed stream for nothing).
+        requeued = []
+        for i in sch.active_indices():
+            if not sch.slots[i].request.done():
+                requeued.append(sch.requeue_slot(i, now))
+        if self.prefix is not None:
+            # finished slots keep their seats (evicted next round),
+            # but the cache flush below refuses live references —
+            # release theirs now and clear the list so the later
+            # evict cannot double-release. Their page-table entries
+            # still name the freed indices, but a done slot only
+            # READS them as discarded ballast — never writes.
+            for i in sch.active_indices():
+                slot = sch.slots[i]
+                if slot.shared_pages:
+                    self.prefix.release(slot.shared_pages)
+                    slot.shared_pages = []
+            self.prefix.flush()
+        self.cache = init_cache(
+            self.cfg.num_layers, self.cfg.num_attention_heads,
+            self.num_pages, self.page_size, self.cfg.head_dim,
+            self._cache_dtype)
+        if self.events is not None:
+            wall = time.perf_counter()
+            for req in requeued:
+                self.events.record("degraded_round", req.rid, tick=now,
+                                   wall=wall)
+                self.events.record("resubmitted", req.rid, tick=now,
+                                   wall=wall)
+        self.resilience.resubmitted += len(requeued)
+        if self._round_failures >= self.round_attempts:
+            raise RuntimeError(
+                f"serving round failed {self._round_failures} "
+                f"consecutive times (last: {failure}) — the "
+                f"SERVE_ROUND_ATTEMPTS budget is exhausted; the "
+                f"device/relay is {failure.verdict}") from failure
+        # RetryPolicy pacing before re-driving the round (the §6
+        # relay-flap backoff; chaos tests pin the wait to 0)
+        wait = self._round_retry.pop_wait()
+        if wait:
+            time.sleep(wait)
+        self._sample_gauges(now)
+        self.tick += 1
+        return {"tick": now, "evicted": [], "admitted": [],
+                "prefilled": [], "verified": [], "decoded_slots": 0,
+                "shed": [],
+                "degraded": {"phase": failure.phase,
+                             "verdict": failure.verdict,
+                             "detail": failure.detail,
+                             "requeued": [r.rid for r in requeued]}}
 
     # ----------------------------------- overlapped round (ISSUE 14)
 
@@ -849,6 +1302,10 @@ class ServingEngine:
         # decode. wall_time=None on evict: finish_wall belongs to the
         # fetch that produced the finishing token (_resolve_pending).
         evicted = sch.evict_done(now, None)
+        # the deadline shedder composes with the overlapped schedule:
+        # it touches QUEUED requests only (no placeholder tokens exist
+        # before admission), so the count-function contract holds
+        shed = self._shed_queue(now, wall) if self.shed else []
         admitted = sch.admit(now, wall)
         if self.events is not None:
             for i in admitted:
@@ -891,21 +1348,50 @@ class ServingEngine:
         self.tick += 1
         return {"tick": now, "evicted": [r.rid for r in evicted],
                 "admitted": admitted, "prefilled": prefilled,
-                "verified": [], "decoded_slots": decoded}
+                "verified": [], "decoded_slots": decoded,
+                "shed": [r.rid for r in shed]}
 
     def run_trace(self, requests, max_ticks=10000):
         """Replay a synthetic trace to completion: requests are
         submitted when their arrival tick is due; returns the
         completed Request list (latency fields filled). Flushes the
         overlapped engine's in-flight round before returning, so the
-        completed list never holds a placeholder token."""
+        completed list never holds a placeholder token. A trace
+        request SETTLES by completing, being shed (deadline shedder)
+        or being rejected at submit (admission control) — the
+        resilience layers drop load, they never hang the drain
+        (rejected/shed requests are in ``self.rejected`` /
+        ``scheduler.shed``, not the completed list)."""
         pending = sorted(requests, key=lambda r: (r.arrival, r.rid))
         n_total = len(pending)
-        while len(self.scheduler.completed) < n_total:
+        trace_set = {id(r) for r in requests}
+        # incremental settle counter: the three lists only ever grow,
+        # so each tick scans NEW entries only (the replay loop is the
+        # measured host slice — a full rescan per tick would inflate
+        # every serving row's host_ms). Counting trace requests only:
+        # chaos bursts (serve_burst) complete/reject through the same
+        # lists but must not inflate the trace's account.
+        settled = 0
+        cursors = [0, 0, 0]
+
+        def _drain_settled():
+            nonlocal settled
+            lists = (self.scheduler.completed, self.rejected,
+                     self.scheduler.shed)
+            for k, lst in enumerate(lists):
+                for idx in range(cursors[k], len(lst)):
+                    item = lst[idx]
+                    r = item[0] if k == 1 else item
+                    if id(r) in trace_set:
+                        settled += 1
+                cursors[k] = len(lst)
+            return settled
+
+        while _drain_settled() < n_total:
             if self.tick >= max_ticks:
                 raise RuntimeError(
                     f"trace did not drain in {max_ticks} ticks "
-                    f"({len(self.scheduler.completed)}/{n_total} done)")
+                    f"({settled}/{n_total} settled)")
             due = [r for r in pending if r.arrival <= self.tick]
             pending = [r for r in pending if r.arrival > self.tick]
             self.step(arrivals=due)

@@ -6,7 +6,10 @@ number; TPU serving comparisons live or die on TAIL latency under load
 module is the host-side substrate (ROADMAP item 2e):
 
 * **EventLog** — per-request ``submitted / admitted / prefill_done /
-  first_token / finished / evicted`` events with wall-clock stamps,
+  first_token / finished / evicted`` events (plus the ISSUE 15
+  resilience chain: terminal ``rejected``/``shed``, and the
+  ``preempted``/``degraded_round`` → ``resubmitted`` suspension cycle
+  — see ``_NEXT``) with wall-clock stamps,
   appended by the engine strictly BETWEEN device steps (events are
   plain host dicts; the jitted prefill/decode programs never see
   them, so ``decode_cache_size()==1`` holds with the log on or off),
@@ -45,10 +48,46 @@ measured dispatch, not asserted dispatch.
 
 import os
 
-# canonical per-request event order — the validate_order invariant
-EVENTS = ("submitted", "admitted", "prefill_done", "first_token",
+# canonical per-request event order — the validate_order invariant.
+# The resilience events (ISSUE 15) extend the PR 10 chain: `rejected`
+# (admission control refused at submit) and `shed` (the deadline
+# shedder dropped a queued request) are terminal; `preempted` (KV
+# pressure) and `degraded_round` (a wedged/crashed dispatch round)
+# suspend a running request and MUST be followed by `resubmitted`,
+# after which the admission cycle may repeat — the once-only events
+# (prefill_done / first_token / finished / evicted) still fire at
+# most once per request across every cycle.
+EVENTS = ("submitted", "rejected", "shed", "admitted", "prefill_done",
+          "first_token", "preempted", "degraded_round", "resubmitted",
           "finished", "evicted")
 _EVENT_IDX = {e: i for i, e in enumerate(EVENTS)}
+# the happy-path chain of an undisturbed request (what dryruns and the
+# churn tests assert a complete lifecycle looks like)
+CORE_EVENTS = ("submitted", "admitted", "prefill_done", "first_token",
+               "finished", "evicted")
+# events that may legally appear at most ONCE in a request's chain
+_ONCE = frozenset(("submitted", "rejected", "shed", "prefill_done",
+                   "first_token", "finished", "evicted"))
+# the per-request transition machine (validate_order): allowed
+# successors of each event. "admitted" may be re-entered only through
+# "resubmitted"; conditional arcs (finished needs a first token; a
+# re-admitted request skips prefill_done/first_token it already has)
+# are resolved in validate_order against the seen-set.
+_SUSPEND = ("preempted", "degraded_round")
+_NEXT = {
+    None: ("submitted",),
+    "submitted": ("rejected", "shed", "admitted"),
+    "rejected": (),
+    "shed": (),
+    "admitted": ("prefill_done", "finished") + _SUSPEND,
+    "prefill_done": ("first_token",) + _SUSPEND,
+    "first_token": ("finished",) + _SUSPEND,
+    "preempted": ("resubmitted",),
+    "degraded_round": ("resubmitted",),
+    "resubmitted": ("shed", "admitted"),
+    "finished": ("evicted",),
+    "evicted": (),
+}
 
 # starting-point SLO thresholds (interactive-serving shaped); a cited
 # slo row pins the RESOLVED values (check 9), so these defaults can
@@ -142,10 +181,15 @@ class EventLog:
 
     def validate_order(self, rid=None):
         """Ordering problems (empty list = clean) for one request or
-        all of them: events must appear in the canonical order with no
-        duplicates, starting at ``submitted``, with non-decreasing
-        wall stamps and ticks — the invariant ``dryrun_serving`` and
-        the churn tests assert mechanically."""
+        all of them: events must walk the ``_NEXT`` transition machine
+        starting at ``submitted`` — the linear PR 10 chain, plus the
+        resilience cycles (a ``preempted``/``degraded_round``
+        suspension must be followed by ``resubmitted``, after which
+        admission may repeat) — with the once-only events
+        (``_ONCE``) never duplicated across cycles, ``finished``
+        only after a first token landed, and non-decreasing wall
+        stamps and ticks. ``dryrun_serving`` and the churn/chaos
+        tests assert it mechanically."""
         problems = []
         rids = [rid] if rid is not None else self.rids()
         for r in rids:
@@ -157,25 +201,32 @@ class EventLog:
                 problems.append(
                     f"rid {r}: first event is {evs[0]['event']!r}, "
                     f"not 'submitted'")
-            last_idx, last_wall, last_tick = -1, None, None
+            last, last_wall, last_tick = None, None, None
             seen = set()
             for e in evs:
-                idx = _EVENT_IDX[e["event"]]
-                if e["event"] in seen:
+                ev = e["event"]
+                if ev in _ONCE and ev in seen:
                     problems.append(
-                        f"rid {r}: duplicate event {e['event']!r}")
-                seen.add(e["event"])
-                if idx < last_idx:
-                    problems.append(
-                        f"rid {r}: {e['event']!r} out of order "
-                        f"(after {EVENTS[last_idx]!r})")
-                last_idx = max(last_idx, idx)
+                        f"rid {r}: duplicate event {ev!r}")
+                elif last is not None or ev == "submitted":
+                    allowed = _NEXT[last]
+                    if ev not in allowed:
+                        problems.append(
+                            f"rid {r}: {ev!r} out of order "
+                            f"(after {last!r})")
+                    elif ev == "finished" \
+                            and "first_token" not in seen:
+                        problems.append(
+                            f"rid {r}: 'finished' before any "
+                            f"'first_token'")
+                seen.add(ev)
+                last = ev
                 w = e.get("wall")
                 if w is not None and last_wall is not None \
                         and w < last_wall:
                     problems.append(
                         f"rid {r}: wall clock went backwards at "
-                        f"{e['event']!r}")
+                        f"{ev!r}")
                 if w is not None:
                     last_wall = w
                 t = e.get("tick")
@@ -183,7 +234,7 @@ class EventLog:
                         and t < last_tick:
                     problems.append(
                         f"rid {r}: tick went backwards at "
-                        f"{e['event']!r}")
+                        f"{ev!r}")
                 if t is not None:
                     last_tick = t
         return problems
@@ -193,14 +244,16 @@ class EventLog:
     def sample_gauges(self, tick, wall, *, slots_active, num_slots,
                       queue_depth, kv_pages_live, kv_pages_total,
                       hol_wait_s, spec_drafted=0, spec_accepted=0,
-                      prefix_hit_tokens=0):
+                      prefix_hit_tokens=0, rejected=0, shed=0,
+                      preempted=0, resubmitted=0, degraded_rounds=0):
         """One per-scheduler-round gauge sample (engine calls this at
         the end of each :meth:`ServingEngine.step`). Names mirror the
         registered telemetry metric specs (``telemetry.metrics``), so
         a ``MetricsWriter`` can sink :meth:`gauge_rows` directly. The
-        generation counters (ISSUE 13) are CUMULATIVE: drafted /
-        accepted speculative tokens and prefix-cache hit tokens as of
-        this round — 0 whenever the feature is off."""
+        generation counters (ISSUE 13) and the resilience counters
+        (ISSUE 15: rejected / shed / preempted / resubmitted requests
+        and degraded rounds) are CUMULATIVE as of this round — 0
+        whenever the feature is off."""
         self.gauges.append({
             "tick": tick, "wall": wall,
             "serve_slots_active": int(slots_active),
@@ -212,6 +265,11 @@ class EventLog:
             "serve_spec_drafted": int(spec_drafted),
             "serve_spec_accepted": int(spec_accepted),
             "serve_prefix_hit_tokens": int(prefix_hit_tokens),
+            "serve_rejected": int(rejected),
+            "serve_shed": int(shed),
+            "serve_preempted": int(preempted),
+            "serve_resubmitted": int(resubmitted),
+            "serve_degraded_rounds": int(degraded_rounds),
         })
 
     def gauge_rows(self, run=None):
@@ -283,11 +341,15 @@ def percentile(values, q):
 
 
 def slo_block(requests, wall_s, *, ttft_ms, tpot_ms, arrival_process,
-              offered_load, log=None):
+              offered_load, log=None, resilience=None):
     """Assemble the validated ``slo`` ledger block from completed
     requests + the run's wall time (+ the EventLog's gauge summary
     when collection was on — occupancy fields null-degrade without
-    it, never vanish)."""
+    it, never vanish). ``resilience`` (ISSUE 15) is the engine's
+    ``resilience_rates()`` dict — ``shed_rate`` / ``preempt_rate`` /
+    ``degraded_rounds``, each None when its knob is off (degradation,
+    never omission; check 9 refuses a non-None rate whose selecting
+    knob is unpinned or off)."""
     lats = request_latencies(requests)
     ttfts = [x["ttft_s"] * 1e3 for x in lats if x["ttft_s"] is not None]
     tpots = [x["tpot_s"] * 1e3 for x in lats if x["tpot_s"] is not None]
@@ -321,4 +383,7 @@ def slo_block(requests, wall_s, *, ttft_ms, tpot_ms, arrival_process,
         "max_queue_depth": summary.get("max_queue_depth"),
         "kv_page_high_water": summary.get("kv_page_high_water"),
         "max_hol_wait_ms": summary.get("max_hol_wait_ms"),
+        "shed_rate": _r((resilience or {}).get("shed_rate"), 4),
+        "preempt_rate": _r((resilience or {}).get("preempt_rate"), 4),
+        "degraded_rounds": (resilience or {}).get("degraded_rounds"),
     }

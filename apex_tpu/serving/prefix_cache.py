@@ -273,6 +273,31 @@ class PrefixCache:
                 break
         return freed
 
+    def flush(self):
+        """Drop EVERY cached chain page and tail snapshot back to the
+        allocator — the round-recovery path (ISSUE 15): after a wedged
+        dispatch the device cache buffer is rebuilt from zeros, so the
+        cached K/V no longer exists and every chain is a dangling
+        pointer. Refuses under live references (the engine requeues —
+        and thereby releases — every slot first); returns the number
+        of pages freed."""
+        held = {p: n for p, n in self.refs.items() if n > 0}
+        assert not held, (
+            f"prefix flush with live references: {held} — requeue the "
+            f"holding slots first")
+        freed = 0
+        for node in self.nodes.values():
+            self.allocator.free(("prefix", node["page"]))
+            freed += 1
+        for h in list(self.tails):
+            self.allocator.free(("prefix-tail", h))
+            freed += 1
+        self.nodes.clear()
+        self.tails.clear()
+        self.refs.clear()
+        self._lru.clear()
+        return freed
+
     # -------------------------------------------------------- invariants
 
     def cached_pages(self):

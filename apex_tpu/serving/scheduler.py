@@ -63,6 +63,7 @@ from collections import deque
 from typing import Any, List, Optional, Tuple
 
 from apex_tpu.dispatch import tiles as _tiles
+from apex_tpu.resilience import faults as _faults
 
 ARRIVALS = ("poisson", "diurnal")
 POLICIES = ("fifo", "priority")
@@ -106,6 +107,14 @@ class Request:
     # priority policy's aging base. None falls back to ``arrival``,
     # so bare-scheduler callers keep today's semantics
     queued_tick: Optional[float] = None
+    # KV-pressure preemption (ISSUE 15): a preempted request's full
+    # known stream (prompt + generated tokens at preemption) — the
+    # effective prompt its re-admission replays through the EXISTING
+    # packed prefill program. None = never preempted past its first
+    # token (re-admission is a plain fresh prefill).
+    resume_tokens: Optional[List[int]] = None
+    preemptions: int = 0
+    shed_tick: Optional[int] = None   # deadline shedder drop point
     # filled in by the engine/scheduler:
     out_tokens: List[int] = dataclasses.field(default_factory=list)
     enqueue_wall: Optional[float] = None
@@ -128,6 +137,12 @@ class Slot:
     pages: List[int]
     pos: int = 0                  # context length held in the cache
     next_token: int = 0           # token the next decode step consumes
+    # the KNOWN token stream this slot must consume before generating
+    # anything new: the prompt for a fresh admission, the preempted
+    # stream (prompt + generated-so-far) for a resumed one. The decode
+    # loop's warmup/seam bookkeeping keys on its length — one rule for
+    # fresh, prefix-hit and resumed slots alike (ISSUE 15).
+    known: List[int] = dataclasses.field(default_factory=list)
     # prefix-cache bookkeeping (ISSUE 13; all empty/zero when the
     # cache is off or the prompt missed):
     shared_pages: List[int] = dataclasses.field(default_factory=list)
@@ -140,16 +155,25 @@ class Slot:
 
 class ContinuousBatchingScheduler:
     def __init__(self, num_slots, max_pages_per_slot, page_size,
-                 allocator, policy=None, prefix=None):
+                 allocator, policy=None, prefix=None, preempt=False):
         self.num_slots = int(num_slots)
         self.max_pages = int(max_pages_per_slot)
         self.page_size = int(page_size)
         self.allocator = allocator
         self.policy = resolve_policy(policy)
         self.prefix = prefix      # PrefixCache or None (engine-owned)
+        # KV-pressure preemption (ISSUE 15): with the flag on,
+        # admission reserves PROMPT pages only (overcommit) and
+        # :meth:`grow` extends the table mid-stream, preempting the
+        # lowest-effective-priority running slot when a grant is
+        # refused. Off = the all-or-nothing up-front reservation the
+        # scheduler always had (disabled mode behavior-identical).
+        self.preempt = bool(preempt)
         self.slots = [None] * self.num_slots
         self.queue = deque()
         self.completed = []
+        self.shed = []            # deadline-shed requests (engine-fed)
+        self._preempted = []      # requests preempted since last drain
 
     # ------------------------------------------------------- bookkeeping
 
@@ -163,6 +187,17 @@ class ContinuousBatchingScheduler:
         ``queued_tick`` — the priority policy ages WAITING time, not
         absolute tick, so a late direct submission gets no spurious
         boost."""
+        self.validate(request)
+        if tick is not None and request.queued_tick is None:
+            request.queued_tick = tick
+        self.queue.append(request)
+
+    def validate(self, request):
+        """The impossible-request teeth, callable on their own: the
+        ENGINE runs them before its admission-control gate (ISSUE 15)
+        so a malformed request always raises — a full queue must
+        reject load, never mask a programming error as a
+        ``Rejected``."""
         if request.max_new_tokens < 1:
             raise ValueError(
                 f"request {request.rid}: max_new_tokens must be >= 1 "
@@ -173,9 +208,6 @@ class ContinuousBatchingScheduler:
                 f"request {request.rid}: {need} pages exceed the "
                 f"per-slot table ({self.max_pages}) — prompt + "
                 f"max_new_tokens over max_seq")
-        if tick is not None and request.queued_tick is None:
-            request.queued_tick = tick
-        self.queue.append(request)
 
     def active_indices(self):
         return [i for i, s in enumerate(self.slots) if s is not None]
@@ -226,13 +258,19 @@ class ContinuousBatchingScheduler:
                 best, best_key = r, key
         return best
 
-    def _alloc_with_reclaim(self, owner, n, protect=()):
+    def _alloc_with_reclaim(self, owner, n, protect=(), tick=None,
+                            phase="admit"):
         """Allocator grant with prefix-cache pressure relief: a short
         free list asks the cache to reclaim unreferenced pages first
         (pages with live refs are NEVER freed — the cache refuses;
         ``protect`` additionally fences the cover THIS admission just
         matched, so reclaim can never free-and-rehand the pages its
-        own request is about to share), then retries once."""
+        own request is about to share), then retries once. The
+        ``serve_alloc`` chaos site (ISSUE 15) can script a refusal at
+        an exact (tick, phase) without shrinking the pool — the
+        preemption path then runs under deterministic page pressure."""
+        if _faults.denied("serve_alloc", tick=tick, phase=phase):
+            return None
         pages = self.allocator.alloc(owner, n)
         if pages is None and self.prefix is not None:
             shortfall = n - self.allocator.free_count
@@ -263,19 +301,33 @@ class ContinuousBatchingScheduler:
             assert need <= self.max_pages, (req.rid, need)
             if not free:
                 break
+            known = req.resume_tokens or req.prompt
             shared, covered, tail = [], 0, None
-            if self.prefix is not None:
+            # a RESUMED request skips the prefix lookup: its effective
+            # prompt is the preempted stream, not the prompt the cache
+            # chains are keyed by — re-admission replays it through
+            # the packed prefill program instead (ISSUE 15)
+            if self.prefix is not None and req.resume_tokens is None:
                 shared, covered, tail = self.prefix.lookup(req.prompt)
             matched = list(shared) + ([tail[0]] if tail else [])
+            # under preemption (overcommit), admission reserves only
+            # the KNOWN stream's pages — decode grows the table as
+            # positions cross page boundaries (grow()); off, the
+            # all-or-nothing full reservation stands
+            from apex_tpu.serving.kv_cache import pages_needed
+
+            reserve = pages_needed(len(known), self.page_size) \
+                if self.preempt else need
             pages = self._alloc_with_reclaim(("req", req.rid),
-                                             need - len(shared),
-                                             protect=matched)
+                                             reserve - len(shared),
+                                             protect=matched, tick=tick)
             if pages is None:
                 break
             self.queue.remove(req)
             idx = free[0]
             slot = Slot(request=req, pages=shared + pages,
-                        shared_pages=list(shared), prefix_hit=covered)
+                        shared_pages=list(shared), prefix_hit=covered,
+                        known=list(known))
             if covered:
                 # the covered suffix replays through decode: position
                 # `covered` is the first token the engine feeds
@@ -296,6 +348,90 @@ class ContinuousBatchingScheduler:
                 req.admitted_wall = wall_time
             admitted.append(idx)
         return admitted
+
+    # -------------------------------------- KV-pressure preemption (15)
+
+    def _select_victim(self, tick):
+        """The slot index to preempt under page pressure: the LOWEST
+        effective priority among running slots — base ``priority``
+        (running requests do not age: aging rewards waiting), youngest
+        admission first on ties (the latest arrival has the least sunk
+        work to replay — vLLM's recompute-preemption order). A slot
+        whose request already FINISHED this round (awaiting next
+        round's evict) is never a victim: its pages free at the evict
+        anyway, and requeuing it would stamp a preempted event after
+        finished — a transition the lifecycle machine forbids. None
+        when nothing preemptible is running."""
+        best, best_key = None, None
+        for i, slot in enumerate(self.slots):
+            if slot is None or slot.request.done():
+                continue
+            r = slot.request
+            key = (r.priority,
+                   -(r.admitted_tick if r.admitted_tick is not None
+                     else tick),
+                   -r.rid)
+            if best_key is None or key < best_key:
+                best, best_key = i, key
+        return best
+
+    def requeue_slot(self, i, tick):
+        """Force running slot *i* back into the queue (preemption
+        under page pressure, or round recovery after a wedged
+        dispatch): free its private pages, decref its shared prefix
+        pages (the cache refuses to free referenced pages — refcounts
+        respected), stash the known stream for the re-prefill replay,
+        and REQUEUE the request (it keeps its original
+        ``queued_tick``, so priority aging preserves its seniority —
+        a preempted request cannot be starved). Returns the
+        request."""
+        slot = self.slots[i]
+        req = slot.request
+        self.allocator.free(("req", req.rid))
+        if slot.shared_pages and self.prefix is not None:
+            self.prefix.release(slot.shared_pages)
+        # the full known stream (prompt + generated) is what
+        # re-admission replays; a slot preempted before its first
+        # token resumes as a plain fresh prefill
+        req.resume_tokens = (list(req.prompt) + list(req.out_tokens)) \
+            if req.out_tokens else None
+        req.preemptions += 1
+        self.slots[i] = None
+        self.queue.append(req)
+        return req
+
+    def grow(self, i, min_pages, tick):
+        """Mid-stream page growth for slot *i* (preemption mode): make
+        its table hold >= ``min_pages`` pages, preempting the
+        lowest-effective-priority running slot (possibly *i* itself —
+        then False is returned and the caller drops the lane) each
+        time a grant is refused. Preempted requests land in the
+        :meth:`take_preempted` buffer for the engine's lifecycle
+        events. Progress is guaranteed by the engine's pool check
+        (``num_pages - 1 >= max_pages``): with everything else
+        preempted and the prefix cache reclaimed, a lone slot can
+        always reach ``max_seq`` pages."""
+        slot = self.slots[i]
+        while len(slot.pages) < min_pages:
+            got = self._alloc_with_reclaim(
+                ("req", slot.request.rid), 1, tick=tick, phase="grow")
+            if got is not None:
+                slot.pages.extend(got)
+                continue
+            victim = self._select_victim(tick)
+            if victim is None:  # defensive: slot i itself is a candidate
+                return False
+            self._preempted.append(self.requeue_slot(victim, tick))
+            if victim == i:
+                return False
+        return True
+
+    def take_preempted(self):
+        """Drain the requests preempted since the last call (the
+        engine records their ``preempted``/``resubmitted`` lifecycle
+        events and counters from this buffer)."""
+        out, self._preempted = self._preempted, []
+        return out
 
     def evict_done(self, tick, wall_time=None):
         """Free slots/pages of completed requests; returns them.

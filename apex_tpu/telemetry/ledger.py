@@ -207,11 +207,18 @@ def read_ledger(path=None):
 SLO_FIELDS = ("ttft_p50_ms", "ttft_p99_ms", "per_token_p50_ms",
               "per_token_p99_ms", "goodput_tok_s", "slo_attainment",
               "slo_ttft_ms", "slo_tpot_ms", "arrival_process",
-              "offered_load", "max_queue_depth", "kv_page_high_water")
+              "offered_load", "max_queue_depth", "kv_page_high_water",
+              # resilience economics (ISSUE 15): None-when-disabled —
+              # present always, so a disabled layer reads as explicit
+              # degradation, never omission (check 9 refuses non-None
+              # rates whose selecting knob is unpinned or off)
+              "shed_rate", "preempt_rate", "degraded_rounds")
 _SLO_NUMERIC = ("ttft_p50_ms", "ttft_p99_ms", "per_token_p50_ms",
                 "per_token_p99_ms", "goodput_tok_s", "slo_ttft_ms",
                 "slo_tpot_ms", "offered_load")
-_SLO_COUNTS = ("max_queue_depth", "kv_page_high_water")
+_SLO_COUNTS = ("max_queue_depth", "kv_page_high_water",
+               "degraded_rounds")
+_SLO_RATES = ("slo_attainment", "shed_rate", "preempt_rate")
 
 
 def _validate_slo(slo):
@@ -231,11 +238,12 @@ def _validate_slo(slo):
         if v is not None and (not isinstance(v, int)
                               or isinstance(v, bool) or v < 0):
             problems.append(f"{field} is not a non-negative int")
-    att = slo.get("slo_attainment")
-    if att is not None and (not isinstance(att, (int, float))
-                            or isinstance(att, bool)
-                            or not 0.0 <= att <= 1.0):
-        problems.append("slo_attainment is not in [0, 1]")
+    for field in _SLO_RATES:
+        att = slo.get(field)
+        if att is not None and (not isinstance(att, (int, float))
+                                or isinstance(att, bool)
+                                or not 0.0 <= att <= 1.0):
+            problems.append(f"{field} is not in [0, 1]")
     for lo, hi in (("ttft_p50_ms", "ttft_p99_ms"),
                    ("per_token_p50_ms", "per_token_p99_ms")):
         a, b = slo.get(lo), slo.get(hi)

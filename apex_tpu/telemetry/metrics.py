@@ -136,6 +136,21 @@ register("serve_spec_accepted", unit="tokens",
 register("serve_prefix_hit_tokens", unit="tokens",
          description="cumulative prompt tokens served from the "
                      "prefix cache (0 with the cache off)")
+register("serve_rejected", unit="requests",
+         description="cumulative submits refused by admission control "
+                     "(ISSUE 15; 0 with APEX_SERVE_ADMIT off)")
+register("serve_shed", unit="requests",
+         description="cumulative queued requests dropped by the "
+                     "deadline shedder (SLO attainment impossible)")
+register("serve_preempted", unit="requests",
+         description="cumulative KV-pressure preemptions (pages freed, "
+                     "stream requeued for prefill replay)")
+register("serve_resubmitted", unit="requests",
+         description="cumulative requeues back into the admission "
+                     "queue (preemption + degraded-round recovery)")
+register("serve_degraded_rounds", unit="rounds",
+         description="cumulative serving rounds lost to a timed-out "
+                     "or crashed device dispatch (watchdog recovery)")
 
 
 # --------------------------------------------------------------------------

@@ -155,6 +155,23 @@ from apex_tpu import overlap as overlap_mod  # noqa: E402
 
 SERVE_OVERLAP = overlap_mod.resolve_serve_overlap(spec_k=SPEC_K)
 os.environ["APEX_SERVE_OVERLAP"] = "1" if SERVE_OVERLAP else "0"
+# ...and the serving RESILIENCE knobs (ISSUE 15, check 9 teeth):
+# admission bound, deadline shedder, KV-pressure preemption, dispatch
+# watchdog — resolved once and pinned back BEFORE the engines build
+# (they re-resolve from these pins), so the record's knobs name
+# exactly the admission/preemption/recovery behavior the replay ran
+# under. The shed-vs-tail overload A/B under the diurnal trace rides
+# run_all_tpu.sh's `serving_resilience` rung (PERF.md §2).
+from apex_tpu.serving import resilience as serve_res  # noqa: E402
+
+ADMIT = serve_res.resolve_admit()
+os.environ["APEX_SERVE_ADMIT"] = str(ADMIT)
+SHED = serve_res.resolve_shed()
+os.environ["APEX_SERVE_SHED"] = "1" if SHED else "0"
+PREEMPT = serve_res.resolve_preempt()
+os.environ["APEX_SERVE_PREEMPT"] = "1" if PREEMPT else "0"
+RECOVER = serve_res.resolve_recover()
+os.environ["APEX_SERVE_RECOVER"] = "1" if RECOVER else "0"
 SLO_TTFT_MS = lifecycle.env_ms("APEX_SERVE_SLO_TTFT_MS",
                                lifecycle.DEFAULT_SLO_TTFT_MS)
 SLO_TPOT_MS = lifecycle.env_ms("APEX_SERVE_SLO_TPOT_MS",
@@ -336,7 +353,7 @@ if not compile_cache.warm_only():
         done, wall, ttft_ms=SLO_TTFT_MS, tpot_ms=SLO_TPOT_MS,
         arrival_process=ARRIVALS,
         offered_load=sched_mod.offered_load(trace),
-        log=replay.events)
+        log=replay.events, resilience=replay.resilience_rates())
     print(f"{'slo (' + ARRIVALS + ')':28s} "
           f"ttft p50/p99 {slo_block['ttft_p50_ms']}/"
           f"{slo_block['ttft_p99_ms']} ms, per-token p50/p99 "
@@ -347,6 +364,18 @@ if not compile_cache.warm_only():
           f"(ttft<={SLO_TTFT_MS:g}ms tpot<={SLO_TPOT_MS:g}ms), "
           f"qmax={slo_block['max_queue_depth']} "
           f"kv_hw={slo_block['kv_page_high_water']}/{PAGES}")
+    res_bits = []
+    if slo_block["shed_rate"] is not None:
+        res_bits.append(f"shed {slo_block['shed_rate']:.0%}")
+    if slo_block["preempt_rate"] is not None:
+        res_bits.append(f"preempt {slo_block['preempt_rate']:.0%}")
+    if slo_block["degraded_rounds"] is not None:
+        res_bits.append(
+            f"degraded rounds {slo_block['degraded_rounds']}")
+    if res_bits:
+        print(f"{'resilience':28s} {', '.join(res_bits)} "
+              f"(admit={ADMIT or 'off'}, {len(replay.rejected)} "
+              f"rejected)")
     # the measured host slice of the serving loop, per decode round
     # (run wall minus device dispatch time) -> the cost block's
     # overlap_bound stamp: what perfect host/device overlap
@@ -377,6 +406,8 @@ rid = TRACER.flush_ledger("profile_serving", extra={
                "sampling": SAMPLING, "spec_decode": SPEC_K,
                "prefix_cache": PREFIX,
                "slo_ttft_ms": SLO_TTFT_MS,
-               "slo_tpot_ms": SLO_TPOT_MS}})
+               "slo_tpot_ms": SLO_TPOT_MS,
+               "admit": ADMIT, "shed": SHED, "preempt": PREEMPT,
+               "recover": RECOVER}})
 if rid:
     print(f"ledger: {rid}")

@@ -222,6 +222,13 @@ run serving          1800 python benchmarks/profile_serving.py
 run serving_sampling 1800 env APEX_SERVE_SAMPLING=1 python benchmarks/profile_serving.py
 run serving_spec     1800 env APEX_SPEC_DECODE=4 python benchmarks/profile_serving.py
 run serving_prefix   1800 env APEX_SERVE_PREFIX_CACHE=1 python benchmarks/profile_serving.py
+# Resilience overload A/B (ISSUE 15, PERF.md §2): the same diurnal
+# trace replayed with admission control + deadline shedding +
+# KV-pressure preemption armed — shed-vs-tail economics (attainment /
+# goodput / shed+preempt rates land in the slo block, all four knobs
+# pinned, check 9). The watchdog knob stays off here: a scored row
+# must measure the serving loop, not a recovery drill.
+run serving_resilience 1800 env APEX_SERVE_ARRIVALS=diurnal APEX_SERVE_ADMIT=32 APEX_SERVE_SHED=1 APEX_SERVE_PREEMPT=1 python benchmarks/profile_serving.py
 fi
 
 echo "=== done; feed the logs into PERF.md"

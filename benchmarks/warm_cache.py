@@ -268,7 +268,17 @@ def main():
                             {"APEX_SERVE_SAMPLING": "1"}),
                            ("serving_spec", {"APEX_SPEC_DECODE": "4"}),
                            ("serving_prefix",
-                            {"APEX_SERVE_PREFIX_CACHE": "1"})):
+                            {"APEX_SERVE_PREFIX_CACHE": "1"}),
+                           # resilience rung (ISSUE 15): admission/
+                           # shed/preempt are host-side — the warmed
+                           # prefill+decode programs are the base
+                           # row's, but the rung rides the list so
+                           # its cashed/owed account matches the shell
+                           ("serving_resilience",
+                            {"APEX_SERVE_ARRIVALS": "diurnal",
+                             "APEX_SERVE_ADMIT": "32",
+                             "APEX_SERVE_SHED": "1",
+                             "APEX_SERVE_PREEMPT": "1"})):
             if row in cashed:
                 print(f"warm {row}: skipped (row cashed in the round "
                       f"manifest)", flush=True)

@@ -576,7 +576,7 @@ def test_serve_overlap_token_parity_and_lifecycle(serve_params):
     assert not ov.events.validate_order()
     for r in done_o:
         got = [e["event"] for e in ov.events.request_events(r.rid)]
-        assert got == list(lifecycle.EVENTS), (r.rid, got)
+        assert got == list(lifecycle.CORE_EVENTS), (r.rid, got)
     ov.allocator.check_invariants()
 
 

@@ -106,6 +106,13 @@ Checks:
    must AGREE with the pinned values — a block claiming a diurnal
    trace under a poisson pin (or a 1000 ms attainment under a
    500 ms pin) is the same label-drift class as a wrong caption.
+   Resilience teeth (ISSUE 15, the check-8 generation pattern): a
+   block whose ``shed_rate`` / ``preempt_rate`` / ``degraded_rounds``
+   is non-None was measured with the deadline shedder / KV-pressure
+   preemption / the dispatch watchdog ENGAGED and must pin
+   ``APEX_SERVE_SHED`` / ``APEX_SERVE_PREEMPT`` /
+   ``APEX_SERVE_RECOVER`` at a non-off value — a rate under an off
+   (or missing) pin names an engine the label did not run.
 10. **Overlap pin-match** — a cited record whose cost block (run-level
     or any span's) carries an ``overlap_bound`` with a non-null
     ``host_ms``/``comm_ms`` alongside an ``overlap`` claim block
@@ -336,6 +343,25 @@ def slo_pin_problems(rec, rid):
                 f"record {rid} slo.{field}={val:g} disagrees with its "
                 f"pinned {knob}={pinned:g} — the attainment was judged "
                 f"against a threshold the label does not name")
+    # resilience teeth (ISSUE 15): a non-None rate/count names an
+    # ENGAGED layer — its selecting knob must be pinned non-off (the
+    # check-8 generation-field pattern)
+    for field, knob in (("shed_rate", "APEX_SERVE_SHED"),
+                        ("preempt_rate", "APEX_SERVE_PREEMPT"),
+                        ("degraded_rounds", "APEX_SERVE_RECOVER")):
+        if slo.get(field) is None:
+            continue
+        pin = knobs.get(knob)
+        if pin is None:
+            problems.append(
+                f"record {rid} carries slo.{field}={slo[field]!r} but "
+                f"does not pin {knob} in its knobs — an unpinned "
+                f"resilience row cannot be cited")
+        elif str(pin) == "0":
+            problems.append(
+                f"record {rid} carries slo.{field}={slo[field]!r} but "
+                f"pins {knob}={pin!r} (off) — the block and the label "
+                f"name different engines")
     return problems
 
 

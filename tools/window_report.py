@@ -384,6 +384,19 @@ def print_report(report, out=None):
                     gap = None
                     if good is not None and scan:
                         gap = 1.0 - good / scan
+                    # resilience economics (ISSUE 15): shed / preempt
+                    # rates + degraded-round count next to attainment
+                    # — None-when-disabled never renders a phantom
+                    res = []
+                    if slo.get("shed_rate") is not None:
+                        res.append(f"shed={slo['shed_rate']:.0%}")
+                    if slo.get("preempt_rate") is not None:
+                        res.append(
+                            f"preempt={slo['preempt_rate']:.0%}")
+                    if slo.get("degraded_rounds") is not None:
+                        res.append(
+                            f"degraded_rounds="
+                            f"{slo['degraded_rounds']}")
                     p(f"      slo: arrival={slo.get('arrival_process')} "
                       f"offered={slo.get('offered_load')} req/tick, "
                       f"attainment="
@@ -393,7 +406,8 @@ def print_report(report, out=None):
                       f"{'?' if good is None else format(good, 'g')} "
                       f"tok/s"
                       + ("" if gap is None else
-                         f" ({gap:.0%} under the scan line)"))
+                         f" ({gap:.0%} under the scan line)")
+                      + (f" [{', '.join(res)}]" if res else ""))
                     p(f"      tails: ttft p50/p99 "
                       f"{slo.get('ttft_p50_ms')}/"
                       f"{slo.get('ttft_p99_ms')} ms, per-token p50/p99 "
