@@ -90,6 +90,22 @@ SERVE_ROUND_ATTEMPTS = 3         # consecutive failed rounds before the
 SERVE_ROUND_RETRY_WAIT_S = 5     # pause before re-driving a failed
 #                                  round (relay-flap pacing; chaos
 #                                  tests pin 0)
+# Flight-recorder entries of the §6 envelope (ISSUE 16): the in-flight
+# silence ladder `flight_watch` and `classify_inflight` judge a child's
+# heartbeat stream against. The silence threshold rides the same
+# evidence as SERVE_DISPATCH_TIMEOUT_S: a process that emits NO phase
+# beat for this long is the relay-wedge signature, not a slow step —
+# every instrumented phase gap (backend init, one compile, one
+# dispatch+fetch round) lands well inside it on a degraded-but-live
+# window, while the round-5 gpt_rows wedge sat silent for 15.0 min.
+FLIGHT_SILENCE_S = 300     # no beat for this long => silent => reap
+FLIGHT_ADVANCE_S = 60      # newest beat younger than this => advancing
+#                            (between the two: slow — beating, watched,
+#                            never reaped before its full cap)
+FLIGHT_GRACE_S = 20        # SIGTERM->SIGKILL grace on a reap: covers
+#                            bench's 15 s inner-child terminate wait so
+#                            the PR 6 emergency flush still banks
+#                            partials before the hard kill
 
 # Exit statuses that mean "the budget killed it" (the wedge signature):
 # timeout(1)'s 124/137, shell-reported SIGTERM (143 = 128+15), and the
@@ -331,3 +347,44 @@ def classify_subprocess(returncode, timed_out=False):
     if returncode == 0:
         return HEALTHY
     return DEGRADED_RELAY
+
+
+# ------------------------------------------------- in-flight verdicts
+# The LIVE counterpart of classify(): judged from a child's heartbeat
+# stream (apex_tpu.telemetry.flight) while it is still running, so the
+# flight_watch supervisor can reap a wedge at the silence threshold
+# instead of burning the full fixed slot (the round-5 gpt_rows mode:
+# 15.0 of 71.4 window minutes on a no-output wedge).
+
+ADVANCING = "advancing"   # newest beat < FLIGHT_ADVANCE_S old
+SLOW = "slow"             # beating, but the newest beat has aged past
+#                           the advance line — watched, never reaped
+#                           before the full per-rung cap
+SILENT = "silent"         # no beats at all, or none for
+#                           FLIGHT_SILENCE_S — the wedge signature
+
+INFLIGHT_VERDICTS = (ADVANCING, SLOW, SILENT)
+
+
+def classify_inflight(beats, now, silence_s=None, advance_s=None):
+    """``advancing | slow | silent`` from a heartbeat list and the
+    judge's own ``time.monotonic()`` *now* (beats carry ``mono``
+    stamps; CLOCK_MONOTONIC is system-wide, so ages are comparable
+    across processes). Beats without a numeric ``mono`` are ignored —
+    a torn line must not fake liveness. NOTE: a child that emitted NO
+    beats classifies silent, but the supervisor still grants it the
+    full cap — only a stream that STOPPED proves instrumentation was
+    there to go quiet (uninstrumented rows keep pre-PR semantics)."""
+    silence = FLIGHT_SILENCE_S if silence_s is None else float(silence_s)
+    advance = FLIGHT_ADVANCE_S if advance_s is None else float(advance_s)
+    stamps = [b["mono"] for b in beats
+              if isinstance(b.get("mono"), (int, float))
+              and not isinstance(b.get("mono"), bool)]
+    if not stamps:
+        return SILENT
+    age = now - max(stamps)
+    if age >= silence:
+        return SILENT
+    if age < advance:
+        return ADVANCING
+    return SLOW

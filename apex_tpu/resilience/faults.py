@@ -73,6 +73,16 @@ trace burst overload (submit storm)       serve_burst/burst with
                                             match_ctx tick (the engine
                                             fabricates + submits the
                                             scripted burst)
+heartbeat-silent wedge (ISSUE 16:         flight_silent/hang — fired by
+  beats arrived, then the stream            bench.py AFTER the boundary-1
+  stopped; flight_watch reaps at the        partial commit, so the reaped
+  silence threshold)                        child has beats AND a banked
+                                            partial behind it
+slow-but-beating run (degraded relay;     heartbeat/hang with seconds=N
+  flight_watch must NOT reap before         — the hook fires inside
+  the full cap)                             flight.beat AFTER the beat
+                                            lands: wall time stretches,
+                                            beats keep arriving
 =======================================  ================================
 
 Kind-specific fields: ``seconds`` (hang: sleep N then continue; absent

@@ -1,0 +1,188 @@
+"""apex_tpu.telemetry.flight — the flight recorder (ISSUE 16).
+
+A crash-safe, append-only per-process heartbeat stream: every process
+that can hold the device appends one JSON line per phase transition
+(``proc_start``, ``backend_init``, ``compile_start``, ``compile_done``,
+``dispatch``, ``fetch``, ``attempt_start``, ``attempt_done``,
+``flush``) to ``$APEX_FLIGHT_DIR/flight-<pid>.jsonl``. Each beat
+carries a wall stamp (``ts`` — for human timelines), a monotonic stamp
+(``mono`` — CLOCK_MONOTONIC is system-wide, so a supervisor in another
+process can age a child's beats against its own clock without trusting
+wall time), the phase, pid, the harness/row label, and the watchdog's
+attempt index.
+
+Gated on ``APEX_FLIGHT_DIR`` per the ``metrics.enabled()`` precedent:
+unset means :func:`beat` returns after ONE env lookup — zero cost,
+behavior-identical (beats are host-side file appends; they never touch
+a traced program, so the disabled-mode jaxpr identity holds by
+construction and is asserted in tests/test_flight.py). Writes never
+raise: a full disk or an unwritable dir degrades to a missing beat,
+never a crashed harness — the recorder must not be able to kill the
+flight it records.
+
+Consumers: ``apex_tpu.resilience.flight_watch`` (heartbeat-driven
+early reap of silent children), ``resilience.classify_inflight``
+(advancing | slow | silent), ``tools/window_report.py`` (exact
+per-attempt minute attribution), and the ``status`` surfaces
+(``python -m apex_tpu.telemetry.flight status``,
+``python -m apex_tpu.telemetry.ledger status``,
+``probe_and_collect.sh --status``).
+
+Stdlib-only at module level (the supervisor imports this relay-proof);
+the chaos hook imports :mod:`apex_tpu.resilience.faults` lazily inside
+:func:`beat` — the ``heartbeat`` fault site is how the chaos suite
+scripts a slow-but-beating run (hang N seconds per beat: wall time
+stretches, beats keep arriving, the supervisor must NOT reap early).
+"""
+
+import json
+import os
+import time
+
+# the phase vocabulary — window_report and the tests pin against this
+PHASES = (
+    "proc_start", "backend_init", "compile_start", "compile_done",
+    "dispatch", "fetch", "attempt_start", "attempt_done", "flush",
+)
+
+
+def flight_dir():
+    """The armed flight directory, or None when the recorder is off."""
+    return os.environ.get("APEX_FLIGHT_DIR") or None
+
+
+def enabled():
+    return flight_dir() is not None
+
+
+def beat(phase, label=None, attempt=None, **extra):
+    """Append one heartbeat; returns the record, or None when disabled
+    or the write failed (never raises).
+
+    ``label`` defaults to ``APEX_FLIGHT_ROW`` (set by the flight_watch
+    supervisor so every beat names the collection row it serves);
+    ``attempt`` defaults to ``APEX_BENCH_ATTEMPT`` (set by bench.py's
+    watchdog on each inner attempt). The beat is written BEFORE the
+    ``heartbeat`` chaos hook fires, so a scripted per-beat hang slows
+    the flight without silencing it.
+    """
+    d = os.environ.get("APEX_FLIGHT_DIR")
+    if not d:
+        return None
+    try:
+        rec = {
+            "ts": round(time.time(), 3),
+            "mono": round(time.monotonic(), 3),
+            "phase": phase,
+            "pid": os.getpid(),
+        }
+        lbl = label if label is not None \
+            else os.environ.get("APEX_FLIGHT_ROW")
+        if lbl is not None:
+            rec["label"] = lbl
+        if attempt is None:
+            raw = os.environ.get("APEX_BENCH_ATTEMPT")
+            if raw:
+                try:
+                    attempt = int(raw)
+                except ValueError:
+                    attempt = None
+        if attempt is not None:
+            rec["attempt"] = attempt
+        if extra:
+            rec.update(extra)
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, "flight-%d.jsonl" % os.getpid())
+        with open(path, "a") as f:
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+            f.flush()
+        from apex_tpu.resilience import faults
+
+        faults.fire("heartbeat", phase=phase)
+        return rec
+    except Exception:
+        return None
+
+
+def read_beats(d=None):
+    """Every heartbeat under ``d`` (default: the armed dir), all
+    ``flight-*.jsonl`` files merged, sorted by monotonic stamp.
+    Unparseable lines are skipped — a torn final line (the writer was
+    reaped mid-append) must not hide the beats before it."""
+    d = d or flight_dir()
+    beats = []
+    if not d or not os.path.isdir(d):
+        return beats
+    try:
+        names = sorted(os.listdir(d))
+    except OSError:
+        return beats
+    for name in names:
+        if not (name.startswith("flight-") and name.endswith(".jsonl")):
+            continue
+        try:
+            with open(os.path.join(d, name)) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(rec, dict):
+                        beats.append(rec)
+        except OSError:
+            continue
+    beats.sort(key=lambda b: b["mono"]
+               if isinstance(b.get("mono"), (int, float))
+               else float("-inf"))
+    return beats
+
+
+def newest_beat(d=None):
+    beats = read_beats(d)
+    return beats[-1] if beats else None
+
+
+def status_line(d=None, now=None):
+    """One human line: the newest heartbeat's phase + age — 'is the
+    window alive right now' without tailing raw logs."""
+    d = d or flight_dir()
+    if not d:
+        return "flight: disabled (APEX_FLIGHT_DIR unset)"
+    b = newest_beat(d)
+    if b is None:
+        return "flight: no heartbeats under %s" % d
+    now = time.time() if now is None else now
+    ts = b.get("ts")
+    age = ("%.1fs ago" % max(0.0, now - ts)
+           if isinstance(ts, (int, float)) else "age ?")
+    parts = ["flight: %s (%s)" % (b.get("phase", "?"), age),
+             "row=%s" % (b.get("label") or "?")]
+    if b.get("attempt") is not None:
+        parts.append("attempt=%s" % b["attempt"])
+    parts.append("pid=%s" % b.get("pid", "?"))
+    return " ".join(parts) + " [%s]" % d
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m apex_tpu.telemetry.flight",
+        description="Inspect the flight recorder (read-only).")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    st = sub.add_parser(
+        "status", help="newest heartbeat's phase + age")
+    st.add_argument("--dir", default=None,
+                    help="flight dir (default: APEX_FLIGHT_DIR)")
+    args = ap.parse_args(argv)
+    print(status_line(args.dir))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

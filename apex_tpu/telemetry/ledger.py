@@ -63,6 +63,8 @@ INFRA_KNOB_PREFIXES = (
     "APEX_COMPILE_CACHE", "APEX_WARM_ONLY", "APEX_WARM_TIMEOUT",
     "APEX_PROBE_", "APEX_FAULT_PLAN", "APEX_COLLECT_MANIFEST",
     "APEX_PROFILE_", "APEX_COST_ANALYSIS", "APEX_SERVE_BENCH",
+    "APEX_FLIGHT_",  # flight recorder / supervisor (ISSUE 16): where
+                     # beats land + reap thresholds — never the program
 )
 
 
@@ -387,6 +389,53 @@ def validate_record(rec):
         # may be null (a trace with no >=2-token request has no TPOT
         # percentile) but must be PRESENT: degradation, not omission.
         problems += [f"slo: {p}" for p in _validate_slo(slo)]
+    fr = rec.get("flight_reap")
+    if fr is not None:
+        # the supervisor's reap stamp (apex_tpu.resilience.flight_watch,
+        # ISSUE 16): a malformed one could claim a rung was reaped for
+        # heartbeat silence when it actually ran out its cap (or vice
+        # versa) — the window account would mis-bill the reclaimed
+        # minutes. Verdict/reason vocabularies come from the resilience
+        # classifier so the two can never drift.
+        from apex_tpu import resilience as _resilience
+
+        if not isinstance(fr, dict):
+            problems.append("flight_reap is not a dict")
+        else:
+            if not (isinstance(fr.get("row"), str) and fr["row"]):
+                problems.append(
+                    "flight_reap.row does not name the reaped row")
+            if fr.get("verdict") not in _resilience.INFLIGHT_VERDICTS:
+                problems.append(
+                    f"flight_reap.verdict {fr.get('verdict')!r} is not a "
+                    f"classified in-flight verdict "
+                    f"{_resilience.INFLIGHT_VERDICTS}")
+            if fr.get("reason") not in ("silence", "cap", "signal"):
+                problems.append(
+                    f"flight_reap.reason {fr.get('reason')!r} is not one "
+                    f"of ('silence', 'cap', 'signal')")
+            for field in ("silence_s", "timeout_s", "elapsed_s"):
+                v = fr.get(field)
+                if not (isinstance(v, (int, float))
+                        and not isinstance(v, bool) and v >= 0):
+                    problems.append(
+                        f"flight_reap.{field} is not a non-negative "
+                        f"number")
+            nb = fr.get("beats")
+            if not (isinstance(nb, int) and not isinstance(nb, bool)
+                    and nb >= 0):
+                problems.append(
+                    "flight_reap.beats is not a non-negative int")
+            age = fr.get("age_s")
+            if age is not None and (not isinstance(age, (int, float))
+                                    or isinstance(age, bool) or age < 0):
+                problems.append(
+                    "flight_reap.age_s is not a non-negative number "
+                    "or null")
+            lp = fr.get("last_phase")
+            if lp is not None and not isinstance(lp, str):
+                problems.append(
+                    "flight_reap.last_phase is not a string or null")
     rf = rec.get("resumed_from")
     if rf is not None:
         # resume provenance (bench.py --resume / profile_gpt): rides
@@ -454,6 +503,10 @@ def _summary_line(rec):
     cost = rec.get("cost")
     if isinstance(cost, dict) and cost.get("peak_hbm_bytes"):
         marks.append(f"peak_hbm={cost['peak_hbm_bytes'] / 2 ** 20:.0f}MiB")
+    fr = rec.get("flight_reap")
+    if isinstance(fr, dict):
+        marks.append(f"reaped:{fr.get('row', '?')}"
+                     f"({fr.get('reason', '?')}/{fr.get('verdict', '?')})")
     return (f"{rec.get('id', '?'):14s} {when}  "
             f"{str(rec.get('harness', '?')):22s} "
             f"{str(rec.get('platform', '?')):4s} "
@@ -526,6 +579,13 @@ def main(argv=None):
                       f"attainment={att_s} "
                       f"goodput={s.get('goodput_tok_s')} tok/s "
                       f"ttft_p99={s.get('ttft_p99_ms')}ms [{tid}]")
+        # newest flight heartbeat (ISSUE 16): when a flight dir is
+        # armed the ledger status also answers "is anything alive
+        # RIGHT NOW" — newest beat's phase + age
+        from apex_tpu.telemetry import flight as _flight
+
+        if _flight.enabled():
+            print(f"  {_flight.status_line()}")
         return 1 if problems else 0
     if args.cmd == "tail":
         # n<=0 prints nothing (records[-0:] would be the WHOLE ledger)

@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from apex_tpu.telemetry import flight
+
 
 def sync(x):
     """Wait for device execution by fetching one element."""
@@ -236,6 +238,9 @@ class Tracer:
         if compile_cache.warm_only():
             try:
                 warm_cost = None
+                # flight beats (ISSUE 16): host-side appends, no trace
+                # interaction — the supervisor sees "compiling" live
+                flight.beat("compile_start", span=name)
                 if hasattr(call, "lower"):
                     info, compiled = compile_cache.warm(call, warm_args)
                     if capture_cost:
@@ -250,6 +255,7 @@ class Tracer:
                 else:
                     sync_out(call(*warm_args))
                     info = {"executed": True}
+                flight.beat("compile_done", span=name)
                 span = Span(name, None, None, self.k, self.overhead,
                             flops_per_iter=flops_per_iter,
                             extra=dict(extra or {}, warm_only=True,
@@ -265,6 +271,11 @@ class Tracer:
                             extra=dict(extra or {}, warm_only=True))
             self.spans.append(span)
             return span
+        # flight beats (ISSUE 16) bracket the phases a supervisor needs
+        # to tell "compiling" from "dispatched, waiting on the fetch":
+        # host-side file appends outside the timed region (the dispatch
+        # beat lands BEFORE t0), never touching the traced program
+        flight.beat("compile_start", span=name)
         try:
             sync_out(call(*warm_args))
         except Exception as e:
@@ -276,9 +287,12 @@ class Tracer:
                         extra=dict(extra or {}))
             self.spans.append(span)
             return span
+        flight.beat("compile_done", span=name)
+        flight.beat("dispatch", span=name)
         t0 = time.perf_counter()
         sync_out(call(*timed_args))
         total = time.perf_counter() - t0
+        flight.beat("fetch", span=name)
         span_extra = dict(extra or {})
         if capture_cost:
             # AFTER the timed region: the lower/compile are host work
@@ -332,6 +346,7 @@ class Tracer:
 
         if compile_cache.warm_only():
             return None
+        flight.beat("flush", harness=harness)
         if platform is None:
             platform = jax.devices()[0].platform
         from apex_tpu.telemetry import costs

@@ -290,8 +290,15 @@ def _warm_bench_programs(programs, platform=None, cost_ctx=None):
         else:
             fn, args = spec
         try:
+            from apex_tpu.telemetry import flight
+
+            # flight beats (ISSUE 16): a warm pass compiles through the
+            # relay's wedge-prone helper — exactly the flight a
+            # supervisor needs phase visibility into
+            flight.beat("compile_start", program=name)
             results[name], compiled_by_name[name] = \
                 compile_cache.warm(fn, args)
+            flight.beat("compile_done", program=name)
         except Exception as e:  # report, keep warming the rest
             results[name] = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
             failed = name
@@ -335,6 +342,12 @@ def main():
     # import, so their injection points sit there too
     from apex_tpu import resilience
     from apex_tpu.resilience import faults
+    from apex_tpu.telemetry import flight
+    # flight recorder (ISSUE 16): host-side phase beats, no-ops unless
+    # APEX_FLIGHT_DIR is set. proc_start BEFORE the fault hooks — a
+    # scripted backend-init hang must leave a beat behind it, so the
+    # supervisor can tell "spawned then wedged" from "never spawned".
+    flight.beat("proc_start")
     faults.fire("backend_init")
     faults.fire("mid_attempt")
 
@@ -359,6 +372,7 @@ def main():
 
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
+    flight.beat("backend_init", platform=platform)
 
     # Kernel-dispatch knobs shared with benchmarks/profile_gpt.py
     # (benchmarks/_knobs.py): the measured winners (PERF.md §3/§4/§7)
@@ -602,9 +616,11 @@ def main():
     # compile + warm + drain (donated inputs: rebind the carried state)
     print(f"# compiling {iters}-step scan at b={b} s={s} ...",
           file=sys.stderr, flush=True)
+    flight.beat("compile_start", batch=b)
     params, opt_state, scaler_state, losses, _ = step(
         params, opt_state, scaler_state, jnp.float32(0.0), ids, pos, labels)
     sync(losses)
+    flight.beat("compile_done", batch=b)
     if ckpt_writer is not None:
         # scan boundary 1: host-stage AND COMMIT the warm scan's output
         # (the device buffers are about to be donated into the timed
@@ -619,6 +635,14 @@ def main():
         ckpt_writer.save(step0 + iters, _EMERGENCY["state"],
                          meta=_EMERGENCY["meta"])
         ckpt_writer.flush()
+
+    # chaos site (ISSUE 16): the heartbeat-silent wedge — beats were
+    # flowing (proc_start..compile_done above), then the process goes
+    # quiet with the scan-boundary-1 partial already committed. The
+    # flight_watch supervisor must reap it at the silence threshold
+    # (SIGTERM -> the emergency flush banks the partial) instead of
+    # burning the full rung slot.
+    faults.fire("flight_silent", batch=b)
 
     from apex_tpu.telemetry import profiling
 
@@ -656,11 +680,15 @@ def main():
         return
 
     print("# compiled; timing", file=sys.stderr, flush=True)
+    # dispatch/fetch beats strictly OUTSIDE the timed region (before t0
+    # / after dt's perf_counter read): the §0 measurement is unchanged
+    flight.beat("dispatch", batch=b)
     t0 = time.perf_counter()
     out = step(params, opt_state, scaler_state, jnp.float32(1e-30), ids, pos,
                labels)
     sync(out[3])
     dt = (time.perf_counter() - t0 - overhead) / iters
+    flight.beat("fetch", batch=b)
 
     final_step = step0 + 2 * iters
     if ckpt_writer is not None:
@@ -857,6 +885,7 @@ def main():
             "37.6% MFU at b=8); value reflects tunnel latency, not the chip")
     # emit-site faults model the wedging-teardown truncation of the one
     # JSON line (no-op without APEX_FAULT_PLAN)
+    flight.beat("flush", batch=b)
     print(faults.transform_output(json.dumps(result)), flush=True)
 
 
@@ -1100,6 +1129,7 @@ def _watchdog():
     from apex_tpu import resilience
     # imported HERE, not inside the signal handler: the import machinery
     # must never run under a mid-import SIGTERM
+    from apex_tpu.telemetry import flight as _flight
     from apex_tpu.telemetry import ledger as _tledger
     _ckpt_mod = None
     if os.environ.get("APEX_CKPT_DIR"):
@@ -1232,9 +1262,15 @@ def _watchdog():
                       f"{wait}s ({i + 1}/{attempts})",
                       file=sys.stderr, flush=True)
                 time.sleep(wait)
+        # attempt beats (ISSUE 16): the watchdog's own stream, so the
+        # flight timeline shows attempt boundaries even when the inner
+        # child wedges before its first beat
+        _flight.beat("attempt_start", attempt=i, config=ladder[i])
         line, rec, rc = _attempt_once(state, ladder[i],
                                       timeout_cap=policy.timeout_cap,
                                       attempt=i)
+        _flight.beat("attempt_done", attempt=i, rc=rc,
+                     timed_out=bool(rec and rec.get("timed_out")))
         armed = policy.note_attempt(rec, rc)
         if armed:
             # rc None + the fabricated timed_out record = the attempt

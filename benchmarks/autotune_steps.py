@@ -56,6 +56,7 @@ sys.path.insert(0, REPO)
 from apex_tpu import dispatch  # noqa: E402
 from apex_tpu import resilience  # noqa: E402
 from apex_tpu.resilience import faults  # noqa: E402
+from apex_tpu.telemetry import flight  # noqa: E402
 from apex_tpu.telemetry import ledger as ledger_mod  # noqa: E402
 
 
@@ -203,16 +204,23 @@ def run_rung(harness, variant_env, smoke, ledger_path, timeout, log_dir,
         raise ValueError(f"unknown harness {harness!r}")
     env = _subprocess_env(variant_env, smoke, ledger_path)
     n0 = _ledger_len(ledger_path)
+    flight.beat("attempt_start", label=tag, rung=harness)
+    timed_out = False
+    rc = None
     try:
         proc = subprocess.run(cmd, env=env, cwd=REPO, text=True,
                               capture_output=True, timeout=timeout)
         out = proc.stdout
+        rc = proc.returncode
         if proc.returncode != 0:
             sys.stderr.write((proc.stderr or "")[-1500:])
             print(f"  {tag}: rc={proc.returncode}", flush=True)
     except subprocess.TimeoutExpired as e:
         out = e.stdout if isinstance(e.stdout, str) else ""
+        timed_out = True
         print(f"  {tag}: timed out after {timeout}s", flush=True)
+    flight.beat("attempt_done", label=tag, rung=harness, rc=rc,
+                timed_out=timed_out)
     if log_dir:
         try:
             with open(os.path.join(log_dir, f"{tag}.log"), "w") as f:

@@ -64,6 +64,7 @@ from apex_tpu import dispatch  # noqa: E402
 from apex_tpu import resilience  # noqa: E402
 from apex_tpu.dispatch import tiles  # noqa: E402
 from apex_tpu.resilience import faults  # noqa: E402
+from apex_tpu.telemetry import flight  # noqa: E402
 from apex_tpu.telemetry import ledger as ledger_mod  # noqa: E402
 from benchmarks.autotune_steps import FLIP_MARGIN, _upsert_entry  # noqa: E402
 
@@ -347,6 +348,7 @@ def run_candidate(group, params, smoke, ledger_path, timeout, log_dir,
                 params=params, smoke=smoke)
     cmd = [sys.executable, os.path.abspath(__file__), "--child",
            json.dumps(spec)]
+    flight.beat("attempt_start", label=tag, candidate=params)
     try:
         proc = subprocess.run(cmd, env=_child_env(smoke, ledger_path),
                               cwd=REPO, text=True, capture_output=True,
@@ -357,6 +359,8 @@ def run_candidate(group, params, smoke, ledger_path, timeout, log_dir,
         out = e.stdout if isinstance(e.stdout, str) else ""
         rc = None
         print(f"  {tag}: timed out after {timeout}s", flush=True)
+    flight.beat("attempt_done", label=tag, rc=rc,
+                timed_out=rc is None)
     if log_dir:
         try:
             with open(os.path.join(log_dir, f"{tag}.log"), "w") as f:

@@ -112,6 +112,16 @@ case "${1:-}" in
             [ -f "$SOUT/warm_cache.log" ] \
                 && echo "warm log: $(tail -1 "$SOUT/warm_cache.log")"
         fi
+        # newest flight heartbeat (ISSUE 16): phase + age of the last
+        # beat any in-flight process emitted — a live wedge shows up
+        # here as a stale age long before its slot expires
+        if [ -d "$SOUT/flight" ]; then
+            timeout 60 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+                python -m apex_tpu.telemetry.flight status \
+                --dir "$SOUT/flight" || true
+        else
+            echo "flight: no heartbeats yet ($SOUT/flight)"
+        fi
         # the durable collection manifest: rows cashed vs owed this
         # round — a glance shows what the next window must still
         # produce (ISSUE 6)
@@ -129,6 +139,7 @@ case "${1:-}" in
             timeout 120 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
                 python tools/window_report.py --logs "$last" \
                 --manifest "$SOUT/manifest.json" \
+                --flight "$SOUT/flight" \
                 --probe-state "$STATE" | sed 's/^/  /' || true
         fi
         exit "$rc"
@@ -219,6 +230,11 @@ mkdir -p "$OUT"
 # device-speed table from a 40x tunnel-bound one.
 export APEX_COLLECT_MANIFEST="$OUT/manifest.json"
 export APEX_PROBE_STATE="$STATE"
+# the round's flight-recorder dir rides at the round root too (ISSUE
+# 16): warm_cache and every passN append to one heartbeat stream, so
+# --status and the end-of-round window_report see a single timeline
+export APEX_FLIGHT_DIR="$OUT/flight"
+mkdir -p "$APEX_FLIGHT_DIR"
 
 probe() {
     # Healthy == the MARGINAL bf16 matmul rate between a K=8 and a K=64

@@ -31,6 +31,9 @@ from benchmarks._smoke import smoke_mode  # noqa: E402
 SMOKE = smoke_mode("APEX_BENCH_SMOKE")  # force-CPU tiny sanity mode
 
 from benchmarks._timing import Tracer  # noqa: E402
+from apex_tpu.telemetry import flight  # noqa: E402
+
+flight.beat("proc_start")  # ISSUE 16: no-op unless APEX_FLIGHT_DIR
 
 from apex_tpu.amp.scaler import LossScaler
 from apex_tpu.dispatch import tiles as _tiles
@@ -86,6 +89,7 @@ params = jax.jit(shmap(
     2))(ids, pos)
 n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
 TRACER = Tracer(K, peak_flops=PEAK)
+flight.beat("backend_init")  # Tracer measured overhead => backend is up
 print(f"params: {n_params/1e6:.1f}M   (method: {K}-step lax.scan, 1 dispatch,"
       f" dispatch overhead {TRACER.overhead_ms:.1f} ms subtracted)")
 

@@ -59,6 +59,9 @@ from benchmarks._smoke import smoke_mode  # noqa: E402
 SMOKE = smoke_mode("APEX_BENCH_SMOKE")
 
 from benchmarks._timing import Tracer  # noqa: E402
+from apex_tpu.telemetry import flight  # noqa: E402
+
+flight.beat("proc_start")  # ISSUE 16: no-op unless APEX_FLIGHT_DIR
 
 from apex_tpu import compile_cache, dispatch  # noqa: E402
 from apex_tpu.dispatch import tiles as _tiles  # noqa: E402
@@ -187,6 +190,7 @@ engine = ServingEngine(cfg, num_slots=SLOTS, page_size=PS,
                        prefill_len=PRE_LEN)
 n_params = sum(x.size for x in jax.tree_util.tree_leaves(engine.params))
 TRACER = Tracer(K, peak_flops=PEAK)
+flight.beat("backend_init")  # Tracer measured overhead => backend is up
 print(f"serving: {n_params / 1e6:.1f}M params, {SLOTS} slots, "
       f"{PAGES} pages x {PS}, quant={'int8' if WQ else 'off'}, "
       f"decode-attn={IMPL}, sampling={'on' if SAMPLING else 'off'}, "

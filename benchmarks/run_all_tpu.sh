@@ -1,7 +1,10 @@
 #!/bin/bash
 # One-shot collection of every queued TPU measurement (PERF.md §6).
 # Run when the axon relay is healthy:  bash benchmarks/run_all_tpu.sh [outdir]
-# Each harness gets its own timeout so one wedged run cannot sink the rest.
+# Each harness runs under the heartbeat supervisor
+# (apex_tpu/resilience/flight_watch.py): the full per-rung cap is kept
+# while flight beats arrive, but a heartbeat-silent wedge is reaped at
+# the silence threshold instead of burning its whole slot (ISSUE 16).
 set -u
 cd "$(dirname "$0")/.."
 # fault injection (apex_tpu/resilience/faults.py) is test-only: a
@@ -41,6 +44,13 @@ OUT="${1:-/tmp/apex_tpu_bench_$(date +%Y%m%d_%H%M)}"
 mkdir -p "$OUT"
 echo "collecting into $OUT"
 
+# Flight recorder (ISSUE 16): one round-root heartbeat dir shared by
+# every rung (probe_and_collect.sh exports APEX_FLIGHT_DIR at the round
+# outdir so warm_cache and all passes land in the same stream; a
+# standalone run keeps its beats next to its own logs).
+FLIGHT_DIR="${APEX_FLIGHT_DIR:-$OUT/flight}"
+mkdir -p "$FLIGHT_DIR"
+
 # Durable collection manifest (apex_tpu/resilience/manifest.py): every
 # row's verdict is banked per ROUND, and a row already cashed (healthy)
 # in an earlier pass/window is skipped — the next healthy window
@@ -61,9 +71,23 @@ run() {  # run <name> <timeout_s> <cmd...>
         return 0
     fi
     echo "=== $name (timeout ${t}s)"
-    # --preserve-status: bench.py's SIGTERM handler flushes its best
-    # measurement and exits with a meaningful status — don't mask it as 124
-    timeout --preserve-status "$t" "$@" >"$OUT/$name.log" 2>&1
+    # Heartbeat supervisor (ISSUE 16): full cap while beats arrive,
+    # early reap (SIGTERM -> grace -> SIGKILL, so bench's emergency
+    # flush still banks partials) on heartbeat silence, classified
+    # flight_reap ledger record, exit 143 -> manifest keeps the row
+    # owed. The supervisor interpreter starts relay-proof
+    # (PALLAS_AXON_POOL_IPS=, CLAUDE.md) and restores the var's
+    # ORIGINAL state (APEX_FLIGHT_POOL_RESTORE) into the child env so
+    # a TPU rung dials the relay exactly as it did under bare timeout.
+    # The outer timeout is a +120s BACKSTOP only (a wedged supervisor
+    # cannot sink the queue); --preserve-status keeps reaped/flushed
+    # exit codes meaningful instead of masking them as 124.
+    timeout --preserve-status $((t + 120)) \
+        env APEX_FLIGHT_POOL_RESTORE="${PALLAS_AXON_POOL_IPS-__unset__}" \
+        PALLAS_AXON_POOL_IPS= \
+        python -m apex_tpu.resilience.flight_watch \
+        --timeout "$t" --row "$name" --flight-dir "$FLIGHT_DIR" \
+        -- "$@" >"$OUT/$name.log" 2>&1
     local rc=$?
     tail -3 "$OUT/$name.log" | sed 's/^/    /'
     [ $rc -ne 0 ] && echo "    rc=$rc (see $OUT/$name.log)"
@@ -239,4 +263,5 @@ manifest_cli status --manifest "$MANIFEST" || true
 # Relay-proof like the manifest CLI (the reporter never dials a backend).
 timeout 120 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     python tools/window_report.py --logs "$OUT" --manifest "$MANIFEST" \
+    --flight "$FLIGHT_DIR" \
     ${APEX_PROBE_STATE:+--probe-state "$APEX_PROBE_STATE"} || true

@@ -47,6 +47,7 @@ sys.path.insert(0, REPO)
 
 from apex_tpu import resilience  # noqa: E402
 from apex_tpu.dispatch.tiles import env_flag  # noqa: E402
+from apex_tpu.telemetry import flight  # noqa: E402
 from bench import _last_json  # noqa: E402  (the ONE driver-line parser)
 
 
@@ -68,6 +69,7 @@ def warm_target(name, cmd, extra_env, timeout):
     # warming REQUIRES the cache on (that is its entire job) — but the
     # escape hatch stays honored: an explicit APEX_COMPILE_CACHE=0 wins
     env.setdefault("APEX_COMPILE_CACHE", "1")
+    flight.beat("attempt_start", label=f"warm:{name}")
     # apexlint: disable=APX004 — warm-subprocess wall for the echo line, not a measurement (the warm pass times nothing, PERF.md §6)
     t0 = time.perf_counter()
     timed_out = False
@@ -111,6 +113,8 @@ def warm_target(name, cmd, extra_env, timeout):
             detail = f" {n} rows warmed"
         if not ok:
             sys.stderr.write((proc.stderr or "")[-2000:])
+    flight.beat("attempt_done", label=f"warm:{name}", ok=ok,
+                timed_out=timed_out)
     print(f"warm {name}: {'ok' if ok else 'FAILED'} "
           f"(verdict={verdict}, {note}, {dt:.0f}s){detail}", flush=True)
     return ok, rec

@@ -20,6 +20,14 @@ SCOPE_CITED = ("apex_tpu", "benchmarks", "tools",
                "bench.py", "__graft_entry__.py")
 
 SHELLS = ("benchmarks/run_all_tpu.sh", "benchmarks/probe_and_collect.sh")
+# APX004 monotonic-home extension (ISSUE 16): the only non-benchmark
+# files allowed to call time.monotonic/monotonic_ns — the beat stamp,
+# its one other emitter, and the supervisor that ages beats
+MONOTONIC_HOMES = (
+    "apex_tpu/telemetry/flight.py",
+    "apex_tpu/telemetry/tracing.py",
+    "apex_tpu/resilience/flight_watch.py",
+)
 API_MD = "docs/API.md"
 LEDGER_PY = "apex_tpu/telemetry/ledger.py"
 KNOB_TABLE_BEGIN = "<!-- apexlint: knob-table begin -->"
@@ -121,6 +129,22 @@ DESIGNATED_READERS = (
      "values back into the env and the record's knobs (check 8)"),
     ("benchmarks/warm_cache.py", "APEX_COLLECT_MANIFEST",
      "manifest-path handoff from probe_and_collect.sh"),
+    # flight recorder + supervisor (ISSUE 16)
+    ("apex_tpu/telemetry/flight.py", "APEX_FLIGHT_*",
+     "the recorder itself: dir path + row label, read per-beat (unset "
+     "= disabled is the whole zero-cost contract — a typed helper "
+     "would be a second home)"),
+    ("apex_tpu/telemetry/flight.py", "APEX_BENCH_ATTEMPT",
+     "beats auto-stamp the watchdog's attempt index; raw int parse "
+     "because a beat must NEVER raise on a malformed value"),
+    ("apex_tpu/resilience/flight_watch.py", "APEX_FLIGHT_*",
+     "supervisor clock thresholds: zero and fractional seconds are "
+     "legal (chaos tests pin seconds-scale silence), which the "
+     "positive-int helpers cannot express; plus the pool-restore "
+     "marker handoff from run_all_tpu.sh"),
+    ("tools/window_report.py", "APEX_FLIGHT_DIR",
+     "CLI --flight default (probe_and_collect.sh exports it per "
+     "round) — path, not a typed value"),
 )
 
 # ---------------------------------------------------------------------------
@@ -130,6 +154,7 @@ DESIGNATED_READERS = (
 
 STDLIB_ONLY_CLAIMED = (
     "apex_tpu/resilience/",
+    "apex_tpu/telemetry/flight.py",
     "apex_tpu/dispatch/tiles.py",
     "apex_tpu/dispatch/__init__.py",
     "apex_tpu/serving/scheduler.py",
