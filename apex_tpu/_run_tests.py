@@ -42,7 +42,8 @@ SUITES = {
     "serving": ["test_serving.py", "test_serving_slo.py",
                 "test_serving_generation.py",
                 "test_serving_resilience.py",
-                "test_serving_chaos.py"],
+                "test_serving_chaos.py",
+                "test_serving_multitok.py"],
     "api_parity": ["test_api_parity_round3.py"],
     "harness": ["test_run_tests.py", "test_bench_contract.py",
                 "test_compile_cache.py", "test_resilience.py",

@@ -53,7 +53,7 @@ PASS_ROWS = (
     "bench", "bench_b32",
     "bench_b32_remat", "bench_profile", "serving",
     "serving_sampling", "serving_spec", "serving_prefix",
-    "serving_resilience",
+    "serving_resilience", "serving_multitok",
 )
 
 

@@ -133,6 +133,29 @@ env-vs-env falls back to serial. The ``serve_*`` chaos sites
 so ``tests/test_serving_chaos.py`` drives every recovery path through
 the real engine.
 
+Multi-token decode blocks (ISSUE 17, ``decode_k=`` >
+``APEX_SERVE_DECODE_K``, default K=1 per the measured-dispatch rule —
+the ``serving_multitok`` A/B is queued in PERF.md §2): ONE dispatch
+runs K decode steps in a ``lax.scan`` (:func:`model.decode_block`),
+amortizing the ~65 ms per-dispatch relay floor across K tokens. K is
+a STATIC program constant — at most a second decode compile-cache
+key; the per-lane step budgets, in-block warmup feed and sampling
+counters ride as VALUES, so ``decode_cache_size()==1`` holds per
+engine whatever the scheduler does. All host-side decisions — admit /
+evict / shed / preempt / sampling-lane restage — coarsen to every-K
+block boundaries; a lane finishing mid-block rides the rest of the
+block as masked ballast (null-page writes, outputs discarded), a
+preemption victim requeues with its mid-block partial tokens and
+replays through the ordinary ``resume_tokens`` path, and the guarded
+dispatch watchdog naturally treats the whole K-block as its unit.
+Token-for-token parity with the K=1 engine is pinned by
+tests/test_serving_multitok.py under every layer combination.
+Speculative decode COMPETES for the same amortization (both batch
+multiple tokens per dispatch) and its verify arithmetic assumes one
+pending token per round, so the pairing follows the established
+asymmetry: two per-call demands raise, a demand drops the other
+side's env preference, env-vs-env falls back to K=1.
+
 Observability (ISSUE 11): when ``lifecycle.enabled()`` the engine
 keeps a request-lifecycle :class:`~apex_tpu.serving.lifecycle.EventLog`
 (``self.events``) — submitted/admitted/prefill_done/first_token/
@@ -176,7 +199,8 @@ class ServingEngine:
                  prefill_requests=None, weight_quant=None,
                  decode_impl=None, decode_block_h=None, interpret=None,
                  policy=None, sampling=None, spec_decode=None,
-                 prefix_cache=None, overlap=None, admit=None,
+                 decode_k=None, prefix_cache=None, overlap=None,
+                 admit=None,
                  shed=None, preempt=None, recover=None,
                  shed_ttft_ms=None, dispatch_timeout_s=None,
                  round_attempts=None, round_retry_wait_s=None, seed=0):
@@ -237,6 +261,33 @@ class ServingEngine:
         if overlap is True and self.spec_k and spec_decode is None:
             self.spec_k = 0
             self.spec_stats = None
+        # multi-token decode blocks (ISSUE 17): K decode steps per
+        # device dispatch — ONE lax.scan program, K a static compile
+        # key — amortizing the per-dispatch relay floor. Default K=1
+        # per the measured-dispatch rule (the serving_multitok A/B is
+        # queued in PERF.md §2). Speculative decode competes for the
+        # same amortization (both batch multiple tokens per dispatch)
+        # and its verify/rollback arithmetic assumes ONE pending token
+        # per decode round, so the pairing follows the established
+        # asymmetry: two per-call demands raise, a demand drops the
+        # other side's env preference, env-vs-env falls back to K=1
+        # (the committed measurement backs the spec layer; the K-block
+        # row is still queued).
+        dk = smodel.resolve_decode_k(decode_k)
+        if dk > 1 and self.spec_k:
+            if decode_k is not None and spec_decode is not None:
+                raise ValueError(
+                    f"decode_k={dk} cannot be honored with "
+                    f"spec_decode={self.spec_k}: the verify rollback "
+                    f"assumes one pending token per decode round "
+                    f"(two demands, no honorable order)")
+            if decode_k is not None:
+                # explicit K-block demand drops the env draft pref
+                self.spec_k = 0
+                self.spec_stats = None
+            else:
+                dk = 1  # APEX_SERVE_DECODE_K preference falls back
+        self.decode_k = dk
         # serving resilience (ISSUE 15): four default-OFF layers.
         # Preemption and round recovery need the serial round (the
         # deferred-fetch step's placeholder tokens must never reach a
@@ -338,7 +389,34 @@ class ServingEngine:
                                   seg, token_rows, page_table,
                                   last_idx, cfg=cfg)
 
-        if self.sampling:
+        # the decode program: at K=1 the single-step program is built
+        # byte-identical to the pre-block engine; at K>1 the ONE
+        # lax.scan K-block program replaces it (K is static — at most
+        # a second compile-cache key; the per-lane budgets/warmup
+        # arrays are VALUES, so the one-compile contract holds)
+        if self.decode_k > 1 and self.sampling:
+            def _decode(cache, tokens, lengths, page_table, steps,
+                        warm_tokens, warm_steps, temps, top_ks,
+                        top_ps, keys, counters):
+                return smodel.decode_block(
+                    self.params, cache, tokens, lengths, page_table,
+                    steps, warm_tokens, warm_steps,
+                    lanes=(temps, top_ks, top_ps, keys, counters),
+                    k=self.decode_k, cfg=cfg, qparams=self.qparams,
+                    decode_impl=self.decode_impl,
+                    decode_block_h=self.decode_block_h,
+                    interpret=self.interpret)
+        elif self.decode_k > 1:
+            def _decode(cache, tokens, lengths, page_table, steps,
+                        warm_tokens, warm_steps):
+                return smodel.decode_block(
+                    self.params, cache, tokens, lengths, page_table,
+                    steps, warm_tokens, warm_steps,
+                    k=self.decode_k, cfg=cfg, qparams=self.qparams,
+                    decode_impl=self.decode_impl,
+                    decode_block_h=self.decode_block_h,
+                    interpret=self.interpret)
+        elif self.sampling:
             def _decode(cache, tokens, lengths, page_table, temps,
                         top_ks, top_ps, keys, counters):
                 cache, _, logits = smodel.decode_step(
@@ -838,26 +916,93 @@ class ServingEngine:
 
     # ------------------------------------------------------------- steps
 
+    def _lane_budget(self, slot):
+        """``(warmup steps remaining, this block's step budget)`` for
+        one live lane: warmup steps consume KNOWN tokens (a prefix-hit
+        covered suffix or a resumed stream's replay overflow, outputs
+        discarded), then emit steps count toward the request's
+        remaining new tokens. The budget caps at ``decode_k`` and at
+        the lane's own finish — a lane never decodes past its last
+        token inside a block, so block writes stay within the
+        request's admitted ``prompt + max_new_tokens`` page span."""
+        req = slot.request
+        warm = max(0, len(slot.known) - 1 - slot.pos)
+        rem = req.max_new_tokens - len(req.out_tokens)
+        return warm, min(self.decode_k, warm + rem)
+
+    def _block_hi(self, slot):
+        """Highest cache position this block writes for a live lane —
+        the page-growth span (at K=1 this is exactly ``slot.pos``)."""
+        return slot.pos + self._lane_budget(slot)[1] - 1
+
+    def _stage_block(self, decode_lanes):
+        """Per-lane staging of one K-block dispatch (ISSUE 17):
+        returns ``(steps, steps_dev, warm_tokens, warm_steps)`` where
+        ``steps`` maps lane -> host bookkeeping step count,
+        ``steps_dev [B]`` is the device step budget (0 for
+        done-ballast lanes: the whole block treats them as inactive —
+        null-page writes, outputs discarded), ``warm_tokens [K, B]``
+        is the in-block warmup feed and ``warm_steps [B]`` how many
+        leading steps consume it. All VALUES — the compiled block
+        never specializes on them (the one-compile contract)."""
+        sch = self.scheduler
+        k = self.decode_k
+        steps = {}
+        steps_dev = np.zeros(self.num_slots, np.int32)
+        warm_tokens = np.zeros((k, self.num_slots), np.int32)
+        warm_steps = np.zeros(self.num_slots, np.int32)
+        for i in decode_lanes:
+            slot = sch.slots[i]
+            if slot.request.done():
+                steps[i] = 1  # ballast: one count step, no device step
+                continue
+            warm, budget = self._lane_budget(slot)
+            steps[i] = budget
+            steps_dev[i] = budget
+            w = min(warm, budget)
+            warm_steps[i] = w
+            for j in range(w):
+                warm_tokens[j, i] = int(slot.known[slot.pos + j + 1])
+        return steps, steps_dev, warm_tokens, warm_steps
+
     def _dispatch_decode(self, assert_lanes, zero_length_lanes=()):
-        """Stage + dispatch ONE decode step for the current slots —
+        """Stage + dispatch ONE decode block for the current slots —
         the SHARED assembly of the serial and overlapped rounds, so
         their token-for-token parity is structural (one staging path)
-        rather than maintained across twin code. ``zero_length_lanes``
-        are this round's verify-satisfied lanes (serial speculative
-        path). Returns ``(next_toks, t0)`` with the fetch left to the
+        rather than maintained across twin code. At K=1 the staged
+        program is the single decode step, byte-identical to the
+        pre-block engine; at K>1 it is the ``decode_block`` scan with
+        the per-lane budget/warmup arrays staged as values.
+        ``zero_length_lanes`` are this round's verify-satisfied lanes
+        (serial speculative path — K=1 only, the pairing rule).
+        Returns ``(next_toks, t0, steps)`` with the fetch left to the
         caller (the serial round fetches immediately; the overlapped
-        round defers it)."""
+        round defers it); ``steps`` maps lane -> how many of the
+        block's scan steps that lane's bookkeeping consumes."""
         sch = self.scheduler
         tokens, lengths = sch.decode_inputs()
         for i in zero_length_lanes:
             lengths[i] = 0  # this round's tokens came via verify
         pt = np.asarray(sch.page_table_rows(), np.int32)
-        for i in assert_lanes:
-            self._assert_writable(sch.slots[i], sch.slots[i].pos,
-                                  sch.slots[i].pos)
+        if self.decode_k > 1:
+            steps, steps_dev, warm_tokens, warm_steps = \
+                self._stage_block(assert_lanes)
+            for i in assert_lanes:
+                if steps_dev[i]:
+                    self._assert_writable(
+                        sch.slots[i], sch.slots[i].pos,
+                        sch.slots[i].pos + int(steps_dev[i]) - 1)
+        else:
+            steps = {i: 1 for i in assert_lanes}
+            for i in assert_lanes:
+                self._assert_writable(sch.slots[i], sch.slots[i].pos,
+                                      sch.slots[i].pos)
         args = [self.cache, jnp.asarray(tokens, dtype=jnp.int32),
                 jnp.asarray(lengths, dtype=jnp.int32),
                 jnp.asarray(pt)]
+        if self.decode_k > 1:
+            args += [jnp.asarray(steps_dev), jnp.asarray(warm_tokens),
+                     jnp.asarray(warm_steps)]
         if self.sampling:
             temps, top_ks, top_ps, keys, counters = \
                 sampling_mod.lane_arrays(sch.slots, self.num_slots)
@@ -877,7 +1022,7 @@ class ServingEngine:
         # state adopted only after a clean return (a timed-out
         # round's late result never overwrites the recovered engine)
         self.cache, next_toks = self._dispatch("decode", call)
-        return next_toks, t0
+        return next_toks, t0, steps
 
     def _sample_gauges(self, tick):
         """One gauge sample per scheduler round, AFTER the round's
@@ -1061,7 +1206,7 @@ class ServingEngine:
             # growing (let alone preempting a live stream) for them
             # would spend pages on a dead write
             grown = set(self._ensure_pages(
-                [(i, sch.slots[i].pos) for i in decode_lanes
+                [(i, self._block_hi(sch.slots[i])) for i in decode_lanes
                  if not sch.slots[i].request.done()], now))
             decode_lanes = [i for i in decode_lanes
                             if sch.slots[i] is not None
@@ -1069,52 +1214,13 @@ class ServingEngine:
                                  or i in grown)]
         decoded = 0
         if decode_lanes:
-            next_toks, t0 = self._dispatch_decode(
+            next_toks, t0, steps = self._dispatch_decode(
                 decode_lanes, zero_length_lanes=verified)
+            plan, decoded = self._advance_counts(decode_lanes, steps)
             next_toks = np.asarray(next_toks)
             wall2 = time.perf_counter()
             self.device_dispatch_s += wall2 - t0
-            for i in decode_lanes:
-                slot = sch.slots[i]
-                k_len = len(slot.known)
-                consumed_pos = slot.pos
-                slot.pos += 1
-                if consumed_pos < k_len - 1:
-                    # warmup: the consumed token was a KNOWN token
-                    # (prefix-hit prompt or a resumed stream's replay
-                    # overflow) with more to come — feed the next one,
-                    # discard the lane's output
-                    slot.next_token = int(slot.known[consumed_pos + 1])
-                    decoded += 1
-                    continue
-                if not slot.request.done():
-                    tok = int(next_toks[i])
-                    slot.request.out_tokens.append(tok)
-                    slot.next_token = tok
-                    self.tokens_generated += 1
-                    if consumed_pos == k_len - 1 \
-                            and slot.request.first_token_wall is None:
-                        # the slot's FIRST output token: its warmup
-                        # ended this round — the prefill-done /
-                        # first-token seam of the cached path. A
-                        # resumed stream's warmup end is NOT a first
-                        # token (its seam fired in an earlier cycle —
-                        # the wall guard keeps the chain single-shot)
-                        slot.request.first_token_wall = wall2
-                        if self.events is not None:
-                            rid = slot.request.rid
-                            self.events.record("prefill_done", rid,
-                                               tick=now, wall=wall2)
-                            self.events.record("first_token", rid,
-                                               tick=now, wall=wall2)
-                    if slot.request.done():
-                        slot.request.finish_wall = wall2
-                        if self.events is not None:
-                            self.events.record("finished",
-                                               slot.request.rid,
-                                               tick=now, wall=wall2)
-                decoded += 1
-            self.decode_steps += 1
+            self._fill_plan(plan, next_toks, wall2, now)
         self._sample_gauges(now)
         # a slot whose LAST token was just produced frees at the next
         # round's evict — one round of slack, never a starved queue
@@ -1199,54 +1305,104 @@ class ServingEngine:
                              "detail": failure.detail,
                              "requeued": [r.rid for r in requeued]}}
 
-    # ----------------------------------- overlapped round (ISSUE 14)
+    # ------------- shared round bookkeeping (ISSUEs 14/17 one seam)
 
-    def _advance_counts(self, decode_lanes):
-        """Post-dispatch COUNT bookkeeping of one decode round: the
-        serial fetch loop's position/length/done transitions, with a
-        placeholder where the token VALUE would land (the fetch fills
-        it in ``_resolve_pending``). This is the seam that keeps the
-        overlapped schedule exact: every transition here is a count
-        function — round t+1's planner never observes round-t token
-        values early. Returns ``(plan, decoded)``; plan entries hold
-        the slot/request REFS (eviction between dispatch and fetch
-        detaches the slot, the refs stay valid)."""
+    def _advance_counts(self, decode_lanes, steps):
+        """Post-dispatch COUNT bookkeeping of one decode block — the
+        ONE round-bookkeeping seam shared by the serial and overlapped
+        rounds (ISSUE 17 satellite: formerly twin code), walking the
+        block's (step, lane) grid with a placeholder where each token
+        VALUE lands (``_fill_plan`` fills it — immediately after the
+        fetch on the serial round, at the deferred fetch on the
+        overlapped one). ``steps`` maps lane -> how many of the
+        block's K scan steps that lane's bookkeeping consumes (1
+        everywhere at K=1). Every transition here is a count function
+        — the overlapped round-t+1 planner never observes round-t
+        token values early. Plan entries hold the slot/request REFS
+        (eviction between dispatch and fetch detaches the slot, the
+        refs stay valid). Returns ``(plan, decoded)``."""
         sch = self.scheduler
         plan = []
         decoded = 0
-        for i in decode_lanes:
-            slot = sch.slots[i]
-            p_len = len(slot.request.prompt)
-            consumed_pos = slot.pos
-            slot.pos += 1
-            if consumed_pos < p_len - 1:
-                # prefix-hit warmup: next prompt token fed, lane
-                # output discarded — value-free either way
-                slot.next_token = slot.request.prompt[consumed_pos + 1]
-                decoded += 1
-                continue
-            if not slot.request.done():
+        for j in range(self.decode_k):
+            for i in decode_lanes:
+                if j >= steps.get(i, 0):
+                    continue
+                slot = sch.slots[i]
                 req = slot.request
-                req.out_tokens.append(None)  # value lands at the fetch
-                self.tokens_generated += 1
-                plan.append({
-                    "lane": i, "slot": slot, "req": req,
-                    "out_idx": len(req.out_tokens) - 1,
-                    # a prefix-hit slot's FIRST output token: warmup
-                    # ended this round (the serial first-token seam)
-                    "first": consumed_pos == p_len - 1,
-                    "done": req.done(),
-                })
-            decoded += 1
+                k_len = len(slot.known)
+                consumed_pos = slot.pos
+                slot.pos += 1
+                if consumed_pos < k_len - 1:
+                    # warmup: the consumed token was a KNOWN token
+                    # (prefix-hit covered suffix or a resumed stream's
+                    # replay overflow) with more to come — the next one
+                    # is fed (host-side here at K=1; the staged
+                    # ``warm_tokens`` row inside the block at K>1) and
+                    # the lane's output is discarded
+                    slot.next_token = int(slot.known[consumed_pos + 1])
+                    decoded += 1
+                    continue
+                if not req.done():
+                    req.out_tokens.append(None)  # value lands at fill
+                    self.tokens_generated += 1
+                    plan.append({
+                        "lane": i, "step": j, "slot": slot, "req": req,
+                        "out_idx": len(req.out_tokens) - 1,
+                        # the slot's FIRST output token: its warmup
+                        # ended this step — the prefill-done /
+                        # first-token seam of the cached path. A
+                        # resumed stream's warmup end is NOT a first
+                        # token (its seam fired in an earlier cycle —
+                        # the wall guard keeps the chain single-shot)
+                        "first": (consumed_pos == k_len - 1
+                                  and req.first_token_wall is None),
+                        "done": req.done(),
+                    })
+                decoded += 1
+        # decode_steps counts DISPATCHES — the ~65 ms relay unit the
+        # K-block amortizes; tokens-per-dispatch is the economics ratio
         self.decode_steps += 1
         return plan, decoded
 
+    def _fill_plan(self, plan, next_toks, wall, tick):
+        """The VALUE half of the round-bookkeeping seam: fill the
+        block's placeholder tokens and stamp the walls / lifecycle
+        events the counts deferred. ``next_toks`` is ``[B]`` from the
+        single-step program or ``[K, B]`` from the K-block; entries
+        index it by (step, lane). A lane with several emits in one
+        block fills in step order, so its ``next_token`` (the NEXT
+        block's feed) is the last step's token."""
+        toks = np.asarray(next_toks)
+        if toks.ndim == 1:
+            toks = toks[None]
+        for e in plan:
+            tok = int(toks[e["step"], e["lane"]])
+            e["req"].out_tokens[e["out_idx"]] = tok
+            e["slot"].next_token = tok
+            rid = e["req"].rid
+            if e["first"]:
+                if e["req"].first_token_wall is None:
+                    e["req"].first_token_wall = wall
+                if self.events is not None:
+                    self.events.record("prefill_done", rid,
+                                       tick=tick, wall=wall)
+                    self.events.record("first_token", rid,
+                                       tick=tick, wall=wall)
+            if e["done"]:
+                if e["req"].finish_wall is None:
+                    e["req"].finish_wall = wall
+                if self.events is not None:
+                    self.events.record("finished", rid,
+                                       tick=tick, wall=wall)
+
+    # ----------------------------------- overlapped round (ISSUE 14)
+
     def _resolve_pending(self):
         """The sync point of the overlapped round: fetch the in-flight
-        decode's tokens, fill every placeholder, stamp first-token /
-        finish walls and record their lifecycle events (with the
-        dispatching round's tick — the round the serial engine would
-        have recorded them at)."""
+        decode block's tokens and hand them to ``_fill_plan`` (stamped
+        with the dispatching round's tick — the round the serial
+        engine would have recorded them at)."""
         p = self._pending
         if p is None:
             return
@@ -1257,25 +1413,7 @@ class ServingEngine:
         # device window — counting it as dispatch wall is the measured
         # claim (run wall minus this = the host slice overlap removed)
         self.device_dispatch_s += wall - p["t0"]
-        for e in p["plan"]:
-            tok = int(next_toks[e["lane"]])
-            e["req"].out_tokens[e["out_idx"]] = tok
-            e["slot"].next_token = tok
-            rid = e["req"].rid
-            if e["first"]:
-                if e["req"].first_token_wall is None:
-                    e["req"].first_token_wall = wall
-                if self.events is not None:
-                    self.events.record("prefill_done", rid,
-                                       tick=p["tick"], wall=wall)
-                    self.events.record("first_token", rid,
-                                       tick=p["tick"], wall=wall)
-            if e["done"]:
-                if e["req"].finish_wall is None:
-                    e["req"].finish_wall = wall
-                if self.events is not None:
-                    self.events.record("finished", rid,
-                                       tick=p["tick"], wall=wall)
+        self._fill_plan(p["plan"], next_toks, wall, p["tick"])
 
     def flush(self):
         """Resolve the in-flight decode round (overlap mode): fill the
@@ -1338,10 +1476,10 @@ class ServingEngine:
         decode_lanes = sch.active_indices()
         decoded = 0
         if decode_lanes:
-            next_toks, t0 = self._dispatch_decode(decode_lanes)
+            next_toks, t0, steps = self._dispatch_decode(decode_lanes)
             # NO fetch: the round returns with the decode in flight;
             # counts advance now so the next round can plan
-            plan, decoded = self._advance_counts(decode_lanes)
+            plan, decoded = self._advance_counts(decode_lanes, steps)
             self._pending = {"next_toks": next_toks, "plan": plan,
                              "t0": t0, "tick": now}
         self._sample_gauges(now)

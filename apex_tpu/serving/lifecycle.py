@@ -341,7 +341,8 @@ def percentile(values, q):
 
 
 def slo_block(requests, wall_s, *, ttft_ms, tpot_ms, arrival_process,
-              offered_load, log=None, resilience=None):
+              offered_load, log=None, resilience=None,
+              decode_block_k=1):
     """Assemble the validated ``slo`` ledger block from completed
     requests + the run's wall time (+ the EventLog's gauge summary
     when collection was on — occupancy fields null-degrade without
@@ -349,7 +350,11 @@ def slo_block(requests, wall_s, *, ttft_ms, tpot_ms, arrival_process,
     ``resilience_rates()`` dict — ``shed_rate`` / ``preempt_rate`` /
     ``degraded_rounds``, each None when its knob is off (degradation,
     never omission; check 9 refuses a non-None rate whose selecting
-    knob is unpinned or off)."""
+    knob is unpinned or off). ``decode_block_k`` (ISSUE 17) is the
+    engine's multi-token block size — the TTFT/TPOT trade the row
+    embodies depends on it, so it rides the block and
+    check_bench_labels check 8 refuses a row whose
+    ``APEX_SERVE_DECODE_K`` pin disagrees with it."""
     lats = request_latencies(requests)
     ttfts = [x["ttft_s"] * 1e3 for x in lats if x["ttft_s"] is not None]
     tpots = [x["tpot_s"] * 1e3 for x in lats if x["tpot_s"] is not None]
@@ -386,4 +391,5 @@ def slo_block(requests, wall_s, *, ttft_ms, tpot_ms, arrival_process,
         "shed_rate": _r((resilience or {}).get("shed_rate"), 4),
         "preempt_rate": _r((resilience or {}).get("preempt_rate"), 4),
         "degraded_rounds": (resilience or {}).get("degraded_rounds"),
+        "decode_block_k": int(decode_block_k),
     }

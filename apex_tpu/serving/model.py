@@ -40,7 +40,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from apex_tpu.dispatch import tiles as _tiles
 from apex_tpu.serving import quant as quant_mod
+from apex_tpu.serving import sampling as sampling_mod
 
 
 def check_serving_config(cfg):
@@ -322,3 +324,90 @@ def decode_step(params, cache, tokens, lengths, page_table, *, cfg,
         active, jnp.argmax(logits.astype(jnp.float32), axis=-1)
         .astype(jnp.int32), 0)
     return cache, next_tokens, logits
+
+
+# ---------------------------------------------- multi-token decode block
+
+
+def resolve_decode_k(per_call=None):
+    """Knob resolution for the multi-token decode block (ISSUE 17),
+    per the CLAUDE.md asymmetry: the per-call ``decode_k=`` argument
+    is a DEMAND — a bool, non-int or K < 1 raises; the
+    ``APEX_SERVE_DECODE_K`` env value is a PREFERENCE through the
+    one-home positive-int parser (garbage warns once and falls back).
+    Default K=1 per the measured-dispatch rule — the single-step
+    program stays the dispatched one until the ``serving_multitok``
+    device A/B (PERF.md §2) lands."""
+    if per_call is not None:
+        if isinstance(per_call, bool) or not isinstance(per_call, int) \
+                or per_call < 1:
+            raise ValueError(
+                f"decode_k= wants an int >= 1, got {per_call!r}")
+        return per_call
+    return _tiles.env_int("APEX_SERVE_DECODE_K") or 1
+
+
+def decode_block(params, cache, tokens, lengths, page_table,
+                 steps_budget, warm_tokens, warm_steps, lanes=None, *,
+                 k, cfg, qparams=None, decode_impl=None,
+                 decode_block_h=None, interpret=None):
+    """K decode steps in ONE dispatch (ISSUE 17): a ``lax.scan`` over
+    :func:`decode_step` with in-program per-slot stop detection, so a
+    single device round trip amortizes the relay's per-dispatch floor
+    across up to K tokens per slot.
+
+    ``k`` is a STATIC program constant — at most a second
+    compile-cache key next to the K=1 single-step program; every
+    per-round quantity below is an array VALUE, so scheduler events
+    (admit/evict/shed/preempt between blocks) never recompile. Per
+    scanned step ``j`` (0-based):
+
+    * a lane is LIVE while ``j < steps_budget[i]`` (its host-computed
+      budget: warmup steps left + remaining token budget, capped at
+      K) and its staged length is non-zero. A finished/empty lane's
+      length is masked to 0 for the step, which routes its K/V write
+      to the null page 0 and emits the pad token 0 — exactly
+      :func:`decode_step`'s inactive-slot contract — and its length
+      does not advance.
+    * warmup steps (``j < warm_steps[i]`` — a prefix-hit prompt or a
+      resumed stream's replay overflow) feed the next KNOWN token
+      (``warm_tokens[j, i]``) as the following step's input instead
+      of the model's emission; the emitted token is discarded
+      host-side, mirroring the K=1 warmup loop.
+    * sampling lanes (``lanes`` = the engine's staged ``(temps,
+      top_ks, top_ps, keys, counters)`` arrays) fold the generation
+      index INSIDE the scan: the draw for generation index g always
+      uses ``fold_in(key, g)`` whatever K or the batch composition —
+      per-step counters are ``counters + max(0, j - warm_steps)``, so
+      a seeded request's stream is pinned identical to the K=1
+      engine's (the per-slot-RNG determinism test, now under K).
+
+    tokens/lengths: ``[B]`` staged exactly as for :func:`decode_step`;
+    steps_budget/warm_steps: ``[B]`` int32; warm_tokens: ``[K, B]``
+    int32. Returns ``(cache, toks [K, B], logits [K, B, vocab])`` —
+    row j holds step j's emissions (warmup/dead rows are discarded or
+    pad by construction).
+    """
+    def body(carry, xs):
+        cache, tok, lens = carry
+        j, warm_j = xs
+        live = (j < steps_budget) & (lens > 0)
+        step_lens = jnp.where(live, lens, 0)
+        cache, emitted, logits = decode_step(
+            params, cache, tok, step_lens, page_table, cfg=cfg,
+            qparams=qparams, decode_impl=decode_impl,
+            decode_block_h=decode_block_h, interpret=interpret)
+        if lanes is not None:
+            temps, top_ks, top_ps, keys, counters = lanes
+            ctr = counters + jnp.maximum(j - warm_steps, 0)
+            emitted = sampling_mod.sample_tokens(
+                logits, temps, top_ks, top_ps, keys, ctr, live)
+        emitted = emitted.astype(jnp.int32)
+        nxt = jnp.where(j < warm_steps, warm_j, emitted)
+        lens = jnp.where(live, lens + 1, lens)
+        return (cache, nxt, lens), (emitted, logits)
+
+    xs = (jnp.arange(k, dtype=jnp.int32), warm_tokens)
+    (cache, _, _), (toks, logits) = lax.scan(
+        body, (cache, tokens, lengths), xs)
+    return cache, toks, logits

@@ -214,7 +214,11 @@ SLO_FIELDS = ("ttft_p50_ms", "ttft_p99_ms", "per_token_p50_ms",
               # present always, so a disabled layer reads as explicit
               # degradation, never omission (check 9 refuses non-None
               # rates whose selecting knob is unpinned or off)
-              "shed_rate", "preempt_rate", "degraded_rounds")
+              "shed_rate", "preempt_rate", "degraded_rounds",
+              # multi-token decode blocks (ISSUE 17): the K the row ran
+              # at — a REQUIRED positive int (every engine has a block
+              # size; K=1 is the single-step program, not an absence)
+              "decode_block_k")
 _SLO_NUMERIC = ("ttft_p50_ms", "ttft_p99_ms", "per_token_p50_ms",
                 "per_token_p99_ms", "goodput_tok_s", "slo_ttft_ms",
                 "slo_tpot_ms", "offered_load")
@@ -256,6 +260,10 @@ def _validate_slo(slo):
     ap = slo.get("arrival_process")
     if "arrival_process" in slo and not (isinstance(ap, str) and ap):
         problems.append("arrival_process is not a non-empty string")
+    dk = slo.get("decode_block_k")
+    if "decode_block_k" in slo and (not isinstance(dk, int)
+                                    or isinstance(dk, bool) or dk < 1):
+        problems.append("decode_block_k is not a positive int")
     return problems
 
 

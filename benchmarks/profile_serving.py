@@ -175,6 +175,18 @@ PREEMPT = serve_res.resolve_preempt()
 os.environ["APEX_SERVE_PREEMPT"] = "1" if PREEMPT else "0"
 RECOVER = serve_res.resolve_recover()
 os.environ["APEX_SERVE_RECOVER"] = "1" if RECOVER else "0"
+# ...and the multi-token decode block size (ISSUE 17, check 8): K
+# decode steps per dispatch amortize the ~65 ms relay floor — a
+# DIFFERENT compiled decode program, so the resolved K is pinned and
+# rides the slo block (decode_block_k) for both-direction agreement.
+# Resolution mirrors the engine's env-vs-env pairing: speculative
+# decode engaged -> the K preference falls back to 1 (the committed
+# measurement backs the spec layer; the serving_multitok A/B rung
+# sets APEX_SERVE_DECODE_K with spec off).
+DECODE_K = smodel.resolve_decode_k()
+if SPEC_K and DECODE_K > 1:
+    DECODE_K = 1
+os.environ["APEX_SERVE_DECODE_K"] = str(DECODE_K)
 SLO_TTFT_MS = lifecycle.env_ms("APEX_SERVE_SLO_TTFT_MS",
                                lifecycle.DEFAULT_SLO_TTFT_MS)
 SLO_TPOT_MS = lifecycle.env_ms("APEX_SERVE_SLO_TPOT_MS",
@@ -321,6 +333,10 @@ if not compile_cache.warm_only():
         "trace_id": trace_id, "kv_pages": PAGES,
         "requests": len(done),
         "decode_steps": replay.decode_steps,
+        # decode_steps counts DISPATCHES (the ~65 ms relay unit);
+        # tokens/dispatch is the K-block amortization the
+        # serving_multitok rung (ISSUE 17) exists to measure
+        "tokens_generated": replay.tokens_generated,
         # generation economics (ISSUE 13): None-when-disabled —
         # degradation, never omission (check 8 refuses a non-None
         # rate whose selecting knob is unpinned or off)
@@ -357,7 +373,8 @@ if not compile_cache.warm_only():
         done, wall, ttft_ms=SLO_TTFT_MS, tpot_ms=SLO_TPOT_MS,
         arrival_process=ARRIVALS,
         offered_load=sched_mod.offered_load(trace),
-        log=replay.events, resilience=replay.resilience_rates())
+        log=replay.events, resilience=replay.resilience_rates(),
+        decode_block_k=replay.decode_k)
     print(f"{'slo (' + ARRIVALS + ')':28s} "
           f"ttft p50/p99 {slo_block['ttft_p50_ms']}/"
           f"{slo_block['ttft_p99_ms']} ms, per-token p50/p99 "
@@ -412,6 +429,6 @@ rid = TRACER.flush_ledger("profile_serving", extra={
                "slo_ttft_ms": SLO_TTFT_MS,
                "slo_tpot_ms": SLO_TPOT_MS,
                "admit": ADMIT, "shed": SHED, "preempt": PREEMPT,
-               "recover": RECOVER}})
+               "recover": RECOVER, "decode_k": DECODE_K}})
 if rid:
     print(f"ledger: {rid}")

@@ -253,6 +253,13 @@ run serving_prefix   1800 env APEX_SERVE_PREFIX_CACHE=1 python benchmarks/profil
 # pinned, check 9). The watchdog knob stays off here: a scored row
 # must measure the serving loop, not a recovery drill.
 run serving_resilience 1800 env APEX_SERVE_ARRIVALS=diurnal APEX_SERVE_ADMIT=32 APEX_SERVE_SHED=1 APEX_SERVE_PREEMPT=1 python benchmarks/profile_serving.py
+# Multi-token decode A/B (ISSUE 17, PERF.md §2): K=4 decode steps per
+# dispatch in ONE lax.scan, amortizing the ~65 ms relay floor across
+# 4 tokens — vs the K=1 base `serving` row above. The slo block's
+# decode_block_k + the APEX_SERVE_DECODE_K pin carry the
+# TTFT-vs-throughput trade (check 8, both directions); spec stays off
+# on this rung (the two layers compete for the same amortization).
+run serving_multitok 1800 env APEX_SERVE_DECODE_K=4 python benchmarks/profile_serving.py
 fi
 
 echo "=== done; feed the logs into PERF.md"

@@ -282,7 +282,13 @@ def main():
                             {"APEX_SERVE_ARRIVALS": "diurnal",
                              "APEX_SERVE_ADMIT": "32",
                              "APEX_SERVE_SHED": "1",
-                             "APEX_SERVE_PREEMPT": "1"})):
+                             "APEX_SERVE_PREEMPT": "1"}),
+                           # multi-token rung (ISSUE 17): K=4 is a
+                           # DIFFERENT compiled decode program (the
+                           # K-block scan) — warmed only when armed,
+                           # with the measured rung's exact pin
+                           ("serving_multitok",
+                            {"APEX_SERVE_DECODE_K": "4"})):
             if row in cashed:
                 print(f"warm {row}: skipped (row cashed in the round "
                       f"manifest)", flush=True)

@@ -339,6 +339,9 @@ def test_check8_unpinned_serving_row_fails(tmp_path):
     assert out.returncode == 1
     assert "APEX_SERVE_WEIGHT_QUANT" in out.stdout
     assert "APEX_DECODE_ATTN_IMPL" in out.stdout
+    # multi-token decode blocks (ISSUE 17): the block size is a third
+    # compiled-program axis the citation must pin
+    assert "APEX_SERVE_DECODE_K" in out.stdout
 
 
 def test_check8_pinned_serving_row_clean(tmp_path):
@@ -346,7 +349,8 @@ def test_check8_pinned_serving_row_clean(tmp_path):
 
     out = run_check_bench_labels(*_check8_env(
         tmp_path, {"APEX_SERVE_WEIGHT_QUANT": "0",
-                   "APEX_DECODE_ATTN_IMPL": "jnp"}))
+                   "APEX_DECODE_ATTN_IMPL": "jnp",
+                   "APEX_SERVE_DECODE_K": "1"}))
     assert out.returncode == 0, out.stdout
 
 

@@ -345,7 +345,9 @@ def _good_slo():
             "max_queue_depth": 3, "kv_page_high_water": 10,
             # resilience economics (ISSUE 15): None = layer disabled
             "shed_rate": None, "preempt_rate": None,
-            "degraded_rounds": None}
+            "degraded_rounds": None,
+            # multi-token decode blocks (ISSUE 17): K=1 = single-step
+            "decode_block_k": 1}
 
 
 def test_slo_block_validation_teeth():
@@ -361,6 +363,11 @@ def test_slo_block_validation_teeth():
         ({"arrival_process": ""}, "arrival_process"),
         ({"max_queue_depth": 2.5}, "max_queue_depth"),
         ({"kv_page_high_water": -1}, "kv_page_high_water"),
+        # ISSUE 17: K is a required POSITIVE int — a K=0 engine does
+        # not exist and None is not a legal degradation here
+        ({"decode_block_k": 0}, "decode_block_k"),
+        ({"decode_block_k": None}, "decode_block_k"),
+        ({"decode_block_k": 2.5}, "decode_block_k"),
     ]
     for mut, needle in cases:
         r = ledger_mod.make_record(

@@ -208,14 +208,20 @@ def test_serving_economics_and_overlap_sections(tmp_path):
            "goodput_tok_s": 90.0, "slo_attainment": 0.75,
            "slo_ttft_ms": 1000.0, "slo_tpot_ms": 100.0,
            "arrival_process": "diurnal", "offered_load": 2.0,
-           "max_queue_depth": 3, "kv_page_high_water": 10}
+           "max_queue_depth": 3, "kv_page_high_water": 10,
+           # multi-token decode blocks (ISSUE 17)
+           "decode_block_k": 4}
     cost = costs.attach_overlap(costs.null_block(), host_ms=0.25)
     rec = ledger.make_record(
         "profile_serving", "cpu", 0.1, 2,
         extra={"serving": {"tokens_per_s": 100.0,
                            "scan_tokens_per_s": 900.0, "p50_ms": 1.0,
                            "p99_ms": 2.0, "trace_id": "tr-abcdef1234",
-                           "kv_pages": 24},
+                           "kv_pages": 24,
+                           # dispatch economics (ISSUE 17): 200 tokens
+                           # over 50 K-block dispatches = 4.00/dispatch
+                           "decode_steps": 50,
+                           "tokens_generated": 200},
                "slo": slo, "cost": cost})
     path = tmp_path / "ledger.jsonl"
     path.write_text(json.dumps(rec) + "\n")
@@ -237,6 +243,10 @@ def test_serving_economics_and_overlap_sections(tmp_path):
     # goodput 90 vs scan 900 -> 90% under the scan line
     assert "90% under the scan line" in text
     assert "max queue 3, kv high-water 10/24 pages" in text
+    # dispatch economics (ISSUE 17): tokens-per-dispatch readout names
+    # the program K it was measured at
+    assert ("dispatch economics: 4.00 tokens/dispatch "
+            "(200 tok / 50 decode dispatches, decode_block_k=4)") in text
     assert "overlap" in text and "comm+host 0.25 ms" in text
 
 

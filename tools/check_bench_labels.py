@@ -266,13 +266,19 @@ def serving_problems(rec, rid):
     ``APEX_SPEC_DECODE`` (and its pin must not be the off value 0 —
     an acceptance rate under a spec-off pin names a program the label
     did not run); same for ``prefix_hit_rate`` and
-    ``APEX_SERVE_PREFIX_CACHE``."""
+    ``APEX_SERVE_PREFIX_CACHE``. Multi-token teeth (ISSUE 17): a
+    serving row must pin ``APEX_SERVE_DECODE_K`` (the block size is a
+    different compiled program — an unpinned K cannot be audited), and
+    when the record's slo block carries ``decode_block_k`` the pin and
+    the field must agree BOTH directions (a pin naming a K the engine
+    did not run, or an engine K the label does not name, both fail)."""
     sv = rec.get("serving")
     if not isinstance(sv, dict):
         return []
     knobs = rec.get("knobs") if isinstance(rec.get("knobs"), dict) else {}
     problems = []
-    for knob in ("APEX_SERVE_WEIGHT_QUANT", "APEX_DECODE_ATTN_IMPL"):
+    for knob in ("APEX_SERVE_WEIGHT_QUANT", "APEX_DECODE_ATTN_IMPL",
+                 "APEX_SERVE_DECODE_K"):
         if knob not in knobs:
             problems.append(
                 f"record {rid} carries a serving block but does not pin "
@@ -294,6 +300,24 @@ def serving_problems(rec, rid):
                 f"record {rid} carries serving.{field}={sv[field]!r} "
                 f"but pins {knob}={pin!r} (off) — the block and the "
                 f"label name different programs")
+    slo = rec.get("slo")
+    dk = slo.get("decode_block_k") if isinstance(slo, dict) else None
+    pin = knobs.get("APEX_SERVE_DECODE_K")
+    if dk is not None and pin is not None:
+        try:
+            pinned = float(pin)
+        except (TypeError, ValueError):
+            problems.append(
+                f"record {rid} pins APEX_SERVE_DECODE_K={pin!r}, which "
+                f"is not a number")
+            pinned = None
+        if pinned is not None and isinstance(dk, (int, float)) \
+                and not isinstance(dk, bool) \
+                and abs(pinned - dk) > 1e-6:
+            problems.append(
+                f"record {rid} slo.decode_block_k={dk!r} disagrees "
+                f"with its pinned APEX_SERVE_DECODE_K={pin!r} — the "
+                f"block and the label name different decode programs")
     return problems
 
 

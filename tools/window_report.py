@@ -210,6 +210,11 @@ def ledger_summary(records):
                 "tokens_per_s": sv.get("tokens_per_s"),
                 "scan_tokens_per_s": sv.get("scan_tokens_per_s"),
                 "kv_pages": sv.get("kv_pages"),
+                # dispatch economics (ISSUE 17): decode_steps counts
+                # DISPATCHES — tokens/dispatch is the K-block
+                # amortization of the per-dispatch relay floor
+                "decode_steps": sv.get("decode_steps"),
+                "tokens_generated": sv.get("tokens_generated"),
                 # generation economics (ISSUE 13): None-when-disabled
                 "spec_acceptance_rate": sv.get("spec_acceptance_rate"),
                 "draft_len": sv.get("draft_len"),
@@ -486,6 +491,21 @@ def print_report(report, out=None):
                 if scan:
                     line += f" vs {scan:g} tok/s decode-scan upper line"
                 p(line)
+                # dispatch economics (ISSUE 17): how many tokens each
+                # ~65 ms relay dispatch bought — the K-block lever;
+                # the slo block's decode_block_k names the program K
+                # the trade was measured at
+                toks = s.get("tokens_generated")
+                steps = s.get("decode_steps")
+                dk = (s.get("slo") or {}).get("decode_block_k") \
+                    if isinstance(s.get("slo"), dict) else None
+                if toks is not None and steps:
+                    per = toks / steps
+                    p(f"      dispatch economics: {per:.2f} "
+                      f"tokens/dispatch ({toks} tok / {steps} "
+                      f"decode dispatches"
+                      + ("" if dk is None else
+                         f", decode_block_k={dk}") + ")")
                 # generation economics (ISSUE 13): the speculation and
                 # prefix-sharing levers, printed only when measured —
                 # None-when-disabled never renders a phantom rate
