@@ -27,7 +27,8 @@ SUITES = {
     "mlp": ["test_mlp_dense.py"],
     "rnn": ["test_rnn.py"],
     "parallel": ["test_parallel.py", "test_multiproc.py",
-                 "test_collectives.py", "test_overlap.py"],
+                 "test_collectives.py", "test_overlap.py",
+                 "test_zero3.py"],
     "transformer": ["test_tensor_parallel.py", "test_pipeline_parallel.py",
                     "test_transformer_models.py", "test_moe.py",
                     "test_context_parallel.py", "test_arguments.py",
@@ -43,7 +44,8 @@ SUITES = {
                 "test_serving_generation.py",
                 "test_serving_resilience.py",
                 "test_serving_chaos.py",
-                "test_serving_multitok.py"],
+                "test_serving_multitok.py",
+                "test_serving_tp.py"],
     "api_parity": ["test_api_parity_round3.py"],
     "harness": ["test_run_tests.py", "test_bench_contract.py",
                 "test_compile_cache.py", "test_resilience.py",

@@ -7,6 +7,7 @@ are XLA psum/all_gather over ICI.
 """
 
 from apex_tpu.parallel import collectives
+from apex_tpu.parallel import zero3
 from apex_tpu.parallel.distributed import (
     pvary,
     DistributedDataParallel,
@@ -26,5 +27,5 @@ __all__ = [
     "DistributedDataParallel", "Reducer", "allreduce_gradients",
     "pvary", "broadcast_params", "SyncBatchNorm", "sync_batch_norm",
     "convert_syncbn_model", "create_syncbn_process_group", "LARC", "larc",
-    "collectives",
+    "collectives", "zero3",
 ]

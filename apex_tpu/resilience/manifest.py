@@ -50,10 +50,11 @@ PASS_ROWS = (
     "resnet", "pretrain", "pretrain_bert", "pretrain_gpt345",
     "convergence", "gpt_rows", "gpt_fused_head", "gpt_ln_pallas",
     "gpt_remat_sel", "attn_seq4096", "overlap_base", "overlap_on",
+    "zero3",
     "bench", "bench_b32",
     "bench_b32_remat", "bench_profile", "serving",
     "serving_sampling", "serving_spec", "serving_prefix",
-    "serving_resilience", "serving_multitok",
+    "serving_resilience", "serving_multitok", "serving_tp",
 )
 
 

@@ -184,6 +184,7 @@ from apex_tpu.serving import quant as quant_mod
 from apex_tpu.serving import resilience as serve_res
 from apex_tpu.serving import sampling as sampling_mod
 from apex_tpu.serving import speculative as spec_mod
+from apex_tpu.serving import tp as tp_mod
 from apex_tpu.serving.kv_cache import PageAllocator, init_cache
 from apex_tpu.serving.scheduler import ContinuousBatchingScheduler, Request
 
@@ -196,7 +197,7 @@ def detokenize(tokens):
 class ServingEngine:
     def __init__(self, cfg, params=None, *, num_slots=4, page_size=16,
                  num_pages=64, max_seq=None, prefill_len=64,
-                 prefill_requests=None, weight_quant=None,
+                 prefill_requests=None, weight_quant=None, tp=None,
                  decode_impl=None, decode_block_h=None, interpret=None,
                  policy=None, sampling=None, spec_decode=None,
                  decode_k=None, prefix_cache=None, overlap=None,
@@ -228,6 +229,27 @@ class ServingEngine:
                         f"weight_quant=True cannot be honored: {name} "
                         f"has dtype {w.dtype}")
         self.weight_quant = quant_mod.resolve(weight_quant)
+        # tensor-parallel serving (ISSUE 18, `tp=` > APEX_SERVE_TP,
+        # default tp=1 — the serving_tp A/B is queued in PERF.md §2;
+        # the capability exception for the >HBM config is argued
+        # there too). The int8 decode records are single-chip tables
+        # (per-channel scales follow the UNSHARDED out dim), so the
+        # weight_quant pairing takes the established asymmetry: two
+        # per-call demands raise, a demand drops the other side's
+        # env/setter preference, env-vs-env falls back to tp=1.
+        self.tp = tp_mod.resolve_serve_tp(
+            tp, n_heads=cfg.num_attention_heads)
+        if self.tp > 1 and self.weight_quant:
+            if tp is not None and weight_quant is True:
+                raise ValueError(
+                    f"tp={self.tp} cannot be honored with "
+                    f"weight_quant=True: the int8 decode records are "
+                    f"single-chip tables (sharding them is its own "
+                    f"queued A/B) — two demands, no honorable order")
+            if tp is not None:
+                self.weight_quant = False  # demand drops the pref
+            else:
+                self.tp = 1  # APEX_SERVE_TP preference falls back
         self.qparams = smodel.quantize_decode_params(
             self.params, cfg) if self.weight_quant else None
         self.decode_impl = decode_impl
@@ -369,9 +391,21 @@ class ServingEngine:
         self._gather_w = self.spec_k + 1
 
         self._cache_dtype = smodel.compute_dtype(cfg)
-        self.cache = init_cache(
+        # tp > 1: params + paged KV cache are device_put over the tp
+        # mesh; the jitted programs below are UNTOUCHED — GSPMD
+        # partitions them from these committed input shardings
+        # (qkv/h_to_4h column-split on whole heads, attn-dense/
+        # 4h_to_h row-split, cache on its leading head axis), so the
+        # one-compile contract holds on the mesh and every host-side
+        # layer composes unchanged (serving/tp.py docstring).
+        self.mesh = tp_mod.mesh_for(self.tp) if self.tp > 1 else None
+        if self.mesh is not None:
+            self.params = jax.device_put(
+                self.params,
+                tp_mod.param_shardings(self.params, self.mesh))
+        self.cache = self._place_cache(init_cache(
             cfg.num_layers, cfg.num_attention_heads, num_pages,
-            page_size, cfg.head_dim, self._cache_dtype)
+            page_size, cfg.head_dim, self._cache_dtype))
         self.allocator = self.prefix.allocator if self.prefix \
             is not None else PageAllocator(num_pages)
         self.scheduler = ContinuousBatchingScheduler(
@@ -468,6 +502,16 @@ class ServingEngine:
         self.device_dispatch_s = 0.0
 
     # ---------------------------------------------------------- plumbing
+
+    def _place_cache(self, cache):
+        """Commit a (re)built KV cache to the tp mesh sharding — the
+        ONE placement home, so the round-recovery rebuild cannot
+        re-enter the jit caches with a drifted sharding (which would
+        break ``decode_cache_size()==1``). tp=1: identity."""
+        if self.mesh is None:
+            return cache
+        return jax.device_put(
+            cache, tp_mod.cache_shardings(cache, self.mesh))
 
     def decode_cache_size(self):
         """jit-cache entry count of the decode step — the
@@ -1272,10 +1316,10 @@ class ServingEngine:
                     self.prefix.release(slot.shared_pages)
                     slot.shared_pages = []
             self.prefix.flush()
-        self.cache = init_cache(
+        self.cache = self._place_cache(init_cache(
             self.cfg.num_layers, self.cfg.num_attention_heads,
             self.num_pages, self.page_size, self.cfg.head_dim,
-            self._cache_dtype)
+            self._cache_dtype))
         if self.events is not None:
             wall = time.perf_counter()
             for req in requeued:

@@ -13,7 +13,13 @@ byte-identical whether or not anyone asks XLA to count its flops).
 The block (:func:`build`; schema policed by :func:`validate`, wired
 into ``ledger.validate_record``)::
 
-    {"source": "compiled"|"lowered"|None,   # what XLA surface reported
+    {"source": "compiled"|"lowered"|"eval_shape"|None,
+                                            # what surface reported —
+                                            # "eval_shape" marks a pure
+                                            # shape-walk lower bound (the
+                                            # ISSUE 18 capability rung:
+                                            # nothing compiled, arg bytes
+                                            # only)
      "steps": K,                            # scan length (metadata —
                                             # XLA counts the body ONCE)
      "xla_flops_per_step":   ...,  # XLA-counted flops (real HLO work)
@@ -589,8 +595,10 @@ def validate(block):
                               or isinstance(v, bool) or v < 0):
             problems.append(f"{field} is not a non-negative number")
     src = block.get("source")
-    if src is not None and src not in ("compiled", "lowered"):
-        problems.append(f"source {src!r} not in ('compiled', 'lowered')")
+    if src is not None and src not in ("compiled", "lowered",
+                                       "eval_shape"):
+        problems.append(f"source {src!r} not in "
+                        f"('compiled', 'lowered', 'eval_shape')")
     steps = block.get("steps")
     if steps is not None and (not isinstance(steps, int)
                               or isinstance(steps, bool) or steps <= 0):

@@ -204,10 +204,51 @@ def global_grad_norm(grads):
     return jnp.sqrt(total)
 
 
+def _resolve_zero_overlap(zero_stage, overlap_grad, pp):
+    """The ONE paired resolution of the ``zero_stage`` × ``overlap_grad``
+    knobs (shared by :func:`gpt_train_step_fn` and the callers that must
+    know whether to cut params into shards — two copies of the pairing
+    could disagree about which program runs). Returns ``(zero_mode,
+    overlap_mode)``. Pairing per the engine precedent: two per-call
+    demands raise; a demand drops the other side's env/setter
+    preference; env-vs-env falls back with ZeRO-3 (the newer layer)
+    yielding. The pp > 1 bucketed-overlap demand keeps its historical
+    raise."""
+    from apex_tpu import overlap as overlap_mod
+    from apex_tpu.parallel import zero3 as zero3_mod
+
+    zero_mode = zero3_mod.resolve_zero_stage(zero_stage)
+    overlap_mode = overlap_mod.resolve_grad_overlap(overlap_grad)
+    if overlap_mode == "bucketed" and pp > 1:
+        if overlap_grad == "bucketed":
+            raise ValueError(
+                f"overlap_grad='bucketed' cannot be honored at pp={pp}: "
+                f"the pipeline schedule owns the backward (the stage "
+                f"grads complete inside the 1F1B scan) — use the env "
+                f"preference for a silent fallback, or pp=1")
+        overlap_mode = "off"  # preference semantics: fall back
+    if zero_mode == 3 and overlap_mode == "bucketed":
+        if zero_stage == 3 and overlap_grad == "bucketed":
+            raise ValueError(
+                "zero_stage=3 cannot be honored with "
+                "overlap_grad='bucketed': the bucketed backward emits "
+                "full dp-averaged grads inside each microbatch, but "
+                "ZeRO-3 reduce-scatters the terminal grads straight "
+                "into the shard (no full-grad materialization) — drop "
+                "one of the two demands")
+        if zero_stage == 3:
+            overlap_mode = "off"  # demand drops the overlap preference
+        else:
+            # overlap demand, or env-vs-env: the zero3 preference yields
+            zero_mode = 0
+    return zero_mode, overlap_mode
+
+
 def gpt_train_step_fn(cfg, pp, num_microbatches, lr=1e-4,
                       checkpoint_stages=True, with_grad_norm=False,
                       dp_axes=DATA_AXIS, compress=None, hierarchical=None,
-                      overlap_grad=None, overlap_buckets=None):
+                      overlap_grad=None, overlap_buckets=None,
+                      zero_stage=None):
     """Returns ``(step, tx, scaler)`` where ``step(params, opt_state,
     scaler_state, batch) -> (params, opt_state, scaler_state, loss)`` — to
     be called INSIDE shard_map over the (pp, dp, tp) mesh; ``tx``/``scaler``
@@ -226,6 +267,23 @@ def gpt_train_step_fn(cfg, pp, num_microbatches, lr=1e-4,
     grad sync here is stateless (no error-feedback residual is
     threaded — the step signature stays fixed); EF-carried compression
     lives in the ZeRO optimizers, whose state holds the residual.
+
+    ``zero_stage`` (ISSUE 18, knob home
+    :func:`apex_tpu.parallel.zero3.resolve_zero_stage`): per-call 3 is
+    a demand for gather-on-use parameter sharding — ``params`` must
+    then be the :class:`~apex_tpu.parallel.zero3.Zero3Params` resident
+    shards (cut by ``zero3.shard_params`` after init), the step
+    all-gathers full weights per layer/bucket at their first use,
+    reduce-scatters the grads straight into the shard and runs the
+    ZeRO-2 flat-Adam update on the shard — no terminal update gather
+    (the master shard IS the parameter). ``compress``/``hierarchical``
+    ride both ZeRO-3 hops exactly as they ride the dp allreduce; the
+    quantized gather is error-feedback-free by construction (params
+    re-gathered fresh from fp32 master each step — ``zero3`` module
+    docstring). None consults the ``APEX_ZERO_STAGE`` preference;
+    default OFF (the measured-dispatch rule — A/B queued in PERF.md
+    §2). Pairing with ``overlap_grad='bucketed'`` per
+    :func:`_resolve_zero_overlap`.
 
     ``overlap_grad``/``overlap_buckets`` (ISSUE 14, knob home
     :mod:`apex_tpu.overlap`): per-call ``"bucketed"`` restructures the
@@ -246,24 +304,19 @@ def gpt_train_step_fn(cfg, pp, num_microbatches, lr=1e-4,
     """
     from apex_tpu import overlap as overlap_mod
     from apex_tpu.overlap.bucketed import tag_tree
+    from apex_tpu.parallel import zero3 as zero3_mod
     from apex_tpu.parallel.distributed import allreduce_gradients
 
     fns, _ = make_gpt_fns(cfg, pp)
     stage_fn, embed_fn, loss_fn = fns
     scaler = LossScaler()  # dynamic, 2^16
-    tx = fused_adam(learning_rate=lr)
     fwd_bwd = (forward_backward_pipelining_without_interleaving if pp > 1
                else forward_backward_no_pipelining)
 
-    overlap_mode = overlap_mod.resolve_grad_overlap(overlap_grad)
-    if overlap_mode == "bucketed" and pp > 1:
-        if overlap_grad == "bucketed":
-            raise ValueError(
-                f"overlap_grad='bucketed' cannot be honored at pp={pp}: "
-                f"the pipeline schedule owns the backward (the stage "
-                f"grads complete inside the 1F1B scan) — use the env "
-                f"preference for a silent fallback, or pp=1")
-        overlap_mode = "off"  # preference semantics: fall back
+    zero_mode, overlap_mode = _resolve_zero_overlap(zero_stage,
+                                                    overlap_grad, pp)
+    tx = (zero3_mod.zero3_adam(learning_rate=lr) if zero_mode == 3
+          else fused_adam(learning_rate=lr))
     if overlap_buckets is not None:
         overlap_mod.resolve_buckets(overlap_buckets)  # demand check
 
@@ -298,8 +351,56 @@ def gpt_train_step_fn(cfg, pp, num_microbatches, lr=1e-4,
             composed, batch, params)
         return jnp.mean(losses), grads
 
+    def zero3_grad_norm(g_shards, grads_full):
+        """`global_grad_norm` semantics off the flat SHARDS: per-bucket
+        per-tensor sq-norms psum'd over dp re-assemble each tensor's
+        full sq-norm; the tp/pp weighting then mirrors the per-leaf
+        walk (tp-sharded tensors psum over tp, stage buckets psum over
+        pp), with the tp flags read structurally off the full-grads
+        tree paths (`_is_tp_sharded`)."""
+        gs, ge, gh = grads_full
+        spec = g_shards.spec
+        sqs = zero3_mod.shard_sq_norms(g_shards, dp_axes)
+        total = jnp.float32(0.0)
+        stage_total = jnp.float32(0.0)
+        for key, kind, sq in zip(spec.keys, spec.kinds, sqs):
+            sub = (gs[key[len("stage:"):]] if kind == "stage"
+                   else ge if kind == "embed" else gh)
+            flat, _ = jax.tree_util.tree_flatten_with_path(sub)
+            flags = jnp.asarray(
+                [1.0 if _is_tp_sharded(p) else 0.0 for p, _ in flat],
+                jnp.float32)
+            sq_dp = lax.psum(sq, dp_axes)
+            combined = (flags * lax.psum(sq_dp, TENSOR_AXIS)
+                        + (1.0 - flags) * sq_dp)
+            if kind == "stage":
+                stage_total = stage_total + jnp.sum(combined)
+            else:
+                total = total + jnp.sum(combined)
+        return jnp.sqrt(total + lax.psum(stage_total, PIPELINE_AXIS))
+
     def step(params, opt_state, scaler_state, batch):
-        if overlap_mode == "bucketed":
+        grads_full = None
+        if zero_mode == 3:
+            # gather-on-use: each bucket's full weights re-assemble
+            # from the resident fp32 shards at their first consumer
+            # (XLA dataflow placement), grads reduce-scatter straight
+            # back into shard form — no full flat grad, no update
+            # gather (zero3 module docstring)
+            full_params = zero3_mod.gather_params(
+                params, dp_axes, compress=compress,
+                hierarchical=hierarchical)
+            loss, grads_full = fwd_bwd(
+                scaled_loss_fns(scaler.scale(jnp.float32(1.0),
+                                             scaler_state)),
+                batch, full_params, num_microbatches=num_microbatches,
+                checkpoint_stages=checkpoint_stages)
+            grads = zero3_mod.grad_shards(
+                grads_full, params.spec, dp_axes, compress=compress,
+                hierarchical=hierarchical)
+            dp_size = _collectives_axes_size(dp_axes)
+            grads = jax.tree_util.tree_map(lambda g: g / dp_size, grads)
+        elif overlap_mode == "bucketed":
             loss, grads = bucketed_fwd_bwd(params, scaler_state, batch)
         else:
             loss, grads = fwd_bwd(
@@ -317,6 +418,10 @@ def gpt_train_step_fn(cfg, pp, num_microbatches, lr=1e-4,
         # transformer.amp.GradScaler (grad_scaler.py:38-49)
         grads, found_inf = scaler.unscale(grads, scaler_state)
         found_inf = lax.pmax(lax.pmax(found_inf, PIPELINE_AXIS), TENSOR_AXIS)
+        if zero_mode == 3:
+            # shard-local infs are NOT dp-replicated (the unsharded
+            # path's post-pmean grads are) — sync the skip decision
+            found_inf = lax.pmax(found_inf, dp_axes)
         new_scaler_state = scaler.update(scaler_state, found_inf)
         updates, new_opt_state = tx.update(grads, opt_state, params)
         # skip-step on overflow (select, not branch: SPMD-uniform)
@@ -328,12 +433,19 @@ def gpt_train_step_fn(cfg, pp, num_microbatches, lr=1e-4,
             new_opt_state, opt_state)
         loss = loss / scaler.scale(jnp.float32(1.0), scaler_state)
         if with_grad_norm:
-            gnorm = global_grad_norm(grads)
+            gnorm = (zero3_grad_norm(grads, grads_full)
+                     if zero_mode == 3 else global_grad_norm(grads))
             return (new_params, new_opt_state, new_scaler_state, loss,
                     gnorm)
         return new_params, new_opt_state, new_scaler_state, loss
 
     return step, tx, scaler
+
+
+def _collectives_axes_size(dp_axes):
+    from apex_tpu.parallel import collectives
+
+    return collectives.axes_size(dp_axes)
 
 
 def dp_axes_of(dp):
@@ -500,7 +612,7 @@ def reference_training(cfg, pp, batch, num_steps, lr=1e-4, device=None):
 def _traced_training_jaxpr(devices, cfg, topology, num_microbatches=4,
                            micro_batch_size=2, seq_len=16, compress=None,
                            hierarchical=None, overlap_grad=None,
-                           overlap_buckets=None):
+                           overlap_buckets=None, zero_stage=None):
     """``(jaxpr, axis_sizes)`` of ONE (pp, dp, tp) training step (init
     + 1 full step) — pure host tracing, nothing compiled or executed.
     The shared front end of :func:`training_comm_bytes` and
@@ -513,17 +625,22 @@ def _traced_training_jaxpr(devices, cfg, topology, num_microbatches=4,
                 (PIPELINE_AXIS, *dp_names, TENSOR_AXIS))
     dp_axes = dp_axis_arg(dp_names)
     _, init_params = make_gpt_fns(cfg, pp)
+    zero_mode, _ = _resolve_zero_overlap(zero_stage, overlap_grad, pp)
     step, tx, scaler = gpt_train_step_fn(
         cfg, pp, num_microbatches, dp_axes=dp_axes, compress=compress,
         hierarchical=hierarchical, overlap_grad=overlap_grad,
-        overlap_buckets=overlap_buckets)
+        overlap_buckets=overlap_buckets, zero_stage=zero_stage)
     global_mb = micro_batch_size * dp_size
     batch = toy_batch(cfg.vocab_size, num_microbatches, global_mb,
                       seq_len)
 
     def one(batch):
+        from apex_tpu.parallel import zero3 as zero3_mod
+
         params = init_params(jax.random.PRNGKey(0),
                              {k: v[0] for k, v in batch.items()})
+        if zero_mode == 3:
+            params = zero3_mod.shard_params(params, dp_axes)
         opt_state = tx.init(params)
         scaler_state = scaler.init()
         out = step(params, opt_state, scaler_state, batch)
@@ -542,7 +659,7 @@ def _traced_training_jaxpr(devices, cfg, topology, num_microbatches=4,
 def training_comm_bytes(devices, cfg, topology, num_microbatches=4,
                         micro_batch_size=2, seq_len=16, compress=None,
                         hierarchical=None, overlap_grad=None,
-                        overlap_buckets=None):
+                        overlap_buckets=None, zero_stage=None):
     """Per-mesh-axis collective payload bytes of ONE (pp, dp, tp)
     training step — init + 1 full step traced to a jaxpr and counted by
     ``apex_tpu.telemetry.costs.comm_from_jaxpr`` (psum/all_gather/
@@ -563,7 +680,8 @@ def training_comm_bytes(devices, cfg, topology, num_microbatches=4,
         devices, cfg, topology, num_microbatches=num_microbatches,
         micro_batch_size=micro_batch_size, seq_len=seq_len,
         compress=compress, hierarchical=hierarchical,
-        overlap_grad=overlap_grad, overlap_buckets=overlap_buckets)
+        overlap_grad=overlap_grad, overlap_buckets=overlap_buckets,
+        zero_stage=zero_stage)
     from apex_tpu.telemetry import costs
 
     # size-1 axes move nothing on the wire (costs.wire_bytes — the
@@ -575,7 +693,7 @@ def training_collective_schedule(devices, cfg, topology,
                                  num_microbatches=4, micro_batch_size=2,
                                  seq_len=16, compress=None,
                                  hierarchical=None, overlap_grad=None,
-                                 overlap_buckets=None):
+                                 overlap_buckets=None, zero_stage=None):
     """``costs.collective_schedule`` verdict of the SAME traced
     training step :func:`training_comm_bytes` counts, judged on the
     DP AXES ONLY (``collective_schedule(axes=...)`` — the forward tp
@@ -591,7 +709,8 @@ def training_collective_schedule(devices, cfg, topology,
         devices, cfg, topology, num_microbatches=num_microbatches,
         micro_batch_size=micro_batch_size, seq_len=seq_len,
         compress=compress, hierarchical=hierarchical,
-        overlap_grad=overlap_grad, overlap_buckets=overlap_buckets)
+        overlap_grad=overlap_grad, overlap_buckets=overlap_buckets,
+        zero_stage=zero_stage)
     from apex_tpu.telemetry import costs
 
     return costs.collective_schedule(jaxpr, axes=dp_names)
@@ -601,7 +720,7 @@ def training_overlap_profile(devices, cfg, topology, num_microbatches=4,
                              micro_batch_size=2, seq_len=16,
                              compress=None, hierarchical=None,
                              overlap_grad=None, overlap_buckets=None,
-                             include_floor=True):
+                             include_floor=True, zero_stage=None):
     """The MULTICHIP tail's per-topology overlap account (ISSUE 14):
     the dp-axes collective-schedule verdict plus an ENVELOPE
     ``costs.overlap_bound`` of the traced (init + 1 step) program —
@@ -623,7 +742,8 @@ def training_overlap_profile(devices, cfg, topology, num_microbatches=4,
         devices, cfg, topology, num_microbatches=num_microbatches,
         micro_batch_size=micro_batch_size, seq_len=seq_len,
         compress=compress, hierarchical=hierarchical,
-        overlap_grad=overlap_grad, overlap_buckets=overlap_buckets)
+        overlap_grad=overlap_grad, overlap_buckets=overlap_buckets,
+        zero_stage=zero_stage)
     from apex_tpu.telemetry import costs
 
     comm = costs.wire_bytes(costs.comm_from_jaxpr(jaxpr), sizes)
@@ -649,7 +769,8 @@ def training_overlap_profile(devices, cfg, topology, num_microbatches=4,
 def run_minimal_gpt_training(n_devices=None, cfg=None, num_microbatches=4,
                              micro_batch_size=2, seq_len=16, num_steps=1,
                              devices=None, topology=None,
-                             return_grad_norms=False):
+                             return_grad_norms=False, zero_stage=None,
+                             compress=None, hierarchical=None):
     """Build an (pp, dp, tp) mesh over ``n_devices`` and run ``num_steps``
     full GPT training steps. Returns the per-step losses (floats).
 
@@ -663,6 +784,15 @@ def run_minimal_gpt_training(n_devices=None, cfg=None, num_microbatches=4,
     This is the dryrun/CI entry: init + steps execute in shard_map with
     real tp/pp/dp shardings; on CPU it runs under
     ``--xla_force_host_platform_device_count``.
+
+    ``zero_stage=3`` (ISSUE 18) cuts the freshly initialized params
+    into :class:`~apex_tpu.parallel.zero3.Zero3Params` resident shards
+    over the dp axes before the first step — every dp rank initializes
+    the same full tree, so the slice needs no broadcast — and the step
+    runs the gather-on-use program; ``compress``/``hierarchical`` ride
+    the ZeRO-3 gather/scatter hops (or the dp allreduce when
+    unsharded). Both default to the env preferences; all OFF by
+    default.
     """
     if devices is None:
         devices = jax.devices()[:n_devices] if n_devices else jax.devices()
@@ -684,16 +814,24 @@ def run_minimal_gpt_training(n_devices=None, cfg=None, num_microbatches=4,
     dp_axes = dp_axis_arg(dp_names)
 
     _, init_params = make_gpt_fns(cfg, pp)
+    zero_mode, _ = _resolve_zero_overlap(zero_stage, None, pp)
     step, tx, scaler = gpt_train_step_fn(cfg, pp, num_microbatches,
                                          with_grad_norm=return_grad_norms,
-                                         dp_axes=dp_axes)
+                                         dp_axes=dp_axes,
+                                         zero_stage=zero_stage,
+                                         compress=compress,
+                                         hierarchical=hierarchical)
 
     global_mb = micro_batch_size * dp_size
     batch = toy_batch(cfg.vocab_size, num_microbatches, global_mb, seq_len)
 
     def whole_run(batch):
+        from apex_tpu.parallel import zero3 as zero3_mod
+
         params = init_params(jax.random.PRNGKey(0),
                              {k: v[0] for k, v in batch.items()})
+        if zero_mode == 3:
+            params = zero3_mod.shard_params(params, dp_axes)
         opt_state = tx.init(params)
         scaler_state = scaler.init()
         losses, gnorms = [], []

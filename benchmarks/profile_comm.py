@@ -114,6 +114,19 @@ from apex_tpu import overlap as overlap_mod  # noqa: E402
 
 GRAD_OVERLAP = overlap_mod.pin_grad_overlap_env()
 
+# ...and the ZeRO stage (ISSUE 18, check 11): resolved through the ONE
+# paired resolution (zero_stage × overlap_grad — the overlap env was
+# just pinned above, so this reads exactly what the step will) and
+# pinned back, so a `zero3` rung's record names the gather-on-use
+# program it measured and an exported APEX_ZERO_STAGE=3 can never
+# reshape a row labeled unsharded
+from apex_tpu.transformer.testing.minimal import (  # noqa: E402
+    _resolve_zero_overlap,
+)
+
+ZERO_STAGE, _ = _resolve_zero_overlap(None, None, 1)
+os.environ["APEX_ZERO_STAGE"] = str(ZERO_STAGE)
+
 _, init_params = make_gpt_fns(cfg, 1)
 step, tx, scaler = gpt_train_step_fn(cfg, 1, M, dp_axes=dp_axes)
 
@@ -125,13 +138,53 @@ ids, labels = batch["ids"], batch["labels"]
 def _init_all(ids, labels):
     params = init_params(jax.random.PRNGKey(0),
                          {"ids": ids[0], "labels": labels[0]})
+    if ZERO_STAGE == 3:
+        # dp-shard BEFORE tx.init: the optimizer state is shard-resident
+        # (zero3_adam) — the full tree never coexists with its moments
+        from apex_tpu.parallel import zero3 as zero3_mod
+
+        params = zero3_mod.shard_params(params, dp_axes)
     return params, tx.init(params), scaler.init()
 
 
+# state placement specs: replicated by default; under zero3 every
+# non-scalar params/opt leaf is a per-rank flat shard that must cross
+# the shard_map boundary dp-SHARDED on its leading axis (P() would
+# silently collapse eight different shards onto device 0's) — the
+# structure comes from eval_shape, nothing materialized, and the
+# P(dp_axes) round trip preserves the `collectives.axes_index`
+# row-major shard order
+P_PARAMS = P_OPT = P()
+if ZERO_STAGE == 3:
+    _struct = jax.eval_shape(jax.shard_map(
+        _init_all, mesh=mesh, in_specs=(spec, spec),
+        out_specs=(P(), P(), P()), check_vma=False), ids, labels)
+
+    def _dp_sharded(tree):
+        return jax.tree_util.tree_map(
+            lambda s: P(dp_axes) if getattr(s, "ndim", 0) else P(), tree)
+
+    P_PARAMS, P_OPT = _dp_sharded(_struct[0]), _dp_sharded(_struct[1])
+
 params, opt_state, scaler_state = jax.jit(jax.shard_map(
     _init_all, mesh=mesh, in_specs=(spec, spec),
-    out_specs=(P(), P(), P()), check_vma=False))(ids, labels)
-n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    out_specs=(P_PARAMS, P_OPT, P()), check_vma=False))(ids, labels)
+# model size from the UNSHARDED tree shapes (eval_shape inside the
+# mesh context, nothing materialized): under zero3 the live `params`
+# leaves are 1/dp flat shards, and a shard count would deflate the
+# flops claim dp-fold
+
+
+def _param_shapes(ids, labels):
+    return init_params(jax.random.PRNGKey(0),
+                       {"ids": ids[0], "labels": labels[0]})
+
+
+n_params = sum(
+    int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(
+        jax.eval_shape(jax.shard_map(
+            _param_shapes, mesh=mesh, in_specs=(spec, spec),
+            out_specs=P(), check_vma=False), ids, labels)))
 
 # bucket count resolved AT THE PAYLOAD and pinned (or popped) via
 # the one-home helper — the same discipline as profile_overlap, one
@@ -171,7 +224,7 @@ def _comm_bytes():
         return step(p, o, ss, {"ids": ids, "labels": labels})[3]
 
     wrapped = jax.shard_map(one_step, mesh=mesh,
-                            in_specs=(P(), P(), P(), spec, spec),
+                            in_specs=(P_PARAMS, P_OPT, P(), spec, spec),
                             out_specs=P(), check_vma=False)
     raw = costs.comm_from_jaxpr(jax.make_jaxpr(wrapped)(
         params, opt_state, scaler_state, ids, labels))
@@ -216,14 +269,16 @@ span = TRACER.scan_time(
     "dp grad sync step", make_step_body,
     (params, opt_state, scaler_state), (ids, labels),
     wrap=lambda run: jax.shard_map(
-        run, mesh=mesh, in_specs=(P(), P(), spec, spec),
-        out_specs=(P(), P()), check_vma=False),
+        run, mesh=mesh,
+        in_specs=((P_PARAMS, P_OPT, P()), P(), spec, spec),
+        out_specs=((P_PARAMS, P_OPT, P()), P()), check_vma=False),
     flops_per_iter=model_flops_fb,
     capture_cost=costs.enabled(default=not SMOKE),
     comm=comm, comm_compression=comm_compression,
     extra={"n_params": n_params, "dp": str(dp_decl),
            "scheme": snap["scheme"],
-           "hierarchical": snap["hierarchical"]})
+           "hierarchical": snap["hierarchical"],
+           "zero_stage": ZERO_STAGE})
 print(span.format_row(PEAK))
 if span.seconds:
     toks = M * global_mb * S
@@ -234,4 +289,8 @@ TRACER.flush_ledger("profile_comm",
                            # the overlap claim block (check 10): the
                            # grad schedule this row's step ran under
                            "overlap": {"grad": GRAD_OVERLAP,
-                                       "buckets": OVERLAP_BUCKETS}})
+                                       "buckets": OVERLAP_BUCKETS},
+                           # the parallel claim block (check 11): the
+                           # sharding program this row's step ran under
+                           # — pinned above, both directions checked
+                           "parallel": {"zero_stage": ZERO_STAGE}})

@@ -175,6 +175,20 @@ PREEMPT = serve_res.resolve_preempt()
 os.environ["APEX_SERVE_PREEMPT"] = "1" if PREEMPT else "0"
 RECOVER = serve_res.resolve_recover()
 os.environ["APEX_SERVE_RECOVER"] = "1" if RECOVER else "0"
+# ...and the TP width (ISSUE 18, check 11): the Megatron column/row
+# NamedShardings re-partition the SAME two serving programs over a
+# (tp,) mesh, so the resolved width is pinned back (the engine
+# re-resolves from this pin) and claimed in the `parallel` block for
+# both-direction agreement. Resolution mirrors the engine's pairing:
+# weight_quant engaged -> the tp preference falls back to 1 (the int8
+# decode records are single-chip tables; the serving_tp rung sets
+# APEX_SERVE_TP with quant off).
+from apex_tpu.serving import tp as tp_mod  # noqa: E402
+
+SERVE_TP = tp_mod.resolve_serve_tp(n_heads=cfg.num_attention_heads)
+if WQ and SERVE_TP > 1:
+    SERVE_TP = 1
+os.environ["APEX_SERVE_TP"] = str(SERVE_TP)
 # ...and the multi-token decode block size (ISSUE 17, check 8): K
 # decode steps per dispatch amortize the ~65 ms relay floor — a
 # DIFFERENT compiled decode program, so the resolved K is pinned and
@@ -419,6 +433,10 @@ rid = TRACER.flush_ledger("profile_serving", extra={
     # replay's host slice was measured under — check 10 pin-matches
     # it against the record's knobs
     "overlap": {"serve": "1" if SERVE_OVERLAP else "0"},
+    # the parallel claim block (ISSUE 18): which mesh width the replay's
+    # programs were partitioned over — check 11 pin-matches it against
+    # the record's APEX_SERVE_TP pin, both directions
+    "parallel": {"tp": SERVE_TP},
     "config": {"slots": SLOTS, "page_size": PS, "pages": PAGES,
                "max_seq": MAX_SEQ, "prefill_len": PRE_LEN,
                "params_m": round(n_params / 1e6, 1),

@@ -181,6 +181,15 @@ run attn_seq4096      900 env APEX_ATTN_SEQ=4096 python benchmarks/profile_atten
 # overlap win needs the pod-slice window; the row says so).
 run overlap_base      900 python benchmarks/profile_overlap.py
 run overlap_on        900 env APEX_OVERLAP_GRAD=bucketed APEX_PREFETCH=2 APEX_SERVE_OVERLAP=1 python benchmarks/profile_overlap.py
+# ZeRO-3 gather-on-use A/B (ISSUE 18, PERF.md §2): the dp step with
+# params resident as fp32 shards, full weights all-gathered per
+# layer-bucket at the point of use and grads reduce-scattered straight
+# back — vs the unsharded profile_comm baseline. APEX_ZERO_STAGE is
+# pinned and claimed (check 11, both directions). Single-chip honest
+# label: dp=1 bounds only the gather/scatter dispatch overhead — the
+# memory claim is the eval_shape capability block (no device needed)
+# and the bandwidth claim needs the pod-slice window.
+run zero3             900 env APEX_ZERO_STAGE=3 python benchmarks/profile_comm.py
 # full-ladder bench retry: if bench_first already landed healthy this is
 # one cached-compile re-measurement plus the b=16 upside attempt.
 # The END-of-queue bench rows run with the DURABILITY layer armed
@@ -260,6 +269,15 @@ run serving_resilience 1800 env APEX_SERVE_ARRIVALS=diurnal APEX_SERVE_ADMIT=32 
 # TTFT-vs-throughput trade (check 8, both directions); spec stays off
 # on this rung (the two layers compete for the same amortization).
 run serving_multitok 1800 env APEX_SERVE_DECODE_K=4 python benchmarks/profile_serving.py
+# TP-sharded serving A/B (ISSUE 18, PERF.md §2): the same trace
+# replayed with the two serving programs GSPMD-partitioned over a
+# (tp,) mesh — Megatron column/row NamedShardings on the params, the
+# paged KV cache sharded on its head axis. APEX_SERVE_TP is pinned
+# and claimed (check 11). On one chip the tp=2 preference FALLS BACK
+# to 1 (whole-heads-per-chip demand; preference semantics) and the
+# record honestly pins tp=1 — the tp>1 leg needs the pod-slice
+# window, which is why the default stays tp=1 (measured-dispatch).
+run serving_tp       1800 env APEX_SERVE_TP=2 python benchmarks/profile_serving.py
 fi
 
 echo "=== done; feed the logs into PERF.md"

@@ -250,6 +250,18 @@ def main():
             continue
         warm_target(row, [sys.executable, overlap_py], extra, timeout)
 
+    # zero3 rung (ISSUE 18): the gather-on-use dp step is a DIFFERENT
+    # compiled program (per-bucket all-gathers in the forward,
+    # reduce-scatters in the backward, shard-resident adam) — warmed
+    # under the exact pin its run_all_tpu.sh row measures with
+    comm_py = os.path.join(REPO, "benchmarks", "profile_comm.py")
+    if "zero3" in cashed:
+        print("warm zero3: skipped (row cashed in the round manifest)",
+              flush=True)
+    else:
+        warm_target("zero3", [sys.executable, comm_py],
+                    {"APEX_ZERO_STAGE": "3"}, timeout)
+
     # serving program set (benchmarks/profile_serving.py) — ONLY when
     # its collection rung is armed (APEX_SERVE_BENCH=1 gates the
     # dead-last run_all_tpu.sh row): an unarmed round must not spend
@@ -288,7 +300,14 @@ def main():
                            # K-block scan) — warmed only when armed,
                            # with the measured rung's exact pin
                            ("serving_multitok",
-                            {"APEX_SERVE_DECODE_K": "4"})):
+                            {"APEX_SERVE_DECODE_K": "4"}),
+                           # tp rung (ISSUE 18): on one chip the tp=2
+                           # preference falls back to 1, so the warmed
+                           # programs are the base row's — the rung
+                           # rides the list so its cashed/owed account
+                           # matches the shell; on a pod slice the
+                           # same pin warms the GSPMD-partitioned pair
+                           ("serving_tp", {"APEX_SERVE_TP": "2"})):
             if row in cashed:
                 print(f"warm {row}: skipped (row cashed in the round "
                       f"manifest)", flush=True)

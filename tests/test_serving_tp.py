@@ -1,0 +1,236 @@
+"""TP-sharded serving (ISSUE 18, apex_tpu.serving.tp):
+
+The SAME two jitted serving programs run over a `(tp,)` GSPMD mesh —
+params device_put with Megatron column/row NamedShardings (whole heads
+per chip), the paged KV cache sharded on its leading head axis — and
+must be TOKEN-FOR-TOKEN identical to the single-device engine across
+tp ∈ {1, 2, 4} on the 8-device CPU mesh, under every host-side layer
+(stochastic sampling lanes, prefix-cache sharing/COW, KV-pressure
+preemption + replay). The one-compile contract
+(``decode_cache_size()==1`` / ``prefill_cache_size()<=1``) holds on
+the mesh with all generation layers engaged. Knob semantics per the
+CLAUDE.md asymmetry: per-call ``tp=`` demands raise on un-honorable
+widths, the APEX_SERVE_TP preference falls back, and the
+``weight_quant`` pairing follows the spec-decode precedent.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from apex_tpu.serving import (
+    Request,
+    SamplingParams,
+    ServingEngine,
+)
+from apex_tpu.serving import tp as tp_mod
+
+
+def _cfg():
+    from apex_tpu.transformer.testing import TransformerConfig
+
+    return TransformerConfig(
+        hidden_size=64, num_layers=2, num_attention_heads=4,
+        vocab_size=128, max_position_embeddings=64,
+        hidden_dropout=0.0, attention_dropout=0.0,
+        apply_query_key_layer_scaling=False, bf16=False)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = _cfg()
+    from apex_tpu.serving import model as smodel
+
+    return cfg, smodel.init_gpt_params(cfg)
+
+
+def _engine(cfg, params, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("num_pages", 48)
+    kw.setdefault("max_seq", 64)
+    kw.setdefault("prefill_len", 40)
+    return ServingEngine(cfg, params=params, **kw)
+
+
+def _requests(**kw):
+    rs = np.random.RandomState(3)
+    return [Request(rid=i, prompt=[int(t) for t in rs.randint(0, 128, 5 + i)],
+                    max_new_tokens=8, **kw) for i in range(3)]
+
+
+def _drive(eng, reqs):
+    for r in reqs:
+        eng.submit(r)
+    while any(not r.done() for r in reqs):
+        eng.step()
+    eng.step()  # final evict round
+    return {r.rid: list(r.out_tokens) for r in reqs}
+
+
+def _assert_contract(eng):
+    assert eng.decode_cache_size() == 1, eng.decode_cache_size()
+    assert eng.prefill_cache_size() <= 1, eng.prefill_cache_size()
+    eng.allocator.check_invariants()
+
+
+# ------------------------------------------------ token-for-token parity
+
+@pytest.mark.parametrize("tp", [2, 4])
+def test_tp_greedy_parity(setup, tp):
+    """Greedy prefill + decode at tp must equal the tp=1 engine
+    token-for-token — GSPMD re-partitions the same programs; the
+    numerics (fp32-accumulated matmuls, psum'd row-parallel outputs)
+    must not drift past argmax boundaries."""
+    cfg, params = setup
+    ref = _drive(_engine(cfg, params), _requests())
+    eng = _engine(cfg, params, tp=tp)
+    assert eng.tp == tp and eng.mesh is not None
+    got = _drive(eng, _requests())
+    assert got == ref, (tp, got, ref)
+    _assert_contract(eng)
+
+
+def test_tp_sampling_parity(setup):
+    """Stochastic lanes ride as replicated VALUE arrays (threefry
+    keys, temps, top-k/p) — per-request determinism must survive the
+    mesh: same seeds, same tokens at tp=2 as at tp=1."""
+    cfg, params = setup
+    sp = SamplingParams(temperature=0.8, top_k=16, seed=11)
+    ref = _drive(_engine(cfg, params, sampling=True),
+                 _requests(sampling=sp))
+    eng = _engine(cfg, params, sampling=True, tp=2)
+    got = _drive(eng, _requests(sampling=sp))
+    assert got == ref, (got, ref)
+    _assert_contract(eng)
+
+
+def test_tp_prefix_cache_parity(setup):
+    """Prefix sharing is host-side page accounting; the shared pages
+    live SHARDED on the mesh and the hit path re-references them for
+    a later stream — token parity and a real hit on both engines."""
+    cfg, params = setup
+    rs = np.random.RandomState(5)
+    shared = [int(t) for t in rs.randint(0, 128, 20)]  # 2.5 pages @ 8
+    reqs = lambda: [Request(rid=i, prompt=list(shared) + [20 + i],
+                            max_new_tokens=8) for i in range(2)]
+
+    def seq_drive(eng):
+        # sequential streams so the second's lookup HITS the pages the
+        # first registered (one prefill batch would mask the hit path)
+        out = {}
+        for r in reqs():
+            out.update(_drive(eng, [r]))
+        return out
+
+    ref_eng = _engine(cfg, params, prefix_cache=True)
+    ref = seq_drive(ref_eng)
+    eng = _engine(cfg, params, prefix_cache=True, tp=2)
+    got = seq_drive(eng)
+    assert got == ref, (got, ref)
+    assert eng.prefix.hit_tokens > 0 and ref_eng.prefix.hit_tokens > 0
+    _assert_contract(eng)
+
+
+def test_tp_preemption_replay_parity(setup):
+    """KV-pressure preemption on the mesh: a pool too small for both
+    streams' peaks (chaos-suite sizing — 16 positions over 4-token
+    pages, 5 allocatable) forces a mid-stream preempt; the replay
+    dispatches the same packed prefill program (sharded cache rebuilt
+    page-for-page) — token parity with the uncontended engine."""
+    cfg, params = setup
+    reqs = lambda: [Request(rid=i, prompt=[1 + i, 2, 3, 4, 5, 6],
+                            max_new_tokens=10) for i in range(2)]
+    ref = _drive(_engine(cfg, params, page_size=4, num_pages=32,
+                         max_seq=16), reqs())
+    eng = _engine(cfg, params, page_size=4, num_pages=6, max_seq=16,
+                  preempt=True, tp=2)
+    got = _drive(eng, reqs())
+    assert got == ref, (got, ref)
+    assert eng.resilience.preempted >= 1, eng.resilience
+    _assert_contract(eng)
+
+
+def test_tp_one_compile_with_all_layers(setup):
+    """The jaxpr-stability contract held on the mesh with sampling +
+    speculative decode + prefix cache all enabled: exactly ONE decode
+    program and ONE (shared admission/verify) prefill program."""
+    cfg, params = setup
+    reqs = [Request(rid=i, prompt=[9, 9, 4, 2, 9, 9, 4][:(4 + i)],
+                    max_new_tokens=8,
+                    sampling=SamplingParams(temperature=0.0, seed=i))
+            for i in range(3)]
+    eng = _engine(cfg, params, sampling=True, spec_decode=3,
+                  prefix_cache=True, tp=2)
+    _drive(eng, reqs)
+    assert eng.spec_k == 3
+    _assert_contract(eng)
+    assert eng.mesh is not None
+
+
+# ------------------------------------------------------- knob semantics
+
+def test_resolve_serve_tp_demands_raise():
+    for bad in (True, 0, -1, 2.0, "2"):
+        with pytest.raises(ValueError, match="tp"):
+            tp_mod.resolve_serve_tp(bad, n_heads=4)
+    # whole-heads split: 4 heads cannot honor tp=3
+    with pytest.raises(ValueError, match="whole heads"):
+        tp_mod.resolve_serve_tp(3, n_heads=4)
+    # more chips than visible
+    with pytest.raises(ValueError, match="visible"):
+        tp_mod.resolve_serve_tp(2, n_heads=4, n_devices=1)
+    assert tp_mod.resolve_serve_tp(2, n_heads=4, n_devices=8) == 2
+
+
+def test_serve_tp_env_preference(monkeypatch):
+    monkeypatch.delenv("APEX_SERVE_TP", raising=False)
+    assert tp_mod.resolve_serve_tp(n_heads=4) == 1
+    monkeypatch.setenv("APEX_SERVE_TP", "2")
+    assert tp_mod.resolve_serve_tp(n_heads=4) == 2
+    # un-honorable env widths fall back to 1 (preference semantics)
+    monkeypatch.setenv("APEX_SERVE_TP", "3")
+    assert tp_mod.resolve_serve_tp(n_heads=4) == 1
+    monkeypatch.setenv("APEX_SERVE_TP", "2")
+    assert tp_mod.resolve_serve_tp(n_heads=4, n_devices=1) == 1
+    # garbage rides the one-home env_int warn-once parser
+    monkeypatch.setenv("APEX_SERVE_TP", "two")
+    assert tp_mod.resolve_serve_tp(n_heads=4) == 1
+    # per-call demand wins over the env preference
+    monkeypatch.setenv("APEX_SERVE_TP", "4")
+    assert tp_mod.resolve_serve_tp(2, n_heads=4) == 2
+
+
+def test_tp_weight_quant_pairing(setup, monkeypatch):
+    """The established asymmetry (the int8 decode records are
+    single-chip tables): two per-call demands raise, a demand drops
+    the other side's env preference, env-vs-env falls back to tp=1."""
+    cfg, params = setup
+    monkeypatch.delenv("APEX_SERVE_TP", raising=False)
+    monkeypatch.delenv("APEX_SERVE_WEIGHT_QUANT", raising=False)
+    with pytest.raises(ValueError, match="cannot be honored"):
+        _engine(cfg, params, tp=2, weight_quant=True)
+    # tp demand drops the weight-quant env preference
+    monkeypatch.setenv("APEX_SERVE_WEIGHT_QUANT", "1")
+    eng = _engine(cfg, params, tp=2)
+    assert eng.tp == 2 and not eng.weight_quant and eng.qparams is None
+    # weight-quant demand: the tp env preference falls back
+    monkeypatch.delenv("APEX_SERVE_WEIGHT_QUANT", raising=False)
+    monkeypatch.setenv("APEX_SERVE_TP", "2")
+    eng = _engine(cfg, params, weight_quant=True)
+    assert eng.tp == 1 and eng.weight_quant
+    # env-vs-env: tp (the newer layer) yields
+    monkeypatch.setenv("APEX_SERVE_WEIGHT_QUANT", "1")
+    eng = _engine(cfg, params)
+    assert eng.tp == 1 and eng.weight_quant
+
+
+def test_tp_default_off(setup, monkeypatch):
+    """tp=1 engines are byte-identical to the pre-TP build: no mesh,
+    no device_put, params untouched (the measured-dispatch default)."""
+    cfg, params = setup
+    monkeypatch.delenv("APEX_SERVE_TP", raising=False)
+    eng = _engine(cfg, params)
+    assert eng.tp == 1 and eng.mesh is None
+    assert eng.params is params

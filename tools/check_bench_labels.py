@@ -129,6 +129,21 @@ Checks:
     overlap_bound but no claim block (the pre-ISSUE-14 serving rows)
     predate the knobs and are skipped. Applies to PERF.md citations
     AND dispatch-table-cited records.
+11. **Parallel pin-match** — ZeRO-3 and tp-serving rows (ISSUE 18).
+    A cited record carrying a ``parallel`` claim block
+    (``benchmarks/profile_comm.py`` / ``profile_serving.py``:
+    ``{zero_stage, tp}`` — whether the measured program ran with
+    params dp-sharded behind the gather-on-use hop, and at what
+    serving tensor-parallel width) must PIN the selecting knobs
+    (``APEX_ZERO_STAGE`` / ``APEX_SERVE_TP``) in its recorded
+    ``knobs`` at the claimed values, and — the other direction — an
+    ENGAGED pin (``APEX_ZERO_STAGE`` past ``0``, ``APEX_SERVE_TP``
+    past ``1``) must appear in the claim block even when the record
+    carries no claim at all: a throughput number measured over the
+    sharded program but labeled unsharded (or vice versa) is the
+    same drift class as checks 7-10, and unlike check 10 there is no
+    measurement gate — the pins reshape EVERY number in the record.
+    Applies to PERF.md citations AND dispatch-table-cited records.
 
 New PERF.md table rows must cite their ledger record id in the caption
 (``ledger:<id>``) — uncited legacy paragraphs are not flagged, but they
@@ -453,6 +468,50 @@ def overlap_problems(rec, rid):
     return problems
 
 
+# check 11: the parallel claim fields (ISSUE 18 — ZeRO-3 parameter
+# sharding and tp-serving) and the knobs that select them; the "off"
+# value is the default program the claim-less rows ran
+_PARALLEL_CLAIM_KNOBS = (
+    ("zero_stage", "APEX_ZERO_STAGE", "0"),
+    ("tp", "APEX_SERVE_TP", "1"),
+)
+
+
+def parallel_problems(rec, rid):
+    """Check-11 pin-match for one cited record; [] when clean. Both
+    directions, with NO measurement gate (unlike check 10): a
+    non-None ``parallel`` claim field must be pinned at the claimed
+    value, and an engaged pin (``APEX_ZERO_STAGE`` past 0,
+    ``APEX_SERVE_TP`` past 1) must be claimed — even on a record
+    with no ``parallel`` block at all, because the pins reshape
+    every number in the record (a sharded program cited under an
+    unsharded label is the checks-7-10 drift class)."""
+    claim = rec.get("parallel")
+    claim = claim if isinstance(claim, dict) else {}
+    knobs = rec.get("knobs") if isinstance(rec.get("knobs"), dict) else {}
+    problems = []
+    for field, knob, off in _PARALLEL_CLAIM_KNOBS:
+        val = claim.get(field)
+        pin = knobs.get(knob)
+        if val is not None:
+            if pin is None:
+                problems.append(
+                    f"record {rid} claims parallel.{field}={val!r} "
+                    f"but does not pin {knob} in its knobs — an "
+                    f"unpinned zero3/tp row cannot be cited")
+            elif str(pin) != str(val):
+                problems.append(
+                    f"record {rid} claims parallel.{field}={val!r} "
+                    f"but pins {knob}={pin!r} — the claim and the "
+                    f"label name different programs")
+        elif pin is not None and str(pin) != off:
+            problems.append(
+                f"record {rid} pins {knob}={pin!r} (engaged) but its "
+                f"parallel claim omits {field!r} — a sharded program "
+                f"ran that the label does not name")
+    return problems
+
+
 def _paragraphs(text):
     """(start_lineno, paragraph_text) blocks of consecutive non-blank
     lines — the unit a caption and its numbers share."""
@@ -530,6 +589,9 @@ def check_captions(perf_text, perf_path, records):
                 problems.append(f"{perf_path}:{lineno}: {p}")
             # check 10: overlap-schedule pin-match (both directions)
             for p in overlap_problems(rec, rid):
+                problems.append(f"{perf_path}:{lineno}: {p}")
+            # check 11: zero3/tp parallel pin-match (both directions)
+            for p in parallel_problems(rec, rid):
                 problems.append(f"{perf_path}:{lineno}: {p}")
             if rec.get("resumed_from") is not None \
                     and COLD_RE.search(para):
@@ -631,6 +693,11 @@ def check_dispatch_table(path, records):
                 # any) entry decided by an overlap-measured row must
                 # cite a knob-pinned, claim-consistent record
                 for p in overlap_problems(rec, rid):
+                    problems.append(f"{tag}: {p}")
+                # check 11 on the table side: a default decided by a
+                # zero3/tp-sharded row must cite a knob-pinned,
+                # claim-consistent record
+                for p in parallel_problems(rec, rid):
                     problems.append(f"{tag}: {p}")
     return problems, len(entries)
 
