@@ -24,7 +24,8 @@ fault::
               "compile" | "calibration_overhead" | "emit" | "verdict" |
               "autotune_budget" | "ckpt_commit" | "ckpt_manifest" |
               "ckpt_data" | "final_save" | "serve_alloc" |
-              "serve_prefill" | "serve_decode" | "serve_burst",
+              "serve_prefill" | "serve_decode" | "serve_burst" |
+              "router_kill" | "router_wedge" | "router_slow",
      "kind":  "hang" | "raise" | "exit" | "fabricate" |
               "sigterm_parent" | "sigkill" | "inflate" | "truncate" |
               "degraded" | "set_budget" | "set_field" |
@@ -83,6 +84,16 @@ slow-but-beating run (degraded relay;     heartbeat/hang with seconds=N
   the full cap)                             flight.beat AFTER the beat
                                             lands: wall time stretches,
                                             beats keep arriving
+whole-replica death mid-trace             router_kill/raise with
+  (fleet serving, ISSUE 19; the             match_ctx tick/replica —
+  router's failover drains + replays        fired inside the replica's
+  through survivors)                        round closure
+replica round wedge (the router's         router_wedge/hang — forever
+  step watchdog times it out to a           under step_timeout_s, the
+  classified DispatchFailure)               breaker trips at the cap
+replica running slow, still serving       router_slow/hang with
+  (degraded, NOT dead — the breaker         seconds=N + times (bounded
+  must not trip on a bounded stall)         stall, round returns clean)
 =======================================  ================================
 
 Kind-specific fields: ``seconds`` (hang: sleep N then continue; absent

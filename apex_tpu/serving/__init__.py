@@ -48,6 +48,13 @@ Layering:
                    all default OFF)
 * ``engine``     — the glue: one ServingEngine owning cache, params,
                    compiled steps and the scheduler loop
+* ``router``     — stdlib-only fleet layer (ISSUE 19): N real engines
+                   under one routing policy (``APEX_ROUTE_POLICY`` —
+                   ``round_robin`` | ``least_loaded`` |
+                   ``prefix_affinity``), per-replica health + circuit
+                   breaker, failover with requeue-and-replay through
+                   survivors, composed fleet/replica admission, and
+                   the validated ``router`` ledger block
 """
 
 from apex_tpu.serving import lifecycle  # noqa: F401
@@ -71,3 +78,8 @@ from apex_tpu.serving.scheduler import (  # noqa: F401
     synthetic_trace,
 )
 from apex_tpu.serving.engine import ServingEngine, detokenize  # noqa: F401
+from apex_tpu.serving.router import (  # noqa: F401
+    AutoscalePolicy,
+    Router,
+    router_block,
+)

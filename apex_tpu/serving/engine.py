@@ -581,28 +581,18 @@ class ServingEngine:
         return serve_res.guarded_dispatch(
             call, self.dispatch_timeout_s, phase)
 
-    def submit(self, request):
-        """Enqueue one request; impossible requests raise HERE, before
-        anything is enqueued or allocated. The scheduler validates the
-        page budget (max_seq); the engine additionally owns the packed
-        prefill bucket, so the prompt-vs-prefill_len bound — which
-        would otherwise crash _run_prefill mid-round AFTER admission
-        had already filled a slot and allocated pages — is checked at
-        the same front door. Sampling demands are validated here too:
-        stochastic params against a sampling-OFF engine raise (an
-        explicit request is a demand, not a preference).
-
-        Under admission control (ISSUE 15, ``admit=`` /
-        ``APEX_SERVE_ADMIT``) a FULL queue is load, not a programming
-        error: submit returns a structured
-        :class:`~apex_tpu.serving.resilience.Rejected` (reason +
-        retry-after estimate in ticks) instead of enqueueing — an
-        exception never escapes the serving loop for overload, and
-        the queue can never grow without bound. Returns None when the
-        request was enqueued."""
-        self.resilience.submit_attempts += 1
-        # impossible-request teeth FIRST: a full queue rejects load,
-        # it must never mask a malformed request as a Rejected
+    def validate_request(self, request):
+        """The front-door teeth, shared with the fleet router: the
+        scheduler validates the page budget (max_seq); the engine
+        additionally owns the packed prefill bucket, so the
+        prompt-vs-prefill_len bound — which would otherwise crash
+        _run_prefill mid-round AFTER admission had already filled a
+        slot and allocated pages — is checked at the same front door.
+        Sampling demands are validated here too: stochastic params
+        against a sampling-OFF engine raise (an explicit request is a
+        demand, not a preference); a validated stochastic request also
+        gets its per-request sampling key stamped here, so the lane
+        key exists from the first admission onward."""
         self.scheduler.validate(request)
         if len(request.prompt) > self.prefill_len:
             raise ValueError(
@@ -619,7 +609,35 @@ class ServingEngine:
                     f"(sampling=True / APEX_SERVE_SAMPLING=1)")
             if request.rng_key is None:
                 request.rng_key = sampling_mod.request_key(sp.seed)
-        if self.admit_limit \
+
+    def submit(self, request, *, quiet=False, replay=False):
+        """Enqueue one request; impossible requests raise HERE, before
+        anything is enqueued or allocated (``validate_request`` — the
+        teeth run FIRST: a full queue rejects load, it must never mask
+        a malformed request as a Rejected).
+
+        Under admission control (ISSUE 15, ``admit=`` /
+        ``APEX_SERVE_ADMIT``) a FULL queue is load, not a programming
+        error: submit returns a structured
+        :class:`~apex_tpu.serving.resilience.Rejected` (reason +
+        retry-after estimate in ticks) instead of enqueueing — an
+        exception never escapes the serving loop for overload, and
+        the queue can never grow without bound. Returns None when the
+        request was enqueued.
+
+        The fleet router's hooks (ISSUE 19): ``quiet=True`` skips the
+        engine's submitted/rejected lifecycle events — the router owns
+        the request's front-of-chain events on the ONE fleet log, and
+        a failover resubmission must not stamp a second ``submitted``.
+        ``replay=True`` (implies the router path) additionally
+        bypasses the admission bound and keeps an already-stamped
+        ``enqueue_wall``: a failover replay is load the fleet ALREADY
+        accepted — dropping it at requeue would break the zero-loss
+        invariant, and re-stamping its wall would hide the latency the
+        dead replica cost it."""
+        self.resilience.submit_attempts += 1
+        self.validate_request(request)
+        if not replay and self.admit_limit \
                 and self.scheduler.queue_depth() >= self.admit_limit:
             # explicit reject at the front door: nothing enqueued,
             # nothing allocated. The retry-after estimate is the
@@ -631,16 +649,17 @@ class ServingEngine:
                          // self.num_slots)))
             self.resilience.rejected += 1
             self.rejected.append((request, rej))
-            if self.events is not None:
+            if self.events is not None and not quiet:
                 wall = time.perf_counter()
                 self.events.record("submitted", request.rid,
                                    tick=self.tick, wall=wall)
                 self.events.record("rejected", request.rid,
                                    tick=self.tick, wall=wall)
             return rej
-        request.enqueue_wall = time.perf_counter()
+        if not (replay and request.enqueue_wall is not None):
+            request.enqueue_wall = time.perf_counter()
         self.scheduler.submit(request, tick=self.tick)
-        if self.events is not None:
+        if self.events is not None and not quiet:
             self.events.record("submitted", request.rid, tick=self.tick,
                                wall=request.enqueue_wall)
         return None
@@ -1348,6 +1367,46 @@ class ServingEngine:
                              "verdict": failure.verdict,
                              "detail": failure.detail,
                              "requeued": [r.rid for r in requeued]}}
+
+    def drain_for_failover(self, tick):
+        """Evacuate this replica for the fleet router's failover
+        (ISSUE 19): every unsettled request — queued AND in-flight —
+        leaves the engine in replayable form, and the engine is left
+        in the same clean state ``_recover_round`` rebuilds, so a
+        later re-admission probe starts from a sound cache. In-flight
+        slots requeue exactly like KV-pressure preemption (pages
+        freed, prefix refcounts respected, the known stream stashed in
+        ``resume_tokens`` for the prefill replay); finished-but-not-
+        evicted slots settle here (their streams are complete — only
+        their pages are reclaimed); the prefix cache is flushed (its
+        chains point into the abandoned buffer) and the device cache
+        rebuilt. Returns the drained requests in replay order
+        (in-flight first — they hold the oldest streams), each ready
+        for ``submit(..., replay=True)`` on a survivor. The router
+        owns the ``failover``/``replayed`` lifecycle events; nothing
+        is stamped here."""
+        sch = self.scheduler
+        wall = time.perf_counter()
+        # finished streams settle (complete output, nothing to replay);
+        # pages + prefix refs reclaim through the normal evict path
+        for r in sch.evict_done(tick, wall):
+            if self.events is not None:
+                self.events.record("evicted", r.rid, tick=tick,
+                                   wall=wall)
+        queued = list(sch.queue)
+        sch.queue.clear()
+        inflight = [sch.requeue_slot(i, tick)
+                    for i in sch.active_indices()]
+        sch.queue.clear()  # requeue_slot re-appended them — the router
+        #                    owns where these requests go next
+        if self.prefix is not None:
+            self.prefix.flush()
+        self.cache = self._place_cache(init_cache(
+            self.cfg.num_layers, self.cfg.num_attention_heads,
+            self.num_pages, self.page_size, self.cfg.head_dim,
+            self._cache_dtype))
+        self._round_failures = 0
+        return inflight + queued
 
     # ------------- shared round bookkeeping (ISSUEs 14/17 one seam)
 

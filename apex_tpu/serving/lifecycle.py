@@ -56,9 +56,17 @@ import os
 # suspend a running request and MUST be followed by `resubmitted`,
 # after which the admission cycle may repeat — the once-only events
 # (prefill_done / first_token / finished / evicted) still fire at
-# most once per request across every cycle.
-EVENTS = ("submitted", "rejected", "shed", "admitted", "prefill_done",
-          "first_token", "preempted", "degraded_round", "resubmitted",
+# most once per request across every cycle. The fleet events
+# (ISSUE 19) extend it again: `routed` (the router assigned the
+# request to a replica — once, right after `submitted`), and the
+# failover cycle — `failover` (the request was pulled off a DEAD
+# replica, queued or mid-stream) MUST be followed by `replayed`
+# (resubmitted through a survivor), after which the admission cycle
+# repeats on the new replica; a request may fail over repeatedly
+# (cascading replica deaths), so neither is once-only.
+EVENTS = ("submitted", "rejected", "shed", "routed", "admitted",
+          "prefill_done", "first_token", "preempted", "degraded_round",
+          "resubmitted", "failover", "replayed",
           "finished", "evicted")
 _EVENT_IDX = {e: i for i, e in enumerate(EVENTS)}
 # the happy-path chain of an undisturbed request (what dryruns and the
@@ -66,25 +74,33 @@ _EVENT_IDX = {e: i for i, e in enumerate(EVENTS)}
 CORE_EVENTS = ("submitted", "admitted", "prefill_done", "first_token",
                "finished", "evicted")
 # events that may legally appear at most ONCE in a request's chain
-_ONCE = frozenset(("submitted", "rejected", "shed", "prefill_done",
-                   "first_token", "finished", "evicted"))
+_ONCE = frozenset(("submitted", "rejected", "shed", "routed",
+                   "prefill_done", "first_token", "finished",
+                   "evicted"))
 # the per-request transition machine (validate_order): allowed
 # successors of each event. "admitted" may be re-entered only through
-# "resubmitted"; conditional arcs (finished needs a first token; a
-# re-admitted request skips prefill_done/first_token it already has)
-# are resolved in validate_order against the seen-set.
+# "resubmitted" or the failover cycle's "replayed"; conditional arcs
+# (finished needs a first token; a re-admitted request skips
+# prefill_done/first_token it already has) are resolved in
+# validate_order against the seen-set. "failover" may interrupt a
+# request anywhere between routing and finishing — a replica dies
+# with the request queued (after routed/replayed) or mid-stream
+# (after admitted/prefill_done/first_token).
 _SUSPEND = ("preempted", "degraded_round")
 _NEXT = {
     None: ("submitted",),
-    "submitted": ("rejected", "shed", "admitted"),
+    "submitted": ("rejected", "shed", "admitted", "routed"),
     "rejected": (),
     "shed": (),
-    "admitted": ("prefill_done", "finished") + _SUSPEND,
-    "prefill_done": ("first_token",) + _SUSPEND,
-    "first_token": ("finished",) + _SUSPEND,
+    "routed": ("admitted", "shed", "failover"),
+    "admitted": ("prefill_done", "finished") + _SUSPEND + ("failover",),
+    "prefill_done": ("first_token",) + _SUSPEND + ("failover",),
+    "first_token": ("finished",) + _SUSPEND + ("failover",),
     "preempted": ("resubmitted",),
     "degraded_round": ("resubmitted",),
-    "resubmitted": ("shed", "admitted"),
+    "resubmitted": ("shed", "admitted", "failover"),
+    "failover": ("replayed",),
+    "replayed": ("admitted", "shed", "failover"),
     "finished": ("evicted",),
     "evicted": (),
 }
@@ -185,7 +201,11 @@ class EventLog:
         starting at ``submitted`` — the linear PR 10 chain, plus the
         resilience cycles (a ``preempted``/``degraded_round``
         suspension must be followed by ``resubmitted``, after which
-        admission may repeat) — with the once-only events
+        admission may repeat) and the fleet failover cycle (ISSUE 19:
+        ``routed`` at most once right after ``submitted``; a
+        ``failover`` anywhere between routing and finishing must be
+        followed by ``replayed``, after which admission repeats on
+        the surviving replica) — with the once-only events
         (``_ONCE``) never duplicated across cycles, ``finished``
         only after a first token landed, and non-decreasing wall
         stamps and ticks. ``dryrun_serving`` and the churn/chaos

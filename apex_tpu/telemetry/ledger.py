@@ -267,6 +267,79 @@ def _validate_slo(slo):
     return problems
 
 
+# the router ledger block's schema (apex_tpu.serving.router builds it;
+# this module owns the validation teeth — same division as the slo
+# block, so the stdlib-only validators never import the serving
+# package). The policy vocabulary is duplicated from
+# router.ROUTE_POLICIES on purpose (no serving import here);
+# tests/test_router.py asserts the two tuples stay identical.
+ROUTER_POLICY_VOCAB = ("round_robin", "least_loaded", "prefix_affinity")
+ROUTER_FIELDS = ("route_policy", "replicas", "fleet_goodput_tok_s",
+                 "util_spread", "ttft_p99_ms", "tpot_p99_ms",
+                 "failovers", "replayed_requests", "requests",
+                 "completed", "rejected_fleet", "rejected_replica",
+                 "prefix_hit_rate_by_policy", "trace_id",
+                 "arrival_process")
+_ROUTER_NUMERIC = ("fleet_goodput_tok_s", "ttft_p99_ms", "tpot_p99_ms")
+_ROUTER_COUNTS = ("failovers", "replayed_requests", "requests",
+                  "completed", "rejected_fleet", "rejected_replica")
+
+
+def _validate_router(rt):
+    if not isinstance(rt, dict):
+        return ["not a dict"]
+    problems = []
+    for field in ROUTER_FIELDS:
+        if field not in rt:
+            problems.append(f"missing field {field!r}")
+    pol = rt.get("route_policy")
+    if "route_policy" in rt and pol not in ROUTER_POLICY_VOCAB:
+        problems.append(
+            f"route_policy {pol!r} is not in {ROUTER_POLICY_VOCAB}")
+    n = rt.get("replicas")
+    if "replicas" in rt and (not isinstance(n, int)
+                             or isinstance(n, bool) or n < 1):
+        problems.append("replicas is not a positive int")
+    for field in _ROUTER_NUMERIC:
+        v = rt.get(field)
+        if v is not None and (not isinstance(v, (int, float))
+                              or isinstance(v, bool) or v < 0):
+            problems.append(f"{field} is not a non-negative number")
+    for field in _ROUTER_COUNTS:
+        v = rt.get(field)
+        if v is not None and (not isinstance(v, int)
+                              or isinstance(v, bool) or v < 0):
+            problems.append(f"{field} is not a non-negative int")
+    sp = rt.get("util_spread")
+    if sp is not None and (not isinstance(sp, (int, float))
+                           or isinstance(sp, bool)
+                           or not 0.0 <= sp <= 1.0):
+        problems.append("util_spread is not in [0, 1]")
+    hr = rt.get("prefix_hit_rate_by_policy")
+    if hr is not None:
+        # the policy sweep's proof surface: per-policy fleet hit rates
+        # under the SAME trace — a malformed one could claim an
+        # affinity win no sweep produced
+        if not isinstance(hr, dict):
+            problems.append("prefix_hit_rate_by_policy is not a dict")
+        else:
+            for k, v in hr.items():
+                if k not in ROUTER_POLICY_VOCAB:
+                    problems.append(
+                        f"prefix_hit_rate_by_policy key {k!r} is not "
+                        f"in {ROUTER_POLICY_VOCAB}")
+                if not isinstance(v, (int, float)) \
+                        or isinstance(v, bool) or not 0.0 <= v <= 1.0:
+                    problems.append(
+                        f"prefix_hit_rate_by_policy[{k!r}] is not in "
+                        f"[0, 1]")
+    for field in ("trace_id", "arrival_process"):
+        v = rt.get(field)
+        if field in rt and not (isinstance(v, str) and v):
+            problems.append(f"{field} is not a non-empty string")
+    return problems
+
+
 def validate_record(rec):
     """Schema problems for one record (empty list = clean)."""
     problems = []
@@ -397,6 +470,14 @@ def validate_record(rec):
         # may be null (a trace with no >=2-token request has no TPOT
         # percentile) but must be PRESENT: degradation, not omission.
         problems += [f"slo: {p}" for p in _validate_slo(slo)]
+    rt = rec.get("router")
+    if rt is not None:
+        # the fleet block (apex_tpu.serving.router.router_block, ISSUE
+        # 19): fleet goodput, utilization spread, cross-replica tails,
+        # and the failover/replay account. Malformed, it could claim a
+        # zero-loss failover or a prefix-affinity hit-rate delta no
+        # fleet produced — same teeth as the slo block.
+        problems += [f"router: {p}" for p in _validate_router(rt)]
     fr = rec.get("flight_reap")
     if fr is not None:
         # the supervisor's reap stamp (apex_tpu.resilience.flight_watch,

@@ -152,6 +152,18 @@ register("serve_degraded_rounds", unit="rounds",
          description="cumulative serving rounds lost to a timed-out "
                      "or crashed device dispatch (watchdog recovery)")
 
+# Fleet-router gauges (apex_tpu.serving.router.Router.gauge_rows — one
+# sample per router round, ISSUE 19): 0/absent without a router.
+register("serve_routed", unit="requests",
+         description="cumulative requests the fleet router assigned "
+                     "to a replica (ISSUE 19; absent without a router)")
+register("serve_failovers", unit="requests",
+         description="cumulative requests pulled off a dead replica "
+                     "(queued + in-flight) for requeue-and-replay")
+register("serve_replayed", unit="requests",
+         description="cumulative failed-over requests resubmitted "
+                     "through a surviving replica (prefill replay)")
+
 
 # --------------------------------------------------------------------------
 # in-step collection

@@ -278,6 +278,17 @@ run serving_multitok 1800 env APEX_SERVE_DECODE_K=4 python benchmarks/profile_se
 # record honestly pins tp=1 — the tp>1 leg needs the pod-slice
 # window, which is why the default stays tp=1 (measured-dispatch).
 run serving_tp       1800 env APEX_SERVE_TP=2 python benchmarks/profile_serving.py
+# Fleet router A/B (ISSUE 19, PERF.md §2): N=3 real engine replicas
+# behind one admission point, replaying the shared-system-prompt
+# trace — routing-policy hit-rate/goodput sweep + the static-N vs
+# lagged scale-out AutoscalePolicy A/B, all in the validated `router`
+# block (both route knobs pinned + claimed, check 12). Single-chip
+# honest label: one chip time-slices the replicas, so goodput prices
+# dispatch interleaving — hit-rate/parity/zero-loss transfer as-is
+# (host-side), absolute tok/s needs one chip per replica. No fault
+# plan here: scored rows measure routing, not the recovery drill
+# (that is dryrun_router's and the chaos tests' job).
+run serving_router   1800 env APEX_ROUTE_REPLICAS=3 APEX_ROUTE_POLICY=round_robin python benchmarks/profile_router.py
 fi
 
 echo "=== done; feed the logs into PERF.md"

@@ -1,0 +1,494 @@
+"""Fleet router unit suite (ISSUE 19): the routing policies, the
+health machine, the circuit breaker + probe schedule, admission
+composition, autoscale, and the validated ``router`` ledger block —
+all at the unit level over STUB engines (the real-engine failover
+parity story lives in tests/test_router_chaos.py). The stubs implement
+exactly the engine surface the router documents itself against:
+``validate_request`` / ``submit(quiet=, replay=)`` / ``step`` /
+``drain_for_failover`` / ``scheduler`` / ``resilience`` / ``events``.
+"""
+
+import types
+
+import pytest
+
+from apex_tpu.serving import lifecycle
+from apex_tpu.serving import router as router_mod
+from apex_tpu.serving.router import (
+    DEAD,
+    DEGRADED,
+    DRAINING,
+    HEALTHY,
+    REJOINED,
+    AutoscalePolicy,
+    Replica,
+    Router,
+    resolve_route_policy,
+    resolve_route_replicas,
+    router_block,
+    validate_health,
+)
+from apex_tpu.serving.scheduler import Request
+from apex_tpu.telemetry import ledger
+
+
+# ------------------------------------------------------- stub engines
+
+
+class _StubScheduler:
+    def __init__(self):
+        self.queue = []
+        self.completed = []
+        self.shed = []
+
+    def queue_depth(self):
+        return len(self.queue)
+
+    def active_indices(self):
+        return []
+
+
+class StubEngine:
+    """The documented router-facing engine surface, queue-only: step()
+    completes one queued request whole (greedy streams are
+    deterministic functions of the prompt here too: rid-seeded)."""
+
+    def __init__(self, *, fail_rounds=0, verdict="degraded_relay",
+                 prefill_len=16, page_size=4, num_slots=2,
+                 overlap=False):
+        self.prefill_len = prefill_len
+        self.page_size = page_size
+        self.num_slots = num_slots
+        self.overlap = overlap
+        self.scheduler = _StubScheduler()
+        self.rejected = []
+        self.resilience = types.SimpleNamespace(
+            degraded_rounds=0, last_verdict=None)
+        self.events = None
+        self.tick = 0
+        self.prefix = None
+        self.tokens_generated = 0
+        self.fail_rounds = fail_rounds
+        self._verdict = verdict
+        self.submits = []           # (request, replay) in arrival order
+
+    def validate_request(self, request):
+        if request.max_new_tokens < 1:
+            raise ValueError("max_new_tokens wants >= 1")
+
+    def submit(self, request, quiet=False, replay=False):
+        self.submits.append((request, replay))
+        self.scheduler.queue.append(request)
+        return None
+
+    def step(self):
+        self.tick += 1
+        if self.fail_rounds > 0:
+            self.fail_rounds -= 1
+            self.resilience.last_verdict = self._verdict
+            raise RuntimeError("injected replica failure")
+        if self.scheduler.queue:
+            req = self.scheduler.queue.pop(0)
+            req.out_tokens = [req.rid % 7 + i
+                              for i in range(req.max_new_tokens)]
+            self.tokens_generated += req.max_new_tokens
+            self.scheduler.completed.append(req)
+        return {}
+
+    def drain_for_failover(self, tick):
+        drained, self.scheduler.queue = self.scheduler.queue, []
+        return drained
+
+
+def _req(rid, prompt=None, max_new=4, arrival=0.0):
+    return Request(rid=rid, prompt=prompt or [rid + 1, 2, 3, 4, 5],
+                   max_new_tokens=max_new, arrival=arrival)
+
+
+def _fleet(n=2, **kw):
+    return [StubEngine(**kw) for _ in range(n)]
+
+
+def _drain(rt, reqs, guard=200):
+    n = 0
+    while not all(r.done() for r in reqs):
+        rt.step()
+        n += 1
+        assert n < guard, [r.out_tokens for r in reqs]
+
+
+# -------------------------------------------------- vocab + resolvers
+
+
+def test_policy_vocab_matches_ledger():
+    # REQUIRED identity: ledger.ROUTER_POLICY_VOCAB deliberately
+    # duplicates router.ROUTE_POLICIES (the stdlib-only validator
+    # never imports the serving package) — this assertion is the
+    # committed sync contract between the two tuples.
+    assert ledger.ROUTER_POLICY_VOCAB == router_mod.ROUTE_POLICIES
+
+
+def test_resolve_route_policy_demand_vs_preference(monkeypatch):
+    # per-call unknowns RAISE (explicit request = demand) ...
+    with pytest.raises(ValueError, match="unknown routing policy"):
+        resolve_route_policy("bogus")
+    # ... a demand beats the env preference ...
+    monkeypatch.setenv("APEX_ROUTE_POLICY", "prefix_affinity")
+    assert resolve_route_policy("least_loaded") == "least_loaded"
+    # ... the env preference is honored when well-formed ...
+    assert resolve_route_policy() == "prefix_affinity"
+    # ... and garbage env falls back to the measured default
+    monkeypatch.setenv("APEX_ROUTE_POLICY", "sticky")
+    assert resolve_route_policy() == "round_robin"
+    monkeypatch.delenv("APEX_ROUTE_POLICY")
+    assert resolve_route_policy() == "round_robin"
+
+
+def test_resolve_route_replicas(monkeypatch):
+    assert resolve_route_replicas(3) == 3
+    for bad in (0, -1, True, "2", 1.5):
+        with pytest.raises(ValueError, match="positive int"):
+            resolve_route_replicas(bad)
+    monkeypatch.setenv("APEX_ROUTE_REPLICAS", "5")
+    assert resolve_route_replicas() == 5
+    monkeypatch.setenv("APEX_ROUTE_REPLICAS", "many")
+    assert resolve_route_replicas() == 2
+    monkeypatch.delenv("APEX_ROUTE_REPLICAS")
+    assert resolve_route_replicas() == 2
+
+
+# ------------------------------------------------------ health machine
+
+
+def test_validate_health():
+    assert validate_health([HEALTHY, DEGRADED, HEALTHY]) == []
+    assert validate_health(
+        [HEALTHY, DEGRADED, DEAD, DRAINING, REJOINED, HEALTHY]) == []
+    assert validate_health([]) == ["empty health history"]
+    assert "not 'healthy'" in validate_health([DEGRADED])[0]
+    # dead replicas re-enter through DRAINING, never straight to live
+    bad = validate_health([HEALTHY, DEGRADED, DEAD, HEALTHY])
+    assert any("not a legal" in p for p in bad)
+
+
+def test_replica_set_state_raises_on_illegal():
+    r = Replica(name="r0", engine=StubEngine())
+    r.set_state(DEGRADED)
+    with pytest.raises(RuntimeError, match="illegal health transition"):
+        r.set_state(DRAINING)
+    assert r.history == [HEALTHY, DEGRADED]
+
+
+# ---------------------------------------------------- routing policies
+
+
+def test_round_robin_cycles_replicas():
+    rt = Router(_fleet(3), policy="round_robin")
+    for i in range(4):
+        assert rt.submit(_req(i)) is None
+    assert [r.routed for r in rt.replicas] == [2, 1, 1]
+    first = [e.submits[0][0].rid for e in
+             (rt.replicas[0].engine, rt.replicas[1].engine,
+              rt.replicas[2].engine)]
+    assert first == [0, 1, 2]
+
+
+def test_least_loaded_picks_smallest_then_index():
+    rt = Router(_fleet(3), policy="least_loaded")
+    rt.replicas[0].engine.scheduler.queue = [_req(90), _req(91)]
+    rt.replicas[2].engine.scheduler.queue = [_req(92)]
+    order = rt._candidates(_req(1))
+    assert [r.name for r in order] == ["r1", "r2", "r0"]
+    # ties break by index: drain the queues, r0/r1/r2 all empty
+    rt.replicas[0].engine.scheduler.queue = []
+    rt.replicas[2].engine.scheduler.queue = []
+    assert [r.name for r in rt._candidates(_req(2))] \
+        == ["r0", "r1", "r2"]
+
+
+def test_prefix_affinity_routes_shared_prefix_together():
+    rt = Router(_fleet(3), policy="prefix_affinity")
+    sys_prompt = [9, 8, 7, 6]       # one full page (page_size=4)
+    reqs = [_req(i, prompt=sys_prompt + [10 + i]) for i in range(6)]
+    for r in reqs:
+        assert rt.submit(r) is None
+    # every request sharing the first-page chain lands on ONE replica
+    assert sorted(r.routed for r in rt.replicas) == [0, 0, 6]
+    # a DIFFERENT first page may hash elsewhere, deterministically
+    other = _req(99, prompt=[1, 1, 1, 1, 2])
+    assert [r.name for r in rt._candidates(other)] \
+        == [r.name for r in rt._candidates(other)]
+
+
+def test_prefix_affinity_rendezvous_stable_under_death():
+    # rendezvous property: removing a NON-winning replica never moves
+    # the key — only the dead winner's keys migrate
+    rt = Router(_fleet(3), policy="prefix_affinity")
+    req = _req(1, prompt=[5, 5, 5, 5, 6])
+    order = rt._candidates(req)
+    loser = order[-1]
+    loser.set_state(DEGRADED)
+    loser.set_state(DEAD)
+    assert rt._candidates(req)[0] is order[0]
+
+
+# ------------------------------------------------ admission composition
+
+
+def test_fleet_vs_replica_vs_no_replica_reasons():
+    rt = Router(_fleet(2), fleet_admit=2)
+    assert rt.submit(_req(0)) is None
+    assert rt.submit(_req(1)) is None
+    rej = rt.submit(_req(2))
+    assert rej.reason == "fleet_full" and rej.retry_after_ticks >= 1
+    assert rt.stats["rejected_fleet"] == 1
+
+    rt2 = Router(_fleet(2), replica_inflight=1)
+    assert rt2.submit(_req(0)) is None
+    assert rt2.submit(_req(1)) is None
+    rej2 = rt2.submit(_req(2))
+    assert rej2.reason == "replica_full"
+    assert rt2.stats["rejected_replica"] == 1
+
+    rt3 = Router(_fleet(2))
+    for r in rt3.replicas:
+        r.set_state(DEGRADED)
+        r.set_state(DEAD)
+    rej3 = rt3.submit(_req(0))
+    assert rej3.reason == "no_replica"
+    # a full fleet never masks a malformed request
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        rt3.submit(_req(9, max_new=0))
+
+
+def test_ctor_demands_raise():
+    with pytest.raises(ValueError, match="at least one engine"):
+        Router([])
+    with pytest.raises(ValueError, match="prefill_len/page_size"):
+        Router([StubEngine(), StubEngine(prefill_len=32)])
+    with pytest.raises(ValueError, match="overlapped engine"):
+        Router([StubEngine(overlap=True)])
+    with pytest.raises(ValueError, match="fleet_admit"):
+        Router(_fleet(), fleet_admit=-1)
+    with pytest.raises(ValueError, match="replica_inflight"):
+        Router(_fleet(), replica_inflight=True)
+    with pytest.raises(ValueError, match="breaker_failures"):
+        Router(_fleet(), breaker_failures=0)
+    with pytest.raises(ValueError, match="unknown routing policy"):
+        Router(_fleet(), policy="sticky")
+    with pytest.raises(ValueError, match="AutoscalePolicy"):
+        Router(_fleet(), autoscale="lagged")
+
+
+def test_autoscale_policy_validation():
+    AutoscalePolicy(min_replicas=1)     # defaults validate
+    for bad in (0, True, "1"):
+        with pytest.raises(ValueError, match="min_replicas"):
+            AutoscalePolicy(min_replicas=bad)
+    for hw in (0.0, 1.5):
+        with pytest.raises(ValueError, match="high_water"):
+            AutoscalePolicy(min_replicas=1, high_water=hw)
+    with pytest.raises(ValueError, match="lag_rounds"):
+        AutoscalePolicy(min_replicas=1, lag_rounds=0)
+
+
+# ------------------------------------- breaker, probe rejoin, orphans
+
+
+def test_breaker_trip_failover_and_probe_rejoin():
+    good, bad = StubEngine(), StubEngine(fail_rounds=2)
+    rt = Router([good, bad], breaker_failures=2, probe_wait_rounds=1,
+                probe_attempts=3)
+    reqs = [_req(i, max_new=2) for i in range(4)]
+    for r in reqs:
+        assert rt.submit(r) is None
+    _drain(rt, reqs)
+    r1 = rt.replicas[1]
+    # two consecutive classified failures tripped the breaker, the two
+    # requests routed to r1 failed over and replayed through r0
+    assert rt.stats["deaths"] == 1
+    assert rt.stats["failovers"] == 2
+    assert rt.stats["replayed"] >= 2
+    assert r1.last_verdict == "degraded_relay"
+    assert all(replay for req, replay in good.submits[2:]), \
+        good.submits
+    # zero loss: all four trace requests completed, none on the dead
+    # replica, and the probe fabrication is excluded from completed()
+    assert sorted(q.rid for q in rt.completed()) == [0, 1, 2, 3]
+    # let the probe schedule run the replica back in
+    n = 0
+    while r1.state not in (REJOINED, HEALTHY):
+        rt.step()
+        n += 1
+        assert n < 60, r1.history
+    rt.step()
+    assert r1.state == HEALTHY
+    assert validate_health(r1.history) == []
+    assert DEAD in r1.history and DRAINING in r1.history \
+        and REJOINED in r1.history
+    assert rt.stats["probes"] >= 1 and rt.stats["rejoins"] == 1
+    assert all(q.rid < router_mod._PROBE_RID_BASE
+               for q in rt.completed())
+
+
+def test_total_outage_parks_orphans_until_rejoin():
+    engines = [StubEngine(fail_rounds=1, verdict="wedged")
+               for _ in range(2)]
+    rt = Router(engines, breaker_failures=1, probe_wait_rounds=1)
+    reqs = [_req(i, max_new=2) for i in range(3)]
+    for r in reqs:
+        assert rt.submit(r) is None
+    rt.step()                       # both replicas die this round
+    assert all(r.state == DEAD for r in rt.replicas)
+    assert rt._orphans, "accepted requests must park, not drop"
+    _drain(rt, reqs)                # probes rejoin, orphans replay
+    assert sorted(q.rid for q in rt.completed()) == [0, 1, 2]
+    assert rt.stats["rejoins"] >= 1
+    for r in rt.replicas:
+        assert validate_health(r.history) == []
+
+
+def test_probe_budget_exhausts_and_stays_dead():
+    dead = StubEngine(fail_rounds=10 ** 6)
+    rt = Router([StubEngine(), dead], breaker_failures=1,
+                probe_wait_rounds=1, probe_attempts=2)
+    rt.submit(_req(0, max_new=1))
+    for _ in range(40):
+        rt.step()
+    r1 = rt.replicas[1]
+    assert r1.state == DEAD
+    assert r1.probe_attempts_left == 0
+    assert rt.stats["probes"] == 2 and rt.stats["rejoins"] == 0
+    assert validate_health(r1.history) == []
+
+
+# ------------------------------------------------- autoscale + gauges
+
+
+def test_autoscale_unparks_after_lag():
+    rt = Router(_fleet(2), policy="round_robin",
+                autoscale=AutoscalePolicy(min_replicas=1,
+                                          high_water=0.5,
+                                          lag_rounds=2))
+    r1 = rt.replicas[1]
+    assert r1.parked and not r1.routable()
+    reqs = [_req(i, max_new=2) for i in range(5)]
+    for r in reqs:
+        assert rt.submit(r) is None     # all land on r0 (r1 parked)
+    assert rt.replicas[0].routed == 5
+    _drain(rt, reqs)
+    assert not r1.parked
+    assert rt.stats["scale_outs"] == 1
+
+
+def test_gauge_rows_track_stats():
+    rt = Router(_fleet(2))
+    reqs = [_req(i, max_new=2) for i in range(3)]
+    for r in reqs:
+        rt.submit(r)
+    _drain(rt, reqs)
+    rows = rt.gauge_rows()
+    assert len(rows) == rt.tick
+    assert rows[-1]["serve_routed"] == rt.stats["routed"] == 3
+    assert rows[-1]["serve_failovers"] == 0
+    assert all(a["serve_routed"] <= b["serve_routed"]
+               for a, b in zip(rows, rows[1:]))
+    assert rt.gauge_rows(run="x")[0]["run"] == "x"
+
+
+def test_fleet_event_log_rebinding():
+    lifecycle.enable()
+    try:
+        rt = Router(_fleet(2))
+    finally:
+        lifecycle.reset_enabled()
+    assert rt.events is not None
+    assert all(r.engine.events is rt.events for r in rt.replicas)
+    rt.submit(_req(0))
+    chain = [e["event"] for e in rt.events.request_events(0)]
+    assert chain == ["submitted", "routed"]
+    # disabled mode: no log, no recording overhead
+    rt2 = Router(_fleet(2))
+    assert rt2.events is None
+
+
+# --------------------------------------- the validated ledger surface
+
+
+def _driven_block():
+    rt = Router(_fleet(2))
+    reqs = [_req(i, max_new=3) for i in range(4)]
+    done = rt.run_trace(reqs)
+    return router_block(rt, done, 1.0, trace_id="tr-unit",
+                        arrival_process="poisson",
+                        prefix_hit_rate_by_policy={
+                            "round_robin": 0.3, "prefix_affinity": 0.4})
+
+
+def test_router_block_fields_and_validation():
+    block = _driven_block()
+    # the block carries EXACTLY the schema fields, and validates clean
+    assert set(block) == set(ledger.ROUTER_FIELDS)
+    assert ledger._validate_router(block) == []
+    assert block["completed"] == block["requests"] == 4
+    assert block["replicas"] == 2
+    assert block["fleet_goodput_tok_s"] == 12.0   # 4 req x 3 tok / 1 s
+    assert 0.0 <= block["util_spread"] <= 1.0
+
+
+def test_router_block_teeth():
+    assert ledger._validate_router("x") == ["not a dict"]
+    block = _driven_block()
+    bad = dict(block, route_policy="sticky")
+    assert any("route_policy" in p
+               for p in ledger._validate_router(bad))
+    missing = {k: v for k, v in block.items() if k != "failovers"}
+    assert any("missing field 'failovers'" in p
+               for p in ledger._validate_router(missing))
+    assert any("util_spread" in p for p in ledger._validate_router(
+        dict(block, util_spread=1.5)))
+    assert any("prefix_hit_rate_by_policy" in p
+               for p in ledger._validate_router(
+                   dict(block, prefix_hit_rate_by_policy={"rr": 0.5})))
+    assert any("not a non-negative int" in p
+               for p in ledger._validate_router(
+                   dict(block, failovers=-1)))
+
+
+def test_check12_router_pin_match_both_directions():
+    from tests.conftest import run_check_bench_labels  # noqa: F401
+    import importlib.util
+    import os
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "check_bench_labels.py")
+    spec = importlib.util.spec_from_file_location("_cbl12", tool)
+    cbl = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cbl)
+    block = {"route_policy": "round_robin", "replicas": 2}
+    good = {"router": block,
+            "knobs": {"APEX_ROUTE_POLICY": "round_robin",
+                      "APEX_ROUTE_REPLICAS": "2"}}
+    assert cbl.router_problems(good, "lg-x") == []
+    # direction 1a: a router block without its pins
+    unpinned = {"router": block, "knobs": {}}
+    assert len(cbl.router_problems(unpinned, "lg-x")) == 2
+    # direction 1b: block and pin disagree
+    skew = {"router": block,
+            "knobs": {"APEX_ROUTE_POLICY": "prefix_affinity",
+                      "APEX_ROUTE_REPLICAS": "2"}}
+    assert any("disagrees" in p
+               for p in cbl.router_problems(skew, "lg-x"))
+    # direction 2: an engaged fleet pin with NO router block
+    silent = {"knobs": {"APEX_ROUTE_POLICY": "round_robin"}}
+    assert any("no router block" in p
+               for p in cbl.router_problems(silent, "lg-x"))
+
+
+def test_run_trace_raises_on_no_drain():
+    # a fleet that cannot drain must fail loudly, not spin: every
+    # replica permanently dead with probes exhausted
+    engines = [StubEngine(fail_rounds=10 ** 6) for _ in range(2)]
+    rt = Router(engines, breaker_failures=1, probe_wait_rounds=1,
+                probe_attempts=1)
+    with pytest.raises(RuntimeError, match="did not drain"):
+        rt.run_trace([_req(0, max_new=1)], max_ticks=50)

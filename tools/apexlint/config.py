@@ -161,6 +161,7 @@ STDLIB_ONLY_CLAIMED = (
     "apex_tpu/serving/lifecycle.py",
     "apex_tpu/serving/speculative.py",
     "apex_tpu/serving/prefix_cache.py",
+    "apex_tpu/serving/router.py",
     "apex_tpu/compile_cache/__init__.py",
     "apex_tpu/telemetry/ledger.py",
     "apex_tpu/telemetry/costs.py",

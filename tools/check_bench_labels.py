@@ -144,6 +144,21 @@ Checks:
     same drift class as checks 7-10, and unlike check 10 there is no
     measurement gate — the pins reshape EVERY number in the record.
     Applies to PERF.md citations AND dispatch-table-cited records.
+12. **Router pin-match** — fleet rows (ISSUE 19). A cited record
+    carrying a ``router`` block (``benchmarks/profile_router.py`` /
+    ``apex_tpu.serving.router.router_block``: fleet goodput,
+    utilization spread, cross-replica tails, failover/replay counts,
+    per-policy prefix hit rates) must PIN both fleet knobs in its
+    recorded ``knobs`` (``APEX_ROUTE_POLICY`` /
+    ``APEX_ROUTE_REPLICAS``), and the block's own ``route_policy`` /
+    ``replicas`` fields must AGREE with the pinned values — a block
+    claiming a prefix-affinity hit rate under a round-robin pin (or
+    a 3-replica spread under a 2-replica pin) names a fleet the
+    label did not run. The other direction: an engaged fleet pin on
+    a record with NO router block is a finding — a routed fleet ran
+    that the label does not name (the check-11 no-measurement-gate
+    pattern: the pins reshape every number in the record). Applies
+    to PERF.md citations AND dispatch-table-cited records.
 
 New PERF.md table rows must cite their ledger record id in the caption
 (``ledger:<id>``) — uncited legacy paragraphs are not flagged, but they
@@ -512,6 +527,47 @@ def parallel_problems(rec, rid):
     return problems
 
 
+# check 12: the router block fields and the fleet knobs that pin them
+_ROUTER_CLAIM_KNOBS = (
+    ("route_policy", "APEX_ROUTE_POLICY"),
+    ("replicas", "APEX_ROUTE_REPLICAS"),
+)
+
+
+def router_problems(rec, rid):
+    """Check-12 pin-match for one cited record; [] when clean. Both
+    directions, with NO measurement gate (the check-11 pattern): a
+    record carrying a ``router`` block must pin both fleet knobs and
+    the block's ``route_policy``/``replicas`` must agree with them;
+    an engaged fleet pin on a record WITHOUT a router block is a
+    finding — a routed fleet ran that the label does not name."""
+    rt = rec.get("router")
+    knobs = rec.get("knobs") if isinstance(rec.get("knobs"), dict) else {}
+    problems = []
+    if isinstance(rt, dict):
+        for field, knob in _ROUTER_CLAIM_KNOBS:
+            val = rt.get(field)
+            pin = knobs.get(knob)
+            if pin is None:
+                problems.append(
+                    f"record {rid} carries a router block but does "
+                    f"not pin {knob} in its knobs — an unpinned fleet "
+                    f"row cannot be cited")
+            elif val is not None and str(pin) != str(val):
+                problems.append(
+                    f"record {rid} router.{field}={val!r} disagrees "
+                    f"with its pinned {knob}={pin!r} — the block and "
+                    f"the label name different fleets")
+    else:
+        for field, knob in _ROUTER_CLAIM_KNOBS:
+            if knobs.get(knob) is not None:
+                problems.append(
+                    f"record {rid} pins {knob}={knobs[knob]!r} "
+                    f"(engaged) but carries no router block — a "
+                    f"routed fleet ran that the label does not name")
+    return problems
+
+
 def _paragraphs(text):
     """(start_lineno, paragraph_text) blocks of consecutive non-blank
     lines — the unit a caption and its numbers share."""
@@ -592,6 +648,9 @@ def check_captions(perf_text, perf_path, records):
                 problems.append(f"{perf_path}:{lineno}: {p}")
             # check 11: zero3/tp parallel pin-match (both directions)
             for p in parallel_problems(rec, rid):
+                problems.append(f"{perf_path}:{lineno}: {p}")
+            # check 12: fleet-router pin-match (both directions)
+            for p in router_problems(rec, rid):
                 problems.append(f"{perf_path}:{lineno}: {p}")
             if rec.get("resumed_from") is not None \
                     and COLD_RE.search(para):
@@ -698,6 +757,11 @@ def check_dispatch_table(path, records):
                 # zero3/tp-sharded row must cite a knob-pinned,
                 # claim-consistent record
                 for p in parallel_problems(rec, rid):
+                    problems.append(f"{tag}: {p}")
+                # check 12 on the table side: a default decided by a
+                # fleet-routed row must cite a knob-pinned,
+                # claim-consistent record
+                for p in router_problems(rec, rid):
                     problems.append(f"{tag}: {p}")
     return problems, len(entries)
 

@@ -136,6 +136,7 @@ def ledger_summary(records):
     comm_rows = []
     serving_rows = []
     overlap_rows = []
+    router_rows = []
     for rec in records:
         by_harness[rec.get("harness", "?")] = \
             by_harness.get(rec.get("harness", "?"), 0) + 1
@@ -221,6 +222,13 @@ def ledger_summary(records):
                 "prefix_hit_rate": sv.get("prefix_hit_rate"),
                 "slo": slo,
             })
+        # fleet economics (ISSUE 19): the router block — utilization
+        # spread, failover/replay account, per-policy prefix hit rates
+        # — one row per record carrying it
+        rt = rec.get("router")
+        if isinstance(rt, dict):
+            router_rows.append(dict(rt, id=rec.get("id"),
+                                    harness=rec.get("harness")))
     ts = [r["ts"] for r in records
           if isinstance(r.get("ts"), (int, float))]
     return {
@@ -237,6 +245,7 @@ def ledger_summary(records):
         "comm": comm_rows,
         "overlap": overlap_rows,
         "serving": serving_rows,
+        "router": router_rows,
     }
 
 
@@ -561,6 +570,36 @@ def print_report(report, out=None):
                       f"{slo.get('kv_page_high_water')}"
                       + (f"/{s['kv_pages']} pages"
                          if s.get("kv_pages") else ""))
+        if led.get("router"):
+            # FLEET (ISSUE 19): the router block next to the per-engine
+            # serving economics — fleet goodput, how evenly the
+            # replicas shared the load, the failover/replay account,
+            # and what each routing policy bought in prefix hits
+            p("  fleet:")
+            for rt in led["router"]:
+                good = rt.get("fleet_goodput_tok_s")
+                sp = rt.get("util_spread")
+                p(f"    {rt['id']} ({rt['harness']}) "
+                  f"[{rt.get('trace_id') or '?'}]: "
+                  f"policy={rt.get('route_policy')} "
+                  f"replicas={rt.get('replicas')}, fleet goodput "
+                  f"{'?' if good is None else format(good, 'g')} tok/s, "
+                  f"util spread "
+                  f"{'?' if sp is None else format(sp, '.1%')}")
+                p(f"      failover: {rt.get('failovers')} failed over, "
+                  f"{rt.get('replayed_requests')} replayed "
+                  f"({rt.get('requests')} routed, "
+                  f"{rt.get('completed')} completed; rejected "
+                  f"fleet={rt.get('rejected_fleet')} "
+                  f"replica={rt.get('rejected_replica')})")
+                p(f"      tails (cross-replica): ttft p99 "
+                  f"{rt.get('ttft_p99_ms')} ms, tpot p99 "
+                  f"{rt.get('tpot_p99_ms')} ms")
+                hr = rt.get("prefix_hit_rate_by_policy")
+                if isinstance(hr, dict) and hr:
+                    bits = ", ".join(
+                        f"{k}={v:.0%}" for k, v in sorted(hr.items()))
+                    p(f"      prefix hit-rate by policy: {bits}")
     fl = report.get("flight")
     if fl:
         p(f"flight: {fl['dir']} (primary timeline — exact phase "
