@@ -45,7 +45,7 @@ SUITES = {
                 "test_serving_resilience.py",
                 "test_serving_chaos.py",
                 "test_serving_multitok.py",
-                "test_serving_tp.py",
+                "test_serving_tp.py", "test_kv_tier.py",
                 "test_router.py", "test_router_chaos.py"],
     "api_parity": ["test_api_parity_round3.py"],
     "harness": ["test_run_tests.py", "test_bench_contract.py",

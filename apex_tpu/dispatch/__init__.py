@@ -107,6 +107,14 @@ OP_CHOICES = {
     # like "grad_comm" — choice is the count as a string, the
     # bench_batch convention for integer-valued ops
     "overlap_buckets": None,
+    # restore path of a preempted stream with the host swap tier on
+    # (serving.kv_tier, ISSUE 20): replay the known stream through the
+    # packed prefill program ("recompute", vLLM's recompute
+    # preemption) vs copy the swapped pages back host→device and
+    # resume decode directly ("swap"). Keyed on the resumed stream's
+    # token length ("s") — the crossover against the ~65 ms relay
+    # dispatch floor is shape-dependent, not a constant
+    "kv_restore": ("recompute", "swap"),
 }
 
 REQUIRED_FIELDS = ("op", "bucket", "dtype", "backend", "choice", "ledger")

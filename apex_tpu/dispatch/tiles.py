@@ -109,7 +109,8 @@ DIM_KEYS = {
     "decode_attention": ("b", "h", "pages", "ps", "d"),
 }
 
-_DTYPE_BYTES = {"bfloat16": 2, "float16": 2, "float32": 4, "float64": 8}
+_DTYPE_BYTES = {"bfloat16": 2, "float16": 2, "float32": 4, "float64": 8,
+                "int8": 1}
 
 
 def itemsize(dtype):
@@ -448,8 +449,12 @@ def _xent_legal(dims, dtype, params):
 def decode_vmem_bytes(bh, ps, d, itembytes):
     """Resident set of one decode-attention grid step: block_h heads'
     K + V page blocks plus the fp32 q row and (acc, m, l) online-softmax
-    accumulators."""
-    return 2 * bh * ps * d * itembytes + 4 * bh * d + 4 * bh * (d + 2)
+    accumulators. At the int8 itemsize (the quantized KV tier,
+    ISSUE 20) the per-(page, head) bf16 scale blocks ride as two more
+    operands — 2 bytes per head each — so the model budgets them too."""
+    scales = 2 * bh * 2 if itembytes == 1 else 0
+    return 2 * bh * ps * d * itembytes + 4 * bh * d + 4 * bh * (d + 2) \
+        + scales
 
 
 def decode_block_h(h, ps, d, itembytes):

@@ -43,6 +43,16 @@ Layouts:
   k_pages/v_pages  [h, pages, page_size, d]
   page_table       [b, max_pages]     int32 (padding -> null page 0)
   lengths          [b]                int32 (0 = inactive slot -> 0 out)
+  k_scale/v_scale  [h, pages]         per-(page, head) scales of the
+                                      int8 KV tier (ISSUE 20), or None
+
+int8 KV tier (serving.kv_tier): when the pages are int8 codes, the
+per-(page, head) scales ride as two more scalar-prefetch-INDEXED
+operands — the same ``page_table[i, j]`` gather as the page blocks,
+one bf16 scalar per head per grid step — and both impls dequantize at
+read (fp32 multiply next to the existing widening cast; no
+dequantized page copy is ever materialized). The VMEM model budgets
+the scale blocks at the int8 itemsize (tiles.decode_vmem_bytes).
 """
 
 import functools
@@ -129,8 +139,12 @@ def _pick_bh(h, ps, d, dtype, block_h, tile_pref):
     return tiles.decode_block_h(h, ps, d, tiles.itemsize(dtype))
 
 
-def _kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-            acc_scr, m_scr, l_scr, *, scale, ps, n_pages):
+def _kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, *rest,
+            scale, ps, n_pages, quant):
+    if quant:
+        ks_ref, vs_ref, o_ref, acc_scr, m_scr, l_scr = rest
+    else:
+        o_ref, acc_scr, m_scr, l_scr = rest
     i = pl.program_id(0)   # sequence slot
     j = pl.program_id(2)   # page index within the slot's table
 
@@ -145,11 +159,16 @@ def _kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
     @pl.when(j * ps < length)
     def _page():
         q = q_ref[0, :, 0, :].astype(jnp.float32) * jnp.float32(scale)
-        k = k_ref[:, 0]                              # [bh, ps, d]
-        v = v_ref[:, 0]
+        k = k_ref[:, 0].astype(jnp.float32)          # [bh, ps, d]
+        v = v_ref[:, 0].astype(jnp.float32)
+        if quant:
+            # dequantize at read: one bf16 scale per head for THIS
+            # page (scalar-prefetch-indexed like the page blocks)
+            k = k * ks_ref[:, 0, 0].astype(jnp.float32)[:, None, None]
+            v = v * vs_ref[:, 0, 0].astype(jnp.float32)[:, None, None]
         # [bh, ps] scores: broadcast-multiply + lane reduction (see
         # module docstring — q_len=1 makes the MXU moot)
-        s = jnp.sum(q[:, None, :] * k.astype(jnp.float32), axis=-1)
+        s = jnp.sum(q[:, None, :] * k, axis=-1)
         col = j * ps + lax.broadcasted_iota(jnp.int32, s.shape, 1)
         masked = col >= length
         s = jnp.where(masked, jnp.float32(NEG_INF), s)
@@ -161,7 +180,7 @@ def _kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=-1,
                                                   keepdims=True)
         acc_scr[...] = acc_scr[...] * alpha + jnp.sum(
-            p[:, :, None] * v.astype(jnp.float32), axis=1)
+            p[:, :, None] * v, axis=1)
         m_scr[...] = m_new
 
     @pl.when(j == n_pages - 1)
@@ -172,21 +191,28 @@ def _kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def decode_attention_pallas(q, k_pages, v_pages, page_table, lengths,
-                            sm_scale, *, block_h=None, interpret=False,
+                            sm_scale, *, k_scale=None, v_scale=None,
+                            block_h=None, interpret=False,
                             tile_pref=None):
     """The Pallas paged-decode kernel (layouts in the module
     docstring). Call :func:`decode_attention` for the dispatched
-    surface; this entry raises on unsupported geometry."""
+    surface; this entry raises on unsupported geometry. With
+    ``k_scale``/``v_scale`` (``[h, pages]`` — the int8 KV tier) the
+    scales ride as two extra operands whose BlockSpec gathers the
+    SAME ``page_table[i, j]`` page the K/V blocks do, and the kernel
+    dequantizes at read."""
     b, h, d = q.shape
     n_pages_total, ps = k_pages.shape[1], k_pages.shape[2]
     max_pages = page_table.shape[1]
+    quant = k_scale is not None
     if not supported(h, n_pages_total, ps, d, k_pages.dtype):
         raise ValueError(
             f"decode_attention_pallas: unsupported geometry h={h} "
             f"ps={ps} d={d} ({k_pages.dtype})")
     # judged at the CACHE dtype — the K/V pages are the streamed
     # working set the VMEM model budgets (same itemsize supported()
-    # gates with)
+    # gates with; the int8 itemsize implies the scale operands, which
+    # tiles.decode_vmem_bytes budgets too)
     bh = _pick_bh(h, ps, d, k_pages.dtype, block_h, tile_pref)
     q4 = q[:, :, None, :]                   # [b, h, 1, d]
     grid = (b, h // bh, max_pages)
@@ -197,18 +223,30 @@ def decode_attention_pallas(q, k_pages, v_pages, page_table, lengths,
     def kv_map(i, hb, j, pt, ln):
         return (hb, pt[i, j], 0, 0)
 
+    def sc_map(i, hb, j, pt, ln):
+        return (hb, pt[i, j], 0)
+
+    in_specs = [
+        pl.BlockSpec((1, bh, 1, d), q_map),
+        pl.BlockSpec((bh, 1, ps, d), kv_map),
+        pl.BlockSpec((bh, 1, ps, d), kv_map),
+    ]
+    operands = [q4, k_pages, v_pages]
+    if quant:
+        # [h, pages] -> [h, pages, 1]: a trailing unit axis keeps the
+        # block's minor dim spanning its full array axis (the same
+        # Mosaic last-two-dims legality argument as the page blocks)
+        in_specs += [pl.BlockSpec((bh, 1, 1), sc_map)] * 2
+        operands += [k_scale[:, :, None], v_scale[:, :, None]]
+
     kern = functools.partial(_kernel, scale=float(sm_scale), ps=ps,
-                             n_pages=max_pages)
+                             n_pages=max_pages, quant=quant)
     out = pl.pallas_call(
         kern,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec((1, bh, 1, d), q_map),
-                pl.BlockSpec((bh, 1, ps, d), kv_map),
-                pl.BlockSpec((bh, 1, ps, d), kv_map),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((1, bh, 1, d), q_map),
             scratch_shapes=[
                 pltpu.VMEM((bh, d), jnp.float32),
@@ -219,27 +257,38 @@ def decode_attention_pallas(q, k_pages, v_pages, page_table, lengths,
         out_shape=jax.ShapeDtypeStruct(q4.shape, q.dtype),
         interpret=interpret,
     )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
-      q4, k_pages, v_pages)
+      *operands)
     return out[:, :, 0, :]
 
 
 def decode_attention_reference(q, k_pages, v_pages, page_table,
-                               lengths, sm_scale):
+                               lengths, sm_scale, k_scale=None,
+                               v_scale=None):
     """The jnp gather-attention reference (and the family's built-in
     default impl): gather each slot's pages, mask past the context
     length, exact fp32 softmax. Inactive slots (length 0) return 0 —
     the same fully-masked-row semantics as every attention kernel in
-    ops/."""
+    ops/. ``k_scale``/``v_scale`` (``[h, pages]``, the int8 KV tier)
+    gather through the SAME page table and dequantize at read."""
     b, h, d = q.shape
     ps = k_pages.shape[2]
     # [h, b, max_pages, ps, d] -> [b, h, S, d]
     k = k_pages[:, page_table].transpose(1, 0, 2, 3, 4).reshape(
-        b, h, -1, d)
+        b, h, -1, d).astype(jnp.float32)
     v = v_pages[:, page_table].transpose(1, 0, 2, 3, 4).reshape(
-        b, h, -1, d)
+        b, h, -1, d).astype(jnp.float32)
+    if k_scale is not None:
+        # [h, b, max_pages] -> [b, h, S] (one scale per page, repeated
+        # over the page's positions)
+        ks = jnp.repeat(k_scale[:, page_table].transpose(1, 0, 2)
+                        .astype(jnp.float32), ps, axis=-1)
+        vs = jnp.repeat(v_scale[:, page_table].transpose(1, 0, 2)
+                        .astype(jnp.float32), ps, axis=-1)
+        k = k * ks[..., None]
+        v = v * vs[..., None]
     s = jnp.sum(
         (q.astype(jnp.float32) * jnp.float32(sm_scale))[:, :, None, :]
-        * k.astype(jnp.float32), axis=-1)          # [b, h, S]
+        * k, axis=-1)                              # [b, h, S]
     col = jnp.arange(s.shape[-1], dtype=jnp.int32)[None, None, :]
     masked = col >= lengths.astype(jnp.int32)[:, None, None]
     s = jnp.where(masked, NEG_INF, s)
@@ -248,8 +297,7 @@ def decode_attention_reference(q, k_pages, v_pages, page_table,
     e = jnp.where(masked, 0.0, e)
     tot = jnp.sum(e, axis=-1, keepdims=True)
     p = jnp.where(tot > 0, e / jnp.where(tot > 0, tot, 1.0), 0.0)
-    return jnp.sum(p[..., None] * v.astype(jnp.float32),
-                   axis=2).astype(q.dtype)
+    return jnp.sum(p[..., None] * v, axis=2).astype(q.dtype)
 
 
 def _effective_impl(impl, q, k_pages, page_table):
@@ -275,7 +323,8 @@ def _effective_impl(impl, q, k_pages, page_table):
 
 
 def decode_attention(q, k_pages, v_pages, page_table, lengths, *,
-                     sm_scale=None, impl=None, block_h=None,
+                     sm_scale=None, k_scale=None, v_scale=None,
+                     impl=None, block_h=None,
                      interpret=None, tile_pref=None):
     """Dispatched paged decode attention (q: [b, h, d]; pages:
     [h, P, ps, d]; page_table: [b, max_pages]; lengths: [b]).
@@ -286,13 +335,24 @@ def decode_attention(q, k_pages, v_pages, page_table, lengths, *,
     unpinned call consults the dispatch table (op "decode_attention").
     ``block_h`` is the per-call tile demand (raises when illegal);
     ``interpret`` defaults to off-TPU autodetect for explicitly
-    requested or table-driven pallas runs."""
+    requested or table-driven pallas runs. ``k_scale``/``v_scale``
+    (``[h, P]``) engage the int8 KV tier's dequantize-at-read on
+    either impl; int8 pages WITHOUT scales raise — codes are
+    meaningless without their scales, there is no honorable
+    fallback."""
     if sm_scale is None:
         import math
 
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     if impl is not None and impl not in ("jnp", "pallas"):
         raise ValueError(f"unknown decode-attention impl {impl!r}")
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("decode_attention: k_scale and v_scale come "
+                         "as a pair (one of them is missing)")
+    if k_scale is None and k_pages.dtype == jnp.int8:
+        raise ValueError(
+            "decode_attention: int8 pages without k_scale/v_scale — "
+            "quantized codes are meaningless without their scales")
     eff, from_table, pref_t = _effective_impl(impl, q, k_pages,
                                               page_table)
     if tile_pref:
@@ -314,6 +374,7 @@ def decode_attention(q, k_pages, v_pages, page_table, lengths, *,
                 interpret = True
         return decode_attention_pallas(
             q, k_pages, v_pages, page_table, lengths, sm_scale,
+            k_scale=k_scale, v_scale=v_scale,
             block_h=block_h, interpret=interpret, tile_pref=pref_t)
     # the jnp path is what actually runs from here on: an explicit
     # per-call tile demand cannot be honored on it, whatever
@@ -324,4 +385,5 @@ def decode_attention(q, k_pages, v_pages, page_table, lengths, *,
         raise ValueError("decode_attention: block_h tiles the pallas "
                          "kernel; it cannot be honored on the jnp path")
     return decode_attention_reference(q, k_pages, v_pages, page_table,
-                                      lengths, sm_scale)
+                                      lengths, sm_scale,
+                                      k_scale=k_scale, v_scale=v_scale)

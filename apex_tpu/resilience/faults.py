@@ -25,11 +25,13 @@ fault::
               "autotune_budget" | "ckpt_commit" | "ckpt_manifest" |
               "ckpt_data" | "final_save" | "serve_alloc" |
               "serve_prefill" | "serve_decode" | "serve_burst" |
+              "serve_swap" |
               "router_kill" | "router_wedge" | "router_slow",
      "kind":  "hang" | "raise" | "exit" | "fabricate" |
               "sigterm_parent" | "sigkill" | "inflate" | "truncate" |
               "degraded" | "set_budget" | "set_field" |
-              "truncate_file" | "corrupt_file" | "deny" | "burst",
+              "truncate_file" | "corrupt_file" | "deny" | "burst" |
+              "corrupt",
      "match_env": {"VAR": "value" | null},   # null = must be unset
      "match_ctx": {"step": 2, "phase": "data_visible"},  # hook kwargs
      ... kind-specific fields ...}
@@ -94,6 +96,16 @@ replica round wedge (the router's         router_wedge/hang — forever
 replica running slow, still serving       router_slow/hang with
   (degraded, NOT dead — the breaker         seconds=N + times (bounded
   must not trip on a bounded stall)         stall, round returns clean)
+host-copy failure banking a preempted     serve_swap/raise or hang with
+  victim's KV pages (swap tier,             match_ctx phase="swap_out"
+  ISSUE 20 — falls back to recompute        — the engine classifies it
+  preemption, a ``swap_failed`` event)      ``swap_failed``, never hangs
+                                            the round (tokens preserved)
+host-copy failure restoring swapped       serve_swap/raise or hang with
+  pages at re-admission                     match_ctx phase="swap_in"
+swapped page bytes rot on the host        serve_swap/corrupt with
+  (the handle's checksum catches it;        match_ctx phase="swap_in" —
+  restore falls back to recompute)          flips the banked bytes
 =======================================  ================================
 
 Kind-specific fields: ``seconds`` (hang: sleep N then continue; absent
@@ -336,6 +348,25 @@ def denied(site, **ctx):
             continue
         if _spend(idx, fault):
             _say(fault, f" (alloc refused, ctx={ctx})")
+            return True
+    return False
+
+
+def corrupt(site, **ctx):
+    """``corrupt``-kind faults (host swap tier chaos, ISSUE 20): True
+    when a matching fault wants the caller's in-memory banked bytes
+    damaged — the ENGINE flips the swapped pages' host buffer so the
+    handle's checksum catches exactly the silent-rot mode, and the
+    restore falls back to recompute instead of resuming from garbage.
+    Honors the ``times`` cap like :func:`denied`."""
+    if not active():
+        return False
+    for idx, fault in enumerate(plan()):
+        if fault.get("site") != site or fault.get("kind") != "corrupt" \
+                or not _match(fault, ctx):
+            continue
+        if _spend(idx, fault):
+            _say(fault, f" (corrupt banked bytes, ctx={ctx})")
             return True
     return False
 

@@ -55,7 +55,7 @@ PASS_ROWS = (
     "bench_b32_remat", "bench_profile", "serving",
     "serving_sampling", "serving_spec", "serving_prefix",
     "serving_resilience", "serving_multitok", "serving_tp",
-    "serving_router",
+    "serving_kv_quant", "serving_kv_swap", "serving_router",
 )
 
 

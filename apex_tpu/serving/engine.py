@@ -177,6 +177,7 @@ import numpy as np
 
 from apex_tpu import resilience as res_mod
 from apex_tpu.resilience import faults as faults_mod
+from apex_tpu.serving import kv_tier as kv_tier_mod
 from apex_tpu.serving import lifecycle
 from apex_tpu.serving import model as smodel
 from apex_tpu.serving import prefix_cache as prefix_mod
@@ -185,7 +186,8 @@ from apex_tpu.serving import resilience as serve_res
 from apex_tpu.serving import sampling as sampling_mod
 from apex_tpu.serving import speculative as spec_mod
 from apex_tpu.serving import tp as tp_mod
-from apex_tpu.serving.kv_cache import PageAllocator, init_cache
+from apex_tpu.serving.kv_cache import (PageAllocator, init_cache,
+                                       pages_needed)
 from apex_tpu.serving.scheduler import ContinuousBatchingScheduler, Request
 
 
@@ -203,6 +205,7 @@ class ServingEngine:
                  decode_k=None, prefix_cache=None, overlap=None,
                  admit=None,
                  shed=None, preempt=None, recover=None,
+                 kv_quant=None, kv_swap=None, kv_restore=None,
                  shed_ttft_ms=None, dispatch_timeout_s=None,
                  round_attempts=None, round_retry_wait_s=None, seed=0):
         smodel.check_serving_config(cfg)
@@ -232,24 +235,14 @@ class ServingEngine:
         # tensor-parallel serving (ISSUE 18, `tp=` > APEX_SERVE_TP,
         # default tp=1 — the serving_tp A/B is queued in PERF.md §2;
         # the capability exception for the >HBM config is argued
-        # there too). The int8 decode records are single-chip tables
-        # (per-channel scales follow the UNSHARDED out dim), so the
-        # weight_quant pairing takes the established asymmetry: two
-        # per-call demands raise, a demand drops the other side's
-        # env/setter preference, env-vs-env falls back to tp=1.
+        # there too). tp x weight_quant COMPOSES (ISSUE 20 satellite,
+        # formerly a two-demand raise): the int8 decode records shard
+        # along the same Megatron split as their float weights
+        # (tp.qparams_shardings — per-out-channel scales ride the
+        # column split, replicate across the row split), device_put
+        # below with the params.
         self.tp = tp_mod.resolve_serve_tp(
             tp, n_heads=cfg.num_attention_heads)
-        if self.tp > 1 and self.weight_quant:
-            if tp is not None and weight_quant is True:
-                raise ValueError(
-                    f"tp={self.tp} cannot be honored with "
-                    f"weight_quant=True: the int8 decode records are "
-                    f"single-chip tables (sharding them is its own "
-                    f"queued A/B) — two demands, no honorable order")
-            if tp is not None:
-                self.weight_quant = False  # demand drops the pref
-            else:
-                self.tp = 1  # APEX_SERVE_TP preference falls back
         self.qparams = smodel.quantize_decode_params(
             self.params, cfg) if self.weight_quant else None
         self.decode_impl = decode_impl
@@ -345,6 +338,41 @@ class ServingEngine:
                     f"one request's max_seq table ({self.max_pages} "
                     f"pages) — a lone preemption survivor could wedge")
             self.preempt = False  # env preference: falls back per shape
+        # KV-cache memory hierarchy (ISSUE 20, serving.kv_tier): int8
+        # KV quantization + host swap tier, both default OFF per the
+        # measured-dispatch rule (the serving_kv_quant/serving_kv_swap
+        # device A/Bs are queued in PERF.md §2). The swap tier banks
+        # pages AT preemption, so kv_swap pairs with preempt by the
+        # established asymmetry: kv_swap=True demanded with preemption
+        # resolved off raises (nothing is ever preempted, so nothing
+        # is ever banked); the APEX_SERVE_KV_SWAP preference falls
+        # back off. Overlap pairing rides preempt's (a swap engine is
+        # a preempting engine, which is already serial-only).
+        self.kv_quant = kv_tier_mod.resolve_kv_quant(kv_quant)
+        self.kv_swap = kv_tier_mod.resolve_kv_swap(kv_swap)
+        if self.kv_swap and not self.preempt:
+            if kv_swap is True:
+                raise ValueError(
+                    "kv_swap=True cannot be honored without "
+                    "KV-pressure preemption (preempt=True / "
+                    "APEX_SERVE_PREEMPT=1): the host tier banks pages "
+                    "AT preemption — with it off nothing is ever "
+                    "swapped")
+            self.kv_swap = False  # env preference falls back
+        if kv_restore is not None:
+            # validate the per-call demand at BUILD: an unknown
+            # vocabulary word or "swap" against a swap-less engine
+            # raises here, not at the first preemption mid-serve
+            kv_tier_mod.resolve_kv_restore(
+                kv_restore, swap_enabled=self.kv_swap, tokens=1,
+                dtype="bfloat16")
+        self.kv_restore = kv_restore
+        self.kv_stats = kv_tier_mod.KVTierStats() if self.kv_swap \
+            else None
+        # rids whose swap-OUT failed since the last preemption drain —
+        # the drain stamps their classified ``swap_failed`` between
+        # ``preempted`` and ``resubmitted``
+        self._swap_failed_rids = set()
         self.admit_limit = serve_res.resolve_admit(admit)
         self.shed = serve_res.resolve_shed(shed)
         if shed_ttft_ms is not None:
@@ -403,25 +431,40 @@ class ServingEngine:
             self.params = jax.device_put(
                 self.params,
                 tp_mod.param_shardings(self.params, self.mesh))
-        self.cache = self._place_cache(init_cache(
-            cfg.num_layers, cfg.num_attention_heads, num_pages,
-            page_size, cfg.head_dim, self._cache_dtype))
+            if self.qparams is not None:
+                self.qparams = jax.device_put(
+                    self.qparams,
+                    tp_mod.qparams_shardings(self.qparams, self.mesh))
+        self.cache = self._fresh_cache()
         self.allocator = self.prefix.allocator if self.prefix \
             is not None else PageAllocator(num_pages)
         self.scheduler = ContinuousBatchingScheduler(
             num_slots, self.max_pages, page_size, self.allocator,
-            policy=policy, prefix=self.prefix, preempt=self.preempt)
+            policy=policy, prefix=self.prefix, preempt=self.preempt,
+            swap_out=self._swap_out_slot if self.kv_swap else None)
         # lifecycle observability (gated, host-side only): None when
         # collection is off — disabled mode appends nothing and reads
         # no extra clocks beyond the per-round stamps below
         self.events = lifecycle.EventLog() if lifecycle.enabled() \
             else None
 
-        def _prefill(cache, ids, positions, seg, token_rows,
-                     page_table, last_idx):
-            return smodel.prefill(self.params, cache, ids, positions,
-                                  seg, token_rows, page_table,
-                                  last_idx, cfg=cfg)
+        # the quantized prefill takes ONE extra operand — the
+        # keep_scale row staged per dispatch (_packed_call); the plain
+        # program keeps its exact pre-tier signature, so the disabled
+        # mode's jaxpr is byte-identical to the pre-ISSUE-20 engine
+        if self.kv_quant:
+            def _prefill(cache, ids, positions, seg, token_rows,
+                         page_table, last_idx, keep_scale):
+                return smodel.prefill(self.params, cache, ids,
+                                      positions, seg, token_rows,
+                                      page_table, last_idx, keep_scale,
+                                      cfg=cfg)
+        else:
+            def _prefill(cache, ids, positions, seg, token_rows,
+                         page_table, last_idx):
+                return smodel.prefill(self.params, cache, ids,
+                                      positions, seg, token_rows,
+                                      page_table, last_idx, cfg=cfg)
 
         # the decode program: at K=1 the single-step program is built
         # byte-identical to the pre-block engine; at K>1 the ONE
@@ -477,11 +520,36 @@ class ServingEngine:
             # are traced scalars, so every COW/snapshot hop reuses ONE
             # compiled copy and the donated cache updates in place —
             # an eager .at[].set here would materialize the ENTIRE
-            # cache per copied page
-            for part in ("k", "v"):
+            # cache per copied page. Iterates every cache leaf: the
+            # int8 tier's [L, h, P] scale planes carry their page axis
+            # at axis 2 exactly like the code arrays, so a COW copy
+            # moves a page's codes AND its scale in the same hop.
+            for part in cache:
                 page = jax.lax.dynamic_index_in_dim(
                     cache[part], src, axis=2, keepdims=False)
                 cache[part] = cache[part].at[:, :, dst].set(page)
+            return cache
+
+        def _swap_gather(cache, page_idx):
+            # host swap tier (ISSUE 20), device half of swap-OUT: one
+            # victim's pages gathered along every leaf's page axis at
+            # a [max_pages] index row PADDED with null page 0 (zero
+            # codes, zero scale), so this program compiles exactly
+            # once whatever the victim's live page count — the
+            # one-compile contract holds; the host device_get of the
+            # result is the staging copy, never a third serving
+            # program
+            return {name: jnp.take(cache[name], page_idx, axis=2)
+                    for name in cache}
+
+        def _swap_scatter(cache, page_idx, leaves):
+            # device half of swap-IN: the banked leaves scatter back
+            # at the freshly granted pages; the padded tail entries
+            # re-write null page 0 with its own zero content — benign,
+            # and the program compiles exactly once
+            for name in cache:
+                cache[name] = cache[name].at[:, :, page_idx].set(
+                    leaves[name])
             return cache
 
         # donate the cache: the scatter-updated pages stay in place
@@ -491,6 +559,11 @@ class ServingEngine:
         # only — never on the per-token path; the TWO serving
         # programs above stay the jaxpr-stability surfaces)
         self._copy_fn = jax.jit(_copy, donate_argnums=(0,))
+        # swap-tier staging hops (preemption/re-admission only — same
+        # auxiliary-program precedent as _copy_fn)
+        self._swap_gather_fn = jax.jit(_swap_gather)
+        self._swap_scatter_fn = jax.jit(_swap_scatter,
+                                        donate_argnums=(0,))
         self.tick = 0
         self.decode_steps = 0
         self.verify_calls = 0
@@ -500,6 +573,11 @@ class ServingEngine:
         # decode fetch): run wall minus this is the HOST slice of the
         # serving loop — the overlap_bound input
         self.device_dispatch_s = 0.0
+        # wall seconds inside swap-tier staging copies (device_get at
+        # swap-out + scatter at swap-in) — the host-copy clock the
+        # kv_restore crossover sweep measures against the replay
+        # dispatch it saves
+        self.swap_copy_s = 0.0
 
     # ---------------------------------------------------------- plumbing
 
@@ -512,6 +590,16 @@ class ServingEngine:
             return cache
         return jax.device_put(
             cache, tp_mod.cache_shardings(cache, self.mesh))
+
+    def _fresh_cache(self):
+        """Build + place a zeroed cache — the ONE construction home
+        (ctor, round recovery, failover drain), so a rebuild can never
+        drop the int8 tier's scale leaves or drift the dtype (either
+        would re-enter the jit caches as a second program)."""
+        return self._place_cache(init_cache(
+            self.cfg.num_layers, self.cfg.num_attention_heads,
+            self.num_pages, self.page_size, self.cfg.head_dim,
+            self._cache_dtype, kv_quant=self.kv_quant))
 
     def decode_cache_size(self):
         """jit-cache entry count of the decode step — the
@@ -550,6 +638,25 @@ class ServingEngine:
         return self.resilience.rates(
             shed_on=self.shed, preempt_on=self.preempt,
             recover_on=self.recover)
+
+    def kv_tier_rates(self):
+        """The ledger-facing KV-tier account (ISSUE 20): ``kv_quant``
+        (True with the int8 tier on, None off), ``swap_rate`` (banked
+        swap-outs over preemptions) and ``swapped_pages_high_water``,
+        the swap fields None when the host tier is off — degradation,
+        never omission (the check 8 teeth)."""
+        quant = True if self.kv_quant else None
+        st = self.kv_stats
+        if st is None:
+            return {"kv_quant": quant, "swap_rate": None,
+                    "swapped_pages_high_water": None}
+        preempted = self.resilience.preempted
+        return {
+            "kv_quant": quant,
+            "swap_rate": (st.swap_outs / preempted) if preempted
+            else 0.0,
+            "swapped_pages_high_water": st.swapped_pages_high_water,
+        }
 
     def _dispatch(self, phase, fn):
         """One device dispatch (call + fetch, no engine-state writes
@@ -691,6 +798,138 @@ class ServingEngine:
                     f"[{first_pos}, {last_pos}] would hit shared page "
                     f"{slot.pages[j]} (COW failed)")
 
+    # ------------------------------------------- host swap tier hops
+
+    def _swap_out_slot(self, slot):
+        """Bank a preemption victim's live pages device→host (the
+        scheduler's ``swap_out`` callback, fired inside
+        ``requeue_slot`` BEFORE the pages are freed). Returns a sealed
+        :class:`~apex_tpu.serving.kv_tier.SwappedPages` handle, or
+        None when there is nothing worth banking (no generated tokens
+        — re-admission is a plain fresh prefill) or the copy failed
+        (the ``serve_swap`` chaos site: the stream falls back to
+        recompute preemption, classified ``swap_failed`` at the
+        drain — tokens preserved either way). The banked extent is
+        every page covering positions ``0..pos-1`` — including
+        previously shared prefix pages' CONTENT (their refs release
+        exactly as before; restore writes private pages, never
+        aliases). The copy is host staging between dispatches
+        (device_get of the one-compile gather) — never a third
+        serving program."""
+        req = slot.request
+        t = slot.pos
+        if not req.out_tokens or t < 1:
+            return None
+        n = pages_needed(t, self.page_size)
+        try:
+            faults_mod.fire("serve_swap", phase="swap_out",
+                            tick=self.tick, rid=req.rid)
+            idx = np.zeros((self.max_pages,), np.int32)
+            idx[:n] = slot.pages[:n]
+            t0 = time.perf_counter()
+            gathered = self._swap_gather_fn(self.cache,
+                                            jnp.asarray(idx))
+            leaves = {name: np.asarray(jax.device_get(arr))
+                      for name, arr in gathered.items()}
+            self.swap_copy_s += time.perf_counter() - t0
+        except Exception:
+            self.kv_stats.swap_out_failures += 1
+            self._swap_failed_rids.add(req.rid)
+            return None
+        handle = kv_tier_mod.SwappedPages(
+            leaves=leaves, page_count=n, tokens=t,
+            quant=self.kv_quant).seal()
+        self.kv_stats.banked(handle)
+        return handle
+
+    def _swap_in_slot(self, si, handle):
+        """Copy one banked stream's pages back into the slot's freshly
+        granted device pages (host→device staging between dispatches —
+        every restore reuses the one-compile scatter). True on
+        success: the slot resumes decode directly past the banked
+        content, skipping the replay dispatch entirely. False when the
+        ``serve_swap`` chaos site fired or the handle no longer
+        matches its seal (classified ``swap_failed``) — the caller
+        replays by recompute; the integrity check runs BEFORE the
+        scatter, so corrupt bytes never reach the device."""
+        sch = self.scheduler
+        slot = sch.slots[si]
+        req = slot.request
+        try:
+            faults_mod.fire("serve_swap", phase="swap_in",
+                            tick=self.tick, rid=req.rid)
+            if faults_mod.corrupt("serve_swap", phase="swap_in",
+                                  tick=self.tick, rid=req.rid):
+                # scripted host rot: flip one banked byte in place —
+                # the seal below must catch it
+                name = sorted(handle.leaves)[0]
+                handle.leaves[name].view(np.uint8).ravel()[0] ^= 0xFF
+            if not handle.intact():
+                raise RuntimeError(
+                    f"rid {req.rid}: swapped pages failed their "
+                    f"checksum — banked bytes rotted on the host")
+            n = handle.page_count
+            dst = np.zeros((self.max_pages,), np.int32)
+            dst[:n] = slot.pages[:n]
+            t0 = time.perf_counter()
+            leaves = {name: jnp.asarray(arr)
+                      for name, arr in handle.leaves.items()}
+            self.cache = self._swap_scatter_fn(
+                self.cache, jnp.asarray(dst), leaves)
+            self.swap_copy_s += time.perf_counter() - t0
+        except Exception:
+            self.kv_stats.swap_in_failures += 1
+            self.kv_stats.released(handle)
+            req.swapped = None
+            if self.events is not None:
+                self.events.record("swap_failed", req.rid,
+                                   tick=self.tick,
+                                   wall=time.perf_counter())
+            return False
+        self.kv_stats.swap_ins += 1
+        self.kv_stats.released(handle)
+        req.swapped = None
+        # resume exactly where the banked content ends: pos positions
+        # are valid, the next known token feeds the first decode step
+        # (for a stream banked mid-warmup this lands back inside the
+        # warmup window — the decode loop's known-token bookkeeping
+        # carries it the rest of the way, same as replay overflow)
+        slot.pos = handle.tokens
+        slot.next_token = int(req.resume_tokens[handle.tokens])
+        return True
+
+    def _restore_resumed(self, resumed):
+        """Route each re-admitted preempted stream down its resolved
+        restore path (ISSUE 20, dispatch op ``kv_restore`` keyed on
+        the resumed stream's token length): ``"swap"`` scatters the
+        banked pages back and resumes decode directly; ``"recompute"``
+        — or any swap failure/corruption — falls back to the
+        replay-prefill the preemption layer always had. Returns the
+        slots still needing the replay dispatch."""
+        sch = self.scheduler
+        replay = []
+        for si in resumed:
+            req = sch.slots[si].request
+            handle = getattr(req, "swapped", None)
+            if handle is not None:
+                choice = kv_tier_mod.resolve_kv_restore(
+                    self.kv_restore, swap_enabled=self.kv_swap,
+                    tokens=len(req.resume_tokens),
+                    dtype=self._cache_dtype)
+                if choice == "swap" and self._swap_in_slot(si, handle):
+                    self.kv_stats.restores_swap += 1
+                    continue
+                if req.swapped is not None:
+                    # recompute resolved: release the handle — the
+                    # replay recomputes these pages (a failed swap-in
+                    # already released it)
+                    self.kv_stats.released(handle)
+                    req.swapped = None
+            if self.kv_stats is not None:
+                self.kv_stats.restores_recompute += 1
+            replay.append(si)
+        return replay
+
     # ----------------------------------------------------------- prefill
 
     def _sample_first_tokens(self, logits_rows, slot_indices):
@@ -759,13 +998,34 @@ class ServingEngine:
             for j, gp in enumerate(gathers):
                 gather_idx[r * W + j] = cursor + gp
             cursor += n
+        keep = None
+        if self.kv_quant:
+            # keep_scale row (kv_tier.prefill_scatter_quant): 1 for
+            # pages whose existing int8 content must survive this
+            # dispatch's scale growth, 0 for pages this dispatch fully
+            # rewrites (fresh pages — stale codes there must NOT pin
+            # the scale). A row writing from write_from>0 (verify
+            # replay) keeps the partially-valid page holding position
+            # write_from-1 and zeroes only the pages past it.
+            keep = np.ones((self.num_pages,), np.float32)
+            for si, fed, write_from, _ in rows:
+                pages = self.scheduler.slots[si].pages
+                first = (0 if write_from == 0
+                         else (write_from - 1) // self.page_size + 1)
+                for j in range(first,
+                               (len(fed) - 1) // self.page_size + 1):
+                    if j < len(pages):
+                        keep[pages[j]] = 0.0
         t0 = time.perf_counter()
 
         def call():
-            cache, logits = self._prefill_fn(
-                self.cache, jnp.asarray(ids), jnp.asarray(positions),
-                jnp.asarray(seg), jnp.asarray(token_rows),
-                jnp.asarray(pt), jnp.asarray(gather_idx))
+            args = [self.cache, jnp.asarray(ids),
+                    jnp.asarray(positions), jnp.asarray(seg),
+                    jnp.asarray(token_rows), jnp.asarray(pt),
+                    jnp.asarray(gather_idx)]
+            if keep is not None:
+                args.append(jnp.asarray(keep))
+            cache, logits = self._prefill_fn(*args)
             if self.recover:
                 # fetch INSIDE the watchdog: the sync on the gathered
                 # logits is where a wedged round actually blocks
@@ -825,7 +1085,14 @@ class ServingEngine:
                    if sch.slots[si].request.resume_tokens]
         slot_indices = [si for si in slot_indices if si not in resumed]
         if resumed:
-            self._replay_prefill(resumed)
+            # swap tier (ISSUE 20): streams with banked pages restore
+            # by host->device copy and skip the replay dispatch; the
+            # rest (recompute-resolved, swap-failed, never banked)
+            # replay as before
+            replay = (self._restore_resumed(resumed) if self.kv_swap
+                      else resumed)
+            if replay:
+                self._replay_prefill(replay)
         if not slot_indices:
             return resumed
         for si in slot_indices:
@@ -1181,8 +1448,16 @@ class ServingEngine:
                 wall = time.perf_counter()
                 self.events.record("preempted", req.rid, tick=tick,
                                    wall=wall)
+                if req.rid in self._swap_failed_rids:
+                    # swap-out raised/hung at requeue (serve_swap chaos
+                    # site): the stream still resubmits — it just
+                    # replays by recompute instead of restoring banked
+                    # pages. Classified, never silent (ISSUE 20).
+                    self.events.record("swap_failed", req.rid,
+                                       tick=tick, wall=wall)
                 self.events.record("resubmitted", req.rid, tick=tick,
                                    wall=wall)
+            self._swap_failed_rids.discard(req.rid)
         return preempted
 
     def _ensure_pages(self, lanes_pos, tick):
@@ -1321,7 +1596,12 @@ class ServingEngine:
         requeued = []
         for i in sch.active_indices():
             if not sch.slots[i].request.done():
-                requeued.append(sch.requeue_slot(i, now))
+                # swap=False: the failed round's cache contents are
+                # exactly what we no longer trust — banking them would
+                # restore poison. (Handles banked BEFORE the failure
+                # survive: host bytes are independent of the rebuilt
+                # device buffer, so those streams still swap in.)
+                requeued.append(sch.requeue_slot(i, now, swap=False))
         if self.prefix is not None:
             # finished slots keep their seats (evicted next round),
             # but the cache flush below refuses live references —
@@ -1335,10 +1615,7 @@ class ServingEngine:
                     self.prefix.release(slot.shared_pages)
                     slot.shared_pages = []
             self.prefix.flush()
-        self.cache = self._place_cache(init_cache(
-            self.cfg.num_layers, self.cfg.num_attention_heads,
-            self.num_pages, self.page_size, self.cfg.head_dim,
-            self._cache_dtype))
+        self.cache = self._fresh_cache()
         if self.events is not None:
             wall = time.perf_counter()
             for req in requeued:
@@ -1395,18 +1672,25 @@ class ServingEngine:
                                    wall=wall)
         queued = list(sch.queue)
         sch.queue.clear()
-        inflight = [sch.requeue_slot(i, tick)
+        # swap=False: the drained requests replay on a DIFFERENT
+        # replica — a host-banked handle from this process cannot
+        # restore into the survivor's cache, so bank nothing and
+        # release any handle still riding a drained request below
+        inflight = [sch.requeue_slot(i, tick, swap=False)
                     for i in sch.active_indices()]
         sch.queue.clear()  # requeue_slot re-appended them — the router
         #                    owns where these requests go next
         if self.prefix is not None:
             self.prefix.flush()
-        self.cache = self._place_cache(init_cache(
-            self.cfg.num_layers, self.cfg.num_attention_heads,
-            self.num_pages, self.page_size, self.cfg.head_dim,
-            self._cache_dtype))
+        self.cache = self._fresh_cache()
         self._round_failures = 0
-        return inflight + queued
+        drained = inflight + queued
+        for req in drained:
+            handle = getattr(req, "swapped", None)
+            if handle is not None:
+                self.kv_stats.released(handle)
+                req.swapped = None
+        return drained
 
     # ------------- shared round bookkeeping (ISSUEs 14/17 one seam)
 

@@ -27,10 +27,27 @@ import jax.numpy as jnp
 
 
 def init_cache(num_layers, num_heads, num_pages, page_size, head_dim,
-               dtype=jnp.bfloat16):
+               dtype=jnp.bfloat16, kv_quant=False):
     """Zeroed cache dict ``{"k", "v"}`` of
-    ``[layers, h, num_pages, page_size, head_dim]`` arrays."""
+    ``[layers, h, num_pages, page_size, head_dim]`` arrays.
+
+    ``kv_quant=True`` (the int8 KV tier, ISSUE 20) stores the code
+    arrays as int8 and adds per-(page, head) bf16 scale leaves
+    ``{"k_scale", "v_scale"}`` of ``[layers, h, num_pages]`` — pages
+    at axis 2 and heads at axis 1 exactly like the code arrays, so
+    page-copy helpers and the TP ``cache_shardings`` treat every leaf
+    uniformly. Zero scales make the all-zero init exact: a zero scale
+    dequantizes (and quantizes) to exact zeros, which is also what
+    pins null page 0 dead through the codec."""
     shape = (num_layers, num_heads, num_pages, page_size, head_dim)
+    if kv_quant:
+        from apex_tpu.serving import kv_tier
+
+        cache = {"k": jnp.zeros(shape, kv_tier.CODE_DTYPE),
+                 "v": jnp.zeros(shape, kv_tier.CODE_DTYPE)}
+        cache.update(kv_tier.init_scales(num_layers, num_heads,
+                                         num_pages))
+        return cache
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 

@@ -65,8 +65,8 @@ import os
 # repeats on the new replica; a request may fail over repeatedly
 # (cascading replica deaths), so neither is once-only.
 EVENTS = ("submitted", "rejected", "shed", "routed", "admitted",
-          "prefill_done", "first_token", "preempted", "degraded_round",
-          "resubmitted", "failover", "replayed",
+          "prefill_done", "first_token", "preempted", "swap_failed",
+          "degraded_round", "resubmitted", "failover", "replayed",
           "finished", "evicted")
 _EVENT_IDX = {e: i for i, e in enumerate(EVENTS)}
 # the happy-path chain of an undisturbed request (what dryruns and the
@@ -93,10 +93,24 @@ _NEXT = {
     "rejected": (),
     "shed": (),
     "routed": ("admitted", "shed", "failover"),
-    "admitted": ("prefill_done", "finished") + _SUSPEND + ("failover",),
+    "admitted": ("prefill_done", "finished", "swap_failed")
+    + _SUSPEND + ("failover",),
     "prefill_done": ("first_token",) + _SUSPEND + ("failover",),
     "first_token": ("finished",) + _SUSPEND + ("failover",),
-    "preempted": ("resubmitted",),
+    # "swap_failed" (ISSUE 20): the host swap tier failed a banked
+    # stream — either at preemption (swap-out could not copy the
+    # victim's pages: `preempted -> swap_failed -> resubmitted`) or at
+    # re-admission (the handle was corrupt or swap-in crashed:
+    # `admitted -> swap_failed -> ...`, after which the stream replays
+    # by recompute and continues its normal arcs). Falls back to
+    # vLLM-style recompute preemption either way — tokens preserved.
+    # NOT once-only: a request preempted repeatedly may fail its swap
+    # repeatedly. A swap-failed stream always has its once-only
+    # prefill_done/first_token already (only a stream with generated
+    # tokens is ever banked), so those arcs are not re-entered here.
+    "preempted": ("resubmitted", "swap_failed"),
+    "swap_failed": ("resubmitted", "finished") + _SUSPEND
+    + ("failover",),
     "degraded_round": ("resubmitted",),
     "resubmitted": ("shed", "admitted", "failover"),
     "failover": ("replayed",),

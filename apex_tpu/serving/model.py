@@ -41,6 +41,7 @@ import numpy as np
 from jax import lax
 
 from apex_tpu.dispatch import tiles as _tiles
+from apex_tpu.serving import kv_tier as kv_tier_mod
 from apex_tpu.serving import quant as quant_mod
 from apex_tpu.serving import sampling as sampling_mod
 
@@ -184,7 +185,7 @@ def _trunk_layer(x, lp, qr, cfg, attn):
 # --------------------------------------------------------------- prefill
 
 def prefill(params, cache, ids, positions, seg, token_rows, page_table,
-            last_idx, *, cfg):
+            last_idx, keep_scale=None, *, cfg):
     """One packed prompt batch through the trunk, filling the cache.
 
     ids/positions/seg/token_rows: ``[S_pack]`` — token values, their
@@ -197,6 +198,11 @@ def prefill(params, cache, ids, positions, seg, token_rows, page_table,
     of this same program (ISSUE 13) gathers K+1 indices per request —
     the pending-token + draft positions whose greedy chain decides
     acceptance. Returns ``(cache, logits [G, vocab])``.
+
+    keep_scale: ``[num_pages]`` float (1 = the page already holds live
+    rows whose scale must survive, 0 = fresh or null) — required by
+    and only consumed on the int8 KV tier (``kv_tier.is_quantized``),
+    where the scatter routes through the quantize-at-write codec.
     """
     dtype = compute_dtype(cfg)
     hd, n_heads = cfg.head_dim, cfg.num_attention_heads
@@ -214,6 +220,12 @@ def prefill(params, cache, ids, positions, seg, token_rows, page_table,
         (positions // ps)[:, None], axis=1)[:, 0]
     dest_off = positions % ps
 
+    quant = kv_tier_mod.is_quantized(cache)
+    if quant and keep_scale is None:
+        raise ValueError(
+            "prefill on a quantized cache needs the keep_scale row — "
+            "requantizing without it would zero surviving pages")
+
     from apex_tpu.ops import fused_attention
 
     seg2 = seg.astype(jnp.int32)[None, :]
@@ -222,14 +234,22 @@ def prefill(params, cache, ids, positions, seg, token_rows, page_table,
             # scatter this layer's K/V into the paged cache: values
             # are [S, H, d] as produced (mixed basic/advanced indexing
             # puts the gathered token axis FIRST) at (page, offset) —
-            # index arithmetic only — then packed causal+segment
-            # attention over the full bucket
-            cache["k"] = cache["k"].at[
-                i, :, dest_page, dest_off, :].set(
-                k.astype(cache["k"].dtype))
-            cache["v"] = cache["v"].at[
-                i, :, dest_page, dest_off, :].set(
-                v.astype(cache["v"].dtype))
+            # index arithmetic only (the int8 tier routes the same
+            # scatter through the quantize-at-write codec) — then
+            # packed causal+segment attention over the full bucket
+            nonlocal cache
+            if quant:
+                cache = kv_tier_mod.prefill_scatter_quant(
+                    cache, i, "k", k, dest_page, dest_off, keep_scale)
+                cache = kv_tier_mod.prefill_scatter_quant(
+                    cache, i, "v", v, dest_page, dest_off, keep_scale)
+            else:
+                cache["k"] = cache["k"].at[
+                    i, :, dest_page, dest_off, :].set(
+                    k.astype(cache["k"].dtype))
+                cache["v"] = cache["v"].at[
+                    i, :, dest_page, dest_off, :].set(
+                    v.astype(cache["v"].dtype))
             ctx = fused_attention(
                 q.transpose(1, 0, 2)[None],
                 k.transpose(1, 0, 2)[None],
@@ -295,19 +315,32 @@ def decode_step(params, cache, tokens, lengths, page_table, *, cfg,
     x = x.astype(dtype)
 
     ql = qparams["layers"] if qparams is not None else None
+    quant = kv_tier_mod.is_quantized(cache)
     for i in range(cfg.num_layers):
         def attn(q, k, v, i=i):
-            # append this step's k/v at (page, offset), then paged
-            # decode attention through the dispatched fifth family
-            cache["k"] = cache["k"].at[
-                i, :, write_page, write_off, :].set(
-                k.astype(cache["k"].dtype))  # [B, H, d] values
-            cache["v"] = cache["v"].at[
-                i, :, write_page, write_off, :].set(
-                v.astype(cache["v"].dtype))
+            # append this step's k/v at (page, offset) — the int8 tier
+            # rewrites the touched pages through the per-page RMW
+            # codec — then paged decode attention through the
+            # dispatched fifth family (quantized pages ride with their
+            # per-(page, head) scale planes)
+            nonlocal cache
+            if quant:
+                cache = kv_tier_mod.decode_scatter_quant(
+                    cache, i, "k", k, write_page, write_off)
+                cache = kv_tier_mod.decode_scatter_quant(
+                    cache, i, "v", v, write_page, write_off)
+            else:
+                cache["k"] = cache["k"].at[
+                    i, :, write_page, write_off, :].set(
+                    k.astype(cache["k"].dtype))  # [B, H, d] values
+                cache["v"] = cache["v"].at[
+                    i, :, write_page, write_off, :].set(
+                    v.astype(cache["v"].dtype))
             ctx = dap.decode_attention(
                 q.astype(dtype), cache["k"][i], cache["v"][i],
                 page_table, lengths, sm_scale=1.0 / math.sqrt(hd),
+                k_scale=cache["k_scale"][i] if quant else None,
+                v_scale=cache["v_scale"][i] if quant else None,
                 impl=decode_impl, block_h=decode_block_h,
                 interpret=interpret)
             return ctx.reshape(B, n_heads * hd).astype(dtype)

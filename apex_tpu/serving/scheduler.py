@@ -114,6 +114,13 @@ class Request:
     # token (re-admission is a plain fresh prefill).
     resume_tokens: Optional[List[int]] = None
     preemptions: int = 0
+    # host swap tier (ISSUE 20): the banked device pages of a
+    # preempted stream (an engine-owned ``kv_tier.SwappedPages``
+    # handle). Typed loosely for the same stdlib-only reason as
+    # ``sampling`` — this module never imports the jax-backed
+    # kv_tier; the ENGINE banks at preemption (via the ``swap_out``
+    # ctor callback) and restores or discards at re-admission.
+    swapped: Optional[Any] = None
     shed_tick: Optional[int] = None   # deadline shedder drop point
     # filled in by the engine/scheduler:
     out_tokens: List[int] = dataclasses.field(default_factory=list)
@@ -155,7 +162,8 @@ class Slot:
 
 class ContinuousBatchingScheduler:
     def __init__(self, num_slots, max_pages_per_slot, page_size,
-                 allocator, policy=None, prefix=None, preempt=False):
+                 allocator, policy=None, prefix=None, preempt=False,
+                 swap_out=None):
         self.num_slots = int(num_slots)
         self.max_pages = int(max_pages_per_slot)
         self.page_size = int(page_size)
@@ -169,6 +177,12 @@ class ContinuousBatchingScheduler:
         # refused. Off = the all-or-nothing up-front reservation the
         # scheduler always had (disabled mode behavior-identical).
         self.preempt = bool(preempt)
+        # host swap tier (ISSUE 20): ``swap_out(slot) -> handle or
+        # None`` banks a victim's live pages device→host BEFORE they
+        # are freed. Engine-owned callable (this module stays
+        # stdlib-only); None = the tier is off and preemption is
+        # vLLM-style recompute, exactly as before.
+        self.swap_out = swap_out
         self.slots = [None] * self.num_slots
         self.queue = deque()
         self.completed = []
@@ -375,7 +389,7 @@ class ContinuousBatchingScheduler:
                 best, best_key = i, key
         return best
 
-    def requeue_slot(self, i, tick):
+    def requeue_slot(self, i, tick, swap=True):
         """Force running slot *i* back into the queue (preemption
         under page pressure, or round recovery after a wedged
         dispatch): free its private pages, decref its shared prefix
@@ -384,9 +398,18 @@ class ContinuousBatchingScheduler:
         and REQUEUE the request (it keeps its original
         ``queued_tick``, so priority aging preserves its seniority —
         a preempted request cannot be starved). Returns the
-        request."""
+        request.
+
+        ``swap=True`` offers the slot to the engine's ``swap_out``
+        callback BEFORE its pages are freed (the host swap tier,
+        ISSUE 20) — the handle rides on ``req.swapped`` next to
+        ``resume_tokens``. The engine passes ``swap=False`` from its
+        round-recovery and failover-drain paths, where the device
+        cache is exactly what cannot be trusted."""
         slot = self.slots[i]
         req = slot.request
+        req.swapped = (self.swap_out(slot)
+                       if swap and self.swap_out is not None else None)
         self.allocator.free(("req", req.rid))
         if slot.shared_pages and self.prefix is not None:
             self.prefix.release(slot.shared_pages)

@@ -122,3 +122,37 @@ def cache_shardings(cache, mesh):
     on its leading head axis, ``P(None, TENSOR_AXIS)``."""
     s = NamedSharding(mesh, P(None, TENSOR_AXIS))
     return jax.tree.map(lambda _: s, cache)
+
+
+def qparams_shardings(qparams, mesh):
+    """NamedSharding tree for the int8 decode records
+    (:func:`model.quantize_decode_params`) — the tp x weight_quant
+    composition (ISSUE 20 satellite). Each record's ``wq`` is
+    ``[out, in]`` and its ``scale`` is per-OUT-channel, so the specs
+    follow the float split table exactly:
+
+    * column-parallel records (``qkv``, ``h4``): ``wq``
+      ``P(TENSOR_AXIS, None)`` — the out dim is the sharded fused
+      output (whole heads per shard for qkv, 4h/tp rows for h4;
+      both divide because ``n_heads % tp == 0`` forces ``h % tp ==
+      0``) — and ``scale`` ``P(TENSOR_AXIS)`` rides the same dim.
+    * row-parallel records (``dense``, ``4h``): ``wq``
+      ``P(None, TENSOR_AXIS)`` on the in dim; ``scale`` replicated
+      ``P()`` (it lands on the UNSHARDED output columns after the
+      GSPMD psum, exactly like the row-parallel float bias).
+    * ``word_logits``: replicated — the float word table is
+      replicated and the logits matmul vocab-unsharded (module
+      docstring), so its int8 copy keeps that layout.
+    """
+    col_wq = NamedSharding(mesh, P(TENSOR_AXIS, None))
+    col_sc = NamedSharding(mesh, P(TENSOR_AXIS))
+    row_wq = NamedSharding(mesh, P(None, TENSOR_AXIS))
+    rep = NamedSharding(mesh, P())
+    spec = {"layers": [
+        {"qkv": {"wq": col_wq, "scale": col_sc},
+         "h4": {"wq": col_wq, "scale": col_sc},
+         "dense": {"wq": row_wq, "scale": rep},
+         "4h": {"wq": row_wq, "scale": rep}}
+        for _ in qparams["layers"]],
+        "word_logits": {"wq": rep, "scale": rep}}
+    return spec

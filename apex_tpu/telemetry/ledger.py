@@ -461,6 +461,23 @@ def validate_record(rec):
                                    or isinstance(dl, bool) or dl < 0):
                 problems.append(
                     "serving.draft_len is not a non-negative number")
+            # KV-tier fields (ISSUE 20): None-when-disabled like the
+            # generation rates — a malformed swap_rate could claim a
+            # restore economy no preemption churn produced
+            kq = sv.get("kv_quant")
+            if kq is not None and not isinstance(kq, bool):
+                problems.append("serving.kv_quant is not a bool")
+            sr = sv.get("swap_rate")
+            if sr is not None and (not isinstance(sr, (int, float))
+                                   or isinstance(sr, bool)
+                                   or not 0.0 <= sr <= 1.0):
+                problems.append("serving.swap_rate is not in [0, 1]")
+            hw = sv.get("swapped_pages_high_water")
+            if hw is not None and (not isinstance(hw, int)
+                                   or isinstance(hw, bool) or hw < 0):
+                problems.append(
+                    "serving.swapped_pages_high_water is not a "
+                    "non-negative int")
     slo = rec.get("slo")
     if slo is not None:
         # the SLO block (apex_tpu.serving.lifecycle.slo_block, ISSUE

@@ -30,8 +30,9 @@ Two evidence classes in one Tracer run (ISSUE 10):
 The ledger record carries the validated ``serving`` block
 ``{tokens_per_s, p50_ms, p99_ms, trace_id, kv_pages}`` and the
 ``slo`` block (``ledger.validate_record``) and PINS every shaping
-knob — ``APEX_SERVE_WEIGHT_QUANT``, ``APEX_DECODE_ATTN_IMPL``
-(check 8), ``APEX_SERVE_SLO_TTFT_MS``, ``APEX_SERVE_SLO_TPOT_MS``,
+knob — ``APEX_SERVE_WEIGHT_QUANT``, ``APEX_DECODE_ATTN_IMPL``,
+``APEX_SERVE_KV_QUANT``, ``APEX_SERVE_KV_SWAP`` (check 8),
+``APEX_SERVE_SLO_TTFT_MS``, ``APEX_SERVE_SLO_TPOT_MS``,
 ``APEX_SERVE_ARRIVALS``, ``APEX_SERVE_SCHED`` (check 9) — at their
 RESOLVED values before the write, so every serving row is citable
 under ``tools/check_bench_labels.py`` by construction.
@@ -179,16 +180,28 @@ os.environ["APEX_SERVE_RECOVER"] = "1" if RECOVER else "0"
 # NamedShardings re-partition the SAME two serving programs over a
 # (tp,) mesh, so the resolved width is pinned back (the engine
 # re-resolves from this pin) and claimed in the `parallel` block for
-# both-direction agreement. Resolution mirrors the engine's pairing:
-# weight_quant engaged -> the tp preference falls back to 1 (the int8
-# decode records are single-chip tables; the serving_tp rung sets
-# APEX_SERVE_TP with quant off).
+# both-direction agreement. tp x weight_quant COMPOSES (ISSUE 20
+# satellite): the int8 decode records shard along the same Megatron
+# split (tp.qparams_shardings), so neither knob drops the other.
 from apex_tpu.serving import tp as tp_mod  # noqa: E402
 
 SERVE_TP = tp_mod.resolve_serve_tp(n_heads=cfg.num_attention_heads)
-if WQ and SERVE_TP > 1:
-    SERVE_TP = 1
 os.environ["APEX_SERVE_TP"] = str(SERVE_TP)
+# ...and the KV-tier knobs (ISSUE 20, check 8 teeth): int8 KV cache
+# and the host swap tier — resolved once, pinned back BEFORE the
+# engines build (they re-resolve from these pins), so the record's
+# knobs name exactly the cache codec and preemption-restore path the
+# replay ran. Resolution mirrors the engine's pairing: the swap
+# preference falls back off without KV-pressure preemption (nothing
+# ever preempts, so there is nothing to bank).
+from apex_tpu.serving import kv_tier as kv_tier_mod  # noqa: E402
+
+KV_QUANT = kv_tier_mod.resolve_kv_quant()
+os.environ["APEX_SERVE_KV_QUANT"] = "1" if KV_QUANT else "0"
+KV_SWAP = kv_tier_mod.resolve_kv_swap()
+if KV_SWAP and not PREEMPT:
+    KV_SWAP = False
+os.environ["APEX_SERVE_KV_SWAP"] = "1" if KV_SWAP else "0"
 # ...and the multi-token decode block size (ISSUE 17, check 8): K
 # decode steps per dispatch amortize the ~65 ms relay floor — a
 # DIFFERENT compiled decode program, so the resolved K is pinned and
@@ -219,6 +232,8 @@ TRACER = Tracer(K, peak_flops=PEAK)
 flight.beat("backend_init")  # Tracer measured overhead => backend is up
 print(f"serving: {n_params / 1e6:.1f}M params, {SLOTS} slots, "
       f"{PAGES} pages x {PS}, quant={'int8' if WQ else 'off'}, "
+      f"kv={'int8' if KV_QUANT else 'off'}"
+      f"{'+swap' if KV_SWAP else ''}, "
       f"decode-attn={IMPL}, sampling={'on' if SAMPLING else 'off'}, "
       f"spec={SPEC_K or 'off'}, "
       f"prefix={'on' if PREFIX else 'off'}   (method: {K}-step decode "
@@ -358,6 +373,11 @@ if not compile_cache.warm_only():
         "draft_len": _r4(gen["draft_len"]),
         "prefix_hit_rate": _r4(gen["prefix_hit_rate"]),
     }
+    # KV-tier economics (ISSUE 20): None-when-disabled like the
+    # generation rates above — check 8 refuses a non-None value
+    # whose selecting knob is unpinned or off
+    serving_block.update({k: (_r4(v) if k == "swap_rate" else v)
+                          for k, v in replay.kv_tier_rates().items()})
     print(f"{'trace replay':28s} {len(done)} req, "
           f"{replay.tokens_generated} tok in {wall:.2f}s -> "
           f"{replay_tps:.0f} tok/s, p50 {p50:.1f} ms, p99 {p99:.1f} ms "
@@ -447,6 +467,7 @@ rid = TRACER.flush_ledger("profile_serving", extra={
                "slo_ttft_ms": SLO_TTFT_MS,
                "slo_tpot_ms": SLO_TPOT_MS,
                "admit": ADMIT, "shed": SHED, "preempt": PREEMPT,
-               "recover": RECOVER, "decode_k": DECODE_K}})
+               "recover": RECOVER, "decode_k": DECODE_K,
+               "kv_quant": KV_QUANT, "kv_swap": KV_SWAP}})
 if rid:
     print(f"ledger: {rid}")

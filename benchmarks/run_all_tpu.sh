@@ -278,6 +278,18 @@ run serving_multitok 1800 env APEX_SERVE_DECODE_K=4 python benchmarks/profile_se
 # record honestly pins tp=1 — the tp>1 leg needs the pod-slice
 # window, which is why the default stays tp=1 (measured-dispatch).
 run serving_tp       1800 env APEX_SERVE_TP=2 python benchmarks/profile_serving.py
+# KV-tier A/Bs (ISSUE 20, PERF.md §2). int8 KV: same trace with the
+# paged cache stored as int8 codes + per-(page, head) bf16 scales —
+# dequantize-at-read VPU work vs halved page HBM traffic, parity
+# already CPU-pinned (check 8 pins kv_quant both directions). Swap:
+# preemption-inducing replay with the host swap tier armed — the
+# device-side kv_restore crossover at serving shapes (the CPU table
+# in PERF.md §2 is the harness proof) plus the swap-out copy tax,
+# swap_rate/swap_copy_s in the record. profile_serving drops the
+# swap pin itself when preemption is off — the label never claims a
+# tier that cannot engage.
+run serving_kv_quant 1800 env APEX_SERVE_KV_QUANT=1 python benchmarks/profile_serving.py
+run serving_kv_swap  1800 env APEX_SERVE_PREEMPT=1 APEX_SERVE_KV_SWAP=1 python benchmarks/profile_serving.py
 # Fleet router A/B (ISSUE 19, PERF.md §2): N=3 real engine replicas
 # behind one admission point, replaying the shared-system-prompt
 # trace — routing-policy hit-rate/goodput sweep + the static-N vs

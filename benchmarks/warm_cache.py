@@ -307,7 +307,20 @@ def main():
                            # rides the list so its cashed/owed account
                            # matches the shell; on a pod slice the
                            # same pin warms the GSPMD-partitioned pair
-                           ("serving_tp", {"APEX_SERVE_TP": "2"})):
+                           ("serving_tp", {"APEX_SERVE_TP": "2"}),
+                           # kv-tier rungs (ISSUE 20): int8 KV is a
+                           # DIFFERENT compiled program pair (int8
+                           # pages + scale operands thread the whole
+                           # prefill/decode graph) — warmed with the
+                           # rung's exact pin; the swap rung's
+                           # gather/scatter jits are host-staging
+                           # programs compiled at engine build, so
+                           # warming the preempt+swap env covers them
+                           ("serving_kv_quant",
+                            {"APEX_SERVE_KV_QUANT": "1"}),
+                           ("serving_kv_swap",
+                            {"APEX_SERVE_PREEMPT": "1",
+                             "APEX_SERVE_KV_SWAP": "1"})):
             if row in cashed:
                 print(f"warm {row}: skipped (row cashed in the round "
                       f"manifest)", flush=True)

@@ -342,6 +342,10 @@ def test_check8_unpinned_serving_row_fails(tmp_path):
     # multi-token decode blocks (ISSUE 17): the block size is a third
     # compiled-program axis the citation must pin
     assert "APEX_SERVE_DECODE_K" in out.stdout
+    # KV tier (ISSUE 20): int8 cache and swap restore are different
+    # cache tiers the citation must pin too
+    assert "APEX_SERVE_KV_QUANT" in out.stdout
+    assert "APEX_SERVE_KV_SWAP" in out.stdout
 
 
 def test_check8_pinned_serving_row_clean(tmp_path):
@@ -350,7 +354,9 @@ def test_check8_pinned_serving_row_clean(tmp_path):
     out = run_check_bench_labels(*_check8_env(
         tmp_path, {"APEX_SERVE_WEIGHT_QUANT": "0",
                    "APEX_DECODE_ATTN_IMPL": "jnp",
-                   "APEX_SERVE_DECODE_K": "1"}))
+                   "APEX_SERVE_DECODE_K": "1",
+                   "APEX_SERVE_KV_QUANT": "0",
+                   "APEX_SERVE_KV_SWAP": "0"}))
     assert out.returncode == 0, out.stdout
 
 

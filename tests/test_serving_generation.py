@@ -675,7 +675,11 @@ BASE_PINS = {"APEX_SERVE_WEIGHT_QUANT": "0",
              "APEX_DECODE_ATTN_IMPL": "jnp",
              # ISSUE 17: serving rows must also pin the decode block
              # size (check 8 — an unpinned K cannot be audited)
-             "APEX_SERVE_DECODE_K": "1"}
+             "APEX_SERVE_DECODE_K": "1",
+             # ISSUE 20: and the KV-tier knobs (int8 cache + swap
+             # restore are different cache tiers)
+             "APEX_SERVE_KV_QUANT": "0",
+             "APEX_SERVE_KV_SWAP": "0"}
 
 
 def _check8(tmp_path, knobs, block):

@@ -394,7 +394,9 @@ def _record(decode_block_k, **knobs):
     from tests.test_serving_slo import SLO_PINS, _good_slo
 
     pins = {"APEX_SERVE_WEIGHT_QUANT": "0",
-            "APEX_DECODE_ATTN_IMPL": "jnp", **SLO_PINS, **knobs}
+            "APEX_DECODE_ATTN_IMPL": "jnp",
+            "APEX_SERVE_KV_QUANT": "0", "APEX_SERVE_KV_SWAP": "0",
+            **SLO_PINS, **knobs}
     slo = dict(_good_slo(), decode_block_k=decode_block_k)
     serving = {"tokens_per_s": 10.0, "p50_ms": 1.0, "p99_ms": 2.0,
                "trace_id": "tr-0123456789", "kv_pages": 8}

@@ -202,28 +202,42 @@ def test_serve_tp_env_preference(monkeypatch):
     assert tp_mod.resolve_serve_tp(2, n_heads=4) == 2
 
 
-def test_tp_weight_quant_pairing(setup, monkeypatch):
-    """The established asymmetry (the int8 decode records are
-    single-chip tables): two per-call demands raise, a demand drops
-    the other side's env preference, env-vs-env falls back to tp=1."""
+@pytest.mark.parametrize("tp", [1, 2])
+def test_tp_weight_quant_composes(setup, monkeypatch, tp):
+    """tp x weight_quant composition (ISSUE 20 satellite — formerly a
+    two-demand raise): the int8 decode records shard along the same
+    Megatron split as their float weights (tp.qparams_shardings), and
+    the sharded-record engine is token-for-token the tp=1 quantized
+    engine. Column records carry their per-out-channel scales on the
+    split dim; row records replicate theirs (they land after the
+    psum)."""
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+
     cfg, params = setup
     monkeypatch.delenv("APEX_SERVE_TP", raising=False)
     monkeypatch.delenv("APEX_SERVE_WEIGHT_QUANT", raising=False)
-    with pytest.raises(ValueError, match="cannot be honored"):
-        _engine(cfg, params, tp=2, weight_quant=True)
-    # tp demand drops the weight-quant env preference
-    monkeypatch.setenv("APEX_SERVE_WEIGHT_QUANT", "1")
-    eng = _engine(cfg, params, tp=2)
-    assert eng.tp == 2 and not eng.weight_quant and eng.qparams is None
-    # weight-quant demand: the tp env preference falls back
-    monkeypatch.delenv("APEX_SERVE_WEIGHT_QUANT", raising=False)
+    ref = _drive(_engine(cfg, params, weight_quant=True), _requests())
+    eng = _engine(cfg, params, tp=tp, weight_quant=True)
+    assert eng.tp == tp and eng.weight_quant \
+        and eng.qparams is not None
+    got = _drive(eng, _requests())
+    assert got == ref, (tp, got, ref)
+    _assert_contract(eng)
+    if tp > 1:
+        rec = eng.qparams["layers"][0]
+        assert rec["qkv"]["wq"].sharding.spec == P(TENSOR_AXIS, None)
+        assert rec["qkv"]["scale"].sharding.spec == P(TENSOR_AXIS)
+        assert rec["dense"]["wq"].sharding.spec == P(None, TENSOR_AXIS)
+        assert rec["dense"]["scale"].sharding.spec == P()
+        assert eng.qparams["word_logits"]["wq"].sharding.spec == P()
+    # both env preferences honored together now — nothing falls back
     monkeypatch.setenv("APEX_SERVE_TP", "2")
-    eng = _engine(cfg, params, weight_quant=True)
-    assert eng.tp == 1 and eng.weight_quant
-    # env-vs-env: tp (the newer layer) yields
     monkeypatch.setenv("APEX_SERVE_WEIGHT_QUANT", "1")
     eng = _engine(cfg, params)
-    assert eng.tp == 1 and eng.weight_quant
+    assert eng.tp == 2 and eng.weight_quant \
+        and eng.qparams is not None
 
 
 def test_tp_default_off(setup, monkeypatch):

@@ -301,19 +301,40 @@ def serving_problems(rec, rid):
     different compiled program — an unpinned K cannot be audited), and
     when the record's slo block carries ``decode_block_k`` the pin and
     the field must agree BOTH directions (a pin naming a K the engine
-    did not run, or an engine K the label does not name, both fail)."""
+    did not run, or an engine K the label does not name, both fail).
+    KV-tier teeth (ISSUE 20): the two cache knobs
+    ``APEX_SERVE_KV_QUANT`` / ``APEX_SERVE_KV_SWAP`` must be pinned
+    (the codec and the restore path are different programs), a
+    non-None ``kv_quant``/``swap_rate`` field demands its knob pinned
+    ON, and a knob pinned ON demands its field non-None — both
+    directions, so neither the label nor the block can claim a tier
+    the other did not run."""
     sv = rec.get("serving")
     if not isinstance(sv, dict):
         return []
     knobs = rec.get("knobs") if isinstance(rec.get("knobs"), dict) else {}
     problems = []
     for knob in ("APEX_SERVE_WEIGHT_QUANT", "APEX_DECODE_ATTN_IMPL",
-                 "APEX_SERVE_DECODE_K"):
+                 "APEX_SERVE_DECODE_K", "APEX_SERVE_KV_QUANT",
+                 "APEX_SERVE_KV_SWAP"):
         if knob not in knobs:
             problems.append(
                 f"record {rid} carries a serving block but does not pin "
                 f"{knob} in its knobs — an unpinned serving row cannot "
                 f"be cited")
+    for field, knob in (("kv_quant", "APEX_SERVE_KV_QUANT"),
+                        ("swap_rate", "APEX_SERVE_KV_SWAP")):
+        pin = knobs.get(knob)
+        if sv.get(field) is not None and str(pin) == "0":
+            problems.append(
+                f"record {rid} carries serving.{field}={sv[field]!r} "
+                f"but pins {knob}={pin!r} (off) — the block and the "
+                f"label name different cache tiers")
+        if str(pin) == "1" and field in sv and sv.get(field) is None:
+            problems.append(
+                f"record {rid} pins {knob}=1 but its "
+                f"serving.{field} is null — a tier the label claims "
+                f"left no account in the block")
     for field, knob, off in (
             ("spec_acceptance_rate", "APEX_SPEC_DECODE", "0"),
             ("prefix_hit_rate", "APEX_SERVE_PREFIX_CACHE", "0")):

@@ -220,6 +220,11 @@ def ledger_summary(records):
                 "spec_acceptance_rate": sv.get("spec_acceptance_rate"),
                 "draft_len": sv.get("draft_len"),
                 "prefix_hit_rate": sv.get("prefix_hit_rate"),
+                # KV-tier economics (ISSUE 20): None-when-disabled
+                "kv_quant": sv.get("kv_quant"),
+                "swap_rate": sv.get("swap_rate"),
+                "swapped_pages_high_water":
+                    sv.get("swapped_pages_high_water"),
                 "slo": slo,
             })
         # fleet economics (ISSUE 19): the router block — utilization
@@ -530,6 +535,19 @@ def print_report(report, out=None):
                         f"prefix hit={s['prefix_hit_rate']:.0%}")
                 if gen:
                     p(f"      generation: {', '.join(gen)}")
+                # KV-tier economics (ISSUE 20): codec + swap/restore
+                # levers, printed only when measured
+                kv = []
+                if s.get("kv_quant") is not None:
+                    kv.append("kv=int8")
+                if s.get("swap_rate") is not None:
+                    kv.append(f"swap rate={s['swap_rate']:.0%}"
+                              + (f" (pages hw "
+                                 f"{s['swapped_pages_high_water']})"
+                                 if s.get("swapped_pages_high_water")
+                                 is not None else ""))
+                if kv:
+                    p(f"      kv tier: {', '.join(kv)}")
                 slo = s.get("slo")
                 if slo:
                     att = slo.get("slo_attainment")
